@@ -1,0 +1,190 @@
+"""Work API: ResourceBinding (the scheduling unit) and Work (the per-cluster
+manifest envelope).
+
+Ref: pkg/apis/work/v1alpha2/binding_types.go — ResourceBinding (:58),
+ReplicaRequirements (:193), TargetCluster (:229), GracefulEvictionTask (:238),
+BindingSnapshot/RequiredBy (:309), status (:326-353);
+pkg/apis/work/v1alpha1/work_types.go — Work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .core import Condition, ObjectMeta, ObjectReference, Resource
+from .policy import Placement
+
+# Binding condition types (binding_types.go:355-371)
+SCHEDULED = "Scheduled"
+FULLY_APPLIED = "FullyApplied"
+
+# Work condition types (work_types.go)
+WORK_APPLIED = "Applied"
+WORK_AVAILABLE = "Available"
+WORK_DEGRADED = "Degraded"
+
+# Eviction producers/reasons (binding_types.go well-knowns)
+EVICTION_PRODUCER_TAINT_MANAGER = "TaintManager"
+EVICTION_REASON_TAINT_UNTOLERATED = "TaintUntolerated"
+EVICTION_REASON_APPLICATION_FAILURE = "ApplicationFailure"
+# PurgeMode
+PURGE_IMMEDIATELY = "Immediately"
+PURGE_GRACIOUSLY = "Graciously"
+PURGE_NEVER = "Never"
+
+
+@dataclass
+class NodeClaim:
+    """Node-level scheduling claim carried with replica requirements.
+    Ref: binding_types.go NodeClaim (nodeSelector/tolerations/hard node
+    affinity)."""
+
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[Any] = field(default_factory=list)
+    hard_node_affinity: Optional[dict] = None
+
+
+@dataclass
+class ReplicaRequirements:
+    """Per-replica requirements (canonical int units).
+    Ref: binding_types.go:193-213."""
+
+    resource_request: dict[str, int] = field(default_factory=dict)
+    node_claim: Optional[NodeClaim] = None
+    namespace: str = ""
+    priority_class_name: str = ""
+
+
+@dataclass
+class TargetCluster:
+    """One schedule-result entry. Ref: binding_types.go:229-236."""
+
+    name: str
+    replicas: int = 0
+
+
+@dataclass
+class GracefulEvictionTask:
+    """Ref: binding_types.go:238-307."""
+
+    from_cluster: str
+    replicas: int = 0
+    reason: str = ""
+    message: str = ""
+    producer: str = ""
+    purge_mode: str = PURGE_GRACIOUSLY
+    grace_period_seconds: Optional[int] = None
+    suppress_deletion: Optional[bool] = None
+    creation_timestamp: float = 0.0
+    # state carried over for stateful failover (PreservedLabelState)
+    preserved_label_state: dict[str, str] = field(default_factory=dict)
+    clusters_before_failover: list[str] = field(default_factory=list)
+
+
+@dataclass
+class BindingSnapshot:
+    """Dependent-binding shadow of another binding's schedule result.
+    Ref: binding_types.go:309-324 (RequiredBy)."""
+
+    namespace: str = ""
+    name: str = ""
+    clusters: list[TargetCluster] = field(default_factory=list)
+
+
+@dataclass
+class AggregatedStatusItem:
+    """Per-cluster aggregated status. Ref: binding_types.go:326-353."""
+
+    cluster_name: str
+    status: Optional[dict] = None
+    applied: bool = False
+    health: str = "Unknown"  # Healthy | Unhealthy | Unknown
+    applied_message: str = ""
+
+
+@dataclass
+class ResourceBindingSpec:
+    """Ref: binding_types.go:58-148."""
+
+    resource: ObjectReference = field(default_factory=ObjectReference)
+    replicas: int = 0
+    replica_requirements: Optional[ReplicaRequirements] = None
+    placement: Optional[Placement] = None
+    clusters: list[TargetCluster] = field(default_factory=list)
+    graceful_eviction_tasks: list[GracefulEvictionTask] = field(default_factory=list)
+    required_by: list[BindingSnapshot] = field(default_factory=list)
+    reschedule_triggered_at: Optional[float] = None
+    conflict_resolution: str = "Abort"
+    failover: Optional[Any] = None  # FailoverBehavior snapshot from policy
+    propagate_deps: bool = False
+    suspend_dispatching: bool = False
+    preserve_resources_on_deletion: bool = False
+    scheduler_name: str = "default-scheduler"
+
+
+@dataclass
+class ResourceBindingStatus:
+    """Ref: binding_types.go:326-353."""
+
+    scheduler_observed_generation: int = 0
+    scheduler_observed_affinity_name: str = ""
+    last_scheduled_time: Optional[float] = None
+    conditions: list[Condition] = field(default_factory=list)
+    aggregated_status: list[AggregatedStatusItem] = field(default_factory=list)
+
+
+@dataclass
+class ResourceBinding:
+    KIND = "ResourceBinding"
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceBindingSpec = field(default_factory=ResourceBindingSpec)
+    status: ResourceBindingStatus = field(default_factory=ResourceBindingStatus)
+
+    @property
+    def cluster_scoped(self) -> bool:
+        return False
+
+
+@dataclass
+class ClusterResourceBinding(ResourceBinding):
+    KIND = "ClusterResourceBinding"
+
+    @property
+    def cluster_scoped(self) -> bool:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Work (ref: pkg/apis/work/v1alpha1/work_types.go)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ManifestStatus:
+    identifier: ObjectReference = field(default_factory=ObjectReference)
+    status: Optional[dict] = None
+    health: str = "Unknown"
+
+
+@dataclass
+class WorkSpec:
+    workload: list[Resource] = field(default_factory=list)
+    suspend_dispatching: bool = False
+    preserve_resources_on_deletion: bool = False
+
+
+@dataclass
+class WorkStatus:
+    conditions: list[Condition] = field(default_factory=list)
+    manifest_statuses: list[ManifestStatus] = field(default_factory=list)
+
+
+@dataclass
+class Work:
+    KIND = "Work"
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: WorkSpec = field(default_factory=WorkSpec)
+    status: WorkStatus = field(default_factory=WorkStatus)

@@ -1,0 +1,15 @@
+"""Pure-Python oracle of reference scheduling semantics (test baseline)."""
+
+from .divider import (  # noqa: F401
+    AGGREGATED,
+    DUPLICATED,
+    DYNAMIC_WEIGHT,
+    MAX_INT32,
+    STATIC_WEIGHT,
+    STRATEGY_NAMES,
+    DivisionProblem,
+    UnschedulableError,
+    assign_replicas,
+    merge_estimates,
+    take_by_weight,
+)

@@ -1,0 +1,13 @@
+"""Shared runtime utilities (ref: pkg/util)."""
+
+from .quantity import (  # noqa: F401
+    CPU,
+    MEMORY,
+    PODS,
+    add_resource_lists,
+    parse_quantity,
+    parse_resource_list,
+    sub_resource_lists,
+)
+from .store import ADDED, DELETED, MODIFIED, Event, Store, obj_key, obj_kind  # noqa: F401
+from .worker import DONE, REQUEUE, Runtime, Worker  # noqa: F401

@@ -1,0 +1,153 @@
+"""In-memory API store with watch bus — the control-plane state hub.
+
+Plays the role the kube-apiserver + informers play in the reference: typed
+buckets keyed by (kind, namespace/name), resource-version bumping, watch
+handlers, finalizer-aware deletion. Controllers subscribe and reconcile; the
+whole control plane can be driven deterministically with
+``Runtime.run_until_settled`` (karmada_tpu.utils.worker).
+
+Ref analogues: client-go informers / fedinformer managers (pkg/util/fedinformer)
+and the apiserver REST semantics the reference assumes.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional
+
+from ..api.core import ObjectMeta, new_uid
+
+ADDED = "Added"
+MODIFIED = "Modified"
+DELETED = "Deleted"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str  # Added | Modified | Deleted
+    kind: str
+    key: str  # namespace/name or name
+    obj: Any
+
+
+WatchHandler = Callable[[Event], None]
+
+
+def obj_key(obj: Any) -> str:
+    meta: ObjectMeta = obj.meta
+    return meta.namespaced_name
+
+
+def obj_kind(obj: Any) -> str:
+    return type(obj).KIND if hasattr(type(obj), "KIND") else type(obj).__name__
+
+
+class Store:
+    """Typed object store. Thread-safe; watch handlers run synchronously on
+    the mutating thread (like a delivering informer)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._buckets: dict[str, dict[str, Any]] = {}
+        self._watchers: dict[str, list[WatchHandler]] = {}
+        self._all_watchers: list[WatchHandler] = []
+        self._rv = 0
+
+    # -- mutation ----------------------------------------------------------
+
+    def apply(self, obj: Any) -> Any:
+        """Create-or-update. Bumps resource_version; bumps generation when a
+        spec is present and changed is not detectable (callers that mutate
+        spec in place should bump generation themselves via ``bump_generation``)."""
+        kind = obj_kind(obj)
+        key = obj_key(obj)
+        with self._lock:
+            bucket = self._buckets.setdefault(kind, {})
+            existing = bucket.get(key)
+            self._rv += 1
+            obj.meta.resource_version = self._rv
+            if not obj.meta.uid:
+                obj.meta.uid = existing.meta.uid if existing else new_uid()
+            if existing is None and not obj.meta.creation_timestamp:
+                import time
+
+                obj.meta.creation_timestamp = time.time()
+            bucket[key] = obj
+            event = Event(MODIFIED if existing is not None else ADDED, kind, key, obj)
+        self._deliver(event)
+        return obj
+
+    def bump_generation(self, obj: Any) -> None:
+        obj.meta.generation += 1
+
+    def delete(self, kind: str, key: str, *, force: bool = False) -> Optional[Any]:
+        """Delete an object. With finalizers present (and not force), only
+        marks deletion_timestamp and emits MODIFIED — controllers must strip
+        finalizers, after which the delete completes (kube semantics)."""
+        import time
+
+        with self._lock:
+            bucket = self._buckets.get(kind, {})
+            obj = bucket.get(key)
+            if obj is None:
+                return None
+            if obj.meta.finalizers and not force:
+                if obj.meta.deletion_timestamp is None:
+                    obj.meta.deletion_timestamp = time.time()
+                    self._rv += 1
+                    obj.meta.resource_version = self._rv
+                    event = Event(MODIFIED, kind, key, obj)
+                else:
+                    return obj
+            else:
+                del bucket[key]
+                event = Event(DELETED, kind, key, obj)
+        self._deliver(event)
+        return obj
+
+    def finalize(self, obj: Any) -> None:
+        """Re-evaluate a deleting object: if finalizers are now empty, remove
+        it for real."""
+        if obj.meta.deletion_timestamp is not None and not obj.meta.finalizers:
+            self.delete(obj_kind(obj), obj_key(obj), force=True)
+        else:
+            self.apply(obj)
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, kind: str, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._buckets.get(kind, {}).get(key)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> list[Any]:
+        with self._lock:
+            objs = list(self._buckets.get(kind, {}).values())
+        if namespace is not None:
+            objs = [o for o in objs if o.meta.namespace == namespace]
+        return objs
+
+    def kinds(self) -> Iterable[str]:
+        with self._lock:
+            return list(self._buckets.keys())
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kind: str, handler: WatchHandler, *, replay: bool = True) -> None:
+        """Subscribe to events for one kind. With replay, synthesizes ADDED
+        events for existing objects (informer initial-list semantics)."""
+        with self._lock:
+            self._watchers.setdefault(kind, []).append(handler)
+            existing = list(self._buckets.get(kind, {}).items()) if replay else []
+        for key, obj in existing:
+            handler(Event(ADDED, kind, key, obj))
+
+    def watch_all(self, handler: WatchHandler) -> None:
+        with self._lock:
+            self._all_watchers.append(handler)
+
+    def _deliver(self, event: Event) -> None:
+        for handler in list(self._watchers.get(event.kind, [])):
+            handler(event)
+        for handler in list(self._all_watchers):
+            handler(event)

@@ -1,0 +1,108 @@
+"""Reconcile runtime: rate-limited work queues + a deterministic driver.
+
+Ref: pkg/util/worker.go:33-140 (util.AsyncWorker — workqueue + reconcile
+loop). The TPU build keeps the same enqueue/reconcile contract but adds a
+deterministic cooperative mode (``Runtime.run_until_settled``) so the whole
+control plane can be exercised in-process without sleeping threads — the
+pattern SURVEY.md section 4.3 calls "distributed-without-a-cluster".
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+from typing import Callable, Hashable, Optional
+
+log = logging.getLogger("karmada_tpu")
+
+# Reconcile results
+DONE = "done"
+REQUEUE = "requeue"
+
+
+class Worker:
+    """A named reconcile queue. ``reconcile(key)`` returns DONE or REQUEUE
+    (or raises — treated as REQUEUE with backoff count)."""
+
+    MAX_RETRIES = 16
+
+    def __init__(self, name: str, reconcile: Callable[[Hashable], Optional[str]]):
+        self.name = name
+        self.reconcile = reconcile
+        self._queue: collections.deque[Hashable] = collections.deque()
+        self._queued: set[Hashable] = set()
+        self._retries: collections.Counter = collections.Counter()
+
+    def enqueue(self, key: Hashable) -> None:
+        if key not in self._queued:
+            self._queued.add(key)
+            self._queue.append(key)
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    def process_one(self) -> bool:
+        """Pop and reconcile one key. Returns True if work was done."""
+        if not self._queue:
+            return False
+        key = self._queue.popleft()
+        self._queued.discard(key)
+        try:
+            result = self.reconcile(key)
+        except Exception:  # noqa: BLE001 — reconcile errors requeue, like workqueue
+            log.exception("worker %s: reconcile %r failed", self.name, key)
+            result = REQUEUE
+        if result == REQUEUE:
+            self._retries[key] += 1
+            if self._retries[key] <= self.MAX_RETRIES:
+                self.enqueue(key)
+            else:
+                log.error("worker %s: dropping %r after max retries", self.name, key)
+                del self._retries[key]
+        else:
+            self._retries.pop(key, None)
+        return True
+
+
+class Runtime:
+    """Holds all workers of a control plane and drives them cooperatively.
+
+    ``run_until_settled`` round-robins workers until every queue is empty
+    (i.e. the control plane reached a fixed point) or the step budget is hit.
+    """
+
+    def __init__(self) -> None:
+        self.workers: list[Worker] = []
+        self._tickers: list[Callable[[], None]] = []
+
+    def new_worker(self, name: str, reconcile) -> Worker:
+        w = Worker(name, reconcile)
+        self.workers.append(w)
+        return w
+
+    def add_ticker(self, fn: Callable[[], None]) -> None:
+        """Periodic function run once per settle pass (cluster status refresh,
+        descheduler sweep, etc. — the analogue of wait.Until loops)."""
+        self._tickers.append(fn)
+
+    def tick(self) -> None:
+        for fn in self._tickers:
+            fn()
+
+    def pending(self) -> int:
+        return sum(len(w) for w in self.workers)
+
+    def run_until_settled(self, max_steps: int = 100_000) -> int:
+        """Process queued work until quiescent. Returns steps executed."""
+        steps = 0
+        while steps < max_steps:
+            progressed = False
+            for w in self.workers:
+                while w.process_one():
+                    progressed = True
+                    steps += 1
+                    if steps >= max_steps:
+                        return steps
+            if not progressed:
+                break
+        return steps

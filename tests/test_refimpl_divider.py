@@ -1,0 +1,183 @@
+"""Oracle tests: hand-computed tables mirroring the reference's unit-test
+strategy for the divider (ref: pkg/scheduler/core/division_algorithm_test.go,
+assignment_test.go — table-driven exact-assignment checks)."""
+
+import pytest
+
+from karmada_tpu.refimpl import (
+    AGGREGATED,
+    DUPLICATED,
+    DYNAMIC_WEIGHT,
+    STATIC_WEIGHT,
+    DivisionProblem,
+    UnschedulableError,
+    assign_replicas,
+    merge_estimates,
+    take_by_weight,
+)
+
+
+class TestTakeByWeight:
+    def test_exact_division(self):
+        # N=6 over weights 1:2:3 with init 1/2/3 -> 2/4/6
+        out = take_by_weight(6, [(0, 1, 0), (1, 2, 0), (2, 3, 0)], {0: 1, 1: 2, 2: 3})
+        assert out == {0: 2, 1: 4, 2: 6}
+
+    def test_remainder_to_heaviest(self):
+        # N=2 over 1:2:3: floors 0/0/0 after w*2//6 = 0,0,1; remainder goes to
+        # heaviest first (C), merged with init
+        out = take_by_weight(2, [(0, 1, 0), (1, 2, 0), (2, 3, 0)], {0: 1, 1: 2, 2: 3})
+        assert out == {0: 1, 1: 2, 2: 5}
+
+    def test_remainder_tiebreak_last_replicas(self):
+        # equal weights; lastReplicas desc decides who gets the remainder
+        out = take_by_weight(4, [(0, 1, 0), (1, 1, 5), (2, 1, 0)])
+        assert out == {1: 2, 0: 1, 2: 1}
+
+    def test_remainder_tiebreak_index(self):
+        # full tie -> ascending index order gets the remainder
+        out = take_by_weight(4, [(2, 1, 0), (0, 1, 0), (1, 1, 0)])
+        assert out == {0: 2, 1: 1, 2: 1}
+
+    def test_zero_weight_sum_no_op(self):
+        assert take_by_weight(5, [(0, 0, 0)], {0: 3}) == {0: 3}
+
+    def test_done_short_circuit(self):
+        assert take_by_weight(0, [(0, 1, 0)], {0: 3}) == {0: 3}
+
+
+class TestStaticWeight:
+    def _solve(self, replicas, weights, prev=None):
+        p = DivisionProblem(
+            replicas=replicas,
+            strategy=STATIC_WEIGHT,
+            candidates=list(range(len(weights))),
+            static_weights=weights,
+            prev=prev,
+        )
+        return assign_replicas(p)
+
+    def test_replica_12_weight_3_2_1(self):
+        assert self._solve(12, [3, 2, 1]) == {0: 6, 1: 4, 2: 2}
+
+    def test_replica_14_weight_3_2_1(self):
+        # floors: 7, 4, 2 (sum 13), remainder 1 -> heaviest
+        assert self._solve(14, [3, 2, 1]) == {0: 8, 1: 4, 2: 2}
+
+    def test_insufficient_gets_zero(self):
+        # N=2 over weight 1:1:1 -> two clusters get 1, the third 0 (dropped)
+        assert self._solve(2, [1, 1, 1]) == {0: 1, 1: 1}
+
+    def test_unweighted_cluster_ignored(self):
+        assert self._solve(12, [3, 0, 1]) == {0: 9, 2: 3}
+
+    def test_all_zero_weights_default_to_one(self):
+        assert self._solve(3, [0, 0, 0]) == {0: 1, 1: 1, 2: 1}
+
+
+class TestDynamicWeight:
+    def _solve(self, replicas, avail, prev=None, fresh=False, strategy=DYNAMIC_WEIGHT):
+        p = DivisionProblem(
+            replicas=replicas,
+            strategy=strategy,
+            candidates=list(range(len(avail))),
+            available=avail,
+            prev=prev,
+            fresh=fresh,
+        )
+        return assign_replicas(p)
+
+    def test_first_assignment_6_8_10(self):
+        # ref table "replica 12, dynamic weight 6:8:10": 3/4/5
+        assert self._solve(12, [6, 8, 10]) == {0: 3, 1: 4, 2: 5}
+
+    def test_first_assignment_8_8_10(self):
+        # floors: 12*8//26=3, 3, 12*10//26=4 -> remainder 2 -> avail desc
+        # (cluster2 w10 first, then tie 8:8 -> index asc)
+        assert self._solve(12, [8, 8, 10]) == {0: 4, 1: 3, 2: 5}
+
+    def test_scale_up_keeps_previous(self):
+        # ref "replica 12 -> 24, dynamic weighted 10:10:10": delta 12 over
+        # availability with init = previous
+        prev = {0: 4, 1: 4, 2: 4}
+        assert self._solve(24, [10, 10, 10], prev) == {0: 8, 1: 8, 2: 8}
+
+    def test_scale_down_proportional(self):
+        # ref "replica 12 -> 6, dynamic weighted 1:1:1": shrink by prev weights
+        prev = {0: 4, 1: 4, 2: 4}
+        assert self._solve(6, [1, 1, 1], prev) == {0: 2, 1: 2, 2: 2}
+
+    def test_scale_down_ignores_availability(self):
+        prev = {0: 9, 1: 3}
+        assert self._solve(4, [0, 0], prev) == {0: 3, 1: 1}
+
+    def test_unschedulable(self):
+        with pytest.raises(UnschedulableError):
+            self._solve(12, [1, 1, 1])
+
+    def test_steady_noop_when_equal(self):
+        prev = {0: 5, 1: 7}
+        assert self._solve(12, [100, 100], prev) == {0: 5, 1: 7}
+
+    def test_fresh_credits_previous(self):
+        # fresh: avail credited with prev, full recompute, no init
+        prev = {0: 6, 1: 6}
+        out = self._solve(12, [0, 0, 12], prev, fresh=True)
+        # credited: 6, 6, 12 -> weights 6:6:12 over 12 -> 3/3/6
+        assert out == {0: 3, 1: 3, 2: 6}
+
+
+class TestAggregated:
+    def _solve(self, replicas, avail, prev=None, fresh=False):
+        p = DivisionProblem(
+            replicas=replicas,
+            strategy=AGGREGATED,
+            candidates=list(range(len(avail))),
+            available=avail,
+            prev=prev,
+            fresh=fresh,
+        )
+        return assign_replicas(p)
+
+    def test_first_assignment_packs_fewest(self):
+        # ref "replica 12, aggregated 6:8:10": prefix by avail desc =
+        # [c2(10), c1(8)] cum 18 >= 12 -> dispense 12 by 10:8
+        assert self._solve(12, [6, 8, 10]) == {2: 7, 1: 5}
+
+    def test_single_cluster_fits_all(self):
+        # ref "replica 12, aggregated 12:8:10": cluster0 alone suffices
+        assert self._solve(12, [12, 8, 10]) == {0: 12}
+
+    def test_all_needed(self):
+        # ref "replica 12, aggregated 3:3:3" -> unschedulable (9 < 12)
+        with pytest.raises(UnschedulableError):
+            self._solve(12, [3, 3, 3])
+
+    def test_scale_up_sticky(self):
+        # ref "replica 12 -> 24, aggregated 4:6:8": prev on all three; delta 12
+        prev = {0: 2, 1: 4, 2: 6}
+        out = self._solve(24, [4, 6, 8], prev)
+        assert sum(out.values()) == 24
+        # previously-used clusters keep at least their replicas
+        assert all(out[i] >= prev[i] for i in prev)
+
+    def test_scale_up_prefers_prev_prefix(self):
+        # prev only on cluster0; delta fits in prev cluster -> stays there
+        prev = {0: 6}
+        out = self._solve(8, [10, 50], prev)
+        assert out == {0: 8}
+
+
+class TestDuplicated:
+    def test_broadcast(self):
+        p = DivisionProblem(replicas=5, strategy=DUPLICATED, candidates=[0, 3, 7])
+        assert assign_replicas(p) == {0: 5, 3: 5, 7: 5}
+
+
+class TestMergeEstimates:
+    def test_min_merge_with_sentinel(self):
+        out = merge_estimates(10, [[5, -1, 30], [7, -1, 20]], 3)
+        assert out == [5, 10, 20]  # -1 ignored everywhere -> clamp to replicas
+
+    def test_non_workload_skips(self):
+        assert merge_estimates(0, [[5, 5]], 2) == [0, 0]

@@ -1,0 +1,78 @@
+"""Store/watch/worker runtime tests (ref analogue: informer + AsyncWorker)."""
+
+from karmada_tpu.api import Cluster, ObjectMeta
+from karmada_tpu.utils import ADDED, DELETED, MODIFIED, DONE, Runtime, Store
+
+
+def make_cluster(name: str) -> Cluster:
+    return Cluster(meta=ObjectMeta(name=name))
+
+
+class TestStore:
+    def test_apply_get_list(self):
+        s = Store()
+        s.apply(make_cluster("m1"))
+        s.apply(make_cluster("m2"))
+        assert s.get("Cluster", "m1").name == "m1"
+        assert {c.name for c in s.list("Cluster")} == {"m1", "m2"}
+
+    def test_watch_events_and_replay(self):
+        s = Store()
+        s.apply(make_cluster("m1"))
+        events = []
+        s.watch("Cluster", events.append)
+        assert [e.type for e in events] == [ADDED]  # replay
+        s.apply(make_cluster("m1"))
+        s.delete("Cluster", "m2")  # no-op
+        s.delete("Cluster", "m1")
+        assert [e.type for e in events] == [ADDED, MODIFIED, DELETED]
+
+    def test_finalizer_blocks_delete(self):
+        s = Store()
+        c = make_cluster("m1")
+        c.meta.finalizers.append("karmada.io/cluster-controller")
+        s.apply(c)
+        s.delete("Cluster", "m1")
+        assert s.get("Cluster", "m1") is not None
+        assert s.get("Cluster", "m1").meta.deletion_timestamp is not None
+        c.meta.finalizers.clear()
+        s.finalize(c)
+        assert s.get("Cluster", "m1") is None
+
+    def test_resource_version_monotonic(self):
+        s = Store()
+        a = s.apply(make_cluster("a"))
+        b = s.apply(make_cluster("b"))
+        assert b.meta.resource_version > a.meta.resource_version
+
+
+class TestRuntime:
+    def test_run_until_settled(self):
+        rt = Runtime()
+        seen = []
+
+        def reconcile(key):
+            seen.append(key)
+            if key == "a" and seen.count("a") == 1:
+                w.enqueue("b")  # cascading work
+            return DONE
+
+        w = rt.new_worker("test", reconcile)
+        w.enqueue("a")
+        steps = rt.run_until_settled()
+        assert steps == 2 and seen == ["a", "b"]
+
+    def test_requeue_retries(self):
+        rt = Runtime()
+        attempts = []
+
+        def reconcile(key):
+            attempts.append(key)
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+            return DONE
+
+        w = rt.new_worker("flaky", reconcile)
+        w.enqueue("x")
+        rt.run_until_settled()
+        assert len(attempts) == 3
