@@ -1,0 +1,31 @@
+"""Pure jittable tensor kernels for the scheduling hot path.
+
+x64 is enabled process-wide: replica-division arithmetic (weight*replicas
+products, availability cumsums) exceeds int32, and exact integer semantics
+are required for the identical-placement guarantee. Storage arrays stay
+int32; only the overflow-prone intermediates widen (TPU emulates int64 at a
+small cost that is negligible next to the kernel's sorts).
+
+NOTE this is a deliberate process-global choice: karmada_tpu owns its
+control-plane process (scheduler/bench/controllers), and the scoped
+alternatives (jax.experimental.enable_x64 contexts) interact badly with jit
+caching. Guest applications embedding this package alongside float32 jax
+models should run the solver in its own process (the gRPC sidecar deployment
+shape of SURVEY.md section 2.2) rather than in-process.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .dispense import take_by_weight, take_by_weight_batch  # noqa: E402,F401
+from .divide import (  # noqa: E402,F401
+    AGGREGATED,
+    DUPLICATED,
+    DYNAMIC_WEIGHT,
+    STATIC_WEIGHT,
+    DivideResult,
+    divide_replicas,
+)
+from .estimate import general_estimate, merge_estimates  # noqa: E402,F401
+from . import masks  # noqa: E402,F401

@@ -1,0 +1,58 @@
+"""Vectorized largest-remainder dispenser — the innermost division kernel.
+
+Tensorization of Dispenser.TakeByWeight (ref:
+pkg/util/helper/binding.go:112-144) with the deterministic total order
+(weight desc, lastReplicas desc, cluster-index asc; see
+karmada_tpu.refimpl.divider for the tie-break note).
+
+Shapes: one binding owns a length-C vector over the cluster axis; the batch
+kernels vmap over the binding axis. Everything is static-shaped and
+jit-friendly; a single ``lax.sort`` with three keys realizes the
+lexicographic order (TPU-native: one fused sort, no host control flow).
+
+int64 is used only where products can overflow int32
+(weight * num_replicas and availability cumsums); storage stays int32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def take_by_weight(
+    num: jnp.ndarray,  # int32 scalar: replicas to dispense
+    weights: jnp.ndarray,  # int32[C], >= 0 (0 = excluded from dispensing)
+    last: jnp.ndarray,  # int32[C], previous replicas (tie-break inertia)
+    init: jnp.ndarray,  # int32[C], initial result merged into the output
+) -> jnp.ndarray:
+    """Returns int32[C] replica assignment == Dispenser result.
+
+    floor_i = w_i * num // sum(w); the remainder is handed out one replica at
+    a time in (weight desc, last desc, index asc) order. A zero weight sum
+    returns ``init`` unchanged (binding.go:117-120).
+    """
+    c = weights.shape[0]
+    idx = jnp.arange(c, dtype=jnp.int32)
+
+    total = jnp.sum(weights.astype(jnp.int64))
+    safe_total = jnp.maximum(total, 1)
+    floors64 = weights.astype(jnp.int64) * num.astype(jnp.int64) // safe_total
+    floors = floors64.astype(jnp.int32)
+    remain = num - jnp.sum(floors).astype(jnp.int32)
+
+    # one fused lexicographic sort; payload = original index
+    _, _, _, perm = lax.sort(
+        (-weights, -last, idx, idx), num_keys=3, is_stable=False
+    )
+    # +1 to the first `remain` clusters in sort order
+    bonus_sorted = (jnp.arange(c, dtype=jnp.int32) < remain).astype(jnp.int32)
+    bonus = jnp.zeros((c,), jnp.int32).at[perm].set(bonus_sorted)
+
+    dispensed = jnp.where(total > 0, floors + bonus, 0)
+    return init + dispensed
+
+
+# Batched over bindings: num[B], weights[B,C], last[B,C], init[B,C] -> [B,C]
+take_by_weight_batch = jax.vmap(take_by_weight, in_axes=(0, 0, 0, 0))

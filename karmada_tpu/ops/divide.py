@@ -1,0 +1,166 @@
+"""Unified replica-assignment kernel: all four strategies as one tensor op.
+
+The reference dispatches through assignFuncMap (core/assignment.go:31-38) into
+per-strategy Go loops. On TPU every strategy reduces to ONE largest-remainder
+dispense with strategy-dependent (target, weights, lastReplicas, init):
+
+- Duplicated  (assignment.go:176-182): broadcast, no dispense
+- StaticWeight (assignment.go:194-206): target=N, w=rule weights, init=0
+- DynamicWeight steady scale-up (division_algorithm.go:119-128):
+  target=N-assigned, w=availability, init=previous
+- DynamicWeight steady scale-down (division_algorithm.go:101-117):
+  target=N, w=FULL previous result, init=0
+- Fresh (division_algorithm.go:130-152): target=N, w=availability+credited
+  previous, init=0
+- Aggregated (division_algorithm.go:80-90 + assignment.go:146-173): same as
+  the dynamic modes but with weights masked to the minimal prefix of clusters
+  ordered (previously-used desc, availability desc, index asc) whose
+  cumulative availability covers the target
+
+so the whole batch runs as two fused sorts + elementwise ops over [B, C]
+arrays — no per-binding control flow, no host round-trips. Branch selection
+is data (jnp.where over cohort masks), exactly the "batch by branch" plan of
+SURVEY.md section 7.
+
+Inputs are dense per-chunk arrays; karmada_tpu.scheduler packs them from API
+objects and unpacks results.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dispense import take_by_weight
+
+# Strategy codes — shared with refimpl.divider
+DUPLICATED = 0
+STATIC_WEIGHT = 1
+DYNAMIC_WEIGHT = 2
+AGGREGATED = 3
+
+
+class DivideResult(NamedTuple):
+    assignment: jnp.ndarray  # int32[B, C] replicas per cluster
+    unschedulable: jnp.ndarray  # bool[B] — available < target (FitError)
+
+
+def _aggregated_prefix_mask(
+    weights: jnp.ndarray,  # int32[C] availability in this mode
+    is_prev: jnp.ndarray,  # bool[C] previously-scheduled (>0 replicas)
+    target: jnp.ndarray,  # int32 scalar
+) -> jnp.ndarray:
+    """bool[C]: minimal prefix of (prev desc, avail desc, idx asc) order whose
+    cumulative availability reaches ``target``.
+
+    Matches resortAvailableClusters + the prefix loop: the availability sort
+    is replicas-desc (division_algorithm.go:31-36) and the resort is a stable
+    partition by previously-used (assignment.go:146-173) — together one
+    3-key sort.
+    """
+    c = weights.shape[0]
+    idx = jnp.arange(c, dtype=jnp.int32)
+    _, _, _, perm = lax.sort(
+        (jnp.where(is_prev, 0, 1).astype(jnp.int32), -weights, idx, idx),
+        num_keys=3,
+        is_stable=False,
+    )
+    w_sorted = weights[perm]
+    cum = jnp.cumsum(w_sorted.astype(jnp.int64))
+    # keep positions up to and including the first where cum >= target
+    reached_before = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int64), cum[:-1]]
+    ) >= target.astype(jnp.int64)
+    keep_sorted = ~reached_before
+    keep = jnp.zeros((c,), bool).at[perm].set(keep_sorted)
+    return keep
+
+
+def _divide_one(
+    strategy: jnp.ndarray,  # int32 scalar code
+    replicas: jnp.ndarray,  # int32 scalar N
+    candidates: jnp.ndarray,  # bool[C] post-filter feasibility
+    static_w: jnp.ndarray,  # int32[C] rule-matched static weights (0 off-list)
+    avail: jnp.ndarray,  # int32[C] estimator availability (candidates only)
+    prev: jnp.ndarray,  # int32[C] full previous assignment (spec.clusters)
+    fresh: jnp.ndarray,  # bool scalar — reschedule triggered (Fresh mode)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    c = candidates.shape[0]
+    prev_cand = jnp.where(candidates, prev, 0)  # buildScheduledClusters
+    assigned = jnp.sum(prev_cand)
+    avail = jnp.where(candidates, avail, 0)
+
+    is_dup = strategy == DUPLICATED
+    is_static = strategy == STATIC_WEIGHT
+    is_dynamic = (strategy == DYNAMIC_WEIGHT) | (strategy == AGGREGATED)
+
+    # --- dynamic cohorts ---------------------------------------------------
+    scale_down = is_dynamic & ~fresh & (assigned > replicas)
+    scale_up = is_dynamic & ~fresh & (assigned < replicas)
+    steady_noop = is_dynamic & ~fresh & (assigned == replicas)
+    is_fresh = is_dynamic & fresh
+
+    target_dyn = jnp.where(scale_up, replicas - assigned, replicas)
+    w_dyn = jnp.where(
+        is_fresh,
+        avail + prev_cand,
+        jnp.where(scale_down, prev, avail),
+    ).astype(jnp.int32)
+    # init/last only exist for scale-up (init = previous scheduled clusters)
+    init_dyn = jnp.where(scale_up, prev_cand, 0)
+    last_dyn = init_dyn
+
+    # availability check precedes division (division_algorithm.go:76-78)
+    unschedulable = is_dynamic & ~steady_noop & (
+        jnp.sum(w_dyn.astype(jnp.int64)) < target_dyn.astype(jnp.int64)
+    )
+
+    # aggregated prefix restriction; prior only exists in steady scale-up
+    is_prev_mask = (prev_cand > 0) & scale_up
+    keep = _aggregated_prefix_mask(w_dyn, is_prev_mask, target_dyn)
+    w_dyn = jnp.where((strategy == AGGREGATED) & keep | (strategy != AGGREGATED), w_dyn, 0)
+
+    # --- static weights ----------------------------------------------------
+    sw = jnp.where(candidates, static_w, 0)
+    # all-zero weights -> every candidate gets weight 1 (division_algorithm.go:63-70)
+    sw = jnp.where(jnp.sum(sw) > 0, sw, candidates.astype(jnp.int32))
+    last_static = jnp.where(candidates, prev, 0)
+
+    # --- unified dispense --------------------------------------------------
+    num = jnp.where(is_static, replicas, target_dyn).astype(jnp.int32)
+    w = jnp.where(is_static, sw, w_dyn)
+    last = jnp.where(is_static, last_static, last_dyn)
+    init = jnp.where(is_static, 0, init_dyn)
+    w = jnp.where(is_dup | steady_noop | unschedulable, 0, w)  # no dispense
+
+    out = take_by_weight(num, w, last, init)
+
+    out = jnp.where(steady_noop, prev_cand, out)
+    out = jnp.where(is_dup, jnp.where(candidates, replicas, 0), out)
+    out = jnp.where(unschedulable, 0, out)
+    # a zero-replica binding assigns all candidates with replicas 0 upstream
+    out = jnp.where(replicas == 0, jnp.zeros((c,), jnp.int32), out)
+    return out, unschedulable
+
+
+_divide_batch = jax.vmap(_divide_one, in_axes=(0, 0, 0, 0, 0, 0, 0))
+
+
+@jax.jit
+def divide_replicas(
+    strategy: jnp.ndarray,  # int32[B]
+    replicas: jnp.ndarray,  # int32[B]
+    candidates: jnp.ndarray,  # bool[B, C]
+    static_w: jnp.ndarray,  # int32[B, C]
+    avail: jnp.ndarray,  # int32[B, C]
+    prev: jnp.ndarray,  # int32[B, C]
+    fresh: jnp.ndarray,  # bool[B]
+) -> DivideResult:
+    """Batched AssignReplicas over a binding chunk."""
+    out, unsched = _divide_batch(
+        strategy, replicas, candidates, static_w, avail, prev, fresh
+    )
+    return DivideResult(assignment=out, unschedulable=unsched)

@@ -1,0 +1,55 @@
+"""Capacity estimation kernels: MaxAvailableReplicas as batched integer math.
+
+General estimator (ref: pkg/estimator/client/general.go:96-196): per cluster,
+available = allocatable - allocated - allocating; max replicas = min over
+requested resource dims of floor(available / request), min'ed with the
+allowed-pod headroom. Each replica occupies one pod, so the pods dimension
+carries an implicit request of 1 — which reproduces getAllowedPodNumber
+(general.go:96-114) as just another dimension.
+
+The node/model-grade variants live in karmada_tpu.estimator; they produce the
+same ``[B, C]`` availability matrix and are min-merged by
+``merge_estimates`` (ref: pkg/scheduler/core/util.go:54-104).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MAX_INT32 = jnp.int32(2**31 - 1)
+UNAUTHENTIC = jnp.int32(-1)  # estimator "no answer" (client/interface.go:30)
+
+
+@jax.jit
+def general_estimate(
+    available_cap: jnp.ndarray,  # int64[C, R]: allocatable-allocated-allocating
+    requests: jnp.ndarray,  # int64[B, R]: per-replica requests (0 = not requested)
+) -> jnp.ndarray:
+    """int32[B, C] max available replicas (>= 0); MAX_INT32 when the binding
+    requests nothing at all (best-effort) — callers clamp the sentinel."""
+    cap = jnp.maximum(available_cap, 0)  # negative available -> 0 replicas
+    r_dims = requests.shape[-1]
+    best = jnp.full(requests.shape[:-1] + (cap.shape[0],), jnp.int64(2**31 - 1))
+    for r in range(r_dims):  # R is small and static; unrolled under jit
+        req_r = requests[..., r][..., None]  # [B, 1]
+        ratio = cap[None, :, r] // jnp.maximum(req_r, 1)
+        best = jnp.where(req_r > 0, jnp.minimum(best, ratio), best)
+    return jnp.minimum(best, jnp.int64(2**31 - 1)).astype(jnp.int32)
+
+
+@jax.jit
+def merge_estimates(
+    replicas: jnp.ndarray,  # int32[B]
+    estimates: tuple[jnp.ndarray, ...],  # each int32[B, C]; -1 = no answer
+) -> jnp.ndarray:
+    """core/util.go:54-104: min across estimators ignoring UNAUTHENTIC,
+    then clamp an untouched MAX_INT32 sentinel to spec.Replicas, and
+    short-circuit zero-replica (non-workload) bindings to the sentinel path."""
+    b = replicas.shape[0]
+    c = estimates[0].shape[1]
+    out = jnp.full((b, c), MAX_INT32)
+    for est in estimates:
+        out = jnp.where(est == UNAUTHENTIC, out, jnp.minimum(out, est))
+    out = jnp.where(replicas[:, None] == 0, MAX_INT32, out)
+    return jnp.where(out == MAX_INT32, replicas[:, None], out)

@@ -122,7 +122,11 @@ def assign_replicas(problem: DivisionProblem) -> dict[int, int]:
     """
     p = problem
     if p.strategy == DUPLICATED:
-        return {idx: p.replicas for idx in p.candidates}
+        # zero-replica entries are stripped for every strategy
+        # (core/util.go:122-130); the replicas==0 "assign all clusters
+        # with no replicas" path (core/common.go:70-74) is the scheduler
+        # layer's job, not the divider's.
+        return {idx: p.replicas for idx in p.candidates if p.replicas > 0}
 
     if p.strategy == STATIC_WEIGHT:
         prev = p.prev or {}

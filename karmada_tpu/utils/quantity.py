@@ -23,7 +23,10 @@ _BIN = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2
 _DEC = {"n": 1e-9, "u": 1e-6, "m": 1e-3, "": 1.0, "k": 1e3, "M": 1e6, "G": 1e9,
         "T": 1e12, "P": 1e15, "E": 1e18}
 
-_QTY_RE = re.compile(r"^\s*([0-9.]+)\s*([A-Za-z]*)\s*$")
+# sign + digits + optional exponent ("1e9", "100e-3" are legal Quantity
+# serializations), then an optional unit suffix. A bare trailing E is the
+# decimal exa suffix; E followed by digits is an exponent (k8s semantics).
+_QTY_RE = re.compile(r"^\s*([+-]?[0-9.]+(?:[eE][-+]?[0-9]+)?)\s*([A-Za-z]*)\s*$")
 
 CPU = "cpu"
 MEMORY = "memory"

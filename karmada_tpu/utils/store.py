@@ -44,8 +44,12 @@ def obj_kind(obj: Any) -> str:
 
 
 class Store:
-    """Typed object store. Thread-safe; watch handlers run synchronously on
-    the mutating thread (like a delivering informer)."""
+    """Typed object store. Mutations are thread-safe; watch handlers run
+    synchronously on the mutating thread, outside the lock (so handlers may
+    re-enter the store). Cross-thread event *ordering* is therefore not
+    guaranteed — the deterministic control-plane runtime (utils.worker) is
+    single-threaded, which is the supported concurrency model; multi-threaded
+    callers must tolerate reordered events, as with real informers."""
 
     def __init__(self) -> None:
         self._lock = threading.RLock()

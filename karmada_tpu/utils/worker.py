@@ -81,8 +81,9 @@ class Runtime:
         return w
 
     def add_ticker(self, fn: Callable[[], None]) -> None:
-        """Periodic function run once per settle pass (cluster status refresh,
-        descheduler sweep, etc. — the analogue of wait.Until loops)."""
+        """Periodic function run at the start of each run_until_settled call
+        (cluster status refresh, descheduler sweep, etc. — the analogue of
+        wait.Until loops)."""
         self._tickers.append(fn)
 
     def tick(self) -> None:
@@ -92,8 +93,15 @@ class Runtime:
     def pending(self) -> int:
         return sum(len(w) for w in self.workers)
 
-    def run_until_settled(self, max_steps: int = 100_000) -> int:
-        """Process queued work until quiescent. Returns steps executed."""
+    def run_until_settled(self, max_steps: int = 100_000, *, tick: bool = True) -> int:
+        """Process queued work until quiescent. Returns steps executed.
+
+        Tickers run once at the start (not per pass — a ticker that always
+        enqueues would never settle); wall-clock periodicity comes from the
+        caller invoking this repeatedly, as a real deployment's main loop
+        does."""
+        if tick:
+            self.tick()
         steps = 0
         while steps < max_steps:
             progressed = False

@@ -2,7 +2,11 @@
 
 Multi-chip hardware is not available in CI; sharding correctness is validated
 on a host-platform device mesh (SURVEY.md section 7 / driver contract).
-Must run before the first jax import anywhere in the test session.
+
+The axon TPU-tunnel sitecustomize (when present) overrides platform selection
+programmatically via ``jax.config.update("jax_platforms", "axon,cpu")``, so an
+env var alone is not enough — we override the config the same way before any
+backend initializes. Tests must never dial the single-client TPU tunnel.
 """
 
 import os
@@ -13,3 +17,7 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,162 @@
+"""Golden equivalence: TPU division kernels == pure-Python oracle.
+
+The identical-placement guarantee (BASELINE.md) is enforced here with
+randomized problems across every strategy/mode cohort, plus the estimator
+min-merge kernel.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from karmada_tpu import refimpl as R
+from karmada_tpu.ops import (
+    divide_replicas,
+    general_estimate,
+    merge_estimates,
+    take_by_weight_batch,
+)
+
+
+def kernel_solve(problems: list[R.DivisionProblem], num_clusters: int):
+    """Pack oracle problems into dense arrays and run the batch kernel."""
+    b = len(problems)
+    c = num_clusters
+    strategy = np.zeros(b, np.int32)
+    replicas = np.zeros(b, np.int32)
+    cand = np.zeros((b, c), bool)
+    static_w = np.zeros((b, c), np.int32)
+    avail = np.zeros((b, c), np.int32)
+    prev = np.zeros((b, c), np.int32)
+    fresh = np.zeros(b, bool)
+    for i, p in enumerate(problems):
+        strategy[i] = p.strategy
+        replicas[i] = p.replicas
+        cand[i, list(p.candidates)] = True
+        if p.static_weights is not None:
+            static_w[i, list(p.candidates)] = p.static_weights
+        if p.available is not None:
+            avail[i, list(p.candidates)] = p.available
+        for idx, r in (p.prev or {}).items():
+            prev[i, idx] = r
+        fresh[i] = p.fresh
+    res = divide_replicas(
+        jnp.asarray(strategy), jnp.asarray(replicas), jnp.asarray(cand),
+        jnp.asarray(static_w), jnp.asarray(avail), jnp.asarray(prev),
+        jnp.asarray(fresh),
+    )
+    return np.asarray(res.assignment), np.asarray(res.unschedulable)
+
+
+def oracle_solve(problems: list[R.DivisionProblem], num_clusters: int):
+    out = np.zeros((len(problems), num_clusters), np.int32)
+    unsched = np.zeros(len(problems), bool)
+    for i, p in enumerate(problems):
+        try:
+            for idx, r in R.assign_replicas(p).items():
+                out[i, idx] = r
+        except R.UnschedulableError:
+            unsched[i] = True
+    return out, unsched
+
+
+def random_problem(rng: np.random.Generator, c: int) -> R.DivisionProblem:
+    strategy = int(rng.integers(0, 4))
+    n_cand = int(rng.integers(1, c + 1))
+    candidates = sorted(rng.choice(c, size=n_cand, replace=False).tolist())
+    replicas = int(rng.integers(0, 40))
+    prev = {}
+    if rng.random() < 0.6:  # previously scheduled (possibly on non-candidates)
+        n_prev = int(rng.integers(1, c + 1))
+        for idx in rng.choice(c, size=n_prev, replace=False):
+            prev[int(idx)] = int(rng.integers(0, 15))
+    return R.DivisionProblem(
+        replicas=replicas,
+        strategy=strategy,
+        candidates=candidates,
+        static_weights=[int(w) for w in rng.integers(0, 5, size=n_cand)],
+        available=[int(a) for a in rng.integers(0, 25, size=n_cand)],
+        prev=prev or None,
+        fresh=bool(rng.random() < 0.25),
+    )
+
+
+class TestKernelOracleEquivalence:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_batches(self, seed):
+        rng = np.random.default_rng(seed)
+        c = int(rng.integers(2, 12))
+        problems = [random_problem(rng, c) for _ in range(64)]
+        got, got_unsched = kernel_solve(problems, c)
+        want, want_unsched = oracle_solve(problems, c)
+        np.testing.assert_array_equal(got_unsched, want_unsched)
+        np.testing.assert_array_equal(got, want)
+
+    def test_large_values_no_overflow(self):
+        # weight * replicas products beyond int32: 2e6 avail, 30k replicas
+        p = R.DivisionProblem(
+            replicas=30_000,
+            strategy=R.DYNAMIC_WEIGHT,
+            candidates=[0, 1, 2],
+            available=[2_000_000, 1_500_000, 1_000_000],
+        )
+        got, gu = kernel_solve([p], 3)
+        want, wu = oracle_solve([p], 3)
+        np.testing.assert_array_equal(got, want)
+        assert not gu[0] and not wu[0]
+        assert got.sum() == 30_000
+
+
+class TestDispenseBatch:
+    def test_matches_oracle(self):
+        rng = np.random.default_rng(7)
+        b, c = 32, 9
+        num = rng.integers(0, 50, size=b).astype(np.int32)
+        w = rng.integers(0, 8, size=(b, c)).astype(np.int32)
+        last = rng.integers(0, 10, size=(b, c)).astype(np.int32)
+        init = rng.integers(0, 5, size=(b, c)).astype(np.int32)
+        got = np.asarray(
+            take_by_weight_batch(
+                jnp.asarray(num), jnp.asarray(w), jnp.asarray(last), jnp.asarray(init)
+            )
+        )
+        for i in range(b):
+            weights = [(j, int(w[i, j]), int(last[i, j])) for j in range(c)]
+            want = R.take_by_weight(
+                int(num[i]), weights, {j: int(init[i, j]) for j in range(c)}
+            )
+            np.testing.assert_array_equal(
+                got[i], [want.get(j, 0) for j in range(c)]
+            )
+
+
+class TestEstimate:
+    def test_general_estimate(self):
+        # 2 clusters x 3 dims (cpu-milli, memory, pods); 2 bindings
+        cap = jnp.asarray(
+            [[4000, 8 << 30, 100], [1000, 2 << 30, 3]], dtype=jnp.int64
+        )
+        req = jnp.asarray(
+            [[500, 1 << 30, 1], [0, 0, 1]], dtype=jnp.int64
+        )
+        got = np.asarray(general_estimate(cap, req))
+        np.testing.assert_array_equal(got[0], [8, 2])  # min(8, 8, 100)=8; min(2,2,3)=2
+        np.testing.assert_array_equal(got[1], [100, 3])  # pods-only
+
+    def test_negative_available_clamps_to_zero(self):
+        cap = jnp.asarray([[-500, 10]], dtype=jnp.int64)
+        req = jnp.asarray([[250, 1]], dtype=jnp.int64)
+        assert np.asarray(general_estimate(cap, req))[0, 0] == 0
+
+    def test_merge_matches_oracle(self):
+        replicas = jnp.asarray([10, 0, 7], jnp.int32)
+        e1 = jnp.asarray([[5, -1], [5, 5], [-1, -1]], jnp.int32)
+        e2 = jnp.asarray([[7, -1], [1, 1], [-1, 3]], jnp.int32)
+        got = np.asarray(merge_estimates(replicas, (e1, e2)))
+        want = [
+            R.merge_estimates(10, [[5, -1], [7, -1]], 2),
+            R.merge_estimates(0, [[5, 5], [1, 1]], 2),
+            R.merge_estimates(7, [[-1, -1], [-1, 3]], 2),
+        ]
+        np.testing.assert_array_equal(got, want)
