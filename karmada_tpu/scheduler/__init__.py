@@ -1,0 +1,10 @@
+"""Batched TPU scheduler (ref: pkg/scheduler)."""
+
+from .core import BindingProblem, ScheduleResult, TensorScheduler  # noqa: F401
+from .snapshot import (  # noqa: F401
+    ClusterSnapshot,
+    CompiledPlacement,
+    compile_affinity,
+    compile_placement,
+    strategy_code,
+)

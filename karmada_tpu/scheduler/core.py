@@ -1,0 +1,295 @@
+"""TensorScheduler: the batched Filter/Score/Select/Assign pipeline.
+
+Re-architecture of the reference's per-binding pipeline
+(core/generic_scheduler.go:70-115 — findClustersThatFit ->
+prioritizeClusters -> SelectClusters -> AssignReplicas) as chunked tensor
+programs over [bindings, clusters] arrays:
+
+- Filter: mask composition from compiled placements + per-binding leniency
+  (already-placed) and eviction masks — HOT LOOP 1+2 of SURVEY.md section 3.1
+  collapse into gathers and boolean ops.
+- Score: locality scoring (cluster already holds the resource scores 100,
+  clusterlocality/cluster_locality.go:43-56); used by spread selection.
+- Select: spread-constraint group selection (karmada_tpu.scheduler.spread).
+- Assign: the unified division kernel (karmada_tpu.ops.divide).
+
+The ordered ClusterAffinities retry loop (scheduler.go:533-596) runs as a
+short host loop over affinity-term rounds: each round schedules every not-
+yet-placed binding against its term-t mask, so T rounds of fully batched
+kernels replace per-binding retries (T == max #terms, almost always 1).
+
+Chunking: bindings are processed in fixed-size chunks (padded) so jit traces
+once; 100k bindings x 5k clusters stream through [chunk, C] arrays sized for
+HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..api.policy import Placement
+from ..ops.divide import divide_replicas
+from ..ops.estimate import general_estimate, merge_estimates
+from .snapshot import ClusterSnapshot, CompiledPlacement, compile_placement
+
+LOCALITY_SCORE = 100  # cluster_locality.go:43-56
+
+
+@dataclass
+class BindingProblem:
+    """Engine-level scheduling unit (decoupled from the API object; the
+    scheduler process builds these from ResourceBindings)."""
+
+    key: str
+    placement: Optional[Placement] = None
+    replicas: int = 0
+    requests: dict[str, int] = dc_field(default_factory=dict)
+    gvk: str = ""
+    prev: dict[str, int] = dc_field(default_factory=dict)  # spec.clusters
+    evict_clusters: tuple[str, ...] = ()  # graceful-eviction tasks
+    fresh: bool = False  # reschedule triggered
+
+
+@dataclass
+class ScheduleResult:
+    key: str
+    clusters: dict[str, int] = dc_field(default_factory=dict)
+    feasible: tuple[str, ...] = ()  # post-filter candidates (zero-replica set)
+    affinity_name: str = ""
+    error: str = ""
+
+    @property
+    def success(self) -> bool:
+        return not self.error
+
+
+class TensorScheduler:
+    """Schedules batches of bindings against one cluster snapshot."""
+
+    def __init__(
+        self,
+        snapshot: ClusterSnapshot,
+        chunk_size: int = 4096,
+        extra_estimators: Sequence = (),
+    ):
+        self.snapshot = snapshot
+        self.chunk_size = chunk_size
+        # callables (requests[B,R] int64, replicas[B] int32) -> int32[B,C]
+        # availability with -1 for "no answer" (accurate estimators plug here)
+        self.extra_estimators = list(extra_estimators)
+        self._placement_cache: dict[int, CompiledPlacement] = {}
+
+    # -- compilation -------------------------------------------------------
+
+    def _compiled(self, placement: Optional[Placement]) -> CompiledPlacement:
+        key = id(placement) if placement is not None else 0
+        cp = self._placement_cache.get(key)
+        if cp is None:
+            cp = compile_placement(placement, self.snapshot)
+            self._placement_cache[key] = cp
+        return cp
+
+    # -- public API --------------------------------------------------------
+
+    def schedule(self, problems: Sequence[BindingProblem]) -> list[ScheduleResult]:
+        snap = self.snapshot
+        results: list[Optional[ScheduleResult]] = [None] * len(problems)
+        compiled = [self._compiled(p.placement) for p in problems]
+        max_terms = max((len(cp.terms) for cp in compiled), default=1)
+
+        pending = list(range(len(problems)))
+        for term_round in range(max_terms):
+            if not pending:
+                break
+            in_round = [i for i in pending if term_round < len(compiled[i].terms)]
+            if not in_round:
+                break
+            round_results = self._schedule_round(
+                [problems[i] for i in in_round],
+                [compiled[i] for i in in_round],
+                term_round,
+            )
+            next_pending = []
+            for i, res in zip(in_round, round_results):
+                has_more = term_round + 1 < len(compiled[i].terms)
+                if res.success or not has_more:
+                    results[i] = res
+                else:
+                    next_pending.append(i)  # FitError -> try next group
+            # bindings whose term list was exhausted before this round keep
+            # their last failure
+            for i in pending:
+                if i not in in_round and results[i] is None:
+                    results[i] = ScheduleResult(
+                        key=problems[i].key, error="no affinity group fits"
+                    )
+            pending = next_pending
+        for i, res in enumerate(results):
+            if res is None:
+                results[i] = ScheduleResult(key=problems[i].key, error="not scheduled")
+        return results  # type: ignore[return-value]
+
+    # -- internals ---------------------------------------------------------
+
+    def _schedule_round(
+        self,
+        problems: list[BindingProblem],
+        compiled: list[CompiledPlacement],
+        term_round: int,
+    ) -> list[ScheduleResult]:
+        out: list[ScheduleResult] = []
+        for start in range(0, len(problems), self.chunk_size):
+            chunk = problems[start : start + self.chunk_size]
+            cchunk = compiled[start : start + self.chunk_size]
+            out.extend(self._schedule_chunk(chunk, cchunk, term_round))
+        return out
+
+    def _pack_chunk(
+        self,
+        problems: list[BindingProblem],
+        compiled: list[CompiledPlacement],
+        term_round: int,
+    ):
+        snap = self.snapshot
+        b, c, r = len(problems), snap.num_clusters, len(snap.dims)
+        dim_index = {d: j for j, d in enumerate(snap.dims)}
+
+        feasible = np.zeros((b, c), bool)
+        strategy = np.zeros(b, np.int32)
+        replicas = np.zeros(b, np.int32)
+        static_w = np.zeros((b, c), np.int32)
+        requests = np.zeros((b, r), np.int64)
+        prev = np.zeros((b, c), np.int32)
+        fresh = np.zeros(b, bool)
+
+        pods_dim = dim_index.get("pods")
+        for i, (p, cp) in enumerate(zip(problems, compiled)):
+            term_idx = min(term_round, len(cp.terms) - 1)
+            _, aff_mask = cp.terms[term_idx]
+            prev_mask = np.zeros(c, bool)
+            for name, reps in p.prev.items():
+                j = snap.index.get(name)
+                if j is not None:
+                    prev[i, j] = reps
+                    prev_mask[j] = True
+            # GVK enablement with already-placed leniency (api_enablement.go)
+            gid = snap.gvk_vocab.get(p.gvk) if p.gvk else None
+            if gid is None:
+                api_ok = (
+                    np.zeros(c, bool)
+                    if p.gvk and len(snap.gvk_vocab) > 0
+                    else np.ones(c, bool)
+                )
+            else:
+                word, bit = gid // 32, gid % 32
+                api_ok = (snap.gvk_bits[:, word] >> np.uint32(bit)) & 1 != 0
+            api_ok = api_ok | (prev_mask & ~snap.complete_enablements)
+            # taints with already-placed leniency (taint_toleration.go:60-63)
+            taint_ok = cp.taint_ok | prev_mask
+            m = aff_mask & cp.spread_field_ok & api_ok & taint_ok
+            # ClusterEviction (cluster_eviction.go:46-53)
+            for name in p.evict_clusters:
+                j = snap.index.get(name)
+                if j is not None:
+                    m[j] = False
+            feasible[i] = m
+            strategy[i] = cp.strategy
+            replicas[i] = p.replicas
+            static_w[i] = cp.static_weights
+            fresh[i] = p.fresh
+            for d, q in p.requests.items():
+                j = dim_index.get(d)
+                if j is not None:
+                    requests[i, j] = q
+            if pods_dim is not None and p.replicas > 0:
+                # each replica occupies a pod (getAllowedPodNumber)
+                requests[i, pods_dim] = max(requests[i, pods_dim], 1)
+        return feasible, strategy, replicas, static_w, requests, prev, fresh
+
+    def _availability(self, requests: np.ndarray, replicas: np.ndarray) -> jnp.ndarray:
+        """calAvailableReplicas (core/util.go:54-104): min-merge over
+        registered estimators, sentinel clamped to spec.Replicas."""
+        snap = self.snapshot
+        req = jnp.asarray(requests)
+        reps = jnp.asarray(replicas)
+        general = general_estimate(jnp.asarray(snap.available_cap), req)
+        # clusters with no ResourceSummary give no answer (UnauthenticReplica)
+        general = jnp.where(
+            jnp.asarray(snap.has_summary)[None, :], general, jnp.int32(-1)
+        )
+        estimates = [general]
+        for est in self.extra_estimators:
+            estimates.append(jnp.asarray(est(req, reps)))
+        return merge_estimates(reps, tuple(estimates))
+
+    def _schedule_chunk(
+        self,
+        problems: list[BindingProblem],
+        compiled: list[CompiledPlacement],
+        term_round: int,
+    ) -> list[ScheduleResult]:
+        snap = self.snapshot
+        feasible, strategy, replicas, static_w, requests, prev, fresh = (
+            self._pack_chunk(problems, compiled, term_round)
+        )
+        avail = self._availability(requests, replicas)
+
+        # Select: spread-constraint group selection narrows the candidate set
+        from .spread import select_clusters_batch  # local import (cycle-free)
+
+        candidates = select_clusters_batch(
+            snap, problems, compiled, term_round, feasible, np.asarray(avail), prev
+        )
+
+        res = divide_replicas(
+            jnp.asarray(strategy),
+            jnp.asarray(replicas),
+            jnp.asarray(candidates),
+            jnp.asarray(static_w),
+            avail,
+            jnp.asarray(prev),
+            jnp.asarray(fresh),
+        )
+        assignment = np.asarray(res.assignment)
+        unschedulable = np.asarray(res.unschedulable)
+
+        out = []
+        for i, p in enumerate(problems):
+            term_idx = min(term_round, len(compiled[i].terms) - 1)
+            term_name = compiled[i].terms[term_idx][0]
+            cand_idx = np.flatnonzero(candidates[i])
+            if cand_idx.size == 0:
+                out.append(
+                    ScheduleResult(
+                        key=p.key,
+                        affinity_name=term_name,
+                        error="no clusters fit the placement",
+                    )
+                )
+                continue
+            if unschedulable[i]:
+                out.append(
+                    ScheduleResult(
+                        key=p.key,
+                        affinity_name=term_name,
+                        error="clusters available replicas are not enough",
+                    )
+                )
+                continue
+            row = assignment[i]
+            placed = {
+                snap.names[j]: int(row[j]) for j in np.flatnonzero(row > 0)
+            }
+            out.append(
+                ScheduleResult(
+                    key=p.key,
+                    clusters=placed,
+                    feasible=tuple(snap.names[j] for j in cand_idx),
+                    affinity_name=term_name,
+                )
+            )
+        return out

@@ -1,0 +1,304 @@
+"""TensorScheduler end-to-end: filters, affinity groups, spread selection,
+assignment — mirroring the reference's scheduler core test strategy
+(fabricated clusters, exact TargetCluster assertions)."""
+
+import numpy as np
+
+from karmada_tpu.api import (
+    ClusterAffinity,
+    ClusterAffinityTerm,
+    LabelSelector,
+    Placement,
+    SpreadConstraint,
+    Taint,
+    Toleration,
+)
+from karmada_tpu.scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+from karmada_tpu.utils.builders import (
+    aggregated_placement,
+    duplicated_placement,
+    dynamic_weight_placement,
+    new_cluster,
+    static_weight_placement,
+    synthetic_fleet,
+)
+from karmada_tpu.utils.quantity import parse_resource_list
+
+REQ = parse_resource_list({"cpu": "1", "memory": "2Gi"})
+
+
+def make_snapshot(clusters):
+    return ClusterSnapshot(clusters)
+
+
+class TestFilters:
+    def test_cluster_names_affinity(self):
+        snap = make_snapshot([new_cluster(f"m{i}") for i in range(4)])
+        sched = TensorScheduler(snap)
+        pl = duplicated_placement(
+            cluster_affinity=ClusterAffinity(cluster_names=["m1", "m3"])
+        )
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=2, gvk="apps/v1/Deployment")]
+        )
+        assert res.clusters == {"m1": 2, "m3": 2}
+
+    def test_label_selector_affinity(self):
+        clusters = [
+            new_cluster("a", labels={"env": "prod", "tier": "t1"}),
+            new_cluster("b", labels={"env": "dev"}),
+            new_cluster("c", labels={"env": "prod"}),
+        ]
+        sched = TensorScheduler(make_snapshot(clusters))
+        pl = duplicated_placement(
+            cluster_affinity=ClusterAffinity(
+                label_selector=LabelSelector(match_labels={"env": "prod"})
+            )
+        )
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=1, gvk="apps/v1/Deployment")]
+        )
+        assert set(res.clusters) == {"a", "c"}
+
+    def test_taint_filter_and_toleration(self):
+        taint = Taint(key="k", value="v", effect="NoSchedule")
+        clusters = [new_cluster("ok"), new_cluster("tainted", taints=[taint])]
+        sched = TensorScheduler(make_snapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=duplicated_placement(), replicas=1,
+                            gvk="apps/v1/Deployment")]
+        )
+        assert set(res.clusters) == {"ok"}
+        pl = duplicated_placement(
+            cluster_tolerations=[Toleration(key="k", operator="Exists")]
+        )
+        [res] = sched.schedule(
+            [BindingProblem(key="b2", placement=pl, replicas=1, gvk="apps/v1/Deployment")]
+        )
+        assert set(res.clusters) == {"ok", "tainted"}
+
+    def test_tainted_cluster_lenient_when_already_placed(self):
+        taint = Taint(key="k", value="v", effect="NoExecute")
+        clusters = [new_cluster("a"), new_cluster("b", taints=[taint])]
+        sched = TensorScheduler(make_snapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=dynamic_weight_placement(), replicas=4,
+                            gvk="apps/v1/Deployment", prev={"b": 2})]
+        )
+        # b keeps being a candidate because it already holds replicas
+        assert "b" in res.clusters
+
+    def test_api_enablement(self):
+        clusters = [
+            new_cluster("with", api_enablements=["apps/v1/Deployment"]),
+            new_cluster("without", api_enablements=["v1/ConfigMap"]),
+        ]
+        sched = TensorScheduler(make_snapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=duplicated_placement(), replicas=1,
+                            gvk="apps/v1/Deployment")]
+        )
+        assert set(res.clusters) == {"with"}
+
+    def test_eviction_filter(self):
+        clusters = [new_cluster("a"), new_cluster("b")]
+        sched = TensorScheduler(make_snapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=duplicated_placement(), replicas=1,
+                            gvk="apps/v1/Deployment", evict_clusters=("a",))]
+        )
+        assert set(res.clusters) == {"b"}
+
+
+class TestAffinityGroups:
+    def test_ordered_groups_fallback(self):
+        clusters = [
+            new_cluster("primary", cpu="2"),  # too small for 8 x 1cpu
+            new_cluster("backup", cpu="100"),
+        ]
+        pl = dynamic_weight_placement(
+            cluster_affinities=[
+                ClusterAffinityTerm(affinity_name="primary", cluster_names=["primary"]),
+                ClusterAffinityTerm(affinity_name="backup", cluster_names=["backup"]),
+            ]
+        )
+        sched = TensorScheduler(ClusterSnapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=8,
+                            requests=REQ, gvk="apps/v1/Deployment")]
+        )
+        assert res.success and res.affinity_name == "backup"
+        assert res.clusters == {"backup": 8}
+
+    def test_first_group_wins_when_it_fits(self):
+        clusters = [new_cluster("primary"), new_cluster("backup")]
+        pl = dynamic_weight_placement(
+            cluster_affinities=[
+                ClusterAffinityTerm(affinity_name="primary", cluster_names=["primary"]),
+                ClusterAffinityTerm(affinity_name="backup", cluster_names=["backup"]),
+            ]
+        )
+        sched = TensorScheduler(ClusterSnapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=2,
+                            requests=REQ, gvk="apps/v1/Deployment")]
+        )
+        assert res.affinity_name == "primary" and res.clusters == {"primary": 2}
+
+
+class TestAssignmentStrategies:
+    def test_static_weight(self):
+        clusters = [new_cluster(n) for n in ("a", "b", "c")]
+        pl = static_weight_placement({"a": 3, "b": 2, "c": 1})
+        sched = TensorScheduler(ClusterSnapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=12,
+                            requests=REQ, gvk="apps/v1/Deployment")]
+        )
+        assert res.clusters == {"a": 6, "b": 4, "c": 2}
+
+    def test_dynamic_weight_proportional_to_capacity(self):
+        clusters = [
+            new_cluster("small", cpu="10", memory="20Gi", allocated={"cpu": 5}),
+            new_cluster("big", cpu="20", memory="40Gi", allocated={"cpu": 5}),
+        ]
+        sched = TensorScheduler(ClusterSnapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=dynamic_weight_placement(), replicas=10,
+                            requests=REQ, gvk="apps/v1/Deployment")]
+        )
+        # availability 5 vs 15 -> weights give 2 (floor 2.5) + remainder rules
+        assert sum(res.clusters.values()) == 10
+        assert res.clusters["big"] > res.clusters["small"]
+
+    def test_aggregated_packs_fewest(self):
+        clusters = [
+            new_cluster("a", cpu="6"),
+            new_cluster("b", cpu="30"),
+            new_cluster("c", cpu="10"),
+        ]
+        sched = TensorScheduler(ClusterSnapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=aggregated_placement(), replicas=8,
+                            requests=REQ, gvk="apps/v1/Deployment")]
+        )
+        assert res.clusters == {"b": 8}
+
+    def test_zero_replica_binding_selects_all(self):
+        clusters = [new_cluster("a"), new_cluster("b")]
+        sched = TensorScheduler(ClusterSnapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=duplicated_placement(), replicas=0,
+                            gvk="apps/v1/Deployment")]
+        )
+        assert res.success and res.clusters == {}
+        assert set(res.feasible) == {"a", "b"}
+
+    def test_unschedulable_reports_error(self):
+        clusters = [new_cluster("tiny", cpu="1")]
+        sched = TensorScheduler(ClusterSnapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=dynamic_weight_placement(), replicas=50,
+                            requests=REQ, gvk="apps/v1/Deployment")]
+        )
+        assert not res.success and "not enough" in res.error
+
+
+class TestSpreadConstraints:
+    def _regional_clusters(self):
+        return [
+            new_cluster("r1a", region="r1", zone="r1-z1", cpu="50"),
+            new_cluster("r1b", region="r1", zone="r1-z2", cpu="40"),
+            new_cluster("r2a", region="r2", zone="r2-z1", cpu="30"),
+            new_cluster("r3a", region="r3", zone="r3-z1", cpu="20"),
+        ]
+
+    def test_cluster_spread_max_groups(self):
+        pl = dynamic_weight_placement(
+            spread_constraints=[
+                SpreadConstraint(spread_by_field="cluster", min_groups=1, max_groups=2)
+            ]
+        )
+        sched = TensorScheduler(ClusterSnapshot(self._regional_clusters()))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=10,
+                            requests=REQ, gvk="apps/v1/Deployment")]
+        )
+        assert res.success and len(res.clusters) <= 2
+        assert sum(res.clusters.values()) == 10
+
+    def test_cluster_spread_min_groups_fit_error(self):
+        pl = dynamic_weight_placement(
+            spread_constraints=[
+                SpreadConstraint(spread_by_field="cluster", min_groups=9, max_groups=9)
+            ]
+        )
+        sched = TensorScheduler(ClusterSnapshot(self._regional_clusters()))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=2,
+                            requests=REQ, gvk="apps/v1/Deployment")]
+        )
+        assert not res.success
+
+    def test_region_spread(self):
+        pl = dynamic_weight_placement(
+            spread_constraints=[
+                SpreadConstraint(spread_by_field="region", min_groups=2, max_groups=2),
+                SpreadConstraint(spread_by_field="cluster", min_groups=2, max_groups=3),
+            ]
+        )
+        sched = TensorScheduler(ClusterSnapshot(self._regional_clusters()))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=12,
+                            requests=REQ, gvk="apps/v1/Deployment")]
+        )
+        assert res.success
+        regions = {n[:2] for n in res.clusters}
+        assert len(regions) == 2
+        assert sum(res.clusters.values()) == 12
+
+    def test_missing_region_field_filtered(self):
+        clusters = [
+            new_cluster("with-region", region="r1"),
+            new_cluster("no-region"),
+        ]
+        pl = duplicated_placement(
+            spread_constraints=[
+                SpreadConstraint(spread_by_field="region", min_groups=1, max_groups=1),
+                SpreadConstraint(spread_by_field="cluster", min_groups=1, max_groups=5),
+            ]
+        )
+        sched = TensorScheduler(ClusterSnapshot(clusters))
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=1, gvk="apps/v1/Deployment")]
+        )
+        assert set(res.clusters) == {"with-region"}
+
+
+class TestBatch:
+    def test_mixed_batch_matches_individual(self):
+        fleet = synthetic_fleet(40, seed=3)
+        snap = ClusterSnapshot(fleet)
+        placements = [
+            duplicated_placement(),
+            static_weight_placement({c.name: (i % 5) + 1 for i, c in enumerate(fleet[:10])}),
+            dynamic_weight_placement(),
+            aggregated_placement(),
+        ]
+        problems = [
+            BindingProblem(
+                key=f"b{i}",
+                placement=placements[i % 4],
+                replicas=(i % 7) + 1,
+                requests=REQ,
+                gvk="apps/v1/Deployment",
+                prev={fleet[i % 40].name: (i % 3)} if i % 2 else {},
+            )
+            for i in range(64)
+        ]
+        sched_batch = TensorScheduler(snap)
+        batch_results = sched_batch.schedule(problems)
+        for p, want in zip(problems, batch_results):
+            [got] = TensorScheduler(snap).schedule([p])
+            assert got.clusters == want.clusters, p.key
+            assert got.error == want.error, p.key
