@@ -1,0 +1,212 @@
+"""North-star benchmark: 100k bindings x 5k clusters replica division on TPU.
+
+Reproduces BASELINE.json config 5 ("descheduler rebalance storm: 100k
+bindings x 5k clusters, dynamic-weight division with taint/toleration
+filters"): every binding re-divides its replicas against live availability
+with previous placements credited (Steady semantics), exactly the
+generic_scheduler assignReplicas subtree this build tensorizes.
+
+Measurement protocol (BASELINE.md):
+- the TPU pass runs the fused schedule_step (estimator availability +
+  min-merge + unified division) over binding chunks; inputs are generated
+  on-device from a seed so the tunnel's host<->device bandwidth is not the
+  thing measured; per-chunk placement summaries are reduced on device.
+- placements are verified identical against the pure-Python oracle
+  (karmada_tpu.refimpl) on a sampled chunk.
+- the baseline is the oracle's per-binding cost measured on the sample and
+  scaled to the full population (the reference repo publishes no numbers;
+  BASELINE.md directs generating the baseline from the divider semantics).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+value = p50 wall seconds for the full 100k x 5k pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--bindings", type=int, default=100_000)
+    p.add_argument("--clusters", type=int, default=5_000)
+    p.add_argument("--chunk", type=int, default=4096)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--sample", type=int, default=512, help="oracle sample size")
+    p.add_argument("--cpu", action="store_true", help="force CPU jax (debug)")
+    p.add_argument("--dims", type=int, default=4)
+    return p
+
+
+def main():
+    args = build_parser().parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+
+    from karmada_tpu.parallel.solver import schedule_step
+    from karmada_tpu import refimpl as R
+
+    b_total, c, r = args.bindings, args.clusters, args.dims
+    chunk = args.chunk
+    n_chunks = (b_total + chunk - 1) // chunk
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform}:{dev.device_kind}", file=sys.stderr)
+
+    # ---- fleet capacity (one-time, represents the cluster snapshot) -------
+    key = jax.random.key(0)
+    kcap, kfeas = jax.random.split(key)
+    # heterogeneous capacity: cpu-milli, memory bytes, pods, storage
+    scales = jnp.asarray([512_000, 4 << 40, 5_500, 1 << 42], jnp.int64)[:r]
+    available_cap = (
+        jax.random.uniform(kcap, (c, r), minval=0.05, maxval=1.0)
+        * scales[None, :].astype(jnp.float32)
+    ).astype(jnp.int64)
+    has_summary = jnp.ones((c,), bool)
+    # taint/toleration filter outcome: ~8% of clusters tainted; ~30% of
+    # bindings tolerate (composed into the feasibility mask, as the engine
+    # does after bitset evaluation)
+    tainted = jax.random.uniform(kfeas, (c,)) < 0.08
+
+    @jax.jit
+    def gen_chunk(i):
+        k = jax.random.fold_in(jax.random.key(42), i)
+        k1, k2, k3, k4, k5, k6, k7 = jax.random.split(k, 7)
+        replicas = jax.random.randint(k1, (chunk,), 1, 100, dtype=jnp.int32)
+        # 8 request profiles (cpu-milli, bytes, pods, storage)
+        profiles = jnp.stack(
+            [
+                jnp.asarray([250, 1 << 29, 1, 1 << 30], jnp.int64)[:r] * (p + 1)
+                for p in range(8)
+            ]
+        )
+        prof_idx = jax.random.randint(k2, (chunk,), 0, 8)
+        requests = profiles[prof_idx]
+        tolerates = jax.random.uniform(k3, (chunk, 1)) < 0.30
+        candidates = ~tainted[None, :] | tolerates
+        # previous placements: ~70% of bindings hold replicas on ~4 clusters
+        has_prev = jax.random.uniform(k4, (chunk, 1)) < 0.7
+        prev_sites = jax.random.uniform(k5, (chunk, c)) < (4.0 / c)
+        prev = jnp.where(
+            has_prev & prev_sites & candidates,
+            jax.random.randint(k6, (chunk, c), 1, 30, dtype=jnp.int32),
+            0,
+        )
+        fresh = jax.random.uniform(k7, (chunk,)) < 0.05
+        strategy = jnp.full((chunk,), 2, jnp.int32)  # DynamicWeight
+        static_w = jnp.zeros((chunk, c), jnp.int32)
+        return requests, strategy, replicas, candidates, static_w, prev, fresh
+
+    @jax.jit
+    def solve_chunk(i):
+        requests, strategy, replicas, candidates, static_w, prev, fresh = gen_chunk(i)
+        res = schedule_step(
+            available_cap, has_summary, requests, strategy, replicas,
+            candidates, static_w, prev, fresh,
+        )
+        placed = (res.assignment > 0).sum(axis=1).astype(jnp.int32)
+        total = res.assignment.sum(axis=1).astype(jnp.int64)
+        return placed, total, res.unschedulable
+
+    # ---- timed passes -----------------------------------------------------
+    times = []
+    summary = None
+    for rep in range(args.repeats):
+        t0 = time.perf_counter()
+        outs = [solve_chunk(i) for i in range(n_chunks)]
+        jax.block_until_ready(outs)
+        t1 = time.perf_counter()
+        times.append(t1 - t0)
+        if rep == 0:
+            placed = np.concatenate([np.asarray(o[0]) for o in outs])[:b_total]
+            total = np.concatenate([np.asarray(o[1]) for o in outs])[:b_total]
+            unsched = np.concatenate([np.asarray(o[2]) for o in outs])[:b_total]
+            summary = (placed, total, unsched)
+        print(f"# pass {rep}: {t1 - t0:.3f}s", file=sys.stderr)
+    p50 = float(np.median(times))
+    placed, total, unsched = summary
+    print(
+        f"# scheduled {int((~unsched).sum())}/{b_total} bindings, "
+        f"mean clusters/binding {placed[~unsched].mean():.1f}",
+        file=sys.stderr,
+    )
+
+    # ---- identical-placement verification + baseline on a sample ----------
+    requests, strategy, replicas, candidates, static_w, prev, fresh = map(
+        np.asarray, gen_chunk(0)
+    )
+    res0 = schedule_step(
+        available_cap, has_summary, jnp.asarray(requests), jnp.asarray(strategy),
+        jnp.asarray(replicas), jnp.asarray(candidates), jnp.asarray(static_w),
+        jnp.asarray(prev), jnp.asarray(fresh),
+    )
+    kernel_assign = np.asarray(res0.assignment)
+    kernel_unsched = np.asarray(res0.unschedulable)
+    cap_np = np.asarray(available_cap)
+
+    sample = min(args.sample, chunk)
+    t0 = time.perf_counter()
+    mismatches = 0
+    for i in range(sample):
+        cand_idx = np.flatnonzero(candidates[i])
+        req = requests[i]
+        est = []
+        for j in cand_idx:
+            per_dim = [
+                max(int(cap_np[j, d]), 0) // int(req[d])
+                for d in range(r)
+                if req[d] > 0
+            ]
+            est.append(min(per_dim) if per_dim else R.MAX_INT32)
+        avail = R.merge_estimates(int(replicas[i]), [est], len(cand_idx))
+        prob = R.DivisionProblem(
+            replicas=int(replicas[i]),
+            strategy=R.DYNAMIC_WEIGHT,
+            candidates=cand_idx.tolist(),
+            available=avail,
+            prev={int(j): int(prev[i, j]) for j in np.flatnonzero(prev[i])} or None,
+            fresh=bool(fresh[i]),
+        )
+        try:
+            want = R.assign_replicas(prob)
+            want_row = np.zeros(c, np.int32)
+            for j, n_rep in want.items():
+                want_row[j] = n_rep
+            if kernel_unsched[i] or not np.array_equal(kernel_assign[i], want_row):
+                mismatches += 1
+        except R.UnschedulableError:
+            if not kernel_unsched[i]:
+                mismatches += 1
+    t_oracle = time.perf_counter() - t0
+    baseline_full = t_oracle / sample * b_total
+    print(
+        f"# identical-placement check: {sample - mismatches}/{sample} match; "
+        f"oracle {t_oracle / sample * 1e3:.2f} ms/binding -> "
+        f"{baseline_full:.1f}s extrapolated for {b_total}",
+        file=sys.stderr,
+    )
+    if mismatches:
+        print(f"# WARNING: {mismatches} placement mismatches", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"p50_schedule_{b_total // 1000}kx{c}_dynamic_weight",
+                "value": round(p50, 4),
+                "unit": "s",
+                "vs_baseline": round(baseline_full / p50, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Capacity estimators (ref: pkg/estimator)."""
+
+from .accurate import (  # noqa: F401
+    AccurateEstimator,
+    EstimatorRegistry,
+    NodeSnapshot,
+    NodeState,
+)

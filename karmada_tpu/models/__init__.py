@@ -1,0 +1,10 @@
+"""Cluster resource modeling: grade-bucket capacity estimation.
+
+Ref: pkg/modeling/modeling.go (node bucketing into resource-model grades) and
+the model-based estimation path of pkg/estimator/client/general.go:198-249.
+The reference walks grade buckets per cluster with a red-black tree; here the
+grade boundaries pack into ``[C, G, R]`` arrays and the whole fleet estimates
+in one batched kernel (karmada_tpu.models.estimate_by_models).
+"""
+
+from .modeling import ModelPack, estimate_by_models, pack_models  # noqa: F401

@@ -1,0 +1,125 @@
+"""Resource-model grade estimation as a batched tensor kernel.
+
+Semantics (general.go:195-249 + modeling.go):
+- each cluster declares G model grades; grade g covers nodes whose capacity
+  falls in [min, max) per resource; the cluster status reports how many
+  allocatable nodes sit in each grade (AllocatableModelings).
+- for a request, the minimum compliant grade per resource is the first grade
+  whose *min* boundary covers the request (a 1.5C request cannot trust the
+  [1C,2C) grade); the overall index is the max across requested resources;
+  no compliant grade for any resource -> 0 replicas.
+- every node of grade >= index contributes min over requested dims of
+  floor(grade_min / request) replicas, floored at 1 ("the first suitable
+  model can hold one pod", general.go:226-231).
+- a requested resource absent from the models entirely makes the model path
+  inapplicable (error -> fall back to the summary path; general.go:127-135).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api.cluster import Cluster
+
+
+@dataclass
+class ModelPack:
+    """Packed model grades for a fleet. G = max grades across clusters;
+    clusters with fewer grades pad with counts 0."""
+
+    min_bounds: np.ndarray  # int64[C, G, R]; -1 where grade/resource undefined
+    counts: np.ndarray  # int32[C, G] allocatable nodes per grade
+    has_models: np.ndarray  # bool[C]
+    covered: np.ndarray  # bool[C, R] resource present in the cluster's models
+
+
+def pack_models(clusters: Sequence[Cluster], dims: Sequence[str]) -> ModelPack:
+    c, r = len(clusters), len(dims)
+    g_max = max(
+        (len(cl.spec.resource_models) for cl in clusters), default=0
+    )
+    g_max = max(g_max, 1)
+    min_bounds = np.full((c, g_max, r), -1, np.int64)
+    counts = np.zeros((c, g_max), np.int32)
+    has_models = np.zeros(c, bool)
+    covered = np.zeros((c, r), bool)
+    dim_idx = {d: j for j, d in enumerate(dims)}
+    for i, cl in enumerate(clusters):
+        models = cl.spec.resource_models
+        modelings = cl.status.resource_summary.allocatable_modelings
+        if not models or not modelings:
+            continue
+        has_models[i] = True
+        count_by_grade = {m.grade: m.count for m in modelings}
+        for g, model in enumerate(sorted(models, key=lambda m: m.grade)):
+            counts[i, g] = count_by_grade.get(model.grade, 0)
+            for rng_ in model.ranges:
+                j = dim_idx.get(rng_.name)
+                if j is not None:
+                    min_bounds[i, g, j] = rng_.min
+                    covered[i, j] = True
+    return ModelPack(
+        min_bounds=min_bounds, counts=counts, has_models=has_models, covered=covered
+    )
+
+
+@jax.jit
+def estimate_by_models(
+    min_bounds: jnp.ndarray,  # int64[C, G, R]
+    counts: jnp.ndarray,  # int32[C, G]
+    covered: jnp.ndarray,  # bool[C, R]
+    requests: jnp.ndarray,  # int64[B, R]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (replicas int32[B, C], applicable bool[B, C]).
+
+    applicable=False means the model path cannot answer for that
+    (binding, cluster) — requested resource not covered — and the caller
+    falls back to the summary estimate.
+    """
+    c_n, g_n, r_n = min_bounds.shape
+    req = requests[:, None, None, :]  # [B,1,1,R]
+    is_req = req > 0
+    mb = min_bounds[None, :, :, :]  # [1,C,G,R]
+
+    # grade compliant per resource: min boundary >= request
+    compliant = (mb >= req) & (mb >= 0)  # [B,C,G,R]
+    # first compliant grade per resource (G if none)
+    first = jnp.where(
+        compliant.any(axis=2),
+        jnp.argmax(compliant, axis=2),
+        g_n,
+    )  # [B,C,R]
+    # overall minimum compliant index = max over requested dims (0 if no dims)
+    idx = jnp.max(jnp.where(is_req[:, :, 0, :], first, 0), axis=-1)  # [B,C]
+    no_grade = idx >= g_n  # some requested resource has no compliant grade
+
+    # per-grade per-node replicas: min over requested dims of mb // req, >= 1
+    safe_req = jnp.maximum(req, 1)
+    per_dim = jnp.where(mb >= 0, mb, 0) // safe_req  # [B,C,G,R]
+    per_node = jnp.min(
+        jnp.where(is_req, per_dim, jnp.int64(2**62)), axis=-1
+    )  # [B,C,G]
+    # degenerate all-zero request -> treat as one pod per node (the reference
+    # early-returns on nil requirements before reaching the model path)
+    per_node = jnp.where(per_node >= 2**62, 0, per_node)
+    per_node = jnp.maximum(per_node, 1)  # general.go:226-231
+
+    grade_ids = jnp.arange(g_n)[None, None, :]
+    usable = grade_ids >= idx[:, :, None]  # grades >= minimum compliant index
+    total = jnp.sum(
+        jnp.where(usable, counts[None, :, :].astype(jnp.int64) * per_node, 0),
+        axis=-1,
+    )
+    total = jnp.where(no_grade, 0, total)
+    total = jnp.minimum(total, jnp.int64(2**31 - 1)).astype(jnp.int32)
+
+    # applicability: every requested dim covered by the cluster's models
+    applicable = jnp.all(
+        jnp.where(is_req[:, :, 0, :], covered[None, :, :], True), axis=-1
+    )
+    return total, applicable

@@ -1,0 +1,3 @@
+"""Device-mesh sharding of the scheduling solver."""
+
+from .solver import default_mesh, make_sharded_step, schedule_step  # noqa: F401

@@ -1,0 +1,96 @@
+"""The fused scheduling step and its device-mesh sharding.
+
+``schedule_step`` is the flagship jitted program: estimator availability +
+min-merge + unified division in one XLA computation (the whole
+Algorithm.Schedule subtree of SURVEY.md section 3.1 minus host-side group
+search). Bindings are independent, so the batch axis shards like data
+parallelism; the cluster axis can shard like model parallelism when
+num_clusters x resource-dims outgrows a core (SURVEY.md section 5
+"long-context" analogue: the per-row sorts over a sharded cluster axis are
+where XLA inserts collectives).
+
+``make_sharded_step`` places inputs with NamedSharding over a
+``Mesh(axis_names=("b", "c"))`` and lets GSPMD partition: elementwise work
+stays local; the lexicographic sorts along the cluster axis induce
+all-gathers on the ``c`` axis only — exactly the collective structure the
+scaling-book recipe predicts for sort-limited kernels. With ``c`` unsharded
+(the default for <=5k clusters) the step runs with zero communication.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.divide import DivideResult, _divide_batch
+from ..ops.estimate import general_estimate, merge_estimates
+
+
+def _schedule_step(
+    available_cap: jnp.ndarray,  # int64[C, R] cluster capacity
+    has_summary: jnp.ndarray,  # bool[C]
+    requests: jnp.ndarray,  # int64[B, R]
+    strategy: jnp.ndarray,  # int32[B]
+    replicas: jnp.ndarray,  # int32[B]
+    candidates: jnp.ndarray,  # bool[B, C]
+    static_w: jnp.ndarray,  # int32[B, C]
+    prev: jnp.ndarray,  # int32[B, C]
+    fresh: jnp.ndarray,  # bool[B]
+) -> DivideResult:
+    general = general_estimate(available_cap, requests)
+    general = jnp.where(has_summary[None, :], general, jnp.int32(-1))
+    avail = merge_estimates(replicas, (general,))
+    out, unsched = _divide_batch(
+        strategy, replicas, candidates, static_w, avail, prev, fresh
+    )
+    return DivideResult(assignment=out, unschedulable=unsched)
+
+
+schedule_step = jax.jit(_schedule_step)
+
+
+def make_sharded_step(mesh: Mesh, *, shard_clusters: bool = False):
+    """jit ``schedule_step`` with bindings sharded over mesh axis ``b`` (and
+    optionally clusters over ``c``). Inputs may be numpy; placement happens
+    via in_shardings."""
+    c_ax = "c" if shard_clusters and "c" in mesh.axis_names else None
+    bc = P("b", c_ax)
+    row_b = P("b")
+    row_c = P(c_ax)
+    in_shardings = tuple(
+        NamedSharding(mesh, s)
+        for s in (
+            P(c_ax, None),  # available_cap[C, R]
+            row_c,  # has_summary[C]
+            P("b", None),  # requests[B, R]
+            row_b,  # strategy
+            row_b,  # replicas
+            bc,  # candidates
+            bc,  # static_w
+            bc,  # prev
+            row_b,  # fresh
+        )
+    )
+    out_shardings = DivideResult(
+        assignment=NamedSharding(mesh, bc),
+        unschedulable=NamedSharding(mesh, row_b),
+    )
+    return jax.jit(
+        _schedule_step, in_shardings=in_shardings, out_shardings=out_shardings
+    )
+
+
+def default_mesh(n_devices: int | None = None, *, cluster_axis: int = 1) -> Mesh:
+    """Mesh over the first n devices: ("b", "c") with the cluster axis sized
+    ``cluster_axis`` (1 = pure binding-parallel)."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    devs = devs[:n]
+    b = n // cluster_axis
+    import numpy as np
+
+    grid = np.array(devs).reshape(b, cluster_axis)
+    return Mesh(grid, axis_names=("b", "c"))

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from ..api.policy import Placement
 from ..ops.divide import divide_replicas
 from ..ops.estimate import general_estimate, merge_estimates
+from ..utils.features import CUSTOMIZED_CLUSTER_RESOURCE_MODELING, feature_gate
 from .snapshot import ClusterSnapshot, CompiledPlacement, compile_placement
 
 LOCALITY_SCORE = 100  # cluster_locality.go:43-56
@@ -217,6 +218,33 @@ class TensorScheduler:
         req = jnp.asarray(requests)
         reps = jnp.asarray(replicas)
         general = general_estimate(jnp.asarray(snap.available_cap), req)
+        mp = snap.model_pack
+        if feature_gate.enabled(CUSTOMIZED_CLUSTER_RESOURCE_MODELING) and mp.has_models.any():
+            # model path replaces the summary path where applicable, still
+            # capped by allowed pods (general.go:63-94,118-135)
+            from ..models import estimate_by_models
+
+            # the implicit pods dimension is the allowedPods cap, applied
+            # separately — models never declare it (general.go:96-114 vs
+            # :198-249), so it must not defeat model applicability
+            pods_dim = snap.dim_index("pods")
+            req_models = (
+                req.at[:, pods_dim].set(0) if pods_dim is not None else req
+            )
+            model_avail, applicable = estimate_by_models(
+                jnp.asarray(mp.min_bounds),
+                jnp.asarray(mp.counts),
+                jnp.asarray(mp.covered),
+                req_models,
+            )
+            if pods_dim is not None:
+                allowed_pods = jnp.minimum(
+                    jnp.maximum(jnp.asarray(snap.available_cap[:, pods_dim]), 0),
+                    2**31 - 1,
+                ).astype(jnp.int32)
+                model_avail = jnp.minimum(model_avail, allowed_pods[None, :])
+            use_model = jnp.asarray(mp.has_models)[None, :] & applicable
+            general = jnp.where(use_model, model_avail, general)
         # clusters with no ResourceSummary give no answer (UnauthenticReplica)
         general = jnp.where(
             jnp.asarray(snap.has_summary)[None, :], general, jnp.int32(-1)

@@ -154,6 +154,11 @@ class ClusterSnapshot:
                     - rs_.allocating.get(d, 0)
                 )
 
+        # --- resource-model grades (CustomizedClusterResourceModeling) ---
+        from ..models import pack_models
+
+        self.model_pack = pack_models(self.clusters, self.dims)
+
     @property
     def num_clusters(self) -> int:
         return len(self.clusters)

@@ -1,0 +1,192 @@
+"""Estimator tests: resource-model grades, node-level accurate estimation,
+min-merge into the scheduler (ref test strategy: estimator server/client unit
+tables)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from karmada_tpu.api import (
+    AllocatableModeling,
+    ResourceModel,
+    ResourceModelRange,
+    Taint,
+)
+from karmada_tpu.api.work import NodeClaim, ReplicaRequirements
+from karmada_tpu.estimator import (
+    AccurateEstimator,
+    EstimatorRegistry,
+    NodeSnapshot,
+    NodeState,
+)
+from karmada_tpu.models import estimate_by_models, pack_models
+from karmada_tpu.scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+from karmada_tpu.utils.builders import dynamic_weight_placement, new_cluster
+from karmada_tpu.utils.quantity import parse_resource_list
+
+DIMS = ["cpu", "memory", "pods", "ephemeral-storage"]
+
+
+def make_model_cluster(name, grades, counts, **kw):
+    """grades: list of (cpu_min_milli, mem_min_bytes)."""
+    models = [
+        ResourceModel(
+            grade=g,
+            ranges=[
+                ResourceModelRange(name="cpu", min=cpu, max=cpu * 2),
+                ResourceModelRange(name="memory", min=mem, max=mem * 2),
+            ],
+        )
+        for g, (cpu, mem) in enumerate(grades)
+    ]
+    cl = new_cluster(name, **kw)
+    cl.spec.resource_models = models
+    cl.status.resource_summary.allocatable_modelings = [
+        AllocatableModeling(grade=g, count=n) for g, n in enumerate(counts)
+    ]
+    return cl
+
+
+class TestModelEstimate:
+    def test_grade_walk(self):
+        # grades: [1C,2C) x [4Gi,8Gi), [2C,4C) x [8Gi,16Gi); counts 3, 2
+        cl = make_model_cluster(
+            "m", [(1000, 4 << 30), (2000, 8 << 30)], [3, 2]
+        )
+        pack = pack_models([cl], DIMS)
+        req = np.zeros((1, len(DIMS)), np.int64)
+        req[0, 0] = 1500  # 1.5C -> grade0 min (1C) not compliant -> grade1
+        req[0, 1] = 1 << 30
+        got, applicable = estimate_by_models(
+            jnp.asarray(pack.min_bounds),
+            jnp.asarray(pack.counts),
+            jnp.asarray(pack.covered),
+            jnp.asarray(req),
+        )
+        # grade1 per-node: min(2000//1500, 8Gi//1Gi) = 1 -> 2 nodes * 1
+        assert int(got[0, 0]) == 2 and bool(applicable[0, 0])
+
+    def test_small_request_uses_all_grades(self):
+        cl = make_model_cluster("m", [(1000, 4 << 30), (2000, 8 << 30)], [3, 2])
+        pack = pack_models([cl], DIMS)
+        req = np.zeros((1, len(DIMS)), np.int64)
+        req[0, 0] = 500  # grade0 compliant: 3*(1000//500=2) + 2*(2000//500=4)
+        got, _ = estimate_by_models(
+            jnp.asarray(pack.min_bounds), jnp.asarray(pack.counts),
+            jnp.asarray(pack.covered), jnp.asarray(req),
+        )
+        assert int(got[0, 0]) == 3 * 2 + 2 * 4
+
+    def test_no_compliant_grade(self):
+        cl = make_model_cluster("m", [(1000, 4 << 30)], [5])
+        pack = pack_models([cl], DIMS)
+        req = np.zeros((1, len(DIMS)), np.int64)
+        req[0, 0] = 99_000  # bigger than any grade min
+        got, applicable = estimate_by_models(
+            jnp.asarray(pack.min_bounds), jnp.asarray(pack.counts),
+            jnp.asarray(pack.covered), jnp.asarray(req),
+        )
+        assert int(got[0, 0]) == 0 and bool(applicable[0, 0])
+
+    def test_uncovered_resource_not_applicable(self):
+        cl = make_model_cluster("m", [(1000, 4 << 30)], [5])
+        pack = pack_models([cl], DIMS)
+        req = np.zeros((1, len(DIMS)), np.int64)
+        req[0, 3] = 1 << 30  # ephemeral-storage not in models
+        _, applicable = estimate_by_models(
+            jnp.asarray(pack.min_bounds), jnp.asarray(pack.counts),
+            jnp.asarray(pack.covered), jnp.asarray(req),
+        )
+        assert not bool(applicable[0, 0])
+
+    def test_scheduler_uses_model_path(self):
+        # summary says huge capacity; models say only 2 replicas fit
+        cl = make_model_cluster(
+            "modeled", [(1000, 4 << 30)], [2], cpu="1000", memory="4000Gi"
+        )
+        plain = new_cluster("plain", cpu="1000", memory="4000Gi")
+        sched = TensorScheduler(ClusterSnapshot([cl, plain]))
+        [res] = sched.schedule(
+            [
+                BindingProblem(
+                    key="b",
+                    placement=dynamic_weight_placement(),
+                    replicas=10,
+                    requests=parse_resource_list({"cpu": "1", "memory": "4Gi"}),
+                    gvk="apps/v1/Deployment",
+                )
+            ]
+        )
+        # modeled cluster capped at 2 by grades, plain takes the rest by weight
+        assert res.clusters.get("modeled", 0) <= 3
+        assert sum(res.clusters.values()) == 10
+
+
+class TestAccurateEstimator:
+    def _nodes(self):
+        alloc = parse_resource_list({"cpu": "8", "memory": "32Gi", "pods": 110})
+        return [
+            NodeState(
+                name=f"n{i}",
+                allocatable=dict(alloc),
+                requested=parse_resource_list({"cpu": "2", "memory": "8Gi"}),
+                labels={"zone": f"z{i % 2}"},
+                num_pods=10,
+            )
+            for i in range(4)
+        ]
+
+    def test_node_sum(self):
+        est = AccurateEstimator("m1", NodeSnapshot(self._nodes(), DIMS))
+        req = np.zeros((1, len(DIMS)), np.int64)
+        req[0, 0] = 2000  # 2C -> per node min((8-2)/2=3, pods 100) = 3
+        req[0, 2] = 1
+        got = est.max_available_replicas(None, req)
+        assert got.tolist() == [12]
+
+    def test_node_selector_prefilter(self):
+        est = AccurateEstimator("m1", NodeSnapshot(self._nodes(), DIMS))
+        reqs = ReplicaRequirements(
+            resource_request=parse_resource_list({"cpu": "2"}),
+            node_claim=NodeClaim(node_selector={"zone": "z0"}),
+        )
+        req = np.zeros((1, len(DIMS)), np.int64)
+        req[0, 0] = 2000
+        got = est.max_available_replicas(reqs, req)
+        assert got.tolist() == [6]  # only 2 of 4 nodes match
+
+    def test_node_taint_prefilter(self):
+        nodes = self._nodes()
+        nodes[0].taints = [Taint(key="gpu", value="true", effect="NoSchedule")]
+        est = AccurateEstimator("m1", NodeSnapshot(nodes, DIMS))
+        reqs = ReplicaRequirements(node_claim=NodeClaim(node_selector={}))
+        req = np.zeros((1, len(DIMS)), np.int64)
+        req[0, 0] = 2000
+        got = est.max_available_replicas(reqs, req)
+        assert got.tolist() == [9]  # tainted node excluded
+
+    def test_registry_min_merges_into_scheduler(self):
+        clusters = [new_cluster("m1", cpu="1000"), new_cluster("m2", cpu="1000")]
+        snap = ClusterSnapshot(clusters)
+        reg = EstimatorRegistry()
+        # accurate estimator for m1 says only 3 replicas fit
+        tiny = NodeState(
+            name="n0",
+            allocatable=parse_resource_list({"cpu": "3", "memory": "64Gi", "pods": 50}),
+        )
+        reg.register(AccurateEstimator("m1", NodeSnapshot([tiny], snap.dims)))
+        sched = TensorScheduler(
+            snap, extra_estimators=[reg.make_batch_estimator(snap.names)]
+        )
+        [res] = sched.schedule(
+            [
+                BindingProblem(
+                    key="b",
+                    placement=dynamic_weight_placement(),
+                    replicas=10,
+                    requests=parse_resource_list({"cpu": "1"}),
+                    gvk="apps/v1/Deployment",
+                )
+            ]
+        )
+        assert res.clusters.get("m1", 0) <= 3
+        assert sum(res.clusters.values()) == 10
