@@ -1,0 +1,24 @@
+"""Control-plane reconcilers (ref: pkg/controllers, pkg/detector,
+pkg/descheduler)."""
+
+from .cluster import (  # noqa: F401
+    ClusterController,
+    ClusterStatusController,
+    TaintManager,
+    evict_binding,
+)
+from .detector import ResourceDetector, binding_name  # noqa: F401
+from .failover import (  # noqa: F401
+    ApplicationFailoverController,
+    Descheduler,
+    GracefulEvictionController,
+)
+from .overridemanager import OverrideManager  # noqa: F401
+from .propagation import (  # noqa: F401
+    BindingController,
+    BindingStatusController,
+    ExecutionController,
+    WorkStatusController,
+    execution_namespace,
+)
+from .scheduler_controller import SchedulerController  # noqa: F401

@@ -1,0 +1,221 @@
+"""ResourceDetector: template -> policy match -> ResourceBinding.
+
+Ref: pkg/detector/detector.go — event-driven discovery of resource
+templates, policy matching with priority + preemption (policy.go,
+preemption.go), claiming (claim.go), and ResourceBinding construction with
+interpreter-provided replicas (BuildResourceBinding, detector.go:710-752).
+Policy add/update/delete re-binds claimed templates (detector.go:851-1360).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..api.core import Resource
+from ..api.policy import (
+    ClusterPropagationPolicy,
+    PropagationPolicy,
+    ResourceSelector,
+)
+from ..api.work import ResourceBinding, ResourceBindingSpec
+from ..api.core import ObjectMeta
+from ..interpreter import ResourceInterpreter
+from ..utils import DONE, Runtime, Store
+from ..utils.features import POLICY_PREEMPTION, feature_gate
+from .overridemanager import resource_matches_selector
+
+# claim labels (ref: policy permanent-ID labels, claim.go)
+POLICY_LABEL = "propagationpolicy.karmada.io/name"
+POLICY_NS_LABEL = "propagationpolicy.karmada.io/namespace"
+CLUSTER_POLICY_LABEL = "clusterpropagationpolicy.karmada.io/name"
+
+
+def binding_name(template: Resource) -> str:
+    return f"{template.meta.name}-{template.kind.lower()}"
+
+
+def policy_matches(template: Resource, selectors: list[ResourceSelector]) -> bool:
+    return any(resource_matches_selector(template, s) for s in selectors)
+
+
+def _policy_priority(policy, template: Resource) -> tuple:
+    """Implicit priority (ref: policy.go getHighestPriorityPropagationPolicy):
+    explicit spec.priority first; for ties, name-selector matches outrank
+    selector-only matches; final tiebreak alphabetical (oldest-wins is
+    approximated by name for determinism)."""
+    by_name = any(
+        s.name == template.meta.name and (not s.kind or s.kind == template.kind)
+        for s in policy.spec.resource_selectors
+    )
+    return (-policy.spec.priority, 0 if by_name else 1, policy.meta.name)
+
+
+class ResourceDetector:
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        interpreter: ResourceInterpreter,
+    ) -> None:
+        self.store = store
+        self.interpreter = interpreter
+        self.worker = runtime.new_worker("detector", self._reconcile)
+        store.watch("Resource", self._on_template_event)
+        store.watch("PropagationPolicy", self._on_policy_event)
+        store.watch("ClusterPropagationPolicy", self._on_policy_event)
+
+    # -- events ------------------------------------------------------------
+
+    def _on_template_event(self, event) -> None:
+        self.worker.enqueue(event.key)
+
+    def _on_policy_event(self, event) -> None:
+        # policy changes re-evaluate every template (conservative requeue;
+        # the reference scopes by selector — optimization left with a marker)
+        for template in self.store.list("Resource"):
+            self.worker.enqueue(template.meta.namespaced_name)
+
+    # -- reconcile ---------------------------------------------------------
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        template = self.store.get("Resource", key)
+        if template is None:
+            self._remove_binding_for(key)
+            return DONE
+        policy = self._match_policy(template)
+        if policy is None:
+            self._unclaim(template)
+            return DONE
+        self._claim(template, policy)
+        self._ensure_binding(template, policy)
+        return DONE
+
+    def _match_policy(self, template: Resource):
+        """Priority + preemption matching. Namespaced policies outrank
+        cluster-scoped ones for namespaced resources (detector.go ordering:
+        PropagationPolicy first, then ClusterPropagationPolicy)."""
+        claimed_by = template.meta.labels.get(POLICY_LABEL)
+        candidates = [
+            p
+            for p in self.store.list("PropagationPolicy", template.meta.namespace or None)
+            if p.meta.namespace == template.meta.namespace
+            and policy_matches(template, p.spec.resource_selectors)
+        ]
+        pool = sorted(candidates, key=lambda p: _policy_priority(p, template))
+        if not pool:
+            cluster_pool = sorted(
+                (
+                    p
+                    for p in self.store.list("ClusterPropagationPolicy")
+                    if policy_matches(template, p.spec.resource_selectors)
+                ),
+                key=lambda p: _policy_priority(p, template),
+            )
+            pool = cluster_pool
+        if not pool:
+            return None
+        best = pool[0]
+        if claimed_by and claimed_by != best.meta.name:
+            if not feature_gate.enabled(POLICY_PREEMPTION):
+                # keep the existing claim unless it vanished
+                current = next((p for p in pool if p.meta.name == claimed_by), None)
+                if current is not None:
+                    return current
+        return best
+
+    def _claim(self, template: Resource, policy) -> None:
+        labels = template.meta.labels
+        if isinstance(policy, ClusterPropagationPolicy) or policy.cluster_scoped:
+            changed = labels.get(CLUSTER_POLICY_LABEL) != policy.meta.name
+            labels[CLUSTER_POLICY_LABEL] = policy.meta.name
+            labels.pop(POLICY_LABEL, None)
+            labels.pop(POLICY_NS_LABEL, None)
+        else:
+            changed = labels.get(POLICY_LABEL) != policy.meta.name
+            labels[POLICY_LABEL] = policy.meta.name
+            labels[POLICY_NS_LABEL] = policy.meta.namespace
+            labels.pop(CLUSTER_POLICY_LABEL, None)
+        if changed:
+            self.store.apply(template)
+
+    def _unclaim(self, template: Resource) -> None:
+        labels = template.meta.labels
+        had = (
+            labels.pop(POLICY_LABEL, None) is not None
+            or labels.pop(CLUSTER_POLICY_LABEL, None) is not None
+        )
+        labels.pop(POLICY_NS_LABEL, None)
+        if had:
+            self.store.apply(template)
+            self._remove_binding_for(template.meta.namespaced_name)
+
+    def _ensure_binding(self, template: Resource, policy) -> None:
+        """BuildResourceBinding (detector.go:710-752)."""
+        replicas, requirements = self.interpreter.get_replicas(template)
+        name = binding_name(template)
+        key = (
+            f"{template.meta.namespace}/{name}" if template.meta.namespace else name
+        )
+        existing = self.store.get("ResourceBinding", key)
+        spec = ResourceBindingSpec(
+            resource=template.object_reference(),
+            replicas=replicas,
+            replica_requirements=requirements,
+            placement=policy.spec.placement,
+            conflict_resolution=policy.spec.conflict_resolution,
+            propagate_deps=policy.spec.propagate_deps,
+            suspend_dispatching=policy.spec.suspend_dispatching,
+            preserve_resources_on_deletion=policy.spec.preserve_resources_on_deletion,
+            failover=policy.spec.failover,
+            scheduler_name=policy.spec.scheduler_name,
+        )
+        if existing is not None:
+            # preserve schedule state; bump generation when the scheduling-
+            # relevant spec changed (placement or replicas)
+            spec.clusters = existing.spec.clusters
+            spec.graceful_eviction_tasks = existing.spec.graceful_eviction_tasks
+            spec.reschedule_triggered_at = existing.spec.reschedule_triggered_at
+            changed = (
+                existing.spec.placement != spec.placement
+                or existing.spec.replicas != spec.replicas
+                or existing.spec.replica_requirements != spec.replica_requirements
+            )
+            existing.spec = spec
+            if changed:
+                existing.meta.generation += 1
+            self.store.apply(existing)
+        else:
+            rb = ResourceBinding(
+                meta=ObjectMeta(
+                    name=name,
+                    namespace=template.meta.namespace,
+                    labels={
+                        POLICY_LABEL: policy.meta.name,
+                    },
+                ),
+                spec=spec,
+            )
+            self.store.apply(rb)
+
+    def _remove_binding_for(self, template_key: str) -> None:
+        ns, _, name = template_key.rpartition("/")
+        for rb in self.store.list("ResourceBinding"):
+            if (
+                rb.spec.resource.namespaced_key == template_key
+                or (rb.meta.namespace == ns and rb.spec.resource.name == name)
+            ):
+                self.store.delete("ResourceBinding", rb.meta.namespaced_name)
+
+    def write_back_status(self, binding: ResourceBinding) -> None:
+        """Detector also writes aggregated status back onto the template
+        (detector.go status sync)."""
+        template = self.store.get("Resource", binding.spec.resource.namespaced_key)
+        if template is None:
+            return
+        updated = self.interpreter.aggregate_status(
+            template, binding.status.aggregated_status
+        )
+        if updated.status != template.status:
+            template.status = updated.status
+            self.store.apply(template)

@@ -1,0 +1,200 @@
+"""Override manager: applies (Cluster)OverridePolicies to per-cluster copies.
+
+Ref: pkg/util/overridemanager (987 LoC): plaintext JSONPatch overriders plus
+image/command/args/labels/annotations shorthands, rule-per-target-cluster,
+cluster-scoped policies applied before namespaced ones, each sorted by name
+(overridemanager.go applyRules ordering).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Optional, Sequence
+
+from ..api.cluster import Cluster
+from ..api.core import Resource
+from ..api.policy import (
+    ClusterOverridePolicy,
+    OverridePolicy,
+    Overriders,
+    ResourceSelector,
+)
+
+
+def resource_matches_selector(obj: Resource, sel: ResourceSelector) -> bool:
+    if sel.api_version and sel.api_version != obj.api_version:
+        return False
+    if sel.kind and sel.kind != obj.kind:
+        return False
+    if sel.namespace and sel.namespace != obj.meta.namespace:
+        return False
+    if sel.name and sel.name != obj.meta.name:
+        return False
+    if sel.label_selector is not None and not sel.label_selector.matches(
+        obj.meta.labels
+    ):
+        return False
+    return True
+
+
+def resource_matches_selectors(obj: Resource, selectors: Sequence[ResourceSelector]) -> bool:
+    return any(resource_matches_selector(obj, s) for s in selectors)
+
+
+# --- JSONPatch-style path ops ------------------------------------------------
+
+
+def _resolve_parent(root: Any, path: str) -> tuple[Any, str]:
+    parts = [p for p in path.strip("/").split("/") if p != ""]
+    if not parts:
+        raise ValueError(f"empty override path {path!r}")
+    node = root
+    for p in parts[:-1]:
+        if isinstance(node, list):
+            node = node[int(p)]
+        else:
+            node = node.setdefault(p, {})
+    return node, parts[-1]
+
+
+def apply_json_patch(doc: dict, op: str, path: str, value: Any) -> None:
+    parent, leaf = _resolve_parent(doc, path)
+    if isinstance(parent, list):
+        idx = int(leaf) if leaf != "-" else len(parent)
+        if op == "add":
+            parent.insert(idx, value)
+        elif op == "replace":
+            parent[idx] = value
+        elif op == "remove":
+            del parent[idx]
+        else:
+            raise ValueError(f"unknown op {op}")
+    else:
+        if op in ("add", "replace"):
+            parent[leaf] = value
+        elif op == "remove":
+            parent.pop(leaf, None)
+        else:
+            raise ValueError(f"unknown op {op}")
+
+
+def _split_image(image: str) -> tuple[str, str, str]:
+    """image -> (registry, repository, tag/digest)."""
+    tag = ""
+    rest = image
+    if "@" in image:
+        rest, tag = image.split("@", 1)
+        tag = "@" + tag
+    elif ":" in image.rsplit("/", 1)[-1]:
+        rest, t = image.rsplit(":", 1)
+        tag = ":" + t
+    if "/" in rest:
+        first, remainder = rest.split("/", 1)
+        if "." in first or ":" in first or first == "localhost":
+            return first, remainder, tag
+    return "", rest, tag
+
+
+def _join_image(registry: str, repo: str, tag: str) -> str:
+    head = f"{registry}/{repo}" if registry else repo
+    return head + tag
+
+
+def apply_overriders(obj: Resource, overriders: Overriders) -> None:
+    for po in overriders.plaintext:
+        doc = {"spec": obj.spec, "metadata": {"labels": obj.meta.labels,
+                                              "annotations": obj.meta.annotations}}
+        apply_json_patch(doc, po.operator, po.path, po.value)
+    for io in overriders.image_overrider:
+        containers = obj.spec.get("template", {}).get("spec", {}).get("containers", [])
+        if obj.kind == "Pod":
+            containers = obj.spec.get("containers", [])
+        for ctr in containers:
+            image = ctr.get("image", "")
+            if not image:
+                continue
+            registry, repo, tag = _split_image(image)
+            if io.component == "Registry":
+                registry = _edit(registry, io.operator, io.value)
+            elif io.component == "Repository":
+                repo = _edit(repo, io.operator, io.value)
+            elif io.component == "Tag":
+                new = _edit(tag.lstrip(":@"), io.operator, io.value)
+                tag = f":{new}" if new else ""
+            ctr["image"] = _join_image(registry, repo, tag)
+    for co in overriders.command_overrider:
+        _edit_container_list(obj, co.container_name, "command", co.operator, co.value)
+    for ao in overriders.args_overrider:
+        _edit_container_list(obj, ao.container_name, "args", ao.operator, ao.value)
+    for lo in overriders.labels_overrider:
+        _apply_map_overrider(obj.meta.labels, lo.operator, lo.value)
+    for ano in overriders.annotations_overrider:
+        _apply_map_overrider(obj.meta.annotations, ano.operator, ano.value)
+
+
+def _edit(current: str, op: str, value: str) -> str:
+    if op == "replace":
+        return value
+    if op == "add":
+        return current + value
+    if op == "remove":
+        return ""
+    raise ValueError(f"unknown image op {op}")
+
+
+def _edit_container_list(
+    obj: Resource, container_name: str, field: str, op: str, value: list[str]
+) -> None:
+    pod_spec = obj.spec if obj.kind == "Pod" else obj.spec.get("template", {}).get(
+        "spec", {}
+    )
+    for ctr in pod_spec.get("containers", []):
+        if container_name and ctr.get("name") != container_name:
+            continue
+        current = list(ctr.get(field, []))
+        if op == "add":
+            current.extend(value)
+        elif op == "remove":
+            current = [v for v in current if v not in set(value)]
+        ctr[field] = current
+
+
+def _apply_map_overrider(target: dict[str, str], op: str, value: dict[str, str]) -> None:
+    if op in ("add", "replace"):
+        target.update(value)
+    elif op == "remove":
+        for k in value:
+            target.pop(k, None)
+
+
+class OverrideManager:
+    """Applies matching override policies for a (resource, cluster) pair.
+    ClusterOverridePolicies first, then namespace-scoped, each name-sorted
+    (overridemanager.go ApplyOverridePolicies)."""
+
+    def __init__(self, store) -> None:
+        self.store = store
+
+    def apply_overrides(self, obj: Resource, cluster: Cluster) -> Resource:
+        out = copy.deepcopy(obj)
+        cops = sorted(
+            self.store.list("ClusterOverridePolicy"), key=lambda p: p.meta.name
+        )
+        ops = sorted(
+            (
+                p
+                for p in self.store.list("OverridePolicy")
+                if p.meta.namespace == obj.meta.namespace
+            ),
+            key=lambda p: p.meta.name,
+        )
+        for policy in list(cops) + list(ops):
+            if not resource_matches_selectors(out, policy.spec.resource_selectors):
+                continue
+            for rule in policy.spec.override_rules:
+                if rule.target_cluster is not None and not rule.target_cluster.matches(
+                    cluster
+                ):
+                    continue
+                apply_overriders(out, rule.overriders)
+        return out

@@ -1,0 +1,383 @@
+"""Propagation controllers: binding -> Work -> member cluster -> status back.
+
+Ref:
+- binding-controller (pkg/controllers/binding/): ensureWork — ReviseReplica
+  for divided placements, override application, suspend/preserve flags,
+  orphan-Work cleanup (binding_controller.go:70-165, common.go:43-143).
+- execution-controller (pkg/controllers/execution/): Work -> member apply /
+  delete via objectwatcher, Applied condition.
+- work-status-controller (pkg/controllers/status/work_status_controller.go):
+  per-member informers reflect member object status+health into
+  Work.Status.ManifestStatuses; recreates deleted-but-desired objects.
+- binding-status controllers (status/rb_status_controller.go): aggregate
+  manifest statuses into ResourceBinding.Status.AggregatedStatus via the
+  interpreter, then the detector writes template status.
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+from typing import Optional
+
+from ..api.core import Condition, ObjectMeta, Resource, set_condition
+from ..api.work import (
+    FULLY_APPLIED,
+    WORK_APPLIED,
+    AggregatedStatusItem,
+    ManifestStatus,
+    ResourceBinding,
+    Work,
+    WorkSpec,
+)
+from ..api.policy import DIVIDED
+from ..interpreter import ResourceInterpreter
+from ..utils import DONE, REQUEUE, Runtime, Store
+from ..utils.member import MemberClientRegistry, MemberEvent, ObjectWatcher, UnreachableError
+from .overridemanager import OverrideManager
+
+ES_PREFIX = "karmada-es-"
+WORK_BINDING_LABEL = "resourcebinding.karmada.io/key"
+
+
+def execution_namespace(cluster: str) -> str:
+    return f"{ES_PREFIX}{cluster}"
+
+
+def cluster_of_execution_namespace(ns: str) -> Optional[str]:
+    return ns[len(ES_PREFIX):] if ns.startswith(ES_PREFIX) else None
+
+
+def _work_signature(work: Work):
+    w = work.spec.workload[0] if work.spec.workload else None
+    return (
+        w.spec if w else None,
+        w.meta.labels if w else None,
+        work.spec.suspend_dispatching,
+        work.spec.preserve_resources_on_deletion,
+    )
+
+
+class BindingController:
+    """ResourceBinding -> per-target-cluster Work objects."""
+
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        interpreter: ResourceInterpreter,
+    ) -> None:
+        self.store = store
+        self.interpreter = interpreter
+        self.overrides = OverrideManager(store)
+        self.worker = runtime.new_worker("binding", self._reconcile)
+        store.watch("ResourceBinding", lambda e: self.worker.enqueue(e.key))
+        store.watch("OverridePolicy", self._requeue_all)
+        store.watch("ClusterOverridePolicy", self._requeue_all)
+
+    def _requeue_all(self, _event) -> None:
+        for rb in self.store.list("ResourceBinding"):
+            self.worker.enqueue(rb.meta.namespaced_name)
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        rb = self.store.get("ResourceBinding", key)
+        if rb is None:
+            self._cleanup_works(key, keep_clusters=set())
+            return DONE
+        template = self.store.get("Resource", rb.spec.resource.namespaced_key)
+        if template is None:
+            return DONE
+        # target set: scheduled clusters + clusters still draining eviction
+        # tasks (their Works must survive until eviction completes,
+        # binding_controller.go:145-165)
+        targets = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        evicting = {t.from_cluster for t in rb.spec.graceful_eviction_tasks}
+        required = {
+            s.namespace + "/" + s.name if s.namespace else s.name: s.clusters
+            for s in rb.spec.required_by
+        }
+        divided = (
+            rb.spec.placement is not None
+            and rb.spec.placement.replica_scheduling_type() == DIVIDED
+        )
+        for cluster_name, replicas in targets.items():
+            workload = copy.deepcopy(template)
+            if divided and rb.spec.replicas > 0:
+                workload = self.interpreter.revise_replica(workload, replicas)
+                # Job completions division (binding/common.go:287-299)
+                if workload.kind == "Job" and "completions" in workload.spec:
+                    total = int(workload.spec["completions"])
+                    workload.spec["completions"] = math.ceil(
+                        total * replicas / max(rb.spec.replicas, 1)
+                    )
+            cluster_obj = self.store.get("Cluster", cluster_name)
+            if cluster_obj is not None:
+                workload = self.overrides.apply_overrides(workload, cluster_obj)
+            self._create_or_update_work(rb, cluster_name, workload)
+        self._cleanup_works(key, keep_clusters=set(targets) | evicting)
+        return DONE
+
+    def _create_or_update_work(
+        self, rb: ResourceBinding, cluster: str, workload: Resource
+    ) -> None:
+        ns = execution_namespace(cluster)
+        name = f"{rb.meta.namespace + '.' if rb.meta.namespace else ''}{rb.meta.name}"
+        key = f"{ns}/{name}"
+        existing = self.store.get("Work", key)
+        if existing is not None and _work_signature(existing) == (
+            workload.spec,
+            workload.meta.labels,
+            rb.spec.suspend_dispatching,
+            rb.spec.preserve_resources_on_deletion,
+        ):
+            return  # no semantic change — avoid churn (idempotent reconcile)
+        work = existing or Work(meta=ObjectMeta(name=name, namespace=ns))
+        work.meta.labels[WORK_BINDING_LABEL] = rb.meta.namespaced_name
+        work.spec = WorkSpec(
+            workload=[workload],
+            suspend_dispatching=rb.spec.suspend_dispatching,
+            preserve_resources_on_deletion=rb.spec.preserve_resources_on_deletion,
+        )
+        self.store.apply(work)
+
+    def _cleanup_works(self, binding_key: str, keep_clusters: set[str]) -> None:
+        for work in self.store.list("Work"):
+            if work.meta.labels.get(WORK_BINDING_LABEL) != binding_key:
+                continue
+            cluster = cluster_of_execution_namespace(work.meta.namespace)
+            if cluster not in keep_clusters:
+                self.store.delete("Work", work.meta.namespaced_name)
+
+
+class ExecutionController:
+    """Work -> member cluster apply/delete (pkg/controllers/execution/)."""
+
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        members: MemberClientRegistry,
+        interpreter: ResourceInterpreter,
+    ) -> None:
+        self.store = store
+        self.members = members
+        self.watcher = ObjectWatcher(members, interpreter)
+        self.worker = runtime.new_worker("execution", self._reconcile)
+        store.watch("Work", self._on_work_event)
+
+    def _on_work_event(self, event) -> None:
+        if event.type == "Deleted":
+            # the Work is gone from the store; carry what we need to delete
+            # the propagated objects (honoring PreserveResourcesOnDeletion,
+            # execution_controller.go:229-257)
+            work: Work = event.obj
+            cluster = cluster_of_execution_namespace(work.meta.namespace)
+            if cluster is None or work.spec.preserve_resources_on_deletion:
+                return
+            targets = tuple(
+                (f"{w.api_version}/{w.kind}", w.meta.namespace, w.meta.name)
+                for w in work.spec.workload
+            )
+            self.worker.enqueue(("delete", cluster, targets))
+        else:
+            self.worker.enqueue(("apply", event.key, None))
+
+    def _reconcile(self, item) -> Optional[str]:
+        action, key_or_cluster, targets = item
+        if action == "delete":
+            for gvk, ns, name in targets:
+                try:
+                    self.watcher.delete(key_or_cluster, gvk, ns, name)
+                except UnreachableError:
+                    return REQUEUE
+            return DONE
+        key = key_or_cluster
+        work = self.store.get("Work", key)
+        cluster = cluster_of_execution_namespace(key.split("/", 1)[0])
+        if work is None or cluster is None:
+            return DONE
+        if work.spec.suspend_dispatching:
+            if set_condition(
+                work.status.conditions,
+                Condition(
+                    type="Dispatching", status=False, reason="SuspendDispatching"
+                ),
+            ):
+                self.store.apply(work)
+            return DONE
+        try:
+            for workload in work.spec.workload:
+                self.watcher.create_or_update(cluster, workload)
+        except UnreachableError:
+            if set_condition(
+                work.status.conditions,
+                Condition(type=WORK_APPLIED, status=False, reason="ClusterUnreachable"),
+            ):
+                self.store.apply(work)
+            return REQUEUE
+        if set_condition(
+            work.status.conditions,
+            Condition(type=WORK_APPLIED, status=True, reason="AppliedSuccessful"),
+        ):
+            self.store.apply(work)
+        return DONE
+
+
+class WorkStatusController:
+    """Member object events -> Work.Status.ManifestStatuses (+ recreation of
+    deleted-but-desired objects)."""
+
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        members: MemberClientRegistry,
+        interpreter: ResourceInterpreter,
+    ) -> None:
+        self.store = store
+        self.members = members
+        self.interpreter = interpreter
+        self.worker = runtime.new_worker("work-status", self._reconcile)
+        for name in members.names():
+            client = members.get(name)
+            if client is not None:
+                client.watch(self._on_member_event)
+
+    def watch_member(self, member) -> None:
+        member.watch(self._on_member_event)
+
+    def _on_member_event(self, event: MemberEvent) -> None:
+        self.worker.enqueue(
+            (event.cluster, event.gvk, event.namespace, event.name, event.type)
+        )
+
+    def _find_work(self, cluster: str, gvk: str, namespace: str, name: str):
+        ns = execution_namespace(cluster)
+        for work in self.store.list("Work", ns):
+            for workload in work.spec.workload:
+                if (
+                    f"{workload.api_version}/{workload.kind}" == gvk
+                    and workload.meta.namespace == namespace
+                    and workload.meta.name == name
+                ):
+                    return work, workload
+        return None, None
+
+    def _reconcile(self, key) -> Optional[str]:
+        cluster, gvk, namespace, name, event_type = key
+        work, desired = self._find_work(cluster, gvk, namespace, name)
+        if work is None:
+            return DONE
+        member = self.members.get(cluster)
+        if member is None:
+            return DONE
+        try:
+            observed = member.get(gvk, namespace, name)
+        except UnreachableError:
+            return REQUEUE
+        if observed is None:
+            # recreate deleted-but-desired (work_status_controller.go:311)
+            if not work.spec.preserve_resources_on_deletion:
+                try:
+                    ObjectWatcher(self.members, self.interpreter).create_or_update(
+                        cluster, desired
+                    )
+                except UnreachableError:
+                    return REQUEUE
+            return DONE
+        status = self.interpreter.reflect_status(observed)
+        # health is Unknown until the member reports any status — a fresh
+        # object is not "Unhealthy" (failover must not fire on it)
+        if status is None:
+            health = "Unknown"
+        else:
+            health = (
+                "Healthy" if self.interpreter.interpret_health(observed) else "Unhealthy"
+            )
+        identifier = observed.object_reference()
+        updated = False
+        for ms in work.status.manifest_statuses:
+            if (
+                ms.identifier.gvk == identifier.gvk
+                and ms.identifier.namespaced_key == identifier.namespaced_key
+            ):
+                if ms.status != status or ms.health != health:
+                    ms.status = status
+                    ms.health = health
+                    updated = True
+                break
+        else:
+            work.status.manifest_statuses.append(
+                ManifestStatus(identifier=identifier, status=status, health=health)
+            )
+            updated = True
+        if updated:
+            self.store.apply(work)
+        return DONE
+
+
+class BindingStatusController:
+    """Work.Status -> ResourceBinding.Status.AggregatedStatus (+ FullyApplied
+    condition), then template status write-back via the detector."""
+
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        detector,
+    ) -> None:
+        self.store = store
+        self.detector = detector
+        self.worker = runtime.new_worker("binding-status", self._reconcile)
+        store.watch("Work", self._on_work_event)
+
+    def _on_work_event(self, event) -> None:
+        key = event.obj.meta.labels.get(WORK_BINDING_LABEL)
+        if key:
+            self.worker.enqueue(key)
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        rb = self.store.get("ResourceBinding", key)
+        if rb is None:
+            return DONE
+        items: list[AggregatedStatusItem] = []
+        applied_clusters = set()
+        for work in self.store.list("Work"):
+            if work.meta.labels.get(WORK_BINDING_LABEL) != key:
+                continue
+            cluster = cluster_of_execution_namespace(work.meta.namespace)
+            if cluster is None:
+                continue
+            applied = any(
+                c.type == WORK_APPLIED and c.status for c in work.status.conditions
+            )
+            if applied:
+                applied_clusters.add(cluster)
+            for ms in work.status.manifest_statuses:
+                items.append(
+                    AggregatedStatusItem(
+                        cluster_name=cluster,
+                        status=ms.status,
+                        applied=applied,
+                        health=ms.health,
+                    )
+                )
+        items.sort(key=lambda i: i.cluster_name)
+        target_clusters = {tc.name for tc in rb.spec.clusters}
+        status_changed = rb.status.aggregated_status != items
+        rb.status.aggregated_status = items
+        cond_changed = set_condition(
+            rb.status.conditions,
+            Condition(
+                type=FULLY_APPLIED,
+                status=bool(target_clusters) and target_clusters <= applied_clusters,
+                reason="FullyAppliedSuccess"
+                if target_clusters <= applied_clusters
+                else "FullyAppliedFailed",
+            ),
+        )
+        if status_changed or cond_changed:
+            self.store.apply(rb)
+            if self.detector is not None:
+                self.detector.write_back_status(rb)
+        return DONE

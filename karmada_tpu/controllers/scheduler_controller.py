@@ -1,0 +1,160 @@
+"""Scheduler process: binding events -> TensorScheduler -> spec.clusters.
+
+Ref: pkg/scheduler/scheduler.go — the event-driven loop (:295-333), the
+should-we-schedule gate (doScheduleBinding :346-414: placement changed /
+replicas changed / reschedule triggered / not yet scheduled), result patching
+(:598-660) and Scheduled conditions (:827-919).
+
+The batched kernel engine (karmada_tpu.scheduler) does the actual work; this
+controller packs ResourceBindings into BindingProblems, maintains snapshot
+freshness (cluster events invalidate), and writes results + conditions back.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..api.core import Condition, set_condition
+from ..api.work import SCHEDULED, ResourceBinding, TargetCluster
+from ..scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+from ..utils import DONE, Runtime, Store
+
+DEFAULT_SCHEDULER = "default-scheduler"
+
+
+class SchedulerController:
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        scheduler_name: str = DEFAULT_SCHEDULER,
+        extra_estimators=(),
+    ) -> None:
+        self.store = store
+        self.scheduler_name = scheduler_name
+        self.extra_estimators = list(extra_estimators)
+        self._snapshot: Optional[ClusterSnapshot] = None
+        self._engine: Optional[TensorScheduler] = None
+        self.worker = runtime.new_worker("scheduler", self._reconcile)
+        store.watch("ResourceBinding", self._on_binding_event)
+        store.watch("ClusterResourceBinding", self._on_binding_event)
+        store.watch("Cluster", self._on_cluster_event)
+
+    # -- events ------------------------------------------------------------
+
+    def _on_binding_event(self, event) -> None:
+        if event.type == "Deleted":
+            return
+        rb = event.obj
+        if rb.spec.scheduler_name != self.scheduler_name:
+            return  # scheduler-name filter (event_handler.go:93-113)
+        self.worker.enqueue((event.kind, event.key))
+
+    def _on_cluster_event(self, event) -> None:
+        self._snapshot = None  # invalidate; rebuild lazily
+        self._engine = None
+        for kind in ("ResourceBinding", "ClusterResourceBinding"):
+            for rb in self.store.list(kind):
+                if rb.spec.scheduler_name == self.scheduler_name:
+                    self.worker.enqueue((kind, rb.meta.namespaced_name))
+
+    # -- engine ------------------------------------------------------------
+
+    def _get_engine(self) -> TensorScheduler:
+        if self._engine is None:
+            clusters = sorted(self.store.list("Cluster"), key=lambda c: c.name)
+            self._snapshot = ClusterSnapshot(clusters)
+            self._engine = TensorScheduler(
+                self._snapshot, extra_estimators=self.extra_estimators
+            )
+        return self._engine
+
+    # -- reconcile ---------------------------------------------------------
+
+    def _needs_scheduling(self, rb: ResourceBinding) -> tuple[bool, bool]:
+        """(should_schedule, fresh). Mirrors doScheduleBinding
+        (scheduler.go:346-414)."""
+        fresh = False
+        if (
+            rb.spec.reschedule_triggered_at is not None
+            and (
+                rb.status.last_scheduled_time is None
+                or rb.spec.reschedule_triggered_at > rb.status.last_scheduled_time
+            )
+        ):
+            return True, True
+        if rb.status.scheduler_observed_generation != rb.meta.generation:
+            return True, False
+        if not any(c.type == SCHEDULED for c in rb.status.conditions):
+            return True, False  # never attempted
+        # replicas drift vs assignment (scale scheduling) — only meaningful
+        # for divided placements (Duplicated assigns replicas per cluster)
+        divided = (
+            rb.spec.placement is not None
+            and rb.spec.placement.replica_scheduling_type() == "Divided"
+        )
+        assigned = sum(tc.replicas for tc in rb.spec.clusters)
+        if divided and rb.spec.replicas > 0 and rb.spec.clusters and (
+            assigned != rb.spec.replicas
+        ):
+            return True, False
+        return False, False
+
+    def _reconcile(self, kind_key) -> Optional[str]:
+        kind, key = kind_key
+        rb = self.store.get(kind, key)
+        if rb is None:
+            return DONE
+        should, fresh = self._needs_scheduling(rb)
+        if not should:
+            return DONE
+        engine = self._get_engine()
+        problem = BindingProblem(
+            key=key,
+            placement=rb.spec.placement,
+            replicas=rb.spec.replicas,
+            requests=(
+                rb.spec.replica_requirements.resource_request
+                if rb.spec.replica_requirements
+                else {}
+            ),
+            gvk=rb.spec.resource.gvk,
+            prev={tc.name: tc.replicas for tc in rb.spec.clusters},
+            evict_clusters=tuple(
+                t.from_cluster for t in rb.spec.graceful_eviction_tasks
+            ),
+            fresh=fresh,
+        )
+        [result] = engine.schedule([problem])
+        if result.success:
+            if rb.spec.replicas > 0:
+                rb.spec.clusters = [
+                    TargetCluster(name=n, replicas=r)
+                    for n, r in sorted(result.clusters.items())
+                ]
+            else:
+                # non-workload: all feasible clusters, no replica counts
+                rb.spec.clusters = [
+                    TargetCluster(name=n) for n in sorted(result.feasible)
+                ]
+            rb.status.scheduler_observed_generation = rb.meta.generation
+            rb.status.scheduler_observed_affinity_name = result.affinity_name
+            rb.status.last_scheduled_time = time.time()
+            set_condition(
+                rb.status.conditions,
+                Condition(type=SCHEDULED, status=True, reason="Success"),
+            )
+        else:
+            rb.status.scheduler_observed_generation = rb.meta.generation
+            set_condition(
+                rb.status.conditions,
+                Condition(
+                    type=SCHEDULED,
+                    status=False,
+                    reason="NoClusterFit",
+                    message=result.error,
+                ),
+            )
+        self.store.apply(rb)
+        return DONE

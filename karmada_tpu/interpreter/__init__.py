@@ -1,0 +1,27 @@
+"""Resource interpreter: pluggable semantics for arbitrary resource kinds.
+
+Ref: pkg/resourceinterpreter/interpreter.go:39-143 — eight operations
+resolved through a chain of responsibility (customized -> native default).
+The reference's customization layers are Lua scripts (declarative CRs +
+embedded thirdparty) and webhooks; the TPU build's extension point is
+registered Python callables per (kind, operation) — same chain order, no
+embedded VM needed in-process.
+"""
+
+from .facade import (  # noqa: F401
+    AGGREGATE_STATUS,
+    GET_DEPENDENCIES,
+    GET_REPLICAS,
+    INTERPRET_HEALTH,
+    REFLECT_STATUS,
+    RETAIN,
+    REVISE_REPLICA,
+    ResourceInterpreter,
+)
+from .native import register_native_interpreters  # noqa: F401
+
+
+def default_interpreter() -> ResourceInterpreter:
+    interp = ResourceInterpreter()
+    register_native_interpreters(interp)
+    return interp

