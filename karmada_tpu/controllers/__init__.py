@@ -7,7 +7,16 @@ from .cluster import (  # noqa: F401
     TaintManager,
     evict_binding,
 )
+from .dependencies import DependenciesDistributor  # noqa: F401
 from .detector import ResourceDetector, binding_name  # noqa: F401
+from .extras import (  # noqa: F401
+    FederatedResourceQuotaController,
+    NamespaceSyncController,
+    WorkloadRebalancer,
+    WorkloadRebalancerController,
+    WorkloadRebalancerSpec,
+    ObjectReferenceSelector,
+)
 from .failover import (  # noqa: F401
     ApplicationFailoverController,
     Descheduler,

@@ -1,0 +1,143 @@
+"""DependenciesDistributor: propagate a workload's dependencies alongside it.
+
+Ref: pkg/dependenciesdistributor/dependencies_distributor.go:333-595 — when
+a policy sets propagateDeps, the interpreter's GetDependencies (configmaps,
+secrets, PVCs, service accounts) produces *attached* ResourceBindings that
+shadow the independent binding's schedule result (RequiredBy snapshots), so
+dependencies land wherever the workload lands.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.core import ObjectMeta
+from ..api.work import BindingSnapshot, ResourceBinding, ResourceBindingSpec
+from ..interpreter import ResourceInterpreter
+from ..utils import DONE, Runtime, Store
+
+DEPENDED_BY_LABEL = "resourcebinding.karmada.io/depended-by"
+
+
+def attached_binding_name(dep_kind: str, dep_name: str) -> str:
+    return f"{dep_name}-{dep_kind.lower()}"
+
+
+class DependenciesDistributor:
+    def __init__(
+        self, store: Store, runtime: Runtime, interpreter: ResourceInterpreter
+    ) -> None:
+        self.store = store
+        self.interpreter = interpreter
+        self.worker = runtime.new_worker("dependencies", self._reconcile)
+        store.watch("ResourceBinding", self._on_binding_event)
+
+    def _on_binding_event(self, event) -> None:
+        rb = event.obj
+        # skip attached bindings driving themselves; everything else may need
+        # (re)distribution or cleanup (e.g. propagateDeps turned off)
+        if DEPENDED_BY_LABEL not in rb.meta.labels:
+            self.worker.enqueue(event.key)
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        rb = self.store.get("ResourceBinding", key)
+        if rb is None or not rb.spec.propagate_deps:
+            self._cleanup_attached(key)
+            return DONE
+        if not rb.spec.clusters:
+            return DONE  # nothing scheduled yet
+        template = self.store.get("Resource", rb.spec.resource.namespaced_key)
+        if template is None:
+            return DONE
+        deps = self.interpreter.get_dependencies(template)
+        seen_keys = set()
+        for dep in deps:
+            dep_template = self.store.get(
+                "Resource", f"{dep.namespace}/{dep.name}" if dep.namespace else dep.name
+            )
+            if dep_template is None or dep_template.kind != dep.kind:
+                continue  # dependency not present on the control plane
+            name = attached_binding_name(dep.kind, dep.name)
+            akey = f"{dep.namespace}/{name}" if dep.namespace else name
+            seen_keys.add(akey)
+            existing = self.store.get("ResourceBinding", akey)
+            snapshot = BindingSnapshot(
+                namespace=rb.meta.namespace,
+                name=rb.meta.name,
+                clusters=list(rb.spec.clusters),
+            )
+            if existing is not None and DEPENDED_BY_LABEL in existing.meta.labels:
+                changed = self._merge_required_by(existing, snapshot)
+                if changed:
+                    self._sync_clusters(existing)
+                    self.store.apply(existing)
+                continue
+            if existing is not None:
+                # independent binding already exists for the dependency; the
+                # reference merges RequiredBy into it (suppressed schedule)
+                changed = self._merge_required_by(existing, snapshot)
+                if changed:
+                    self.store.apply(existing)
+                continue
+            attached = ResourceBinding(
+                meta=ObjectMeta(
+                    name=name,
+                    namespace=dep.namespace,
+                    labels={DEPENDED_BY_LABEL: rb.meta.namespaced_name},
+                ),
+                spec=ResourceBindingSpec(
+                    resource=dep_template.object_reference(),
+                    replicas=0,
+                    required_by=[snapshot],
+                    # attached bindings shadow the parent's schedule; the
+                    # scheduler must not re-place them
+                    scheduler_name="",
+                ),
+            )
+            self._sync_clusters(attached)
+            self.store.apply(attached)
+        # drop stale attachments no longer in the dependency set
+        for other in self.store.list("ResourceBinding"):
+            if (
+                other.meta.labels.get(DEPENDED_BY_LABEL) == key
+                and other.meta.namespaced_name not in seen_keys
+            ):
+                self.store.delete("ResourceBinding", other.meta.namespaced_name)
+        return DONE
+
+    def _merge_required_by(self, binding: ResourceBinding, snap: BindingSnapshot) -> bool:
+        for i, existing in enumerate(binding.spec.required_by):
+            if (
+                existing.namespace == snap.namespace
+                and existing.name == snap.name
+            ):
+                if [
+                    (c.name, c.replicas) for c in existing.clusters
+                ] != [(c.name, c.replicas) for c in snap.clusters]:
+                    binding.spec.required_by[i] = snap
+                    self._sync_clusters(binding)
+                    return True
+                return False
+        binding.spec.required_by.append(snap)
+        self._sync_clusters(binding)
+        return True
+
+    def _sync_clusters(self, binding: ResourceBinding) -> None:
+        """Attached bindings aggregate the union of all RequiredBy cluster
+        sets as their own schedule result (zero-replica placement)."""
+        if DEPENDED_BY_LABEL not in binding.meta.labels and binding.spec.clusters:
+            return  # independent binding keeps its own schedule
+        from ..api.work import TargetCluster
+
+        clusters: dict[str, int] = {}
+        for snap in binding.spec.required_by:
+            for tc in snap.clusters:
+                clusters.setdefault(tc.name, 0)
+        binding.spec.clusters = [
+            TargetCluster(name=n) for n in sorted(clusters)
+        ]
+
+    def _cleanup_attached(self, parent_key: str) -> None:
+        for other in self.store.list("ResourceBinding"):
+            if other.meta.labels.get(DEPENDED_BY_LABEL) == parent_key:
+                self.store.delete("ResourceBinding", other.meta.namespaced_name)

@@ -151,13 +151,15 @@ class ResourceDetector:
             self._remove_binding_for(template.meta.namespaced_name)
 
     def _ensure_binding(self, template: Resource, policy) -> None:
-        """BuildResourceBinding (detector.go:710-752)."""
+        """BuildResourceBinding (detector.go:710-752). Cluster-scoped
+        templates produce ClusterResourceBindings."""
         replicas, requirements = self.interpreter.get_replicas(template)
         name = binding_name(template)
         key = (
             f"{template.meta.namespace}/{name}" if template.meta.namespace else name
         )
-        existing = self.store.get("ResourceBinding", key)
+        kind = "ResourceBinding" if template.meta.namespace else "ClusterResourceBinding"
+        existing = self.store.get(kind, key)
         spec = ResourceBindingSpec(
             resource=template.object_reference(),
             replicas=replicas,
@@ -186,7 +188,10 @@ class ResourceDetector:
                 existing.meta.generation += 1
             self.store.apply(existing)
         else:
-            rb = ResourceBinding(
+            from ..api.work import ClusterResourceBinding
+
+            cls = ResourceBinding if template.meta.namespace else ClusterResourceBinding
+            rb = cls(
                 meta=ObjectMeta(
                     name=name,
                     namespace=template.meta.namespace,
@@ -200,12 +205,13 @@ class ResourceDetector:
 
     def _remove_binding_for(self, template_key: str) -> None:
         ns, _, name = template_key.rpartition("/")
-        for rb in self.store.list("ResourceBinding"):
-            if (
-                rb.spec.resource.namespaced_key == template_key
-                or (rb.meta.namespace == ns and rb.spec.resource.name == name)
-            ):
-                self.store.delete("ResourceBinding", rb.meta.namespaced_name)
+        for kind in ("ResourceBinding", "ClusterResourceBinding"):
+            for rb in self.store.list(kind):
+                if (
+                    rb.spec.resource.namespaced_key == template_key
+                    or (rb.meta.namespace == ns and rb.spec.resource.name == name)
+                ):
+                    self.store.delete(kind, rb.meta.namespaced_name)
 
     def write_back_status(self, binding: ResourceBinding) -> None:
         """Detector also writes aggregated status back onto the template

@@ -1,0 +1,213 @@
+"""Smaller control-plane components: namespace sync, WorkloadRebalancer,
+FederatedResourceQuota, unified auth.
+
+Ref:
+- namespace-sync-controller (pkg/controllers/namespace/, 285 LoC):
+  auto-propagates user namespaces to every member cluster.
+- workloadRebalancer (pkg/controllers/workloadrebalancer/):
+  `WorkloadRebalancer` CR sets spec.rescheduleTriggeredAt on listed bindings
+  -> Fresh reassignment (assignment.go:109-117).
+- federatedResourceQuota sync/status (pkg/controllers/federatedresourcequota/):
+  static quota slices propagated to member clusters as Works; status
+  aggregates used from members.
+- unified-auth-controller (pkg/controllers/unifiedauth/): RBAC sync into
+  members for admin subjects.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.core import ObjectMeta, Resource
+from ..api.work import Work, WorkSpec
+from ..utils import DONE, Runtime, Store
+from .propagation import execution_namespace
+
+SKIP_AUTO_PROPAGATION_LABEL = "namespace.karmada.io/skip-auto-propagation"
+_RESERVED_NS_PREFIXES = ("kube-", "karmada-")
+_RESERVED_NS = {"default", "kube-system", "kube-public"}
+
+
+class NamespaceSyncController:
+    """Namespace templates -> Works in every member cluster
+    (namespace/namespace_sync_controller.go)."""
+
+    def __init__(self, store: Store, runtime: Runtime) -> None:
+        self.store = store
+        self.worker = runtime.new_worker("namespace-sync", self._reconcile)
+        store.watch("Resource", self._on_resource_event)
+        store.watch("Cluster", self._on_cluster_event)
+
+    def _on_resource_event(self, event) -> None:
+        if event.obj.kind == "Namespace":
+            self.worker.enqueue(event.obj.meta.name)
+
+    def _on_cluster_event(self, event) -> None:
+        for res in self.store.list("Resource"):
+            if res.kind == "Namespace":
+                self.worker.enqueue(res.meta.name)
+
+    def _should_sync(self, ns: Resource) -> bool:
+        name = ns.meta.name
+        if name in _RESERVED_NS or any(
+            name.startswith(p) for p in _RESERVED_NS_PREFIXES
+        ):
+            return False
+        if ns.meta.labels.get(SKIP_AUTO_PROPAGATION_LABEL) == "true":
+            return False
+        return True
+
+    def _reconcile(self, name: str) -> Optional[str]:
+        ns = self.store.get("Resource", name)
+        if ns is None or ns.kind != "Namespace" or not self._should_sync(ns):
+            return DONE
+        for cluster in self.store.list("Cluster"):
+            work_ns = execution_namespace(cluster.name)
+            key = f"{work_ns}/ns-{name}"
+            if self.store.get("Work", key) is None:
+                self.store.apply(
+                    Work(
+                        meta=ObjectMeta(name=f"ns-{name}", namespace=work_ns),
+                        spec=WorkSpec(workload=[ns]),
+                    )
+                )
+        return DONE
+
+
+# --- WorkloadRebalancer ------------------------------------------------------
+
+
+@dataclass
+class ObjectReferenceSelector:
+    api_version: str = "apps/v1"
+    kind: str = "Deployment"
+    namespace: str = ""
+    name: str = ""
+
+
+@dataclass
+class WorkloadRebalancerSpec:
+    workloads: list[ObjectReferenceSelector] = field(default_factory=list)
+
+
+@dataclass
+class WorkloadRebalancerStatus:
+    observed_workloads: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class WorkloadRebalancer:
+    KIND = "WorkloadRebalancer"
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: WorkloadRebalancerSpec = field(default_factory=WorkloadRebalancerSpec)
+    status: WorkloadRebalancerStatus = field(default_factory=WorkloadRebalancerStatus)
+
+
+class WorkloadRebalancerController:
+    """Sets rescheduleTriggeredAt on the bindings of listed workloads
+    (workloadrebalancer controller -> Fresh assignment)."""
+
+    def __init__(self, store: Store, runtime: Runtime, clock=time.time) -> None:
+        self.store = store
+        self.clock = clock
+        self.worker = runtime.new_worker("workload-rebalancer", self._reconcile)
+        store.watch("WorkloadRebalancer", lambda e: self.worker.enqueue(e.key))
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        rebalancer = self.store.get("WorkloadRebalancer", key)
+        if rebalancer is None:
+            return DONE
+        observed = []
+        for target in rebalancer.spec.workloads:
+            result = "NotFound"
+            for rb in self.store.list("ResourceBinding"):
+                ref = rb.spec.resource
+                if (
+                    ref.kind == target.kind
+                    and ref.name == target.name
+                    and (not target.namespace or ref.namespace == target.namespace)
+                ):
+                    rb.spec.reschedule_triggered_at = self.clock()
+                    rb.meta.generation += 1
+                    self.store.apply(rb)
+                    result = "Successful"
+            observed.append(
+                {"workload": f"{target.kind}/{target.namespace}/{target.name}",
+                 "result": result}
+            )
+        if rebalancer.status.observed_workloads != observed:
+            rebalancer.status.observed_workloads = observed
+            self.store.apply(rebalancer)
+        return DONE
+
+
+# --- FederatedResourceQuota --------------------------------------------------
+
+
+class FederatedResourceQuotaController:
+    """Static assignment sync: per-cluster ResourceQuota slices shipped as
+    Works; status aggregation sums member-reported usage
+    (federatedresourcequota/federated_resource_quota_sync_controller.go +
+    _status_controller.go)."""
+
+    def __init__(self, store: Store, runtime: Runtime, members=None) -> None:
+        self.store = store
+        self.members = members
+        self.worker = runtime.new_worker("frq", self._reconcile)
+        store.watch("FederatedResourceQuota", lambda e: self.worker.enqueue(e.key))
+        store.watch("Cluster", self._on_cluster_event)
+
+    def _on_cluster_event(self, event) -> None:
+        for frq in self.store.list("FederatedResourceQuota"):
+            self.worker.enqueue(frq.meta.namespaced_name)
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        frq = self.store.get("FederatedResourceQuota", key)
+        if frq is None:
+            return DONE
+        for assignment in frq.spec.static_assignments:
+            cluster = self.store.get("Cluster", assignment.cluster_name)
+            if cluster is None:
+                continue
+            quota = Resource(
+                api_version="v1",
+                kind="ResourceQuota",
+                meta=ObjectMeta(name=frq.meta.name, namespace=frq.meta.namespace),
+                spec={"hard": dict(assignment.hard)},
+            )
+            work_ns = execution_namespace(assignment.cluster_name)
+            work_name = f"quota-{frq.meta.namespace}.{frq.meta.name}"
+            wkey = f"{work_ns}/{work_name}"
+            existing = self.store.get("Work", wkey)
+            if existing is None or existing.spec.workload[0].spec != quota.spec:
+                self.store.apply(
+                    Work(
+                        meta=ObjectMeta(name=work_name, namespace=work_ns),
+                        spec=WorkSpec(workload=[quota]),
+                    )
+                )
+        # status aggregation from member-side quota status
+        overall_used: dict[str, int] = {}
+        if self.members is not None:
+            for assignment in frq.spec.static_assignments:
+                member = self.members.get(assignment.cluster_name)
+                if member is None or not member.reachable:
+                    continue
+                obj = member.get("v1/ResourceQuota", frq.meta.namespace, frq.meta.name)
+                if obj is None or not obj.status:
+                    continue
+                for res_name, used in obj.status.get("used", {}).items():
+                    overall_used[res_name] = overall_used.get(res_name, 0) + int(used)
+        changed = False
+        if frq.status.overall != frq.spec.overall:
+            frq.status.overall = dict(frq.spec.overall)
+            changed = True
+        if frq.status.overall_used != overall_used:
+            frq.status.overall_used = overall_used
+            changed = True
+        if changed:
+            self.store.apply(frq)
+        return DONE

@@ -37,7 +37,13 @@ from ..utils.member import MemberClientRegistry, MemberEvent, ObjectWatcher, Unr
 from .overridemanager import OverrideManager
 
 ES_PREFIX = "karmada-es-"
-WORK_BINDING_LABEL = "resourcebinding.karmada.io/key"
+WORK_BINDING_LABEL = "resourcebinding.karmada.io/key"  # value: "<kind>:<key>"
+
+BINDING_KINDS = ("ResourceBinding", "ClusterResourceBinding")
+
+
+def binding_ref(kind: str, key: str) -> str:
+    return f"{kind}:{key}"
 
 
 def execution_namespace(cluster: str) -> str:
@@ -71,18 +77,23 @@ class BindingController:
         self.interpreter = interpreter
         self.overrides = OverrideManager(store)
         self.worker = runtime.new_worker("binding", self._reconcile)
-        store.watch("ResourceBinding", lambda e: self.worker.enqueue(e.key))
+        for kind in BINDING_KINDS:
+            store.watch(
+                kind, lambda e, k=kind: self.worker.enqueue((k, e.key))
+            )
         store.watch("OverridePolicy", self._requeue_all)
         store.watch("ClusterOverridePolicy", self._requeue_all)
 
     def _requeue_all(self, _event) -> None:
-        for rb in self.store.list("ResourceBinding"):
-            self.worker.enqueue(rb.meta.namespaced_name)
+        for kind in BINDING_KINDS:
+            for rb in self.store.list(kind):
+                self.worker.enqueue((kind, rb.meta.namespaced_name))
 
-    def _reconcile(self, key: str) -> Optional[str]:
-        rb = self.store.get("ResourceBinding", key)
+    def _reconcile(self, kind_key) -> Optional[str]:
+        kind, key = kind_key
+        rb = self.store.get(kind, key)
         if rb is None:
-            self._cleanup_works(key, keep_clusters=set())
+            self._cleanup_works(binding_ref(kind, key), keep_clusters=set())
             return DONE
         template = self.store.get("Resource", rb.spec.resource.namespaced_key)
         if template is None:
@@ -92,10 +103,11 @@ class BindingController:
         # binding_controller.go:145-165)
         targets = {tc.name: tc.replicas for tc in rb.spec.clusters}
         evicting = {t.from_cluster for t in rb.spec.graceful_eviction_tasks}
-        required = {
-            s.namespace + "/" + s.name if s.namespace else s.name: s.clusters
-            for s in rb.spec.required_by
-        }
+        # RequiredBy snapshots extend the target set: dependencies follow
+        # their dependers (binding/common.go mergeTargetClusters)
+        for snap in rb.spec.required_by:
+            for tc in snap.clusters:
+                targets.setdefault(tc.name, 0)
         divided = (
             rb.spec.placement is not None
             and rb.spec.placement.replica_scheduling_type() == DIVIDED
@@ -113,12 +125,14 @@ class BindingController:
             cluster_obj = self.store.get("Cluster", cluster_name)
             if cluster_obj is not None:
                 workload = self.overrides.apply_overrides(workload, cluster_obj)
-            self._create_or_update_work(rb, cluster_name, workload)
-        self._cleanup_works(key, keep_clusters=set(targets) | evicting)
+            self._create_or_update_work(rb, kind, cluster_name, workload)
+        self._cleanup_works(
+            binding_ref(kind, key), keep_clusters=set(targets) | evicting
+        )
         return DONE
 
     def _create_or_update_work(
-        self, rb: ResourceBinding, cluster: str, workload: Resource
+        self, rb: ResourceBinding, kind: str, cluster: str, workload: Resource
     ) -> None:
         ns = execution_namespace(cluster)
         name = f"{rb.meta.namespace + '.' if rb.meta.namespace else ''}{rb.meta.name}"
@@ -132,7 +146,9 @@ class BindingController:
         ):
             return  # no semantic change — avoid churn (idempotent reconcile)
         work = existing or Work(meta=ObjectMeta(name=name, namespace=ns))
-        work.meta.labels[WORK_BINDING_LABEL] = rb.meta.namespaced_name
+        work.meta.labels[WORK_BINDING_LABEL] = binding_ref(
+            kind, rb.meta.namespaced_name
+        )
         work.spec = WorkSpec(
             workload=[workload],
             suspend_dispatching=rb.spec.suspend_dispatching,
@@ -336,14 +352,17 @@ class BindingStatusController:
         if key:
             self.worker.enqueue(key)
 
-    def _reconcile(self, key: str) -> Optional[str]:
-        rb = self.store.get("ResourceBinding", key)
+    def _reconcile(self, ref: str) -> Optional[str]:
+        kind, _, key = ref.partition(":")
+        if kind not in BINDING_KINDS:
+            return DONE
+        rb = self.store.get(kind, key)
         if rb is None:
             return DONE
         items: list[AggregatedStatusItem] = []
         applied_clusters = set()
         for work in self.store.list("Work"):
-            if work.meta.labels.get(WORK_BINDING_LABEL) != key:
+            if work.meta.labels.get(WORK_BINDING_LABEL) != ref:
                 continue
             cluster = cluster_of_execution_namespace(work.meta.namespace)
             if cluster is None:

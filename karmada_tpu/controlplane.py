@@ -24,12 +24,16 @@ from .controllers import (
     BindingStatusController,
     ClusterController,
     ClusterStatusController,
+    DependenciesDistributor,
     Descheduler,
     ExecutionController,
+    FederatedResourceQuotaController,
     GracefulEvictionController,
+    NamespaceSyncController,
     ResourceDetector,
     SchedulerController,
     TaintManager,
+    WorkloadRebalancerController,
     WorkStatusController,
 )
 from .estimator import AccurateEstimator, EstimatorRegistry, NodeSnapshot
@@ -95,6 +99,16 @@ class ControlPlane:
             Descheduler(self.store, self.runtime, self.members)
             if enable_descheduler
             else None
+        )
+        self.dependencies_distributor = DependenciesDistributor(
+            self.store, self.runtime, self.interpreter
+        )
+        self.namespace_sync = NamespaceSyncController(self.store, self.runtime)
+        self.workload_rebalancer = WorkloadRebalancerController(
+            self.store, self.runtime, clock=self.clock
+        )
+        self.frq_controller = FederatedResourceQuotaController(
+            self.store, self.runtime, self.members
         )
 
     # -- cluster lifecycle (karmadactl join/unjoin analogue) ---------------

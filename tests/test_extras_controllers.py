@@ -1,0 +1,221 @@
+"""Tests: dependencies distribution, namespace sync, workload rebalancer,
+federated resource quota, cluster-scoped bindings."""
+
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.api.policy import (
+    FederatedResourceQuota,
+    FederatedResourceQuotaSpec,
+    StaticClusterAssignment,
+)
+from karmada_tpu.controllers import (
+    ObjectReferenceSelector,
+    WorkloadRebalancer,
+    WorkloadRebalancerSpec,
+    execution_namespace,
+)
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.utils.builders import (
+    duplicated_placement,
+    dynamic_weight_placement,
+    new_cluster,
+    new_deployment,
+)
+
+
+def make_plane(n=2, **kw):
+    cp = ControlPlane(**kw)
+    for i in range(1, n + 1):
+        cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+    cp.settle()
+    return cp
+
+
+def nginx_policy(placement, propagate_deps=False):
+    return PropagationPolicy(
+        meta=ObjectMeta(name="p", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=placement,
+            propagate_deps=propagate_deps,
+        ),
+    )
+
+
+class TestDependenciesDistributor:
+    def test_configmap_follows_workload(self):
+        cp = make_plane(2)
+        dep = new_deployment("app", replicas=2)
+        dep.spec["template"]["spec"]["volumes"] = [
+            {"name": "cfg", "configMap": {"name": "app-config"}}
+        ]
+        cm = Resource(
+            api_version="v1",
+            kind="ConfigMap",
+            meta=ObjectMeta(name="app-config", namespace="default"),
+            spec={"data": {"k": "v"}},
+        )
+        cp.store.apply(cm)
+        cp.store.apply(dep)
+        cp.store.apply(nginx_policy(dynamic_weight_placement(), propagate_deps=True))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        placed = {tc.name for tc in rb.spec.clusters}
+        attached = cp.store.get("ResourceBinding", "default/app-config-configmap")
+        assert attached is not None
+        assert {tc.name for tc in attached.spec.clusters} == placed
+        # configmap physically lands on the member clusters
+        for name in placed:
+            assert (
+                cp.members.get(name).get("v1/ConfigMap", "default", "app-config")
+                is not None
+            )
+
+    def test_attached_removed_when_parent_deleted(self):
+        cp = make_plane(1)
+        dep = new_deployment("app", replicas=1)
+        dep.spec["template"]["spec"]["volumes"] = [
+            {"name": "cfg", "configMap": {"name": "c1"}}
+        ]
+        cp.store.apply(
+            Resource(api_version="v1", kind="ConfigMap",
+                     meta=ObjectMeta(name="c1", namespace="default"))
+        )
+        cp.store.apply(dep)
+        cp.store.apply(nginx_policy(duplicated_placement(), propagate_deps=True))
+        cp.settle()
+        assert cp.store.get("ResourceBinding", "default/c1-configmap") is not None
+        cp.store.delete("Resource", "default/app")
+        cp.settle()
+        assert cp.store.get("ResourceBinding", "default/c1-configmap") is None
+
+
+class TestNamespaceSync:
+    def test_namespace_propagates_to_all_members(self):
+        cp = make_plane(2)
+        cp.store.apply(
+            Resource(api_version="v1", kind="Namespace", meta=ObjectMeta(name="team-a"))
+        )
+        cp.settle()
+        for m in ("member1", "member2"):
+            assert cp.members.get(m).get("v1/Namespace", "", "team-a") is not None
+
+    def test_reserved_namespaces_skipped(self):
+        cp = make_plane(1)
+        cp.store.apply(
+            Resource(api_version="v1", kind="Namespace",
+                     meta=ObjectMeta(name="kube-system"))
+        )
+        cp.settle()
+        assert cp.members.get("member1").get("v1/Namespace", "", "kube-system") is None
+
+
+class TestWorkloadRebalancer:
+    def test_triggers_fresh_reschedule(self):
+        clock = [5000.0]
+        cp = ControlPlane(clock=lambda: clock[0])
+        for i in (1, 2):
+            cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+        cp.store.apply(new_deployment("app", replicas=4))
+        cp.store.apply(nginx_policy(dynamic_weight_placement()))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert rb.spec.reschedule_triggered_at is None
+        clock[0] += 10
+        cp.store.apply(
+            WorkloadRebalancer(
+                meta=ObjectMeta(name="rb1"),
+                spec=WorkloadRebalancerSpec(
+                    workloads=[ObjectReferenceSelector(kind="Deployment", name="app")]
+                ),
+            )
+        )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert rb.spec.reschedule_triggered_at == clock[0]
+        assert rb.status.last_scheduled_time is not None
+        rebalancer = cp.store.get("WorkloadRebalancer", "rb1")
+        assert rebalancer.status.observed_workloads[0]["result"] == "Successful"
+
+
+class TestFederatedResourceQuota:
+    def test_static_assignments_propagate_and_aggregate(self):
+        cp = make_plane(2)
+        cp.store.apply(
+            FederatedResourceQuota(
+                meta=ObjectMeta(name="quota", namespace="default"),
+                spec=FederatedResourceQuotaSpec(
+                    overall={"cpu": 10_000},
+                    static_assignments=[
+                        StaticClusterAssignment(cluster_name="member1",
+                                                hard={"cpu": 6000}),
+                        StaticClusterAssignment(cluster_name="member2",
+                                                hard={"cpu": 4000}),
+                    ],
+                ),
+            )
+        )
+        cp.settle()
+        q1 = cp.members.get("member1").get("v1/ResourceQuota", "default", "quota")
+        assert q1 is not None and q1.spec["hard"]["cpu"] == 6000
+        # member reports usage
+        cp.members.get("member1").set_workload_status(
+            "v1/ResourceQuota", "default", "quota", {"used": {"cpu": 2500}}
+        )
+        cp.members.get("member2").set_workload_status(
+            "v1/ResourceQuota", "default", "quota", {"used": {"cpu": 1000}}
+        )
+        # quota status aggregation runs on the frq worker; poke it
+        frq = cp.store.get("FederatedResourceQuota", "default/quota")
+        cp.frq_controller.worker.enqueue("default/quota")
+        cp.settle()
+        frq = cp.store.get("FederatedResourceQuota", "default/quota")
+        assert frq.status.overall_used == {"cpu": 3500}
+        assert frq.status.overall == {"cpu": 10_000}
+
+
+class TestClusterScopedBindings:
+    def test_cluster_role_propagates_via_crb(self):
+        from karmada_tpu.api.policy import ClusterPropagationPolicy
+
+        cp = make_plane(2)
+        role = Resource(
+            api_version="rbac.authorization.k8s.io/v1",
+            kind="ClusterRole",
+            meta=ObjectMeta(name="viewer"),
+            spec={"rules": [{"apiGroups": [""], "resources": ["pods"],
+                             "verbs": ["get", "list"]}]},
+        )
+        for m in cp.members.names():
+            cp.members.get(m).api_enablements.append(
+                "rbac.authorization.k8s.io/v1/ClusterRole"
+            )
+        # refresh cluster status with new enablements
+        cp.settle()
+        cp.store.apply(role)
+        cp.store.apply(
+            ClusterPropagationPolicy(
+                meta=ObjectMeta(name="roles"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(
+                            api_version="rbac.authorization.k8s.io/v1",
+                            kind="ClusterRole",
+                        )
+                    ],
+                    placement=duplicated_placement(),
+                ),
+            )
+        )
+        cp.settle()
+        crb = cp.store.get("ClusterResourceBinding", "viewer-clusterrole")
+        assert crb is not None
+        for m in ("member1", "member2"):
+            assert (
+                cp.members.get(m).get(
+                    "rbac.authorization.k8s.io/v1/ClusterRole", "", "viewer"
+                )
+                is not None
+            )
