@@ -54,7 +54,10 @@ class ControlPlane:
         import time as _time
 
         self.clock = clock or _time.time
-        self.store = Store()
+        from .webhook import default_admission_chain
+
+        self.admission = default_admission_chain()
+        self.store = Store(admission=self.admission.admit)
         self.runtime = Runtime()
         self.members = MemberClientRegistry()
         self.interpreter = default_interpreter()

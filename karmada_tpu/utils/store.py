@@ -51,12 +51,14 @@ class Store:
     single-threaded, which is the supported concurrency model; multi-threaded
     callers must tolerate reordered events, as with real informers."""
 
-    def __init__(self) -> None:
+    def __init__(self, admission: Optional[Callable[[str, Any], None]] = None) -> None:
         self._lock = threading.RLock()
         self._buckets: dict[str, dict[str, Any]] = {}
         self._watchers: dict[str, list[WatchHandler]] = {}
         self._all_watchers: list[WatchHandler] = []
         self._rv = 0
+        # admission(kind, obj) raises to reject an apply (webhook seam)
+        self._admission = admission
 
     # -- mutation ----------------------------------------------------------
 
@@ -66,6 +68,8 @@ class Store:
         spec in place should bump generation themselves via ``bump_generation``)."""
         kind = obj_kind(obj)
         key = obj_key(obj)
+        if self._admission is not None:
+            self._admission(kind, obj)
         with self._lock:
             bucket = self._buckets.setdefault(kind, {})
             existing = bucket.get(key)

@@ -1,0 +1,10 @@
+"""Admission webhooks: mutation defaulting + validation invariants.
+
+Ref: pkg/webhook/** (22 handlers registered at cmd/webhook/app/webhook.go:
+161-183): mutators default placement/suspension fields and inject permanent
+IDs; validators enforce policy/override/quota invariants. Here the chain is
+in-process: the store runs it on every apply (the admission seam of the
+apiserver), and the same functions are importable for CLI-side validation.
+"""
+
+from .chain import AdmissionChain, ValidationError, default_admission_chain  # noqa: F401

@@ -1,0 +1,182 @@
+"""Admission chain: per-kind mutators then validators, run on store.apply."""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Optional
+
+from ..api.policy import (
+    DIVIDED,
+    DUPLICATED,
+    WEIGHTED,
+    AGGREGATED,
+    PropagationPolicy,
+)
+
+PERMANENT_ID_ANNOTATION = "policy.karmada.io/permanent-id"
+
+
+class ValidationError(Exception):
+    """Admission rejection (webhook validate deny)."""
+
+
+Mutator = Callable[[Any], None]
+Validator = Callable[[Any], None]
+
+
+class AdmissionChain:
+    def __init__(self) -> None:
+        self._mutators: dict[str, list[Mutator]] = {}
+        self._validators: dict[str, list[Validator]] = {}
+
+    def register_mutator(self, kind: str, fn: Mutator) -> None:
+        self._mutators.setdefault(kind, []).append(fn)
+
+    def register_validator(self, kind: str, fn: Validator) -> None:
+        self._validators.setdefault(kind, []).append(fn)
+
+    def admit(self, kind: str, obj: Any) -> None:
+        for fn in self._mutators.get(kind, []):
+            fn(obj)
+        for fn in self._validators.get(kind, []):
+            fn(obj)
+
+
+# --- mutators (defaulting; ref: pkg/webhook/*/mutating.go) -------------------
+
+
+def mutate_propagation_policy(policy: PropagationPolicy) -> None:
+    if PERMANENT_ID_ANNOTATION not in policy.meta.annotations:
+        policy.meta.annotations[PERMANENT_ID_ANNOTATION] = str(uuid.uuid4())
+    pl = policy.spec.placement
+    for sc in pl.spread_constraints:
+        if sc.min_groups <= 0:
+            sc.min_groups = 1  # webhook defaults minGroups to 1
+    if not policy.spec.scheduler_name:
+        policy.spec.scheduler_name = "default-scheduler"
+    if not policy.spec.conflict_resolution:
+        policy.spec.conflict_resolution = "Abort"
+
+
+# --- validators (ref: pkg/webhook/*/validating.go) ---------------------------
+
+
+def validate_placement(pl) -> None:
+    if pl is None:
+        return
+    if pl.cluster_affinity is not None and pl.cluster_affinities:
+        raise ValidationError(
+            "clusterAffinity and clusterAffinities are mutually exclusive"
+        )
+    names = [t.affinity_name for t in pl.cluster_affinities]
+    if len(names) != len(set(names)):
+        raise ValidationError("clusterAffinities names must be unique")
+    if any(not n for n in names):
+        raise ValidationError("clusterAffinities entries need affinityName")
+    by_field = {}
+    for sc in pl.spread_constraints:
+        if sc.spread_by_field and sc.spread_by_label:
+            raise ValidationError(
+                "spreadByField and spreadByLabel are mutually exclusive"
+            )
+        if sc.spread_by_field:
+            if sc.spread_by_field not in ("cluster", "zone", "region", "provider"):
+                raise ValidationError(
+                    f"invalid spreadByField {sc.spread_by_field!r}"
+                )
+            if sc.spread_by_field in by_field:
+                raise ValidationError(
+                    f"duplicate spread constraint for {sc.spread_by_field}"
+                )
+            by_field[sc.spread_by_field] = sc
+        if sc.max_groups and sc.max_groups < sc.min_groups:
+            raise ValidationError("maxGroups must be >= minGroups")
+        if sc.max_groups < 0 or sc.min_groups < 0:
+            raise ValidationError("spread constraint groups must be >= 0")
+    # a region/provider/zone constraint requires cluster-or-region selection
+    # support (select_clusters.go:58)
+    rs = pl.replica_scheduling
+    if rs is not None:
+        if rs.replica_scheduling_type not in ("", DUPLICATED, DIVIDED):
+            raise ValidationError(
+                f"invalid replicaSchedulingType {rs.replica_scheduling_type!r}"
+            )
+        if rs.replica_scheduling_type == DIVIDED and rs.replica_division_preference:
+            if rs.replica_division_preference not in (AGGREGATED, WEIGHTED):
+                raise ValidationError(
+                    f"invalid replicaDivisionPreference "
+                    f"{rs.replica_division_preference!r}"
+                )
+        wp = rs.weight_preference
+        if wp is not None:
+            for entry in wp.static_weight_list:
+                if entry.weight < 1:
+                    raise ValidationError("static weights must be >= 1")
+            if wp.dynamic_weight and wp.dynamic_weight != "AvailableReplicas":
+                raise ValidationError(
+                    f"invalid dynamicWeight factor {wp.dynamic_weight!r}"
+                )
+
+
+def validate_propagation_policy(policy: PropagationPolicy) -> None:
+    if not policy.spec.resource_selectors:
+        raise ValidationError("resourceSelectors must not be empty")
+    validate_placement(policy.spec.placement)
+    fo = policy.spec.failover
+    if fo is not None and fo.application is not None:
+        app = fo.application
+        if app.decision_conditions_toleration_seconds < 0:
+            raise ValidationError("tolerationSeconds must be >= 0")
+        if app.purge_mode not in ("Immediately", "Graciously", "Never"):
+            raise ValidationError(f"invalid purgeMode {app.purge_mode!r}")
+
+
+def validate_override_policy(policy) -> None:
+    for rule in policy.spec.override_rules:
+        for po in rule.overriders.plaintext:
+            if po.operator not in ("add", "remove", "replace"):
+                raise ValidationError(f"invalid plaintext operator {po.operator!r}")
+            if not po.path.startswith("/"):
+                raise ValidationError("plaintext path must start with '/'")
+        for io in rule.overriders.image_overrider:
+            if io.component not in ("Registry", "Repository", "Tag"):
+                raise ValidationError(f"invalid image component {io.component!r}")
+
+
+def validate_federated_resource_quota(frq) -> None:
+    for assignment in frq.spec.static_assignments:
+        for res, v in assignment.hard.items():
+            if v < 0:
+                raise ValidationError("quota values must be >= 0")
+            if res not in frq.spec.overall:
+                raise ValidationError(
+                    f"static assignment resource {res!r} missing from overall"
+                )
+    totals: dict[str, int] = {}
+    for assignment in frq.spec.static_assignments:
+        for res, v in assignment.hard.items():
+            totals[res] = totals.get(res, 0) + v
+    for res, total in totals.items():
+        if total > frq.spec.overall.get(res, 0):
+            raise ValidationError(
+                f"static assignments for {res!r} exceed the overall quota"
+            )
+
+
+def validate_resource_binding(rb) -> None:
+    if rb.spec.replicas < 0:
+        raise ValidationError("replicas must be >= 0")
+    validate_placement(rb.spec.placement)
+
+
+def default_admission_chain() -> AdmissionChain:
+    chain = AdmissionChain()
+    for kind in ("PropagationPolicy", "ClusterPropagationPolicy"):
+        chain.register_mutator(kind, mutate_propagation_policy)
+        chain.register_validator(kind, validate_propagation_policy)
+    for kind in ("OverridePolicy", "ClusterOverridePolicy"):
+        chain.register_validator(kind, validate_override_policy)
+    chain.register_validator("FederatedResourceQuota", validate_federated_resource_quota)
+    for kind in ("ResourceBinding", "ClusterResourceBinding"):
+        chain.register_validator(kind, validate_resource_binding)
+    return chain

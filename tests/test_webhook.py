@@ -1,0 +1,126 @@
+"""Admission chain tests (ref: pkg/webhook validating/mutating handlers)."""
+
+import pytest
+
+from karmada_tpu.api import (
+    ClusterAffinity,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ReplicaSchedulingStrategy,
+    ResourceSelector,
+    SpreadConstraint,
+)
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.api.policy import (
+    ClusterAffinityTerm,
+    ClusterPreferences,
+    FederatedResourceQuota,
+    FederatedResourceQuotaSpec,
+    StaticClusterAssignment,
+    StaticClusterWeight,
+)
+from karmada_tpu.webhook import ValidationError, default_admission_chain
+from karmada_tpu.webhook.chain import PERMANENT_ID_ANNOTATION
+
+
+def make_policy(placement=None, selectors=None):
+    return PropagationPolicy(
+        meta=ObjectMeta(name="p", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=selectors
+            if selectors is not None
+            else [ResourceSelector(api_version="apps/v1", kind="Deployment")],
+            placement=placement or Placement(),
+        ),
+    )
+
+
+@pytest.fixture
+def chain():
+    return default_admission_chain()
+
+
+class TestMutation:
+    def test_permanent_id_and_defaults(self, chain):
+        policy = make_policy(
+            Placement(spread_constraints=[SpreadConstraint(spread_by_field="cluster",
+                                                           min_groups=0, max_groups=3)])
+        )
+        chain.admit("PropagationPolicy", policy)
+        assert PERMANENT_ID_ANNOTATION in policy.meta.annotations
+        assert policy.spec.placement.spread_constraints[0].min_groups == 1
+        assert policy.spec.scheduler_name == "default-scheduler"
+
+
+class TestValidation:
+    def test_empty_selectors_rejected(self, chain):
+        with pytest.raises(ValidationError, match="resourceSelectors"):
+            chain.admit("PropagationPolicy", make_policy(selectors=[]))
+
+    def test_affinity_exclusive(self, chain):
+        pl = Placement(
+            cluster_affinity=ClusterAffinity(cluster_names=["a"]),
+            cluster_affinities=[ClusterAffinityTerm(affinity_name="g1")],
+        )
+        with pytest.raises(ValidationError, match="mutually exclusive"):
+            chain.admit("PropagationPolicy", make_policy(pl))
+
+    def test_duplicate_affinity_names(self, chain):
+        pl = Placement(
+            cluster_affinities=[
+                ClusterAffinityTerm(affinity_name="g"),
+                ClusterAffinityTerm(affinity_name="g"),
+            ]
+        )
+        with pytest.raises(ValidationError, match="unique"):
+            chain.admit("PropagationPolicy", make_policy(pl))
+
+    def test_max_groups_lt_min_rejected(self, chain):
+        pl = Placement(
+            spread_constraints=[
+                SpreadConstraint(spread_by_field="region", min_groups=3, max_groups=1)
+            ]
+        )
+        with pytest.raises(ValidationError, match="maxGroups"):
+            chain.admit("PropagationPolicy", make_policy(pl))
+
+    def test_zero_static_weight_rejected(self, chain):
+        pl = Placement(
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type="Divided",
+                replica_division_preference="Weighted",
+                weight_preference=ClusterPreferences(
+                    static_weight_list=[
+                        StaticClusterWeight(
+                            target_cluster=ClusterAffinity(cluster_names=["a"]),
+                            weight=0,
+                        )
+                    ]
+                ),
+            )
+        )
+        with pytest.raises(ValidationError, match="weights"):
+            chain.admit("PropagationPolicy", make_policy(pl))
+
+    def test_quota_over_assignment_rejected(self, chain):
+        frq = FederatedResourceQuota(
+            meta=ObjectMeta(name="q", namespace="default"),
+            spec=FederatedResourceQuotaSpec(
+                overall={"cpu": 1000},
+                static_assignments=[
+                    StaticClusterAssignment(cluster_name="m1", hard={"cpu": 800}),
+                    StaticClusterAssignment(cluster_name="m2", hard={"cpu": 800}),
+                ],
+            ),
+        )
+        with pytest.raises(ValidationError, match="exceed"):
+            chain.admit("FederatedResourceQuota", frq)
+
+    def test_store_integration_rejects(self, chain):
+        from karmada_tpu.utils import Store
+
+        store = Store(admission=chain.admit)
+        with pytest.raises(ValidationError):
+            store.apply(make_policy(selectors=[]))
+        assert store.list("PropagationPolicy") == []
