@@ -1,0 +1,223 @@
+"""FederatedHPA + CronFederatedHPA controllers and the metrics path.
+
+Ref:
+- FederatedHPA (pkg/controllers/federatedhpa/, 2,402 LoC): the kube HPA loop
+  ported to multi-cluster — target the binding's clusters, pull pod metrics
+  through the karmada-metrics-adapter, calibrate by ready-pod ratio, apply
+  the stabilization window, write the scale subresource on the template
+  (federatedhpa_controller.go:406-467, replica_calculator.go, :921-960).
+- CronFederatedHPA (pkg/controllers/cronfederatedhpa/, gocron): cron rules
+  scale a FederatedHPA's bounds or a workload's replicas directly.
+
+Metrics transport: member clusters expose per-workload utilization samples
+(MemberCluster.pod_metrics, the stand-in for metrics.k8s.io served by the
+karmada-metrics-adapter — see karmada_tpu.metricsadapter); the replica
+calculator merges them across the binding's clusters weighted by pod count.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+from ..api.autoscaling import CronFederatedHPA, FederatedHPA
+from ..utils import DONE, Runtime, Store
+from ..utils.cron import cron_matches
+from .detector import binding_name
+
+
+class FederatedHPAController:
+    def __init__(
+        self, store: Store, runtime: Runtime, members, clock=time.time
+    ) -> None:
+        self.store = store
+        self.members = members
+        self.clock = clock
+        # scale-down stabilization: (hpa key) -> [(t, recommendation)]
+        self._recommendations: dict[str, list[tuple[float, int]]] = {}
+        # kube HPA sync period: evaluations are at least this far apart, so
+        # stale metric samples cannot compound within one settle pass
+        self.sync_period_seconds = 15.0
+        self._last_eval: dict[str, float] = {}
+        self.worker = runtime.new_worker("federated-hpa", self._reconcile)
+        store.watch("FederatedHPA", lambda e: self.worker.enqueue(e.key))
+        runtime.add_ticker(self._sweep)
+
+    def _sweep(self) -> None:
+        for hpa in self.store.list("FederatedHPA"):
+            self.worker.enqueue(hpa.meta.namespaced_name)
+
+    # -- metric collection (metrics-adapter fan-out analogue) --------------
+
+    def _collect(self, hpa: FederatedHPA, clusters: list[str]) -> Optional[tuple[float, int, int]]:
+        """Returns (avg_utilization_pct, ready_pods, total_pods) merged
+        across the target clusters, or None when no samples exist."""
+        target = hpa.spec.scale_target_ref
+        workload_key = (
+            f"{hpa.meta.namespace}/{target.name}"
+            if hpa.meta.namespace
+            else target.name
+        )
+        total_util = 0.0
+        total_pods = 0
+        ready = 0
+        for name in clusters:
+            member = self.members.get(name)
+            if member is None or not member.reachable:
+                continue
+            sample = member.pod_metrics.get(workload_key)
+            if not sample:
+                continue
+            pods = int(sample.get("pods", 0))
+            total_util += float(sample.get("cpu_utilization", 0.0)) * pods
+            total_pods += pods
+            ready += int(sample.get("ready_pods", pods))
+        if total_pods == 0:
+            return None
+        return total_util / total_pods, ready, total_pods
+
+    # -- reconcile ---------------------------------------------------------
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        hpa = self.store.get("FederatedHPA", key)
+        if hpa is None:
+            self._recommendations.pop(key, None)
+            return DONE
+        target = hpa.spec.scale_target_ref
+        template_key = (
+            f"{hpa.meta.namespace}/{target.name}" if hpa.meta.namespace else target.name
+        )
+        template = self.store.get("Resource", template_key)
+        if template is None or template.kind != target.kind:
+            return DONE
+        rb_key = (
+            f"{hpa.meta.namespace}/{binding_name(template)}"
+            if hpa.meta.namespace
+            else binding_name(template)
+        )
+        rb = self.store.get("ResourceBinding", rb_key)
+        clusters = [tc.name for tc in rb.spec.clusters] if rb is not None else []
+        current = int(template.spec.get("replicas", 0))
+        now = self.clock()
+        last = self._last_eval.get(key)
+        if last is not None and now - last < self.sync_period_seconds:
+            return DONE
+        metrics = self._collect(hpa, clusters)
+        if metrics is None or current == 0:
+            self._update_status(hpa, current, current)
+            return DONE
+        self._last_eval[key] = now
+        avg_util, ready, total = metrics
+
+        # desired = max over metrics of ceil(current * currentMetric /
+        # targetMetric), calibrated by ready ratio (replica_calculator.go);
+        # no computable metric keeps the current size
+        proposals = []
+        for metric in hpa.spec.metrics or []:
+            if metric.target_average_utilization:
+                calibration = ready / total if total else 1.0
+                raw = current * (avg_util / metric.target_average_utilization)
+                proposals.append(math.ceil(raw * calibration))
+        desired = max(proposals) if proposals else current
+        desired = min(max(desired, hpa.spec.min_replicas), hpa.spec.max_replicas)
+
+        # scale-down stabilization: act on the max recommendation inside the
+        # window (federatedhpa_controller.go:921-960); the first evaluation
+        # seeds the window with the current size for continuity
+        window = hpa.spec.stabilization_window_seconds
+        prior = self._recommendations.get(key)
+        if prior is None:
+            prior = [(now, current)]
+        recs = [(t, r) for t, r in prior if now - t <= window]
+        recs.append((now, desired))
+        self._recommendations[key] = recs
+        if desired < current:
+            desired = max(r for _, r in recs)
+
+        if desired != current:
+            template.spec["replicas"] = desired
+            self.store.apply(template)  # detector re-derives binding replicas
+            hpa.status.last_scale_time = now
+        self._update_status(hpa, current, desired)
+        return DONE
+
+    def _update_status(self, hpa: FederatedHPA, current: int, desired: int) -> None:
+        if (
+            hpa.status.current_replicas != current
+            or hpa.status.desired_replicas != desired
+        ):
+            hpa.status.current_replicas = current
+            hpa.status.desired_replicas = desired
+            self.store.apply(hpa)
+
+
+class CronFederatedHPAController:
+    """Cron-driven scaling (pkg/controllers/cronfederatedhpa/). Each tick,
+    rules whose schedule matches the current minute fire once."""
+
+    def __init__(self, store: Store, runtime: Runtime, clock=time.time) -> None:
+        self.store = store
+        self.clock = clock
+        self._last_fired: dict[tuple[str, str], int] = {}  # (key, rule) -> minute
+        runtime.add_ticker(self.tick)
+
+    def tick(self) -> None:
+        now = self.clock()
+        minute = int(now // 60)
+        for cron_hpa in self.store.list("CronFederatedHPA"):
+            for rule in cron_hpa.spec.rules:
+                if rule.suspend:
+                    continue
+                k = (cron_hpa.meta.namespaced_name, rule.name)
+                if self._last_fired.get(k) == minute:
+                    continue
+                if not cron_matches(rule.schedule, now):
+                    continue
+                self._last_fired[k] = minute
+                self._fire(cron_hpa, rule, now)
+
+    def _fire(self, cron_hpa: CronFederatedHPA, rule, now: float) -> None:
+        from ..api.autoscaling import ExecutionHistoryItem
+
+        target = cron_hpa.spec.scale_target_ref
+        applied = None
+        message = ""
+        if target.kind == "FederatedHPA":
+            key = (
+                f"{cron_hpa.meta.namespace}/{target.name}"
+                if cron_hpa.meta.namespace
+                else target.name
+            )
+            hpa = self.store.get("FederatedHPA", key)
+            if hpa is None:
+                message = "target FederatedHPA not found"
+            else:
+                if rule.target_min_replicas is not None:
+                    hpa.spec.min_replicas = rule.target_min_replicas
+                if rule.target_max_replicas is not None:
+                    hpa.spec.max_replicas = rule.target_max_replicas
+                self.store.apply(hpa)
+                applied = rule.target_min_replicas
+        else:
+            key = (
+                f"{cron_hpa.meta.namespace}/{target.name}"
+                if cron_hpa.meta.namespace
+                else target.name
+            )
+            template = self.store.get("Resource", key)
+            if template is None or rule.target_replicas is None:
+                message = "target workload not found"
+            else:
+                template.spec["replicas"] = rule.target_replicas
+                self.store.apply(template)
+                applied = rule.target_replicas
+        cron_hpa.status.execution_histories.append(
+            ExecutionHistoryItem(
+                rule_name=rule.name,
+                execution_time=now,
+                applied_replicas=applied,
+                message=message,
+            )
+        )
+        self.store.apply(cron_hpa)

@@ -113,6 +113,17 @@ class ControlPlane:
         self.frq_controller = FederatedResourceQuotaController(
             self.store, self.runtime, self.members
         )
+        from .controllers.autoscaling import (
+            CronFederatedHPAController,
+            FederatedHPAController,
+        )
+
+        self.federated_hpa = FederatedHPAController(
+            self.store, self.runtime, self.members, clock=self.clock
+        )
+        self.cron_federated_hpa = CronFederatedHPAController(
+            self.store, self.runtime, clock=self.clock
+        )
 
     # -- cluster lifecycle (karmadactl join/unjoin analogue) ---------------
 

@@ -61,6 +61,9 @@ class MemberCluster:
         # workload-key -> unschedulable replica count (descheduler input;
         # ref: estimator server/replica/replica.go)
         self.unschedulable_replicas: dict[str, int] = {}
+        # workload-key -> metric sample {"pods", "ready_pods",
+        # "cpu_utilization"} (metrics.k8s.io stand-in for the metrics adapter)
+        self.pod_metrics: dict[str, dict] = {}
 
     # -- client surface ----------------------------------------------------
 

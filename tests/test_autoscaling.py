@@ -1,0 +1,151 @@
+"""FederatedHPA / CronFederatedHPA tests (ref: federatedhpa e2e + unit
+tables)."""
+
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.autoscaling import (
+    CronFederatedHPA,
+    CronFederatedHPARule,
+    CronFederatedHPASpec,
+    FederatedHPA,
+    FederatedHPASpec,
+    MetricSpec,
+    ScaleTargetRef,
+)
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    new_cluster,
+    new_deployment,
+)
+from karmada_tpu.utils.cron import cron_matches
+
+
+def make_plane(clock):
+    cp = ControlPlane(clock=clock)
+    for i in (1, 2):
+        cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+    cp.store.apply(new_deployment("web", replicas=4))
+    cp.store.apply(
+        PropagationPolicy(
+            meta=ObjectMeta(name="p", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=dynamic_weight_placement(),
+            ),
+        )
+    )
+    cp.settle()
+    return cp
+
+
+def make_hpa(min_r=1, max_r=10, target_util=50, window=0):
+    return FederatedHPA(
+        meta=ObjectMeta(name="web-hpa", namespace="default"),
+        spec=FederatedHPASpec(
+            scale_target_ref=ScaleTargetRef(kind="Deployment", name="web"),
+            min_replicas=min_r,
+            max_replicas=max_r,
+            metrics=[MetricSpec(resource_name="cpu", target_average_utilization=target_util)],
+            stabilization_window_seconds=window,
+        ),
+    )
+
+
+class TestFederatedHPA:
+    def test_scale_up_on_high_utilization(self):
+        clock = [0.0]
+        cp = make_plane(lambda: clock[0])
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        for tc in rb.spec.clusters:
+            cp.members.get(tc.name).pod_metrics["default/web"] = {
+                "pods": tc.replicas, "ready_pods": tc.replicas,
+                "cpu_utilization": 100.0,
+            }
+        cp.store.apply(make_hpa(target_util=50))
+        cp.settle()
+        template = cp.store.get("Resource", "default/web")
+        assert template.spec["replicas"] == 8  # 4 * 100/50
+        # binding followed the scale (detector -> scheduler scale-up)
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        assert sum(tc.replicas for tc in rb.spec.clusters) == 8
+
+    def test_scale_down_respects_stabilization_window(self):
+        clock = [0.0]
+        cp = make_plane(lambda: clock[0])
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        for tc in rb.spec.clusters:
+            cp.members.get(tc.name).pod_metrics["default/web"] = {
+                "pods": tc.replicas, "ready_pods": tc.replicas,
+                "cpu_utilization": 10.0,
+            }
+        cp.store.apply(make_hpa(target_util=50, window=300))
+        cp.settle()
+        # low utilization recommends scale-down to 1, but the window holds
+        # the recent high recommendation (initial = current 4)
+        template = cp.store.get("Resource", "default/web")
+        assert template.spec["replicas"] == 4
+        # past the window, scale-down proceeds
+        clock[0] += 400
+        cp.settle()
+        template = cp.store.get("Resource", "default/web")
+        assert template.spec["replicas"] == 1
+
+    def test_max_replicas_clamp(self):
+        clock = [0.0]
+        cp = make_plane(lambda: clock[0])
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        for tc in rb.spec.clusters:
+            cp.members.get(tc.name).pod_metrics["default/web"] = {
+                "pods": tc.replicas, "ready_pods": tc.replicas,
+                "cpu_utilization": 500.0,
+            }
+        cp.store.apply(make_hpa(max_r=6))
+        cp.settle()
+        assert cp.store.get("Resource", "default/web").spec["replicas"] == 6
+
+
+class TestCron:
+    def test_cron_matcher(self):
+        # 2026-01-01 00:00 UTC is a Thursday
+        import calendar
+
+        ts = calendar.timegm((2026, 1, 1, 0, 0, 0, 0, 0, 0))
+        assert cron_matches("* * * * *", ts)
+        assert cron_matches("0 0 * * *", ts)
+        assert not cron_matches("30 * * * *", ts)
+        assert cron_matches("*/15 * * * *", ts)
+        assert cron_matches("0 0 1 1 *", ts)
+        assert not cron_matches("0 0 2 1 *", ts)
+        assert cron_matches("0 0 * * 4", ts)  # Thursday
+
+    def test_cron_scales_workload(self):
+        import calendar
+
+        base = calendar.timegm((2026, 1, 1, 8, 59, 30, 0, 0, 0))
+        clock = [float(base)]
+        cp = make_plane(lambda: clock[0])
+        cp.store.apply(
+            CronFederatedHPA(
+                meta=ObjectMeta(name="nightly", namespace="default"),
+                spec=CronFederatedHPASpec(
+                    scale_target_ref=ScaleTargetRef(kind="Deployment", name="web"),
+                    rules=[
+                        CronFederatedHPARule(
+                            name="morning-scale",
+                            schedule="0 9 * * *",
+                            target_replicas=12,
+                        )
+                    ],
+                ),
+            )
+        )
+        cp.settle()
+        assert cp.store.get("Resource", "default/web").spec["replicas"] == 4
+        clock[0] += 40  # crosses 09:00
+        cp.settle()
+        assert cp.store.get("Resource", "default/web").spec["replicas"] == 12
+        cron = cp.store.get("CronFederatedHPA", "default/nightly")
+        assert cron.status.execution_histories[0].applied_replicas == 12
