@@ -124,6 +124,17 @@ class ControlPlane:
         self.cron_federated_hpa = CronFederatedHPAController(
             self.store, self.runtime, clock=self.clock
         )
+        from .controllers.mcs import (
+            MultiClusterServiceController,
+            ServiceExportController,
+        )
+
+        self.service_export = ServiceExportController(
+            self.store, self.runtime, self.members
+        )
+        self.multicluster_service = MultiClusterServiceController(
+            self.store, self.runtime, self.members
+        )
 
     # -- cluster lifecycle (karmadactl join/unjoin analogue) ---------------
 

@@ -1,0 +1,85 @@
+"""Multi-cluster service tests (ref: test/e2e/mcs_test.go patterns)."""
+
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.api.networking import (
+    ExposureRange,
+    MultiClusterService,
+    MultiClusterServiceSpec,
+    ServiceExport,
+)
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.utils.builders import new_cluster
+
+
+def endpoint_slice(name, service, addresses):
+    return Resource(
+        api_version="discovery.k8s.io/v1",
+        kind="EndpointSlice",
+        meta=ObjectMeta(
+            name=name,
+            namespace="default",
+            labels={"kubernetes.io/service-name": service},
+        ),
+        spec={"endpoints": [{"addresses": [a]} for a in addresses]},
+    )
+
+
+def service(name):
+    return Resource(
+        api_version="v1",
+        kind="Service",
+        meta=ObjectMeta(name=name, namespace="default"),
+        spec={"ports": [{"port": 80}], "clusterIP": "10.0.0.5"},
+    )
+
+
+def make_plane():
+    cp = ControlPlane()
+    for i in (1, 2, 3):
+        cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+    cp.settle()
+    return cp
+
+
+class TestServiceExport:
+    def test_slices_collected_to_control_plane(self):
+        cp = make_plane()
+        m1 = cp.members.get("member1")
+        m1.apply(service("web"))
+        m1.apply(endpoint_slice("web-abc", "web", ["10.1.0.1", "10.1.0.2"]))
+        cp.store.apply(
+            ServiceExport(meta=ObjectMeta(name="web", namespace="default"))
+        )
+        cp.settle()
+        collected = cp.store.get("Resource", "default/member1-web-abc")
+        assert collected is not None
+        assert collected.meta.labels["endpointslice.karmada.io/source-cluster"] == "member1"
+
+
+class TestMultiClusterService:
+    def test_derived_service_dispatched_to_consumers(self):
+        cp = make_plane()
+        m1 = cp.members.get("member1")
+        m1.apply(service("web"))
+        m1.apply(endpoint_slice("web-abc", "web", ["10.1.0.1"]))
+        cp.store.apply(
+            MultiClusterService(
+                meta=ObjectMeta(name="web", namespace="default"),
+                spec=MultiClusterServiceSpec(
+                    provider_clusters=[ExposureRange(cluster_names=["member1"])],
+                    consumer_clusters=[ExposureRange(cluster_names=["member2"])],
+                ),
+            )
+        )
+        cp.settle()
+        m2 = cp.members.get("member2")
+        derived = m2.get("v1/Service", "default", "derived-web")
+        assert derived is not None
+        assert derived.spec["ports"] == [{"port": 80}]
+        slice_obj = m2.get("discovery.k8s.io/v1/EndpointSlice", "default",
+                           "member1-web-abc")
+        assert slice_obj is not None
+        assert slice_obj.spec["endpoints"] == [{"addresses": ["10.1.0.1"]}]
+        # non-consumer cluster stays clean
+        m3 = cp.members.get("member3")
+        assert m3.get("v1/Service", "default", "derived-web") is None
