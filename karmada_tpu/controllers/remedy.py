@@ -1,0 +1,201 @@
+"""Remedy controller + cluster-api discovery + pull-mode agent.
+
+Ref:
+- remedy-controller (pkg/controllers/remediation/, pkg/apis/remedy):
+  `Remedy` CRs match clusters by decision conditions (cluster condition
+  types) and apply actions (TrafficControl) recorded on the cluster.
+- clusterdiscovery (pkg/clusterdiscovery/clusterapi/): auto-join clusters
+  surfaced by an infrastructure inventory.
+- karmada-agent (cmd/agent): runs inside Pull-mode member clusters — pulls
+  Works destined for its cluster from the control plane, applies them
+  locally, pushes status back. Here the agent is an object bound to one
+  member cluster running the same execution/status logic in pull direction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.cluster import PULL, Cluster
+from ..api.core import Condition, ObjectMeta, is_condition_true, set_condition
+from ..api.work import WORK_APPLIED, ManifestStatus, Work
+from ..utils import DONE, Runtime, Store
+from ..utils.member import MemberCluster, UnreachableError
+from .propagation import execution_namespace
+
+REMEDY_ACTION_TRAFFIC_CONTROL = "TrafficControl"
+REMEDY_ACTIONS_ANNOTATION = "remedy.karmada.io/traffic-control"
+
+
+@dataclass
+class DecisionMatch:
+    cluster_condition_type: str = "ServiceDomainNameResolutionReady"
+    cluster_condition_status: str = "False"
+
+
+@dataclass
+class RemedySpec:
+    cluster_affinity: Optional[object] = None  # api.policy.ClusterAffinity
+    decision_matches: list[DecisionMatch] = field(default_factory=list)
+    actions: list[str] = field(default_factory=lambda: [REMEDY_ACTION_TRAFFIC_CONTROL])
+
+
+@dataclass
+class Remedy:
+    KIND = "Remedy"
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: RemedySpec = field(default_factory=RemedySpec)
+
+
+class RemedyController:
+    def __init__(self, store: Store, runtime: Runtime) -> None:
+        self.store = store
+        self.worker = runtime.new_worker("remedy", self._reconcile)
+        store.watch("Remedy", lambda e: self._requeue_clusters())
+        store.watch("Cluster", lambda e: self.worker.enqueue(e.key))
+
+    def _requeue_clusters(self) -> None:
+        for cluster in self.store.list("Cluster"):
+            self.worker.enqueue(cluster.name)
+
+    def _matches(self, remedy: Remedy, cluster: Cluster) -> bool:
+        if remedy.spec.cluster_affinity is not None and not (
+            remedy.spec.cluster_affinity.matches(cluster)
+        ):
+            return False
+        if not remedy.spec.decision_matches:
+            return True  # unconditional remedy
+        for match in remedy.spec.decision_matches:
+            for cond in cluster.status.conditions:
+                status = "True" if cond.status else "False"
+                if (
+                    cond.type == match.cluster_condition_type
+                    and status == match.cluster_condition_status
+                ):
+                    return True
+        return False
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        cluster = self.store.get("Cluster", key)
+        if cluster is None:
+            return DONE
+        actions: set[str] = set()
+        for remedy in self.store.list("Remedy"):
+            if self._matches(remedy, cluster):
+                actions.update(remedy.spec.actions)
+        current = cluster.meta.annotations.get(REMEDY_ACTIONS_ANNOTATION)
+        wanted = ",".join(sorted(actions)) if actions else None
+        if wanted != current:
+            if wanted is None:
+                cluster.meta.annotations.pop(REMEDY_ACTIONS_ANNOTATION, None)
+            else:
+                cluster.meta.annotations[REMEDY_ACTIONS_ANNOTATION] = wanted
+            self.store.apply(cluster)
+        return DONE
+
+
+class ClusterDiscoveryController:
+    """Auto-join clusters from an infrastructure inventory
+    (pkg/clusterdiscovery/clusterapi). The inventory is a callable returning
+    (name, MemberCluster) pairs — the cluster-api informer analogue."""
+
+    def __init__(self, control_plane, inventory) -> None:
+        self.control_plane = control_plane
+        self.inventory = inventory
+        control_plane.runtime.add_ticker(self.discover_once)
+
+    def discover_once(self) -> None:
+        from ..utils.builders import new_cluster
+
+        for name, member in self.inventory():
+            if self.control_plane.store.get("Cluster", name) is None:
+                cluster = new_cluster(name)
+                self.control_plane.join_cluster(cluster, member)
+
+
+class KarmadaAgent:
+    """Pull-mode agent for one member cluster (cmd/agent): pulls Works for
+    its execution namespace, applies them into the local cluster, reflects
+    status into the Work — the same propagation semantics with the member
+    driving. Push-mode controllers skip Pull clusters."""
+
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        member: MemberCluster,
+        interpreter,
+    ) -> None:
+        self.store = store
+        self.member = member
+        self.interpreter = interpreter
+        self.ns = execution_namespace(member.name)
+        self.worker = runtime.new_worker(f"agent-{member.name}", self._reconcile)
+        store.watch("Work", self._on_work_event)
+        member.watch(self._on_member_event)
+
+    def _on_work_event(self, event) -> None:
+        if event.obj.meta.namespace == self.ns:
+            self.worker.enqueue(event.key)
+
+    def _on_member_event(self, event) -> None:
+        for work in self.store.list("Work", self.ns):
+            for w in work.spec.workload:
+                if (
+                    f"{w.api_version}/{w.kind}" == event.gvk
+                    and w.meta.namespace == event.namespace
+                    and w.meta.name == event.name
+                ):
+                    self.worker.enqueue(work.meta.namespaced_name)
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        work = self.store.get("Work", key)
+        if work is None or work.spec.suspend_dispatching:
+            return DONE
+        if not self.member.reachable:
+            return DONE  # agent inside the cluster: unreachable means dead
+        changed = False
+        for desired in work.spec.workload:
+            gvk = f"{desired.api_version}/{desired.kind}"
+            observed = self.member.get(
+                gvk, desired.meta.namespace, desired.meta.name
+            )
+            if observed is None:
+                import copy
+
+                self.member.apply(copy.deepcopy(desired))
+                observed = self.member.get(
+                    gvk, desired.meta.namespace, desired.meta.name
+                )
+            status = self.interpreter.reflect_status(observed)
+            health = (
+                "Unknown"
+                if status is None
+                else (
+                    "Healthy"
+                    if self.interpreter.interpret_health(observed)
+                    else "Unhealthy"
+                )
+            )
+            identifier = observed.object_reference()
+            for ms in work.status.manifest_statuses:
+                if ms.identifier.namespaced_key == identifier.namespaced_key:
+                    if ms.status != status or ms.health != health:
+                        ms.status, ms.health = status, health
+                        changed = True
+                    break
+            else:
+                work.status.manifest_statuses.append(
+                    ManifestStatus(identifier=identifier, status=status, health=health)
+                )
+                changed = True
+        if set_condition(
+            work.status.conditions,
+            Condition(type=WORK_APPLIED, status=True, reason="AppliedSuccessful"),
+        ):
+            changed = True
+        if changed:
+            self.store.apply(work)
+        return DONE

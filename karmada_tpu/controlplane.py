@@ -135,13 +135,31 @@ class ControlPlane:
         self.multicluster_service = MultiClusterServiceController(
             self.store, self.runtime, self.members
         )
+        from .controllers.remedy import RemedyController
+        from .metricsadapter import MetricsAdapter
+        from .search import Proxy, SearchController
+
+        self.remedy_controller = RemedyController(self.store, self.runtime)
+        self.search = SearchController(self.store, self.runtime, self.members)
+        self.proxy = Proxy(self.store, self.members, self.search.cache)
+        self.metrics_adapter = MetricsAdapter(self.members)
+        self.agents: dict[str, object] = {}
 
     # -- cluster lifecycle (karmadactl join/unjoin analogue) ---------------
 
     def join_cluster(self, cluster: Cluster, member: Optional[MemberCluster] = None):
-        """Register a member (push mode: control plane owns the client)."""
+        """Register a member. Push mode: the control plane owns the client
+        (karmadactl join); Pull mode: a KarmadaAgent runs "inside" the member
+        and drives the work application itself (karmadactl register)."""
         member = member or MemberCluster(cluster.name)
         self.members.register(member)
+        if cluster.spec.sync_mode == "Pull":
+            from .controllers.remedy import KarmadaAgent
+
+            self.agents = getattr(self, "agents", {})
+            self.agents[cluster.name] = KarmadaAgent(
+                self.store, self.runtime, member, self.interpreter
+            )
         self.work_status_controller.watch_member(member)
         if self._accurate_enabled:
             snap_dims = ["cpu", "memory", "pods", "ephemeral-storage"]

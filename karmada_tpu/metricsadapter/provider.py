@@ -1,0 +1,76 @@
+"""Metrics providers fanning out to member clusters.
+
+The member-side sources are the MemberCluster metric surfaces
+(pod_metrics for resource metrics, custom_metrics for custom/external);
+a real deployment swaps those for metrics.k8s.io clients — the merge
+semantics here mirror provider/resourcemetrics.go (sum/weighted-average
+across clusters) and provider/custommetrics.go (per-cluster series united).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.member import MemberClientRegistry
+
+
+@dataclass
+class MetricValue:
+    cluster: str
+    value: float
+    labels: dict[str, str] = field(default_factory=dict)
+
+
+class MetricsAdapter:
+    def __init__(self, members: MemberClientRegistry) -> None:
+        self.members = members
+
+    # -- resource metrics (metrics.k8s.io flavor) --------------------------
+
+    def resource_metrics(self, workload_key: str) -> list[MetricValue]:
+        """Per-cluster cpu utilization samples for a workload."""
+        out = []
+        for name in self.members.names():
+            member = self.members.get(name)
+            if member is None or not member.reachable:
+                continue
+            sample = member.pod_metrics.get(workload_key)
+            if sample:
+                out.append(
+                    MetricValue(
+                        cluster=name,
+                        value=float(sample.get("cpu_utilization", 0.0)),
+                        labels={"pods": str(sample.get("pods", 0))},
+                    )
+                )
+        return out
+
+    def merged_utilization(self, workload_key: str) -> Optional[float]:
+        """Pod-weighted average across clusters (replica_calculator merge)."""
+        samples = self.resource_metrics(workload_key)
+        total_pods = sum(int(s.labels.get("pods", 0)) for s in samples)
+        if total_pods == 0:
+            return None
+        return (
+            sum(s.value * int(s.labels.get("pods", 0)) for s in samples) / total_pods
+        )
+
+    # -- custom / external metrics -----------------------------------------
+
+    def custom_metric(self, metric_name: str) -> list[MetricValue]:
+        out = []
+        for name in self.members.names():
+            member = self.members.get(name)
+            if member is None or not member.reachable:
+                continue
+            value = getattr(member, "custom_metrics", {}).get(metric_name)
+            if value is not None:
+                out.append(MetricValue(cluster=name, value=float(value)))
+        return out
+
+    def external_metric_sum(self, metric_name: str) -> Optional[float]:
+        samples = self.custom_metric(metric_name)
+        if not samples:
+            return None
+        return sum(s.value for s in samples)

@@ -1,0 +1,116 @@
+"""ResourceRegistry + multi-cluster cache.
+
+Ref: pkg/apis/search/v1alpha1 (ResourceRegistry: target clusters + resource
+selectors + backend) and pkg/search/controller.go (per-cluster caches for the
+selected GVKs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api.core import ObjectMeta, Resource
+from ..api.policy import ClusterAffinity
+from ..utils import DONE, Runtime, Store
+from ..utils.member import MemberClientRegistry, UnreachableError
+
+
+@dataclass
+class ResourceRegistrySpec:
+    target_cluster: ClusterAffinity = field(default_factory=ClusterAffinity)
+    resource_selectors: list[dict] = field(default_factory=list)  # {apiVersion, kind}
+    backend: str = "cache"  # cache | opensearch (external indexer plug point)
+
+
+@dataclass
+class ResourceRegistry:
+    KIND = "ResourceRegistry"
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ResourceRegistrySpec = field(default_factory=ResourceRegistrySpec)
+
+
+class MultiClusterCache:
+    """(cluster, gvk, namespace, name) -> Resource, queryable across
+    clusters. Fed by the SearchController's collection sweeps (the informer
+    analogue)."""
+
+    def __init__(self) -> None:
+        self._items: dict[tuple[str, str, str, str], Resource] = {}
+
+    def put(self, cluster: str, obj: Resource) -> None:
+        self._items[
+            (cluster, f"{obj.api_version}/{obj.kind}", obj.meta.namespace, obj.meta.name)
+        ] = obj
+
+    def drop_cluster(self, cluster: str) -> None:
+        self._items = {
+            k: v for k, v in self._items.items() if k[0] != cluster
+        }
+
+    def get(
+        self, gvk: str, namespace: str, name: str, cluster: Optional[str] = None
+    ) -> Optional[tuple[str, Resource]]:
+        for (c, g, ns, n), obj in self._items.items():
+            if g == gvk and ns == namespace and n == name:
+                if cluster is None or c == cluster:
+                    return c, obj
+        return None
+
+    def list(
+        self,
+        gvk: str,
+        namespace: Optional[str] = None,
+        labels: Optional[dict[str, str]] = None,
+    ) -> list[tuple[str, Resource]]:
+        out = []
+        for (c, g, ns, _), obj in self._items.items():
+            if g != gvk:
+                continue
+            if namespace is not None and ns != namespace:
+                continue
+            if labels and any(
+                obj.meta.labels.get(k) != v for k, v in labels.items()
+            ):
+                continue
+            out.append((c, obj))
+        return sorted(out, key=lambda t: (t[0], t[1].meta.namespaced_name))
+
+
+class SearchController:
+    """Builds/refreshes the cache for every ResourceRegistry
+    (pkg/search/controller.go)."""
+
+    def __init__(
+        self, store: Store, runtime: Runtime, members: MemberClientRegistry
+    ) -> None:
+        self.store = store
+        self.members = members
+        self.cache = MultiClusterCache()
+        self.worker = runtime.new_worker("search", self._reconcile)
+        store.watch("ResourceRegistry", lambda e: self.worker.enqueue(e.key))
+        runtime.add_ticker(self._sweep)
+
+    def _sweep(self) -> None:
+        for rr in self.store.list("ResourceRegistry"):
+            self.worker.enqueue(rr.meta.namespaced_name)
+
+    def _reconcile(self, key: str) -> Optional[str]:
+        rr = self.store.get("ResourceRegistry", key)
+        if rr is None:
+            return DONE
+        for cluster in self.store.list("Cluster"):
+            if not rr.spec.target_cluster.matches(cluster):
+                continue
+            member = self.members.get(cluster.name)
+            if member is None or not member.reachable:
+                continue
+            for sel in rr.spec.resource_selectors:
+                gvk = f"{sel.get('apiVersion', 'v1')}/{sel.get('kind', '')}"
+                try:
+                    for obj in member.list(gvk):
+                        self.cache.put(cluster.name, obj)
+                except UnreachableError:
+                    self.cache.drop_cluster(cluster.name)
+        return DONE

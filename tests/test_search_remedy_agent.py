@@ -1,0 +1,163 @@
+"""Search/proxy, remedy, pull-mode agent, metrics adapter tests."""
+
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.cluster import PULL
+from karmada_tpu.api.core import Condition, ObjectMeta, Resource, set_condition
+from karmada_tpu.api.policy import ClusterAffinity
+from karmada_tpu.controllers.remedy import (
+    DecisionMatch,
+    Remedy,
+    RemedySpec,
+    REMEDY_ACTIONS_ANNOTATION,
+)
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.search import ProxyRequest
+from karmada_tpu.search.registry import ResourceRegistry, ResourceRegistrySpec
+from karmada_tpu.utils.builders import (
+    duplicated_placement,
+    new_cluster,
+    new_deployment,
+)
+
+
+def member_pod(name, ns="default", phase="Running"):
+    return Resource(
+        api_version="v1",
+        kind="Pod",
+        meta=ObjectMeta(name=name, namespace=ns, labels={"app": "web"}),
+        spec={"containers": []},
+        status={"phase": phase},
+    )
+
+
+def make_plane(n=2):
+    cp = ControlPlane()
+    for i in range(1, n + 1):
+        cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+    cp.settle()
+    return cp
+
+
+class TestSearchAndProxy:
+    def test_registry_caches_member_resources(self):
+        cp = make_plane()
+        cp.members.get("member1").apply(member_pod("p1"))
+        cp.members.get("member2").apply(member_pod("p2"))
+        cp.store.apply(
+            ResourceRegistry(
+                meta=ObjectMeta(name="pods"),
+                spec=ResourceRegistrySpec(
+                    resource_selectors=[{"apiVersion": "v1", "kind": "Pod"}]
+                ),
+            )
+        )
+        cp.settle()
+        resp = cp.proxy.connect(ProxyRequest(verb="list", gvk="v1/Pod"))
+        assert resp.served_by == "cache"
+        assert {(c, o.meta.name) for c, o in resp.items} == {
+            ("member1", "p1"), ("member2", "p2"),
+        }
+
+    def test_cluster_proxy_passthrough(self):
+        cp = make_plane()
+        cp.members.get("member2").apply(member_pod("direct"))
+        resp = cp.proxy.connect(
+            ProxyRequest(verb="get", gvk="v1/Pod", namespace="default",
+                         name="direct", cluster="member2")
+        )
+        assert resp.served_by == "cluster" and resp.obj.meta.name == "direct"
+
+    def test_karmada_fallback_serves_templates(self):
+        cp = make_plane()
+        cp.store.apply(new_deployment("tmpl"))
+        resp = cp.proxy.connect(
+            ProxyRequest(verb="get", gvk="apps/v1/Deployment",
+                         namespace="default", name="tmpl")
+        )
+        assert resp.served_by == "karmada" and resp.obj.meta.name == "tmpl"
+
+
+class TestRemedy:
+    def test_traffic_control_applied_on_condition(self):
+        cp = make_plane()
+        cp.store.apply(
+            Remedy(
+                meta=ObjectMeta(name="dns-remedy"),
+                spec=RemedySpec(
+                    cluster_affinity=ClusterAffinity(cluster_names=["member1"]),
+                    decision_matches=[
+                        DecisionMatch(
+                            cluster_condition_type="ServiceDomainNameResolutionReady",
+                            cluster_condition_status="False",
+                        )
+                    ],
+                ),
+            )
+        )
+        cp.settle()
+        cluster = cp.store.get("Cluster", "member1")
+        assert REMEDY_ACTIONS_ANNOTATION not in cluster.meta.annotations
+        set_condition(
+            cluster.status.conditions,
+            Condition(type="ServiceDomainNameResolutionReady", status=False),
+        )
+        cp.store.apply(cluster)
+        cp.settle()
+        cluster = cp.store.get("Cluster", "member1")
+        assert cluster.meta.annotations[REMEDY_ACTIONS_ANNOTATION] == "TrafficControl"
+        # condition recovers -> action removed
+        set_condition(
+            cluster.status.conditions,
+            Condition(type="ServiceDomainNameResolutionReady", status=True),
+        )
+        cp.store.apply(cluster)
+        cp.settle()
+        cluster = cp.store.get("Cluster", "member1")
+        assert REMEDY_ACTIONS_ANNOTATION not in cluster.meta.annotations
+
+
+class TestPullModeAgent:
+    def test_agent_applies_works_and_reports_status(self):
+        cp = ControlPlane()
+        push = new_cluster("pusher", cpu="100", memory="200Gi")
+        pull = new_cluster("puller", cpu="100", memory="200Gi")
+        pull.spec.sync_mode = PULL
+        cp.join_cluster(push)
+        cp.join_cluster(pull)
+        cp.settle()
+        cp.store.apply(new_deployment("app", replicas=2))
+        cp.store.apply(
+            PropagationPolicy(
+                meta=ObjectMeta(name="p", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="apps/v1", kind="Deployment")
+                    ],
+                    placement=duplicated_placement(),
+                ),
+            )
+        )
+        cp.settle()
+        # the pull cluster got the deployment via its agent, not the pusher path
+        obj = cp.members.get("puller").get("apps/v1/Deployment", "default", "app")
+        assert obj is not None and obj.spec["replicas"] == 2
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert {i.cluster_name for i in rb.status.aggregated_status} >= {"puller"}
+
+
+class TestMetricsAdapter:
+    def test_weighted_merge(self):
+        cp = make_plane()
+        cp.members.get("member1").pod_metrics["default/web"] = {
+            "pods": 3, "cpu_utilization": 90.0,
+        }
+        cp.members.get("member2").pod_metrics["default/web"] = {
+            "pods": 1, "cpu_utilization": 10.0,
+        }
+        assert cp.metrics_adapter.merged_utilization("default/web") == 70.0
+
+    def test_external_metric_sum(self):
+        cp = make_plane()
+        cp.members.get("member1").custom_metrics = {"queue_depth": 5}
+        cp.members.get("member2").custom_metrics = {"queue_depth": 7}
+        assert cp.metrics_adapter.external_metric_sum("queue_depth") == 12
