@@ -1,0 +1,255 @@
+"""karmadactl-style operations (ref: pkg/karmadactl/karmadactl.go:98-178).
+
+The reference CLI talks to a remote control plane; here every command is a
+function over a ControlPlane handle (the in-proc apiserver seam), so the same
+operations serve tests, the demo driver, and a future remote transport:
+
+- lifecycle: init (local_up), join / unjoin (push), register / unregister
+  (pull), addons
+- ops: get / describe / top across clusters (via the search proxy +
+  metrics adapter)
+- migration: promote (import a member resource as template + policy)
+- maintenance: cordon / uncordon, taint
+- interpret: dry-run interpreter operations against a template
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from .api.cluster import NO_EXECUTE, NO_SCHEDULE, PULL, Cluster, Taint
+from .api.core import ObjectMeta
+from .api.policy import (
+    ClusterAffinity,
+    Placement,
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+)
+from .controlplane import ControlPlane
+from .search import ProxyRequest
+from .utils.builders import new_cluster
+from .utils.member import MemberCluster
+
+CORDON_TAINT_KEY = "node.karmada.io/unschedulable"  # cordon analogue
+
+
+def cmd_init(**kw) -> ControlPlane:
+    """Bootstrap a control plane (karmadactl init / operator install)."""
+    return ControlPlane(**kw)
+
+
+def cmd_local_up(n_members: int = 3, **kw) -> ControlPlane:
+    """hack/local-up-karmada.sh: control plane + n members (last one Pull)."""
+    cp = cmd_init(**kw)
+    for i in range(1, n_members + 1):
+        cluster = new_cluster(f"member{i}", cpu="100", memory="200Gi")
+        if i == n_members and n_members >= 3:
+            cluster.spec.sync_mode = PULL
+        cp.join_cluster(cluster)
+    cp.settle()
+    return cp
+
+
+def cmd_join(
+    cp: ControlPlane, name: str, member: Optional[MemberCluster] = None, **cluster_kw
+) -> Cluster:
+    """Push-mode join (pkg/karmadactl/join)."""
+    cluster = new_cluster(name, **cluster_kw)
+    cp.join_cluster(cluster, member)
+    return cluster
+
+
+def cmd_unjoin(cp: ControlPlane, name: str) -> None:
+    cp.unjoin_cluster(name)
+
+
+def cmd_register(
+    cp: ControlPlane, name: str, member: Optional[MemberCluster] = None, **cluster_kw
+) -> Cluster:
+    """Pull-mode register (pkg/karmadactl/register): deploys the agent."""
+    cluster = new_cluster(name, **cluster_kw)
+    cluster.spec.sync_mode = PULL
+    cp.join_cluster(cluster, member)
+    return cluster
+
+
+def cmd_unregister(cp: ControlPlane, name: str) -> None:
+    cp.unjoin_cluster(name)
+
+
+def cmd_cordon(cp: ControlPlane, name: str) -> None:
+    """Mark a cluster unschedulable (pkg/karmadactl/cordon)."""
+    cluster = cp.store.get("Cluster", name)
+    if cluster is None:
+        raise KeyError(name)
+    if not any(t.key == CORDON_TAINT_KEY for t in cluster.spec.taints):
+        cluster.spec.taints.append(Taint(key=CORDON_TAINT_KEY, effect=NO_SCHEDULE))
+        cp.store.apply(cluster)
+
+
+def cmd_uncordon(cp: ControlPlane, name: str) -> None:
+    cluster = cp.store.get("Cluster", name)
+    if cluster is None:
+        raise KeyError(name)
+    before = len(cluster.spec.taints)
+    cluster.spec.taints = [
+        t for t in cluster.spec.taints if t.key != CORDON_TAINT_KEY
+    ]
+    if len(cluster.spec.taints) != before:
+        cp.store.apply(cluster)
+
+
+def cmd_taint(
+    cp: ControlPlane, name: str, key: str, value: str = "", effect: str = NO_SCHEDULE,
+    remove: bool = False,
+) -> None:
+    """pkg/karmadactl/cordon taint command analogue."""
+    cluster = cp.store.get("Cluster", name)
+    if cluster is None:
+        raise KeyError(name)
+    cluster.spec.taints = [
+        t for t in cluster.spec.taints if not (t.key == key and t.effect == effect)
+    ]
+    if not remove:
+        cluster.spec.taints.append(Taint(key=key, value=value, effect=effect))
+    cp.store.apply(cluster)
+
+
+def cmd_get(
+    cp: ControlPlane,
+    gvk: str,
+    namespace: str = "",
+    name: str = "",
+    cluster: Optional[str] = None,
+    labels: Optional[dict] = None,
+):
+    """Multi-cluster get/list through the proxy chain."""
+    verb = "get" if name else "list"
+    return cp.proxy.connect(
+        ProxyRequest(
+            verb=verb, gvk=gvk, namespace=namespace, name=name,
+            cluster=cluster, labels=dict(labels or {}),
+        )
+    )
+
+
+def cmd_describe(cp: ControlPlane, gvk: str, namespace: str, name: str) -> str:
+    """Aggregated description: template + binding + per-cluster status."""
+    lines = [f"{gvk} {namespace}/{name}"]
+    resp = cmd_get(cp, gvk, namespace, name)
+    if resp.obj is None:
+        return f"{gvk} {namespace}/{name}: not found"
+    kind = gvk.rsplit("/", 1)[-1].lower()
+    rb = cp.store.get(
+        "ResourceBinding",
+        f"{namespace}/{name}-{kind}" if namespace else f"{name}-{kind}",
+    )
+    if rb is not None:
+        lines.append("placements:")
+        for tc in rb.spec.clusters:
+            lines.append(f"  {tc.name}: {tc.replicas} replicas")
+        for item in rb.status.aggregated_status:
+            lines.append(
+                f"  {item.cluster_name}: applied={item.applied} health={item.health}"
+            )
+    return "\n".join(lines)
+
+
+def cmd_top(cp: ControlPlane, workload_key: str):
+    """Per-cluster + merged utilization (pkg/karmadactl/top)."""
+    samples = cp.metrics_adapter.resource_metrics(workload_key)
+    merged = cp.metrics_adapter.merged_utilization(workload_key)
+    return {"clusters": {s.cluster: s.value for s in samples}, "merged": merged}
+
+
+def cmd_promote(
+    cp: ControlPlane, cluster_name: str, gvk: str, namespace: str, name: str
+) -> None:
+    """Import an existing member-cluster resource into the control plane as a
+    template + policy pinned to that cluster (pkg/karmadactl/promote)."""
+    member = cp.members.get(cluster_name)
+    if member is None:
+        raise KeyError(cluster_name)
+    obj = member.get(gvk, namespace, name)
+    if obj is None:
+        raise KeyError(f"{gvk} {namespace}/{name} not found in {cluster_name}")
+    import copy
+
+    template = copy.deepcopy(obj)
+    template.meta.resource_version = 0
+    cp.store.apply(template)
+    api_version, _, kind = gvk.rpartition("/")
+    cp.store.apply(
+        PropagationPolicy(
+            meta=ObjectMeta(name=f"promote-{name}", namespace=namespace),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(
+                        api_version=api_version, kind=kind,
+                        namespace=namespace, name=name,
+                    )
+                ],
+                placement=Placement(
+                    cluster_affinity=ClusterAffinity(cluster_names=[cluster_name])
+                ),
+            ),
+        )
+    )
+
+
+def cmd_interpret(cp: ControlPlane, template, operation: str, **kw):
+    """Dry-run an interpreter operation (pkg/karmadactl/interpret)."""
+    interp = cp.interpreter
+    if operation == "GetReplicas":
+        return interp.get_replicas(template)
+    if operation == "ReviseReplica":
+        return interp.revise_replica(template, kw["replicas"])
+    if operation == "InterpretHealth":
+        return interp.interpret_health(template)
+    if operation == "ReflectStatus":
+        return interp.reflect_status(template)
+    if operation == "GetDependencies":
+        return interp.get_dependencies(template)
+    if operation == "AggregateStatus":
+        return interp.aggregate_status(template, kw.get("items", []))
+    raise ValueError(f"unknown operation {operation}")
+
+
+def cmd_addons(cp: ControlPlane, enable: Sequence[str] = (), disable: Sequence[str] = ()):
+    """Toggle optional components (pkg/karmadactl/addons: estimator,
+    descheduler, search, metrics-adapter)."""
+    from .controllers import Descheduler
+
+    state = {}
+    for name in enable:
+        if name == "karmada-descheduler" and cp.descheduler is None:
+            cp.descheduler = Descheduler(cp.store, cp.runtime, cp.members)
+        state[name] = "enabled"
+    for name in disable:
+        if name == "karmada-descheduler":
+            cp.descheduler = None
+        state[name] = "disabled"
+    return state
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """Thin argparse front end over a fresh local-up plane (demo mode)."""
+    parser = argparse.ArgumentParser(prog="karmadactl-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+    lu = sub.add_parser("local-up", help="bootstrap a demo control plane")
+    lu.add_argument("--members", type=int, default=3)
+    args = parser.parse_args(argv)
+    if args.command == "local-up":
+        cp = cmd_local_up(args.members)
+        clusters = [c.name for c in cp.store.list("Cluster")]
+        print(json.dumps({"clusters": clusters}))
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

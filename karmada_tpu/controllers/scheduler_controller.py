@@ -88,16 +88,18 @@ class SchedulerController:
             return True, False
         if not any(c.type == SCHEDULED for c in rb.status.conditions):
             return True, False  # never attempted
-        # replicas drift vs assignment (scale scheduling) — only meaningful
-        # for divided placements (Duplicated assigns replicas per cluster)
         divided = (
             rb.spec.placement is not None
             and rb.spec.placement.replica_scheduling_type() == "Divided"
         )
+        # Duplicated (and non-workload) bindings are always (re)scheduled so
+        # cluster-set changes take effect (scheduler.go:393-401); the result
+        # write-back below is change-detected, so this stays quiescent.
+        if rb.spec.replicas == 0 or not divided:
+            return True, False
+        # replicas drift vs assignment (scale scheduling)
         assigned = sum(tc.replicas for tc in rb.spec.clusters)
-        if divided and rb.spec.replicas > 0 and rb.spec.clusters and (
-            assigned != rb.spec.replicas
-        ):
+        if rb.spec.clusters and assigned != rb.spec.replicas:
             return True, False
         return False, False
 
@@ -127,6 +129,8 @@ class SchedulerController:
             fresh=fresh,
         )
         [result] = engine.schedule([problem])
+        before = [(tc.name, tc.replicas) for tc in rb.spec.clusters]
+        changed = rb.status.scheduler_observed_generation != rb.meta.generation
         if result.success:
             if rb.spec.replicas > 0:
                 rb.spec.clusters = [
@@ -138,16 +142,24 @@ class SchedulerController:
                 rb.spec.clusters = [
                     TargetCluster(name=n) for n in sorted(result.feasible)
                 ]
+            if [(tc.name, tc.replicas) for tc in rb.spec.clusters] != before:
+                changed = True
+                rb.status.last_scheduled_time = time.time()
             rb.status.scheduler_observed_generation = rb.meta.generation
-            rb.status.scheduler_observed_affinity_name = result.affinity_name
-            rb.status.last_scheduled_time = time.time()
-            set_condition(
+            if rb.status.scheduler_observed_affinity_name != result.affinity_name:
+                rb.status.scheduler_observed_affinity_name = result.affinity_name
+                changed = True
+            if rb.status.last_scheduled_time is None:
+                rb.status.last_scheduled_time = time.time()
+                changed = True
+            if set_condition(
                 rb.status.conditions,
                 Condition(type=SCHEDULED, status=True, reason="Success"),
-            )
+            ):
+                changed = True
         else:
             rb.status.scheduler_observed_generation = rb.meta.generation
-            set_condition(
+            if set_condition(
                 rb.status.conditions,
                 Condition(
                     type=SCHEDULED,
@@ -155,6 +167,8 @@ class SchedulerController:
                     reason="NoClusterFit",
                     message=result.error,
                 ),
-            )
-        self.store.apply(rb)
+            ):
+                changed = True
+        if changed:
+            self.store.apply(rb)
         return DONE

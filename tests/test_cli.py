@@ -1,0 +1,120 @@
+"""karmadactl-analogue tests (ref: pkg/karmadactl command behaviors)."""
+
+from karmada_tpu import cli
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.utils.builders import (
+    duplicated_placement,
+    new_deployment,
+)
+
+
+def policy(placement):
+    return PropagationPolicy(
+        meta=ObjectMeta(name="p", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=placement,
+        ),
+    )
+
+
+class TestLifecycle:
+    def test_local_up_with_pull_member(self):
+        cp = cli.cmd_local_up(3)
+        clusters = {c.name: c.spec.sync_mode for c in cp.store.list("Cluster")}
+        assert set(clusters) == {"member1", "member2", "member3"}
+        assert clusters["member3"] == "Pull"
+        assert "member3" in cp.agents
+
+    def test_join_unjoin(self):
+        cp = cli.cmd_init()
+        cli.cmd_join(cp, "m1")
+        cp.settle()
+        assert cp.store.get("Cluster", "m1") is not None
+        cli.cmd_unjoin(cp, "m1")
+        assert cp.store.get("Cluster", "m1") is None
+
+
+class TestMaintenance:
+    def test_cordon_excludes_from_scheduling(self):
+        cp = cli.cmd_local_up(2)
+        cli.cmd_cordon(cp, "member2")
+        cp.settle()
+        cp.store.apply(new_deployment("app", replicas=1))
+        cp.store.apply(policy(duplicated_placement()))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert {tc.name for tc in rb.spec.clusters} == {"member1"}
+        cli.cmd_uncordon(cp, "member2")
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert {tc.name for tc in rb.spec.clusters} == {"member1", "member2"}
+
+    def test_taint_add_remove(self):
+        cp = cli.cmd_local_up(1)
+        cli.cmd_taint(cp, "member1", key="dedicated", value="infra")
+        cluster = cp.store.get("Cluster", "member1")
+        assert any(t.key == "dedicated" for t in cluster.spec.taints)
+        cli.cmd_taint(cp, "member1", key="dedicated", remove=True)
+        cluster = cp.store.get("Cluster", "member1")
+        assert not any(t.key == "dedicated" for t in cluster.spec.taints)
+
+
+class TestOps:
+    def test_promote_imports_member_resource(self):
+        cp = cli.cmd_local_up(2)
+        member = cp.members.get("member1")
+        member.apply(
+            Resource(
+                api_version="v1",
+                kind="ConfigMap",
+                meta=ObjectMeta(name="legacy", namespace="default"),
+                spec={"data": {"k": "v"}},
+            )
+        )
+        cli.cmd_promote(cp, "member1", "v1/ConfigMap", "default", "legacy")
+        cp.settle()
+        assert cp.store.get("Resource", "default/legacy") is not None
+        rb = cp.store.get("ResourceBinding", "default/legacy-configmap")
+        assert rb is not None
+        assert {tc.name for tc in rb.spec.clusters} == {"member1"}
+
+    def test_describe_and_top(self):
+        cp = cli.cmd_local_up(2)
+        cp.store.apply(new_deployment("app", replicas=2))
+        cp.store.apply(policy(duplicated_placement()))
+        cp.settle()
+        out = cli.cmd_describe(cp, "apps/v1/Deployment", "default", "app")
+        assert "member1: 2 replicas" in out
+        cp.members.get("member1").pod_metrics["default/app"] = {
+            "pods": 2, "cpu_utilization": 42.0,
+        }
+        top = cli.cmd_top(cp, "default/app")
+        assert top["clusters"] == {"member1": 42.0}
+
+    def test_interpret_dry_run(self):
+        cp = cli.cmd_init()
+        template = new_deployment("app", replicas=7)
+        replicas, reqs = cli.cmd_interpret(cp, template, "GetReplicas")
+        assert replicas == 7 and reqs.resource_request["cpu"] == 250
+        revised = cli.cmd_interpret(cp, template, "ReviseReplica", replicas=3)
+        assert revised.spec["replicas"] == 3
+
+    def test_main_local_up(self, capsys):
+        assert cli.main(["local-up", "--members", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "member1" in out
+
+
+class TestAddons:
+    def test_toggle_descheduler(self):
+        cp = cli.cmd_local_up(1)
+        assert cp.descheduler is None
+        state = cli.cmd_addons(cp, enable=["karmada-descheduler"])
+        assert state["karmada-descheduler"] == "enabled"
+        assert cp.descheduler is not None
+        cli.cmd_addons(cp, disable=["karmada-descheduler"])
+        assert cp.descheduler is None
