@@ -111,6 +111,9 @@ class SchedulerController:
         should, fresh = self._needs_scheduling(rb)
         if not should:
             return DONE
+        from ..utils.metrics import e2e_scheduling_duration, schedule_attempts
+
+        start = time.perf_counter()
         engine = self._get_engine()
         problem = BindingProblem(
             key=key,
@@ -171,4 +174,9 @@ class SchedulerController:
                 changed = True
         if changed:
             self.store.apply(rb)
+        e2e_scheduling_duration.observe(time.perf_counter() - start)
+        schedule_attempts.inc(
+            result="success" if result.success else "error",
+            schedule_type="FreshSchedule" if fresh else "ReconcileSchedule",
+        )
         return DONE

@@ -1,0 +1,179 @@
+"""Estimator service contract + scheduler-side connection machinery.
+
+Ref: pkg/estimator/service/service.proto:26-29 (service Estimator —
+MaxAvailableReplicas / GetUnschedulableReplicas), pb/types.go:26-119
+(request/response shapes), client/{cache,service}.go (per-cluster connection
+cache, naming-convention discovery {prefix}-{cluster}:port) and
+client/accurate.go:139-162 (concurrent fan-out under one deadline).
+
+The wire types are dataclasses with dict (de)serialization — the protobuf
+schema shape without generated code. Transports are pluggable: the in-proc
+transport calls the service object directly (this image ships no grpcio);
+a gRPC transport slots into ``EstimatorConnection.call`` without touching
+the scheduler side.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..api.work import ReplicaRequirements
+from .accurate import UNAUTHENTIC, AccurateEstimator
+
+
+@dataclass
+class MaxAvailableReplicasRequest:
+    cluster: str = ""
+    # ReplicaRequirements (pb/types.go:52-69)
+    resource_request: dict[str, int] = field(default_factory=dict)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[dict] = field(default_factory=list)
+    namespace: str = ""
+    priority_class_name: str = ""
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass
+class MaxAvailableReplicasResponse:
+    max_replicas: int = 0
+
+
+@dataclass
+class UnschedulableReplicasRequest:
+    cluster: str = ""
+    resource_kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    unschedulable_threshold_seconds: int = 60
+
+
+@dataclass
+class UnschedulableReplicasResponse:
+    unschedulable_replicas: int = 0
+
+
+class EstimatorService:
+    """Server side: wraps one cluster's AccurateEstimator behind the service
+    contract (ref: server/server.go:194-225)."""
+
+    def __init__(self, estimator: AccurateEstimator):
+        self.estimator = estimator
+
+    def max_available_replicas(
+        self, req: MaxAvailableReplicasRequest
+    ) -> MaxAvailableReplicasResponse:
+        requirements = ReplicaRequirements(
+            resource_request=dict(req.resource_request),
+            namespace=req.namespace,
+            priority_class_name=req.priority_class_name,
+        )
+        if req.node_selector or req.tolerations:
+            from ..api.work import NodeClaim
+
+            requirements.node_claim = NodeClaim(
+                node_selector=dict(req.node_selector),
+                tolerations=list(req.tolerations),
+            )
+        dims = self.estimator.snapshot.dims
+        row = np.zeros((1, len(dims)), np.int64)
+        for j, d in enumerate(dims):
+            row[0, j] = req.resource_request.get(d, 0)
+        out = self.estimator.max_available_replicas(requirements, row)
+        return MaxAvailableReplicasResponse(max_replicas=int(out[0]))
+
+    def get_unschedulable_replicas(
+        self, req: UnschedulableReplicasRequest
+    ) -> UnschedulableReplicasResponse:
+        key = f"{req.namespace}/{req.name}" if req.namespace else req.name
+        return UnschedulableReplicasResponse(
+            unschedulable_replicas=self.estimator.get_unschedulable_replicas(key)
+        )
+
+
+class EstimatorConnection:
+    """One cluster's channel. ``call`` is the transport seam."""
+
+    def __init__(self, cluster: str, service: EstimatorService):
+        self.cluster = cluster
+        self._service = service
+
+    def call(self, method: str, request):
+        if method == "MaxAvailableReplicas":
+            return self._service.max_available_replicas(request)
+        if method == "GetUnschedulableReplicas":
+            return self._service.get_unschedulable_replicas(request)
+        raise ValueError(f"unknown method {method}")
+
+
+class EstimatorClientPool:
+    """Scheduler-side connection cache + service discovery
+    (client/cache.go + client/service.go). Discovery resolves
+    ``{prefix}-{cluster}`` through a resolver callable — the DNS-by-
+    convention analogue."""
+
+    def __init__(
+        self,
+        resolver: Callable[[str], Optional[EstimatorService]],
+        timeout_seconds: float = 3.0,
+    ):
+        self.resolver = resolver
+        self.timeout = timeout_seconds
+        self._conns: dict[str, EstimatorConnection] = {}
+        self._lock = threading.Lock()
+
+    def connection(self, cluster: str) -> Optional[EstimatorConnection]:
+        with self._lock:
+            conn = self._conns.get(cluster)
+        if conn is not None:
+            return conn
+        service = self.resolver(cluster)
+        if service is None:
+            return None
+        conn = EstimatorConnection(cluster, service)
+        with self._lock:
+            self._conns[cluster] = conn
+        return conn
+
+    def evict(self, cluster: str) -> None:
+        with self._lock:
+            self._conns.pop(cluster, None)
+
+    def max_available_replicas(
+        self,
+        clusters: list[str],
+        resource_request: dict[str, int],
+        **req_kw,
+    ) -> dict[str, int]:
+        """Concurrent fan-out with one shared deadline
+        (client/accurate.go:139-162). Clusters without a connection answer
+        UnauthenticReplica (-1)."""
+        results: dict[str, int] = {c: UNAUTHENTIC for c in clusters}
+        deadline = time.time() + self.timeout
+        threads = []
+
+        def one(cluster: str) -> None:
+            conn = self.connection(cluster)
+            if conn is None:
+                return
+            resp = conn.call(
+                "MaxAvailableReplicas",
+                MaxAvailableReplicasRequest(
+                    cluster=cluster, resource_request=resource_request, **req_kw
+                ),
+            )
+            results[cluster] = resp.max_replicas
+
+        for c in clusters:
+            t = threading.Thread(target=one, args=(c,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(max(deadline - time.time(), 0.0))
+        return results

@@ -1,0 +1,14 @@
+"""Operator: declarative install/upgrade of a control plane from a CR.
+
+Ref: operator/ (21.5k LoC) — a `Karmada` CR (operator/pkg/apis/operator/
+v1alpha1/type.go:32) reconciled through a workflow engine of init/deinit
+tasks (operator/pkg/workflow/{job,task}.go, operator/pkg/tasks/{init,deinit}).
+Here the artifact being installed is the in-process ControlPlane; the
+workflow engine is generic (ordered tasks with sub-tasks, run-data bag,
+failure propagation) and the init pipeline mirrors the reference's
+certs -> etcd -> apiserver -> components -> wait sequence at the granularity
+that exists in-process.
+"""
+
+from .workflow import Job, Task, WorkflowError  # noqa: F401
+from .karmada_operator import Karmada, KarmadaOperator, KarmadaSpec  # noqa: F401

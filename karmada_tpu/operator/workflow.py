@@ -1,0 +1,55 @@
+"""Workflow engine: ordered tasks with nested sub-tasks and a shared
+run-data bag (ref: operator/pkg/workflow/job.go + task.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+class WorkflowError(Exception):
+    def __init__(self, task_name: str, cause: Exception):
+        super().__init__(f"task {task_name!r} failed: {cause}")
+        self.task_name = task_name
+        self.cause = cause
+
+
+@dataclass
+class Task:
+    name: str
+    run: Optional[Callable[[dict], None]] = None
+    # skip gate: returns True to skip this task (and its children)
+    skip: Optional[Callable[[dict], bool]] = None
+    tasks: list["Task"] = field(default_factory=list)
+    run_sub_tasks: bool = True
+
+
+@dataclass
+class Job:
+    """Executes tasks depth-first in declaration order; the ``data`` dict is
+    the RunData every task shares. Failure aborts the job (the reference's
+    workflow halts and surfaces the failed task)."""
+
+    tasks: list[Task] = field(default_factory=list)
+    data: dict[str, Any] = field(default_factory=dict)
+    completed: list[str] = field(default_factory=list)
+
+    def append_task(self, task: Task) -> None:
+        self.tasks.append(task)
+
+    def run(self) -> None:
+        for task in self.tasks:
+            self._run_task(task)
+
+    def _run_task(self, task: Task) -> None:
+        if task.skip is not None and task.skip(self.data):
+            return
+        if task.run is not None:
+            try:
+                task.run(self.data)
+            except Exception as e:  # noqa: BLE001 — workflow surfaces any failure
+                raise WorkflowError(task.name, e) from e
+        self.completed.append(task.name)
+        if task.run_sub_tasks:
+            for sub in task.tasks:
+                self._run_task(sub)

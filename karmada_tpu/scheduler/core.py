@@ -260,20 +260,35 @@ class TensorScheduler:
         compiled: list[CompiledPlacement],
         term_round: int,
     ) -> list[ScheduleResult]:
+        from ..utils.metrics import scheduling_algorithm_duration as algo_timer
+
         snap = self.snapshot
-        feasible, strategy, replicas, static_w, requests, prev, fresh = (
-            self._pack_chunk(problems, compiled, term_round)
-        )
-        avail = self._availability(requests, replicas)
+        with algo_timer.time(schedule_step="Filter"):
+            feasible, strategy, replicas, static_w, requests, prev, fresh = (
+                self._pack_chunk(problems, compiled, term_round)
+            )
+        with algo_timer.time(schedule_step="Score"):
+            avail = self._availability(requests, replicas)
 
         # Select: spread-constraint group selection narrows the candidate set
         from .spread import select_clusters_batch  # local import (cycle-free)
 
-        candidates = select_clusters_batch(
-            snap, problems, compiled, term_round, feasible, np.asarray(avail), prev
-        )
+        with algo_timer.time(schedule_step="Select"):
+            candidates = select_clusters_batch(
+                snap, problems, compiled, term_round, feasible,
+                np.asarray(avail), prev,
+            )
 
-        res = divide_replicas(
+        with algo_timer.time(schedule_step="AssignReplicas"):
+            res = self._assign(strategy, replicas, candidates, static_w, avail,
+                               prev, fresh)
+        assignment = np.asarray(res.assignment)
+        unschedulable = np.asarray(res.unschedulable)
+        return self._unpack(problems, compiled, term_round, candidates,
+                            assignment, unschedulable)
+
+    def _assign(self, strategy, replicas, candidates, static_w, avail, prev, fresh):
+        return divide_replicas(
             jnp.asarray(strategy),
             jnp.asarray(replicas),
             jnp.asarray(candidates),
@@ -282,9 +297,11 @@ class TensorScheduler:
             jnp.asarray(prev),
             jnp.asarray(fresh),
         )
-        assignment = np.asarray(res.assignment)
-        unschedulable = np.asarray(res.unschedulable)
 
+    def _unpack(
+        self, problems, compiled, term_round, candidates, assignment, unschedulable
+    ) -> list[ScheduleResult]:
+        snap = self.snapshot
         out = []
         for i, p in enumerate(problems):
             term_idx = min(term_round, len(compiled[i].terms) - 1)

@@ -1,0 +1,143 @@
+"""Observability: counters/histograms with a Prometheus-style registry.
+
+Ref: pkg/scheduler/metrics/metrics.go:61-115 (schedule_attempts_total,
+e2e_scheduling_duration_seconds, scheduling_algorithm_duration_seconds
+{schedule_step=Filter|Score|Select|AssignReplicas}, per-plugin timers) and
+pkg/metrics (controller metrics). Text exposition follows the Prometheus
+format so a scraper can consume ``render()`` directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Iterable, Optional
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+def _label_key(labels: dict[str, str]) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] += amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> Iterable[str]:
+        yield f"# TYPE {self.name} counter"
+        for key, v in sorted(self._values.items()):
+            label_s = ",".join(f'{k}="{val}"' for k, val in key)
+            yield f"{self.name}{{{label_s}}} {v}" if label_s else f"{self.name} {v}"
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._totals: dict[tuple, int] = defaultdict(int)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    @contextmanager
+    def time(self, **labels):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - start, **labels)
+
+    def summary(self, **labels) -> Optional[dict]:
+        key = _label_key(labels)
+        if key not in self._totals:
+            return None
+        return {
+            "count": self._totals[key],
+            "sum": self._sums[key],
+            "avg": self._sums[key] / max(self._totals[key], 1),
+        }
+
+    def render(self) -> Iterable[str]:
+        yield f"# TYPE {self.name} histogram"
+        for key in sorted(self._totals):
+            label_s = ",".join(f'{k}="{v}"' for k, v in key)
+            prefix = f"{self.name}_bucket{{{label_s}" if label_s else f"{self.name}_bucket{{"
+            counts = self._counts[key]  # already cumulative (observe adds to
+            # every bucket whose bound covers the value)
+            for i, bound in enumerate(self.buckets):
+                sep = "," if label_s else ""
+                yield f'{prefix}{sep}le="{bound}"}} {counts[i]}'
+            sep = "," if label_s else ""
+            yield f'{prefix}{sep}le="+Inf"}} {self._totals[key]}'
+            base = f"{self.name}_sum{{{label_s}}}" if label_s else f"{self.name}_sum"
+            yield f"{base} {self._sums[key]}"
+            base = f"{self.name}_count{{{label_s}}}" if label_s else f"{self.name}_count"
+            yield f"{base} {self._totals[key]}"
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: list = []
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        c = Counter(name, help_)
+        self._metrics.append(c)
+        return c
+
+    def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        h = Histogram(name, help_, buckets)
+        self._metrics.append(h)
+        return h
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# global registry + the scheduler metric set (metrics.go:61-115)
+registry = Registry()
+
+schedule_attempts = registry.counter(
+    "karmada_scheduler_schedule_attempts_total",
+    "scheduling attempts by result and type",
+)
+e2e_scheduling_duration = registry.histogram(
+    "karmada_scheduler_e2e_scheduling_duration_seconds",
+    "end-to-end schedule latency",
+)
+scheduling_algorithm_duration = registry.histogram(
+    "karmada_scheduler_scheduling_algorithm_duration_seconds",
+    "per-step scheduling latency",
+)
+queue_incoming_bindings = registry.counter(
+    "karmada_scheduler_queue_incoming_bindings_total",
+    "queue pressure by event",
+)

@@ -1,0 +1,157 @@
+"""Operator workflow engine, estimator service contract, metrics registry."""
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.estimator import AccurateEstimator, NodeSnapshot, NodeState
+from karmada_tpu.estimator.service import (
+    EstimatorClientPool,
+    EstimatorService,
+    MaxAvailableReplicasRequest,
+    UnschedulableReplicasRequest,
+)
+from karmada_tpu.operator import (
+    Job,
+    Karmada,
+    KarmadaOperator,
+    KarmadaSpec,
+    Task,
+    WorkflowError,
+)
+from karmada_tpu.operator.karmada_operator import KarmadaComponents
+from karmada_tpu.utils.metrics import Registry
+from karmada_tpu.utils.quantity import parse_resource_list
+
+DIMS = ["cpu", "memory", "pods", "ephemeral-storage"]
+
+
+class TestWorkflow:
+    def test_ordered_execution_with_subtasks(self):
+        seen = []
+        job = Job(
+            tasks=[
+                Task(name="a", run=lambda d: seen.append("a"),
+                     tasks=[Task(name="a.1", run=lambda d: seen.append("a.1"))]),
+                Task(name="b", run=lambda d: seen.append("b")),
+            ]
+        )
+        job.run()
+        assert seen == ["a", "a.1", "b"]
+        assert job.completed == ["a", "a.1", "b"]
+
+    def test_skip_gate_skips_children(self):
+        seen = []
+        job = Job(
+            tasks=[
+                Task(name="a", skip=lambda d: True, run=lambda d: seen.append("a"),
+                     tasks=[Task(name="a.1", run=lambda d: seen.append("a.1"))]),
+            ]
+        )
+        job.run()
+        assert seen == []
+
+    def test_failure_propagates(self):
+        def boom(d):
+            raise RuntimeError("nope")
+
+        job = Job(tasks=[Task(name="bad", run=boom)])
+        with pytest.raises(WorkflowError, match="bad"):
+            job.run()
+
+
+class TestKarmadaOperator:
+    def test_install_and_deinit(self):
+        op = KarmadaOperator()
+        karmada = Karmada(
+            meta=ObjectMeta(name="prod"),
+            spec=KarmadaSpec(
+                components=KarmadaComponents(descheduler=True),
+                member_clusters=["m1", "m2"],
+            ),
+        )
+        cp = op.reconcile(karmada)
+        assert any(c.type == "Ready" and c.status for c in karmada.status.conditions)
+        assert "join-members" in karmada.status.completed_tasks
+        assert {c.name for c in cp.store.list("Cluster")} == {"m1", "m2"}
+        assert cp.descheduler is not None
+        op.deinit(karmada)
+        assert not any(
+            c.type == "Ready" and c.status for c in karmada.status.conditions
+        )
+
+
+class TestEstimatorService:
+    def _service(self):
+        nodes = [
+            NodeState(
+                name="n0",
+                allocatable=parse_resource_list(
+                    {"cpu": "8", "memory": "32Gi", "pods": 110}
+                ),
+            )
+        ]
+        est = AccurateEstimator("m1", NodeSnapshot(nodes, DIMS))
+        est.unschedulable["default/web"] = 3
+        return EstimatorService(est)
+
+    def test_max_available_replicas(self):
+        svc = self._service()
+        resp = svc.max_available_replicas(
+            MaxAvailableReplicasRequest(
+                cluster="m1",
+                resource_request=parse_resource_list({"cpu": "2", "pods": 1}),
+            )
+        )
+        assert resp.max_replicas == 4
+
+    def test_unschedulable_replicas(self):
+        svc = self._service()
+        resp = svc.get_unschedulable_replicas(
+            UnschedulableReplicasRequest(cluster="m1", namespace="default", name="web")
+        )
+        assert resp.unschedulable_replicas == 3
+
+    def test_pool_fanout_with_missing_cluster(self):
+        svc = self._service()
+        pool = EstimatorClientPool(
+            resolver=lambda name: svc if name == "m1" else None
+        )
+        out = pool.max_available_replicas(
+            ["m1", "ghost"], parse_resource_list({"cpu": "2", "pods": 1})
+        )
+        assert out == {"m1": 4, "ghost": -1}
+
+
+class TestMetrics:
+    def test_counter_and_histogram_render(self):
+        reg = Registry()
+        c = reg.counter("requests_total")
+        h = reg.histogram("latency_seconds", buckets=(0.1, 1.0))
+        c.inc(result="ok")
+        c.inc(result="ok")
+        h.observe(0.05)
+        h.observe(0.5)
+        text = reg.render()
+        assert 'requests_total{result="ok"} 2.0' in text
+        assert 'latency_seconds_bucket{le="0.1"} 1' in text
+        assert 'latency_seconds_bucket{le="1.0"} 2' in text
+        assert "latency_seconds_count 2" in text
+        assert h.summary()["count"] == 2
+
+    def test_scheduler_step_timers_populate(self):
+        from karmada_tpu.scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+        from karmada_tpu.utils.builders import duplicated_placement, new_cluster
+        from karmada_tpu.utils.metrics import scheduling_algorithm_duration
+
+        sched = TensorScheduler(ClusterSnapshot([new_cluster("m1")]))
+        sched.schedule(
+            [BindingProblem(key="b", placement=duplicated_placement(), replicas=1,
+                            gvk="apps/v1/Deployment")]
+        )
+        assert (
+            scheduling_algorithm_duration.summary(schedule_step="AssignReplicas")[
+                "count"
+            ]
+            >= 1
+        )
