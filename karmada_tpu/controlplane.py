@@ -48,6 +48,8 @@ class ControlPlane:
         *,
         enable_descheduler: bool = False,
         enable_accurate_estimator: bool = False,
+        # disabled by default like the reference (controllermanager.go:213-214)
+        enable_member_hpa_sync: bool = False,
         eviction_timeout: float = 600.0,
         clock=None,
     ) -> None:
@@ -143,6 +145,25 @@ class ControlPlane:
         self.search = SearchController(self.store, self.runtime, self.members)
         self.proxy = Proxy(self.store, self.members, self.search.cache)
         self.metrics_adapter = MetricsAdapter(self.members)
+        from .controllers.hpa_sync import (
+            DeploymentReplicasSyncer,
+            HpaScaleTargetMarker,
+            UnifiedAuthController,
+        )
+        from .interpreter.declarative import CustomizationConfigManager
+
+        if enable_member_hpa_sync:
+            self.hpa_marker = HpaScaleTargetMarker(self.store, self.runtime)
+            self.replicas_syncer = DeploymentReplicasSyncer(
+                self.store, self.runtime, self.members
+            )
+        else:
+            self.hpa_marker = None
+            self.replicas_syncer = None
+        self.unified_auth = UnifiedAuthController(self.store, self.runtime)
+        self.interpreter_config = CustomizationConfigManager(
+            self.store, self.runtime, self.interpreter
+        )
         self.agents: dict[str, object] = {}
 
     # -- cluster lifecycle (karmadactl join/unjoin analogue) ---------------

@@ -137,8 +137,9 @@ def _aggregate_status_sum(obj: Resource, items: list[AggregatedStatusItem]) -> R
 
 def _retain_default(desired: Resource, observed: Resource) -> Resource:
     """Keep member-side mutated fields the control plane must not stomp
-    (native/retain.go): nodeName on pods, clusterIP on services, plus
-    observed annotations the member added under its own domains."""
+    (native/retain.go): nodeName on pods, clusterIP on services, and
+    member-HPA-owned replica counts (the hpaScaleTargetMarker label marks
+    workloads whose replicas belong to the members)."""
     out = copy.deepcopy(desired)
     if _gvk(desired) == POD:
         node_name = observed.spec.get("nodeName")
@@ -148,6 +149,12 @@ def _retain_default(desired: Resource, observed: Resource) -> Resource:
         cluster_ip = observed.spec.get("clusterIP")
         if cluster_ip:
             out.spec["clusterIP"] = cluster_ip
+    if (
+        desired.meta.labels.get("resourcetemplate.karmada.io/retain-replicas")
+        == "true"
+        and "replicas" in observed.spec
+    ):
+        out.spec["replicas"] = observed.spec["replicas"]
     return out
 
 

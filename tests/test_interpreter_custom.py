@@ -1,0 +1,201 @@
+"""Declarative interpreter customizations + hpa marker/syncer/auth tests."""
+
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.autoscaling import FederatedHPA, FederatedHPASpec, ScaleTargetRef
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.controllers.hpa_sync import HPA_TARGET_LABEL
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.interpreter.declarative import (
+    CustomizationRules,
+    ResourceInterpreterCustomization,
+)
+from karmada_tpu.utils.builders import (
+    duplicated_placement,
+    dynamic_weight_placement,
+    new_cluster,
+    new_deployment,
+)
+
+
+def make_plane(n=2):
+    cp = ControlPlane()
+    for i in range(1, n + 1):
+        cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+    cp.settle()
+    return cp
+
+
+def crd_workload(name="wf1", replicas=6):
+    return Resource(
+        api_version="example.io/v1",
+        kind="Workflow",
+        meta=ObjectMeta(name=name, namespace="default"),
+        spec={
+            "parallelism": {"workers": replicas},
+            "resources": {"cpu": "500m"},
+            "configRef": "wf-config",
+        },
+        status={},
+    )
+
+
+def customization():
+    return ResourceInterpreterCustomization(
+        meta=ObjectMeta(name="workflow-rules"),
+        target_api_version="example.io/v1",
+        target_kind="Workflow",
+        rules=CustomizationRules(
+            replica_path="parallelism.workers",
+            requests_path="resources",
+            status_paths=["phase", "readyWorkers"],
+            health=[{"path": "phase", "op": "==", "value": "Running"}],
+            status_aggregation={"readyWorkers": "sum"},
+            dependencies=[
+                {"kind": "ConfigMap", "api_version": "v1", "name_path": "configRef"}
+            ],
+        ),
+    )
+
+
+class TestDeclarativeCustomization:
+    def test_crd_scheduling_via_declared_replicas(self):
+        cp = make_plane(2)
+        for m in cp.members.names():
+            cp.members.get(m).api_enablements.append("example.io/v1/Workflow")
+        cp.settle()
+        cp.store.apply(customization())
+        cp.store.apply(crd_workload(replicas=6))
+        cp.store.apply(
+            PropagationPolicy(
+                meta=ObjectMeta(name="wf", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="example.io/v1", kind="Workflow")
+                    ],
+                    placement=dynamic_weight_placement(),
+                ),
+            )
+        )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/wf1-workflow")
+        assert rb is not None and rb.spec.replicas == 6
+        assert rb.spec.replica_requirements.resource_request["cpu"] == 500
+        assert sum(tc.replicas for tc in rb.spec.clusters) == 6
+        # ReviseReplica wrote the divided count through the declared path
+        for tc in rb.spec.clusters:
+            obj = cp.members.get(tc.name).get("example.io/v1/Workflow", "default", "wf1")
+            assert obj.spec["parallelism"]["workers"] == tc.replicas
+
+    def test_health_and_status_aggregation(self):
+        cp = make_plane(2)
+        for m in cp.members.names():
+            cp.members.get(m).api_enablements.append("example.io/v1/Workflow")
+        cp.settle()
+        cp.store.apply(customization())
+        cp.store.apply(crd_workload(replicas=4))
+        cp.store.apply(
+            PropagationPolicy(
+                meta=ObjectMeta(name="wf", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="example.io/v1", kind="Workflow")
+                    ],
+                    placement=dynamic_weight_placement(),
+                ),
+            )
+        )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/wf1-workflow")
+        for tc in rb.spec.clusters:
+            cp.members.get(tc.name).set_workload_status(
+                "example.io/v1/Workflow", "default", "wf1",
+                {"phase": "Running", "readyWorkers": tc.replicas},
+            )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/wf1-workflow")
+        assert all(i.health == "Healthy" for i in rb.status.aggregated_status)
+        template = cp.store.get("Resource", "default/wf1")
+        assert template.status.get("readyWorkers") == 4
+
+    def test_dependency_declared_path(self):
+        cp = make_plane(1)
+        cp.store.apply(customization())
+        cp.settle()
+        deps = cp.interpreter.get_dependencies(crd_workload())
+        assert [(d.kind, d.name) for d in deps] == [("ConfigMap", "wf-config")]
+
+    def test_deregistration_on_delete(self):
+        cp = make_plane(1)
+        cp.store.apply(customization())
+        cp.settle()
+        assert cp.interpreter.get_replicas(crd_workload())[0] == 6
+        cp.store.delete("ResourceInterpreterCustomization", "workflow-rules")
+        cp.settle()
+        assert cp.interpreter.get_replicas(crd_workload())[0] == 0  # no handler
+
+
+def make_hpa_sync_plane(n=2):
+    cp = ControlPlane(enable_member_hpa_sync=True)
+    for i in range(1, n + 1):
+        cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+    cp.settle()
+    return cp
+
+
+class TestHpaMarkerAndSyncer:
+    def test_marker_labels_target(self):
+        cp = make_hpa_sync_plane(1)
+        cp.store.apply(new_deployment("web", replicas=2))
+        cp.store.apply(
+            FederatedHPA(
+                meta=ObjectMeta(name="web-hpa", namespace="default"),
+                spec=FederatedHPASpec(
+                    scale_target_ref=ScaleTargetRef(kind="Deployment", name="web")
+                ),
+            )
+        )
+        cp.settle()
+        template = cp.store.get("Resource", "default/web")
+        assert template.meta.labels[HPA_TARGET_LABEL] == "default/web-hpa"
+
+    def test_replicas_synced_from_members(self):
+        cp = make_hpa_sync_plane(2)
+        cp.store.apply(new_deployment("web", replicas=4))
+        cp.store.apply(
+            PropagationPolicy(
+                meta=ObjectMeta(name="p", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="apps/v1", kind="Deployment")
+                    ],
+                    placement=duplicated_placement(),
+                ),
+            )
+        )
+        cp.store.apply(
+            FederatedHPA(
+                meta=ObjectMeta(name="web-hpa", namespace="default"),
+                spec=FederatedHPASpec(
+                    scale_target_ref=ScaleTargetRef(kind="Deployment", name="web")
+                ),
+            )
+        )
+        cp.settle()
+        # member-side HPAs scaled the deployments up
+        for name in ("member1", "member2"):
+            obj = cp.members.get(name).get("apps/v1/Deployment", "default", "web")
+            obj.spec["replicas"] = 5
+            cp.members.get(name).apply(obj)
+        cp.settle()
+        template = cp.store.get("Resource", "default/web")
+        assert template.spec["replicas"] == 10
+
+
+class TestUnifiedAuth:
+    def test_rbac_work_created_per_cluster(self):
+        cp = make_plane(2)
+        for name in ("member1", "member2"):
+            work = cp.store.get("Work", f"karmada-es-{name}/unified-auth")
+            assert work is not None
+            kinds = [w.kind for w in work.spec.workload]
+            assert kinds == ["ClusterRole", "ClusterRoleBinding"]
