@@ -267,6 +267,23 @@ class TensorScheduler:
             feasible, strategy, replicas, static_w, requests, prev, fresh = (
                 self._pack_chunk(problems, compiled, term_round)
             )
+            # pad the binding axis to the next power of two (capped at the
+            # chunk size) so jit traces are reused across differently-sized
+            # batches; pad rows are no-candidate zero-replica bindings
+            b = len(problems)
+            padded = 1
+            while padded < b:
+                padded *= 2
+            padded = min(padded, self.chunk_size)
+            if padded > b:
+                pad = padded - b
+                feasible = np.pad(feasible, ((0, pad), (0, 0)))
+                strategy = np.pad(strategy, (0, pad))
+                replicas = np.pad(replicas, (0, pad))
+                static_w = np.pad(static_w, ((0, pad), (0, 0)))
+                requests = np.pad(requests, ((0, pad), (0, 0)))
+                prev = np.pad(prev, ((0, pad), (0, 0)))
+                fresh = np.pad(fresh, (0, pad))
         with algo_timer.time(schedule_step="Score"):
             avail = self._availability(requests, replicas)
 
