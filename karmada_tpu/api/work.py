@@ -173,6 +173,7 @@ class WorkSpec:
     workload: list[Resource] = field(default_factory=list)
     suspend_dispatching: bool = False
     preserve_resources_on_deletion: bool = False
+    conflict_resolution: str = "Overwrite"  # Overwrite | Abort
 
 
 @dataclass

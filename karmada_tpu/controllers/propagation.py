@@ -33,7 +33,13 @@ from ..api.work import (
 from ..api.policy import DIVIDED
 from ..interpreter import ResourceInterpreter
 from ..utils import DONE, REQUEUE, Runtime, Store
-from ..utils.member import MemberClientRegistry, MemberEvent, ObjectWatcher, UnreachableError
+from ..utils.member import (
+    ConflictError,
+    MemberClientRegistry,
+    MemberEvent,
+    ObjectWatcher,
+    UnreachableError,
+)
 from .overridemanager import OverrideManager
 
 ES_PREFIX = "karmada-es-"
@@ -153,6 +159,7 @@ class BindingController:
             workload=[workload],
             suspend_dispatching=rb.spec.suspend_dispatching,
             preserve_resources_on_deletion=rb.spec.preserve_resources_on_deletion,
+            conflict_resolution=rb.spec.conflict_resolution,
         )
         self.store.apply(work)
 
@@ -226,7 +233,20 @@ class ExecutionController:
             return DONE
         try:
             for workload in work.spec.workload:
-                self.watcher.create_or_update(cluster, workload)
+                self.watcher.create_or_update(
+                    cluster, workload,
+                    conflict_resolution=work.spec.conflict_resolution,
+                )
+        except ConflictError as e:
+            if set_condition(
+                work.status.conditions,
+                Condition(
+                    type=WORK_APPLIED, status=False,
+                    reason="ResourceConflict", message=str(e),
+                ),
+            ):
+                self.store.apply(work)
+            return DONE  # permanent until the member object changes
         except UnreachableError:
             if set_condition(
                 work.status.conditions,

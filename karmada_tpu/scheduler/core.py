@@ -71,17 +71,30 @@ class ScheduleResult:
 class TensorScheduler:
     """Schedules batches of bindings against one cluster snapshot."""
 
+    #: the in-tree filter/score plugin set (framework/plugins/registry.go:30-39)
+    PLUGINS = (
+        "APIEnablement",
+        "ClusterAffinity",
+        "ClusterEviction",
+        "ClusterLocality",
+        "SpreadConstraint",
+        "TaintToleration",
+    )
+
     def __init__(
         self,
         snapshot: ClusterSnapshot,
         chunk_size: int = 4096,
         extra_estimators: Sequence = (),
+        disabled_plugins: Sequence[str] = (),
     ):
         self.snapshot = snapshot
         self.chunk_size = chunk_size
         # callables (requests[B,R] int64, replicas[B] int32) -> int32[B,C]
         # availability with -1 for "no answer" (accurate estimators plug here)
         self.extra_estimators = list(extra_estimators)
+        # --plugins enable/disable list (scheduler.go:243-247)
+        self.disabled_plugins = set(disabled_plugins)
         self._placement_cache: dict[int, CompiledPlacement] = {}
 
     # -- compilation -------------------------------------------------------
@@ -168,6 +181,7 @@ class TensorScheduler:
         fresh = np.zeros(b, bool)
 
         pods_dim = dim_index.get("pods")
+        disabled = self.disabled_plugins
         for i, (p, cp) in enumerate(zip(problems, compiled)):
             term_idx = min(term_round, len(cp.terms) - 1)
             _, aff_mask = cp.terms[term_idx]
@@ -191,12 +205,21 @@ class TensorScheduler:
             api_ok = api_ok | (prev_mask & ~snap.complete_enablements)
             # taints with already-placed leniency (taint_toleration.go:60-63)
             taint_ok = cp.taint_ok | prev_mask
-            m = aff_mask & cp.spread_field_ok & api_ok & taint_ok
+            m = np.ones(c, bool)
+            if "ClusterAffinity" not in disabled:
+                m &= aff_mask
+            if "SpreadConstraint" not in disabled:
+                m &= cp.spread_field_ok
+            if "APIEnablement" not in disabled:
+                m &= api_ok
+            if "TaintToleration" not in disabled:
+                m &= taint_ok
             # ClusterEviction (cluster_eviction.go:46-53)
-            for name in p.evict_clusters:
-                j = snap.index.get(name)
-                if j is not None:
-                    m[j] = False
+            if "ClusterEviction" not in disabled:
+                for name in p.evict_clusters:
+                    j = snap.index.get(name)
+                    if j is not None:
+                        m[j] = False
             feasible[i] = m
             strategy[i] = cp.strategy
             replicas[i] = p.replicas

@@ -27,6 +27,14 @@ class UnreachableError(Exception):
     pass
 
 
+class ConflictError(Exception):
+    """Propagation target already exists and is not managed by the control
+    plane (ConflictResolution=Abort)."""
+
+
+MANAGED_ANNOTATION = "karmada.io/managed"
+
+
 @dataclass(frozen=True)
 class MemberEvent:
     type: str  # Added | Modified | Deleted
@@ -166,15 +174,29 @@ class ObjectWatcher:
         self.interpreter = interpreter
         self._versions: dict[tuple[str, str, str, str], int] = {}
 
-    def create_or_update(self, cluster: str, desired: Resource) -> Resource:
+    def create_or_update(
+        self, cluster: str, desired: Resource, conflict_resolution: str = "Overwrite"
+    ) -> Resource:
         member = self.members.get(cluster)
         if member is None:
             raise UnreachableError(f"no client for cluster {cluster}")
         gvk = f"{desired.api_version}/{desired.kind}"
         observed = member.get(gvk, desired.meta.namespace, desired.meta.name)
         to_apply = copy.deepcopy(desired)
+        to_apply.meta.annotations[MANAGED_ANNOTATION] = "true"
         if observed is not None:
+            # an unmanaged pre-existing object is a conflict
+            # (execution_controller + objectwatcher ConflictResolution)
+            if (
+                observed.meta.annotations.get(MANAGED_ANNOTATION) != "true"
+                and conflict_resolution == "Abort"
+            ):
+                raise ConflictError(
+                    f"{gvk} {desired.meta.namespaced_name} already exists in "
+                    f"{cluster} and is not managed"
+                )
             to_apply = self.interpreter.retain(to_apply, observed)
+            to_apply.meta.annotations[MANAGED_ANNOTATION] = "true"
             to_apply.meta.resource_version = observed.meta.resource_version
             # member status is owned by the member; never push it down
             to_apply.status = observed.status

@@ -1,0 +1,80 @@
+"""Slow-operation tracing + event recording.
+
+Ref: k8s.io/utils/trace usage (estimator server/estimate.go:37-54 logs
+"Estimating" traces over 100ms) and the EventRecorder pattern
+(scheduler.go:921-967 — events recorded on both binding and template).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+log = logging.getLogger("karmada_tpu.trace")
+
+
+@dataclass
+class Step:
+    name: str
+    at: float
+
+
+class Trace:
+    """utiltrace.Trace: named steps, logged when total exceeds threshold."""
+
+    def __init__(self, name: str, **fields):
+        self.name = name
+        self.fields = fields
+        self.start = time.perf_counter()
+        self.steps: list[Step] = []
+
+    def step(self, name: str) -> None:
+        self.steps.append(Step(name, time.perf_counter()))
+
+    def log_if_long(self, threshold_seconds: float = 0.1) -> Optional[str]:
+        total = time.perf_counter() - self.start
+        if total < threshold_seconds:
+            return None
+        parts = [f'"{self.name}" total={total * 1e3:.1f}ms']
+        last = self.start
+        for s in self.steps:
+            parts.append(f"{s.name}={(s.at - last) * 1e3:.1f}ms")
+            last = s.at
+        msg = " ".join(parts) + (
+            " " + " ".join(f"{k}={v}" for k, v in self.fields.items())
+            if self.fields
+            else ""
+        )
+        log.info(msg)
+        return msg
+
+
+@dataclass
+class Event:
+    object_ref: str  # "<kind>/<key>"
+    type: str  # Normal | Warning
+    reason: str
+    message: str
+    timestamp: float = field(default_factory=time.time)
+
+
+class EventRecorder:
+    """In-memory event sink (kube EventRecorder seam). Bounded ring."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self.events: list[Event] = []
+
+    def event(self, object_ref: str, type_: str, reason: str, message: str) -> None:
+        self.events.append(Event(object_ref, type_, reason, message))
+        if len(self.events) > self.capacity:
+            self.events = self.events[-self.capacity :]
+
+    def for_object(self, object_ref: str) -> list[Event]:
+        return [e for e in self.events if e.object_ref == object_ref]
+
+
+# shared recorder (cmd binaries each had one; in-proc a single sink suffices)
+recorder = EventRecorder()

@@ -1,0 +1,103 @@
+"""Conflict resolution, tracing, events, plugin toggles."""
+
+import logging
+
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+from karmada_tpu.utils.builders import (
+    duplicated_placement,
+    new_cluster,
+    new_deployment,
+)
+from karmada_tpu.utils.tracing import EventRecorder, Trace
+
+
+def make_plane(n=1, **kw):
+    cp = ControlPlane(**kw)
+    for i in range(1, n + 1):
+        cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+    cp.settle()
+    return cp
+
+
+def nginx_policy(conflict_resolution="Abort"):
+    return PropagationPolicy(
+        meta=ObjectMeta(name="p", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=duplicated_placement(),
+            conflict_resolution=conflict_resolution,
+        ),
+    )
+
+
+class TestConflictResolution:
+    def test_abort_on_unmanaged_existing_object(self):
+        cp = make_plane(1)
+        # a pre-existing unmanaged deployment in the member
+        cp.members.get("member1").apply(new_deployment("app", replicas=9))
+        cp.store.apply(new_deployment("app", replicas=2))
+        cp.store.apply(nginx_policy("Abort"))
+        cp.settle()
+        # member object untouched; work carries the conflict condition
+        obj = cp.members.get("member1").get("apps/v1/Deployment", "default", "app")
+        assert obj.spec["replicas"] == 9
+        work = cp.store.get("Work", "karmada-es-member1/default.app-deployment")
+        cond = next(c for c in work.status.conditions if c.type == "Applied")
+        assert not cond.status and cond.reason == "ResourceConflict"
+
+    def test_overwrite_takes_over(self):
+        cp = make_plane(1)
+        cp.members.get("member1").apply(new_deployment("app", replicas=9))
+        cp.store.apply(new_deployment("app", replicas=2))
+        cp.store.apply(nginx_policy("Overwrite"))
+        cp.settle()
+        obj = cp.members.get("member1").get("apps/v1/Deployment", "default", "app")
+        assert obj.spec["replicas"] == 2
+        assert obj.meta.annotations["karmada.io/managed"] == "true"
+
+
+class TestTracing:
+    def test_trace_logs_only_slow_ops(self, caplog):
+        t = Trace("fast-op")
+        t.step("a")
+        assert t.log_if_long(10.0) is None
+        t2 = Trace("slow-op", binding="default/x")
+        t2.step("estimate")
+        msg = t2.log_if_long(0.0)
+        assert "slow-op" in msg and "estimate=" in msg and "binding=default/x" in msg
+
+    def test_event_recorder_ring(self):
+        rec = EventRecorder(capacity=2)
+        for i in range(4):
+            rec.event("ResourceBinding/default/x", "Normal", "Scheduled", str(i))
+        assert len(rec.events) == 2
+        assert [e.message for e in rec.for_object("ResourceBinding/default/x")] == [
+            "2", "3",
+        ]
+
+
+class TestPluginToggles:
+    def test_disabled_taint_plugin_admits_tainted_cluster(self):
+        from karmada_tpu.api.cluster import Taint
+
+        clusters = [
+            new_cluster("ok"),
+            new_cluster("tainted", taints=[Taint(key="k", value="v",
+                                                 effect="NoSchedule")]),
+        ]
+        snap = ClusterSnapshot(clusters)
+        strict = TensorScheduler(snap)
+        lenient = TensorScheduler(snap, disabled_plugins=["TaintToleration"])
+        problem = BindingProblem(
+            key="b", placement=duplicated_placement(), replicas=1,
+            gvk="apps/v1/Deployment",
+        )
+        [r1] = strict.schedule([problem])
+        [r2] = lenient.schedule([problem])
+        assert set(r1.clusters) == {"ok"}
+        assert set(r2.clusters) == {"ok", "tainted"}
