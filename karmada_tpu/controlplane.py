@@ -137,6 +137,11 @@ class ControlPlane:
         self.multicluster_service = MultiClusterServiceController(
             self.store, self.runtime, self.members
         )
+        from .controllers.mci import MultiClusterIngressController
+
+        self.multicluster_ingress = MultiClusterIngressController(
+            self.store, self.runtime, self.members
+        )
         from .controllers.remedy import RemedyController
         from .metricsadapter import MetricsAdapter
         from .search import Proxy, SearchController

@@ -81,12 +81,45 @@ def _node_sum_estimate(
     return jnp.minimum(total, jnp.int64(2**31 - 1)).astype(jnp.int32)
 
 
+class ResourceQuotaPlugin:
+    """Estimate plugin capping replicas by namespace ResourceQuota headroom
+    (ref: estimator server mini plugin framework,
+    server/framework/interface.go + plugins/resourcequota/resourcequota.go,
+    gated by the ResourceQuotaEstimate feature).
+
+    ``quotas`` maps namespace -> {resource: remaining} (canonical units)."""
+
+    def __init__(self, quotas: Optional[dict[str, dict[str, int]]] = None):
+        self.quotas = quotas or {}
+
+    def estimate(
+        self, namespace: str, requirements: Optional[ReplicaRequirements]
+    ) -> Optional[int]:
+        """Max replicas the namespace quota still admits; None = no opinion."""
+        quota = self.quotas.get(namespace)
+        if quota is None or requirements is None:
+            return None
+        best: Optional[int] = None
+        for res, req in requirements.resource_request.items():
+            if req <= 0 or res not in quota:
+                continue
+            fit = max(quota[res], 0) // req
+            best = fit if best is None else min(best, fit)
+        return best
+
+
 class AccurateEstimator:
     """Per-cluster node-level estimator service object."""
 
-    def __init__(self, cluster_name: str, snapshot: NodeSnapshot):
+    def __init__(
+        self,
+        cluster_name: str,
+        snapshot: NodeSnapshot,
+        quota_plugin: Optional[ResourceQuotaPlugin] = None,
+    ):
         self.cluster_name = cluster_name
         self.snapshot = snapshot
+        self.quota_plugin = quota_plugin
         # unschedulable replicas per workload key (fed by the member watcher;
         # ref: server/replica/replica.go:43-77)
         self.unschedulable: dict[str, int] = {}
@@ -142,10 +175,26 @@ class AccurateEstimator:
         node_ok = np.broadcast_to(
             self._node_prefilter(requirements)[None, :], (len(req), len(self.snapshot.nodes))
         )
-        out = _node_sum_estimate(
-            jnp.asarray(self.snapshot.available), jnp.asarray(node_ok), jnp.asarray(req)
+        out = np.asarray(
+            _node_sum_estimate(
+                jnp.asarray(self.snapshot.available),
+                jnp.asarray(node_ok),
+                jnp.asarray(req),
+            )
         )
-        return np.asarray(out)
+        # quota plugin caps the node-sum estimate (server/estimate.go:98-101,
+        # RunEstimateReplicasPlugins min-merge), feature-gated
+        from ..utils.features import RESOURCE_QUOTA_ESTIMATE, feature_gate
+
+        if (
+            self.quota_plugin is not None
+            and requirements is not None
+            and feature_gate.enabled(RESOURCE_QUOTA_ESTIMATE)
+        ):
+            cap = self.quota_plugin.estimate(requirements.namespace, requirements)
+            if cap is not None:
+                out = np.minimum(out, np.int32(cap))
+        return out
 
     def get_unschedulable_replicas(self, workload_key: str) -> int:
         """Ref: server GetUnschedulableReplicas; counts come from the member

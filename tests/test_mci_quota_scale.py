@@ -1,0 +1,114 @@
+"""MultiClusterIngress, quota estimate plugin, and a batch-scale smoke test."""
+
+import numpy as np
+
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.api.networking import (
+    ExposureRange,
+    MultiClusterIngress,
+    MultiClusterIngressSpec,
+    MultiClusterService,
+    MultiClusterServiceSpec,
+)
+from karmada_tpu.api.work import ReplicaRequirements
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.estimator import AccurateEstimator, NodeSnapshot, NodeState
+from karmada_tpu.estimator.accurate import ResourceQuotaPlugin
+from karmada_tpu.scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    new_cluster,
+    synthetic_fleet,
+)
+from karmada_tpu.utils.features import RESOURCE_QUOTA_ESTIMATE, feature_gate
+from karmada_tpu.utils.quantity import parse_resource_list
+
+DIMS = ["cpu", "memory", "pods", "ephemeral-storage"]
+
+
+class TestMultiClusterIngress:
+    def test_ingress_dispatched_to_serving_clusters(self):
+        cp = ControlPlane()
+        for i in (1, 2, 3):
+            cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+        cp.settle()
+        svc = Resource(
+            api_version="v1", kind="Service",
+            meta=ObjectMeta(name="web", namespace="default"),
+            spec={"ports": [{"port": 80}]},
+        )
+        cp.members.get("member1").apply(svc)
+        cp.store.apply(
+            MultiClusterIngress(
+                meta=ObjectMeta(name="web-ingress", namespace="default"),
+                spec=MultiClusterIngressSpec(
+                    rules=[{
+                        "host": "web.example.com",
+                        "http": {"paths": [{"path": "/", "backend": {
+                            "service": {"name": "web"}}}]},
+                    }]
+                ),
+            )
+        )
+        cp.settle()
+        obj = cp.members.get("member1").get(
+            "networking.k8s.io/v1/Ingress", "default", "web-ingress"
+        )
+        assert obj is not None
+        assert cp.members.get("member2").get(
+            "networking.k8s.io/v1/Ingress", "default", "web-ingress"
+        ) is None
+        mci = cp.store.get("MultiClusterIngress", "default/web-ingress")
+        assert mci.status["clusters"] == ["member1"]
+
+
+class TestResourceQuotaPlugin:
+    def test_quota_caps_estimate(self):
+        feature_gate.set(RESOURCE_QUOTA_ESTIMATE, True)
+        try:
+            nodes = [
+                NodeState(
+                    name="n0",
+                    allocatable=parse_resource_list(
+                        {"cpu": "64", "memory": "256Gi", "pods": 200}
+                    ),
+                )
+            ]
+            plugin = ResourceQuotaPlugin(
+                {"default": parse_resource_list({"cpu": "3"})}
+            )
+            est = AccurateEstimator("m1", NodeSnapshot(nodes, DIMS), plugin)
+            reqs = ReplicaRequirements(
+                resource_request=parse_resource_list({"cpu": "1"}),
+                namespace="default",
+            )
+            row = np.zeros((1, len(DIMS)), np.int64)
+            row[0, 0] = 1000
+            out = est.max_available_replicas(reqs, row)
+            assert out.tolist() == [3]  # node fit 64, quota caps at 3
+        finally:
+            feature_gate.set(RESOURCE_QUOTA_ESTIMATE, False)
+
+
+class TestBatchScale:
+    def test_2k_bindings_500_clusters_batch(self):
+        """Scale smoke: the batched engine handles thousands of bindings in
+        one call with conserved replica sums (the CPU-side stand-in for the
+        BASELINE workloads; the TPU path is bench.py)."""
+        fleet = synthetic_fleet(500, seed=11)
+        snap = ClusterSnapshot(fleet)
+        sched = TensorScheduler(snap, chunk_size=1024)
+        pl = dynamic_weight_placement()
+        req = parse_resource_list({"cpu": "500m", "memory": "1Gi"})
+        problems = [
+            BindingProblem(
+                key=f"b{i}", placement=pl, replicas=(i % 50) + 1,
+                requests=req, gvk="apps/v1/Deployment",
+            )
+            for i in range(2000)
+        ]
+        results = sched.schedule(problems)
+        scheduled = [r for r in results if r.success]
+        assert len(scheduled) == 2000
+        for p, r in zip(problems, results):
+            assert sum(r.clusters.values()) == p.replicas
