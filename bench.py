@@ -111,6 +111,7 @@ def main():
         res = schedule_step(
             available_cap, has_summary, requests, strategy, replicas,
             candidates, static_w, prev, fresh,
+            has_aggregated=False,  # config-5 workload is pure DynamicWeight
         )
         placed = (res.assignment > 0).sum(axis=1).astype(jnp.int32)
         total = res.assignment.sum(axis=1).astype(jnp.int64)

@@ -28,6 +28,7 @@ objects and unpacks results.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -87,6 +88,7 @@ def _divide_one(
     avail: jnp.ndarray,  # int32[C] estimator availability (candidates only)
     prev: jnp.ndarray,  # int32[C] full previous assignment (spec.clusters)
     fresh: jnp.ndarray,  # bool scalar — reschedule triggered (Fresh mode)
+    has_aggregated: bool = True,  # static: chunk contains Aggregated bindings
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     c = candidates.shape[0]
     prev_cand = jnp.where(candidates, prev, 0)  # buildScheduledClusters
@@ -118,10 +120,15 @@ def _divide_one(
         jnp.sum(w_dyn.astype(jnp.int64)) < target_dyn.astype(jnp.int64)
     )
 
-    # aggregated prefix restriction; prior only exists in steady scale-up
-    is_prev_mask = (prev_cand > 0) & scale_up
-    keep = _aggregated_prefix_mask(w_dyn, is_prev_mask, target_dyn)
-    w_dyn = jnp.where((strategy == AGGREGATED) & keep | (strategy != AGGREGATED), w_dyn, 0)
+    # aggregated prefix restriction; prior only exists in steady scale-up.
+    # The prefix sort is skipped entirely (statically) for chunks without
+    # Aggregated bindings — one of the two kernel sorts disappears.
+    if has_aggregated:
+        is_prev_mask = (prev_cand > 0) & scale_up
+        keep = _aggregated_prefix_mask(w_dyn, is_prev_mask, target_dyn)
+        w_dyn = jnp.where(
+            (strategy == AGGREGATED) & keep | (strategy != AGGREGATED), w_dyn, 0
+        )
 
     # --- static weights ----------------------------------------------------
     sw = jnp.where(candidates, static_w, 0)
@@ -146,10 +153,12 @@ def _divide_one(
     return out, unschedulable
 
 
-_divide_batch = jax.vmap(_divide_one, in_axes=(0, 0, 0, 0, 0, 0, 0))
+_divide_batch = jax.vmap(
+    _divide_one, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
+)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("has_aggregated",))
 def divide_replicas(
     strategy: jnp.ndarray,  # int32[B]
     replicas: jnp.ndarray,  # int32[B]
@@ -158,9 +167,13 @@ def divide_replicas(
     avail: jnp.ndarray,  # int32[B, C]
     prev: jnp.ndarray,  # int32[B, C]
     fresh: jnp.ndarray,  # bool[B]
+    has_aggregated: bool = True,
 ) -> DivideResult:
-    """Batched AssignReplicas over a binding chunk."""
+    """Batched AssignReplicas over a binding chunk. Pass
+    ``has_aggregated=False`` (static) when the chunk is known to contain no
+    Aggregated-strategy bindings to skip the prefix sort."""
     out, unsched = _divide_batch(
-        strategy, replicas, candidates, static_w, avail, prev, fresh
+        strategy, replicas, candidates, static_w, avail, prev, fresh,
+        has_aggregated,
     )
     return DivideResult(assignment=out, unschedulable=unsched)

@@ -39,17 +39,19 @@ def _schedule_step(
     static_w: jnp.ndarray,  # int32[B, C]
     prev: jnp.ndarray,  # int32[B, C]
     fresh: jnp.ndarray,  # bool[B]
+    has_aggregated: bool = True,
 ) -> DivideResult:
     general = general_estimate(available_cap, requests)
     general = jnp.where(has_summary[None, :], general, jnp.int32(-1))
     avail = merge_estimates(replicas, (general,))
     out, unsched = _divide_batch(
-        strategy, replicas, candidates, static_w, avail, prev, fresh
+        strategy, replicas, candidates, static_w, avail, prev, fresh,
+        has_aggregated,
     )
     return DivideResult(assignment=out, unschedulable=unsched)
 
 
-schedule_step = jax.jit(_schedule_step)
+schedule_step = jax.jit(_schedule_step, static_argnames=("has_aggregated",))
 
 
 def make_sharded_step(mesh: Mesh, *, shard_clusters: bool = False):
@@ -79,7 +81,10 @@ def make_sharded_step(mesh: Mesh, *, shard_clusters: bool = False):
         unschedulable=NamedSharding(mesh, row_b),
     )
     return jax.jit(
-        _schedule_step, in_shardings=in_shardings, out_shardings=out_shardings
+        _schedule_step,
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+        static_argnames=("has_aggregated",),
     )
 
 

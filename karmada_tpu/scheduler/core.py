@@ -328,6 +328,8 @@ class TensorScheduler:
                             assignment, unschedulable)
 
     def _assign(self, strategy, replicas, candidates, static_w, avail, prev, fresh):
+        from ..ops.divide import AGGREGATED
+
         return divide_replicas(
             jnp.asarray(strategy),
             jnp.asarray(replicas),
@@ -336,6 +338,7 @@ class TensorScheduler:
             avail,
             jnp.asarray(prev),
             jnp.asarray(fresh),
+            has_aggregated=bool((strategy == AGGREGATED).any()),
         )
 
     def _unpack(
