@@ -147,7 +147,8 @@ class TaintManager:
             return DONE
         if not feature_gate.enabled(FAILOVER):
             return DONE
-        for rb in self.store.list("ResourceBinding"):
+        for kind in ("ResourceBinding", "ClusterResourceBinding"):
+          for rb in self.store.list(kind):
             if not any(tc.name == cluster.name for tc in rb.spec.clusters):
                 continue
             tolerations = (

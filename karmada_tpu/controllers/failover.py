@@ -51,16 +51,19 @@ class GracefulEvictionController:
         self.timeout = timeout_seconds
         self.clock = clock
         self.worker = runtime.new_worker("graceful-eviction", self._reconcile)
-        store.watch("ResourceBinding", lambda e: self.worker.enqueue(e.key))
+        for kind in ("ResourceBinding", "ClusterResourceBinding"):
+            store.watch(kind, lambda e, k=kind: self.worker.enqueue((k, e.key)))
         runtime.add_ticker(self._sweep)
 
     def _sweep(self) -> None:
-        for rb in self.store.list("ResourceBinding"):
-            if rb.spec.graceful_eviction_tasks:
-                self.worker.enqueue(rb.meta.namespaced_name)
+        for kind in ("ResourceBinding", "ClusterResourceBinding"):
+            for rb in self.store.list(kind):
+                if rb.spec.graceful_eviction_tasks:
+                    self.worker.enqueue((kind, rb.meta.namespaced_name))
 
-    def _reconcile(self, key: str) -> Optional[str]:
-        rb = self.store.get("ResourceBinding", key)
+    def _reconcile(self, kind_key) -> Optional[str]:
+        kind, key = kind_key
+        rb = self.store.get(kind, key)
         if rb is None or not rb.spec.graceful_eviction_tasks:
             return DONE
         keep = []
@@ -110,16 +113,19 @@ class ApplicationFailoverController:
         # cluster -> first-unhealthy timestamp per binding key
         self._unhealthy_since: dict[tuple[str, str], float] = {}
         self.worker = runtime.new_worker("app-failover", self._reconcile)
-        store.watch("ResourceBinding", lambda e: self.worker.enqueue(e.key))
+        for kind in ("ResourceBinding", "ClusterResourceBinding"):
+            store.watch(kind, lambda e, k=kind: self.worker.enqueue((k, e.key)))
         runtime.add_ticker(self._sweep)
 
     def _sweep(self) -> None:
-        for rb in self.store.list("ResourceBinding"):
-            if rb.spec.failover is not None:
-                self.worker.enqueue(rb.meta.namespaced_name)
+        for kind in ("ResourceBinding", "ClusterResourceBinding"):
+            for rb in self.store.list(kind):
+                if rb.spec.failover is not None:
+                    self.worker.enqueue((kind, rb.meta.namespaced_name))
 
-    def _reconcile(self, key: str) -> Optional[str]:
-        rb = self.store.get("ResourceBinding", key)
+    def _reconcile(self, kind_key) -> Optional[str]:
+        kind, key = kind_key
+        rb = self.store.get(kind, key)
         if rb is None or rb.spec.failover is None:
             return DONE
         app = getattr(rb.spec.failover, "application", None)
@@ -198,7 +204,8 @@ class Descheduler:
         each target cluster's estimator for unschedulable replicas and shrink
         the schedule result accordingly (floor at 0); the scheduler then
         scale-reschedules the delta elsewhere."""
-        for rb in self.store.list("ResourceBinding"):
+        for kind in ("ResourceBinding", "ClusterResourceBinding"):
+          for rb in self.store.list(kind):
             if rb.spec.replicas <= 0 or not rb.spec.clusters:
                 continue
             workload_key = rb.spec.resource.namespaced_key
