@@ -40,7 +40,115 @@ def build_parser():
     p.add_argument("--sample", type=int, default=512, help="oracle sample size")
     p.add_argument("--cpu", action="store_true", help="force CPU jax (debug)")
     p.add_argument("--dims", type=int, default=4)
+    p.add_argument(
+        "--config",
+        type=int,
+        default=5,
+        choices=(1, 2, 3, 4, 5),
+        help="BASELINE.json workload config (default 5: 100k x 5k "
+        "dynamic-weight rebalance storm); 1-4 run the smaller scenario "
+        "suites through the full engine",
+    )
     return p
+
+
+def run_engine_config(config: int) -> dict:
+    """Configs 1-4: the engine-level BASELINE scenarios (full control-plane
+    packing path, CPU-or-TPU agnostic). Returns the result JSON dict."""
+    import time as _time
+
+    import numpy as np
+
+    from karmada_tpu.scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+    from karmada_tpu.api.policy import SpreadConstraint, ClusterAffinity, LabelSelector
+    from karmada_tpu.utils.builders import (
+        aggregated_placement,
+        duplicated_placement,
+        dynamic_weight_placement,
+        static_weight_placement,
+        synthetic_fleet,
+        new_cluster,
+    )
+    from karmada_tpu.utils.quantity import parse_resource_list
+
+    req = parse_resource_list({"cpu": "250m", "memory": "512Mi"})
+    if config == 1:
+        # samples/nginx: Duplicated across 3 members
+        clusters = [new_cluster(f"member{i}") for i in (1, 2, 3)]
+        placement = duplicated_placement()
+        problems = [
+            BindingProblem(key="nginx", placement=placement, replicas=2,
+                           requests=req, gvk="apps/v1/Deployment")
+        ]
+        metric = "config1_nginx_duplicated"
+    elif config == 2:
+        clusters = [new_cluster(f"member{i}") for i in (1, 2, 3)]
+        placement = static_weight_placement(
+            {"member1": 2, "member2": 1, "member3": 1}
+        )
+        problems = [
+            BindingProblem(key="web", placement=placement, replicas=10,
+                           requests=req, gvk="apps/v1/Deployment")
+        ]
+        metric = "config2_static_weight_10"
+    elif config == 3:
+        from karmada_tpu.api.cluster import ResourceModel, ResourceModelRange, AllocatableModeling
+
+        clusters = synthetic_fleet(20, seed=3)
+        for cl in clusters:  # per-cluster ResourceModels (grade buckets)
+            cl.spec.resource_models = [
+                ResourceModel(grade=g, ranges=[
+                    ResourceModelRange(name="cpu", min=1000 * 2**g, max=1000 * 2**(g + 1)),
+                    ResourceModelRange(name="memory", min=(2 << 30) * 2**g,
+                                       max=(2 << 30) * 2**(g + 1)),
+                ])
+                for g in range(3)
+            ]
+            cl.status.resource_summary.allocatable_modelings = [
+                AllocatableModeling(grade=g, count=10 * (g + 1)) for g in range(3)
+            ]
+        placement = aggregated_placement()
+        problems = [
+            BindingProblem(key=f"b{i}", placement=placement,
+                           replicas=(i % 20) + 1, requests=req,
+                           gvk="apps/v1/Deployment")
+            for i in range(100)
+        ]
+        metric = "config3_aggregated_models_100x20"
+    else:  # config 4
+        clusters = synthetic_fleet(500, seed=4)
+        placement = dynamic_weight_placement(
+            cluster_affinity=ClusterAffinity(
+                label_selector=LabelSelector(match_labels={"env": "prod"})
+            ),
+            spread_constraints=[
+                SpreadConstraint(spread_by_field="region", min_groups=2, max_groups=4),
+                SpreadConstraint(spread_by_field="cluster", min_groups=2, max_groups=10),
+            ],
+        )
+        problems = [
+            BindingProblem(key=f"b{i}", placement=placement,
+                           replicas=(i % 40) + 1, requests=req,
+                           gvk="apps/v1/Deployment")
+            for i in range(10_000)
+        ]
+        metric = "config4_spread_region_10kx500"
+
+    snap = ClusterSnapshot(clusters)
+    sched = TensorScheduler(snap, chunk_size=4096)
+    sched.schedule(problems[:1])  # warm the trace
+    t0 = _time.perf_counter()
+    results = sched.schedule(problems)
+    wall = _time.perf_counter() - t0
+    ok = sum(1 for r in results if r.success)
+    print(f"# config {config}: {ok}/{len(problems)} scheduled in {wall:.3f}s",
+          file=sys.stderr)
+    return {
+        "metric": metric,
+        "value": round(wall, 4),
+        "unit": "s",
+        "vs_baseline": 1.0,
+    }
 
 
 def main():
@@ -49,6 +157,9 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if args.config != 5:
+        print(json.dumps(run_engine_config(args.config)))
+        return
     import jax
     import jax.numpy as jnp
 
