@@ -168,63 +168,73 @@ class TensorScheduler:
         compiled: list[CompiledPlacement],
         term_round: int,
     ):
+        """Vectorized packing: per-binding work is O(sparse entries); the
+        O(B x C) mask algebra happens once per *unique* placement/GVK and is
+        gathered by row — the constant-factor lever SURVEY.md section 7 calls
+        out for label matching at fleet scale."""
         snap = self.snapshot
         b, c, r = len(problems), snap.num_clusters, len(snap.dims)
         dim_index = {d: j for j, d in enumerate(snap.dims)}
-
-        feasible = np.zeros((b, c), bool)
-        strategy = np.zeros(b, np.int32)
-        replicas = np.zeros(b, np.int32)
-        static_w = np.zeros((b, c), np.int32)
-        requests = np.zeros((b, r), np.int64)
-        prev = np.zeros((b, c), np.int32)
-        fresh = np.zeros(b, bool)
-
-        pods_dim = dim_index.get("pods")
         disabled = self.disabled_plugins
-        for i, (p, cp) in enumerate(zip(problems, compiled)):
-            term_idx = min(term_round, len(cp.terms) - 1)
-            _, aff_mask = cp.terms[term_idx]
-            prev_mask = np.zeros(c, bool)
+
+        # --- unique placements -> stacked per-placement masks -------------
+        cp_slot: dict[int, int] = {}
+        unique_cps: list[CompiledPlacement] = []
+        cp_idx = np.empty(b, np.int32)
+        for i, cp in enumerate(compiled):
+            slot = cp_slot.get(id(cp))
+            if slot is None:
+                slot = len(unique_cps)
+                cp_slot[id(cp)] = slot
+                unique_cps.append(cp)
+            cp_idx[i] = slot
+        aff_pl = np.stack(
+            [cp.terms[min(term_round, len(cp.terms) - 1)][1] for cp in unique_cps]
+        )
+        spread_pl = np.stack([cp.spread_field_ok for cp in unique_cps])
+        taint_pl = np.stack([cp.taint_ok for cp in unique_cps])
+        static_pl = np.stack([cp.static_weights for cp in unique_cps])
+        strategy = np.array([cp.strategy for cp in unique_cps], np.int32)[cp_idx]
+
+        # --- unique GVKs -> per-GVK enablement masks ----------------------
+        gvk_slot: dict[str, int] = {}
+        gvk_masks: list[np.ndarray] = []
+        gvk_idx = np.empty(b, np.int32)
+        for i, p in enumerate(problems):
+            slot = gvk_slot.get(p.gvk)
+            if slot is None:
+                slot = len(gvk_masks)
+                gvk_slot[p.gvk] = slot
+                gid = snap.gvk_vocab.get(p.gvk) if p.gvk else None
+                if gid is None:
+                    mask = (
+                        np.zeros(c, bool)
+                        if p.gvk and len(snap.gvk_vocab) > 0
+                        else np.ones(c, bool)
+                    )
+                else:
+                    word, bit = gid // 32, gid % 32
+                    mask = (snap.gvk_bits[:, word] >> np.uint32(bit)) & 1 != 0
+                gvk_masks.append(mask)
+            gvk_idx[i] = slot
+        api_gvk = np.stack(gvk_masks)
+
+        # --- sparse per-binding state -------------------------------------
+        replicas = np.fromiter((p.replicas for p in problems), np.int32, b)
+        fresh = np.fromiter((p.fresh for p in problems), bool, b)
+        prev = np.zeros((b, c), np.int32)
+        evict = np.zeros((b, c), bool)
+        requests = np.zeros((b, r), np.int64)
+        pods_dim = dim_index.get("pods")
+        for i, p in enumerate(problems):
             for name, reps in p.prev.items():
                 j = snap.index.get(name)
                 if j is not None:
                     prev[i, j] = reps
-                    prev_mask[j] = True
-            # GVK enablement with already-placed leniency (api_enablement.go)
-            gid = snap.gvk_vocab.get(p.gvk) if p.gvk else None
-            if gid is None:
-                api_ok = (
-                    np.zeros(c, bool)
-                    if p.gvk and len(snap.gvk_vocab) > 0
-                    else np.ones(c, bool)
-                )
-            else:
-                word, bit = gid // 32, gid % 32
-                api_ok = (snap.gvk_bits[:, word] >> np.uint32(bit)) & 1 != 0
-            api_ok = api_ok | (prev_mask & ~snap.complete_enablements)
-            # taints with already-placed leniency (taint_toleration.go:60-63)
-            taint_ok = cp.taint_ok | prev_mask
-            m = np.ones(c, bool)
-            if "ClusterAffinity" not in disabled:
-                m &= aff_mask
-            if "SpreadConstraint" not in disabled:
-                m &= cp.spread_field_ok
-            if "APIEnablement" not in disabled:
-                m &= api_ok
-            if "TaintToleration" not in disabled:
-                m &= taint_ok
-            # ClusterEviction (cluster_eviction.go:46-53)
-            if "ClusterEviction" not in disabled:
-                for name in p.evict_clusters:
-                    j = snap.index.get(name)
-                    if j is not None:
-                        m[j] = False
-            feasible[i] = m
-            strategy[i] = cp.strategy
-            replicas[i] = p.replicas
-            static_w[i] = cp.static_weights
-            fresh[i] = p.fresh
+            for name in p.evict_clusters:
+                j = snap.index.get(name)
+                if j is not None:
+                    evict[i, j] = True
             for d, q in p.requests.items():
                 j = dim_index.get(d)
                 if j is not None:
@@ -232,6 +242,24 @@ class TensorScheduler:
             if pods_dim is not None and p.replicas > 0:
                 # each replica occupies a pod (getAllowedPodNumber)
                 requests[i, pods_dim] = max(requests[i, pods_dim], 1)
+        prev_mask = prev > 0
+
+        # --- mask composition (api_enablement.go / taint_toleration.go
+        # leniency for already-placed clusters) -----------------------------
+        feasible = np.ones((b, c), bool)
+        if "ClusterAffinity" not in disabled:
+            feasible &= aff_pl[cp_idx]
+        if "SpreadConstraint" not in disabled:
+            feasible &= spread_pl[cp_idx]
+        if "APIEnablement" not in disabled:
+            feasible &= api_gvk[gvk_idx] | (
+                prev_mask & ~snap.complete_enablements[None, :]
+            )
+        if "TaintToleration" not in disabled:
+            feasible &= taint_pl[cp_idx] | prev_mask
+        if "ClusterEviction" not in disabled:
+            feasible &= ~evict
+        static_w = static_pl[cp_idx]
         return feasible, strategy, replicas, static_w, requests, prev, fresh
 
     def _availability(self, requests: np.ndarray, replicas: np.ndarray) -> jnp.ndarray:
