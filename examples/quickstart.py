@@ -1,0 +1,91 @@
+"""Quickstart demo: the samples/nginx scenario end-to-end, then a failover.
+
+Run: PYTHONPATH=/root/repo python examples/quickstart.py
+(uses CPU JAX; the scheduler kernels are the same programs bench.py runs on
+TPU).
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from karmada_tpu import cli
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    new_deployment,
+)
+from karmada_tpu.utils.features import FAILOVER, feature_gate
+
+
+def show(cp, key="default/nginx-deployment"):
+    rb = cp.store.get("ResourceBinding", key)
+    placed = {tc.name: tc.replicas for tc in rb.spec.clusters}
+    print(f"  placement: {placed}")
+    for item in rb.status.aggregated_status:
+        print(f"  {item.cluster_name}: applied={item.applied} health={item.health}")
+
+
+def main():
+    feature_gate.set(FAILOVER, True)
+    print("== local-up: 3 member clusters (member3 is Pull-mode)")
+    cp = cli.cmd_local_up(3)
+
+    print("== propagate nginx x6 with dynamic-weight division")
+    cp.store.apply(new_deployment("nginx", replicas=6))
+    cp.store.apply(
+        PropagationPolicy(
+            meta=ObjectMeta(name="nginx", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=dynamic_weight_placement(),
+            ),
+        )
+    )
+    cp.settle()
+    show(cp)
+
+    print("== member1 becomes unreachable -> taint -> evict -> rehome")
+    cp.members.get("member1").reachable = False
+    cp.settle()
+    show(cp)
+
+    print("== replacements report healthy -> graceful eviction completes")
+    rb = cp.store.get("ResourceBinding", "default/nginx-deployment")
+    for tc in rb.spec.clusters:
+        cp.members.get(tc.name).set_workload_status(
+            "apps/v1/Deployment", "default", "nginx",
+            {"replicas": tc.replicas, "readyReplicas": tc.replicas,
+             "updatedReplicas": tc.replicas},
+        )
+    cp.settle()
+    show(cp)
+
+    print("== member1 recovers; trigger a fresh rebalance")
+    cp.members.get("member1").reachable = True
+    from karmada_tpu.controllers import (
+        ObjectReferenceSelector,
+        WorkloadRebalancer,
+        WorkloadRebalancerSpec,
+    )
+
+    cp.settle()
+    cp.store.apply(
+        WorkloadRebalancer(
+            meta=ObjectMeta(name="rebalance"),
+            spec=WorkloadRebalancerSpec(
+                workloads=[ObjectReferenceSelector(kind="Deployment", name="nginx")]
+            ),
+        )
+    )
+    cp.settle()
+    show(cp)
+    print("== describe")
+    print(cli.cmd_describe(cp, "apps/v1/Deployment", "default", "nginx"))
+
+
+if __name__ == "__main__":
+    main()

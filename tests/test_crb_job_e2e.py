@@ -1,0 +1,104 @@
+"""Cluster-scoped binding failover + Job completions division e2e."""
+
+from karmada_tpu.api import PropagationSpec, ResourceSelector
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.api.policy import ClusterPropagationPolicy, PropagationPolicy
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    new_cluster,
+)
+from karmada_tpu.utils.features import FAILOVER, feature_gate
+
+
+def make_plane(n=3):
+    cp = ControlPlane()
+    for i in range(1, n + 1):
+        member = cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+        member.api_enablements.append("rbac.authorization.k8s.io/v1/ClusterRole")
+        member.api_enablements.append("batch/v1/Job")
+    cp.settle()
+    return cp
+
+
+class TestClusterScopedFailover:
+    def test_crb_rehomes_on_cluster_failure(self):
+        feature_gate.set(FAILOVER, True)
+        try:
+            cp = make_plane(3)
+            role = Resource(
+                api_version="rbac.authorization.k8s.io/v1",
+                kind="ClusterRole",
+                meta=ObjectMeta(name="ops"),
+                spec={"rules": []},
+            )
+            cp.store.apply(role)
+            cp.store.apply(
+                ClusterPropagationPolicy(
+                    meta=ObjectMeta(name="roles"),
+                    spec=PropagationSpec(
+                        resource_selectors=[
+                            ResourceSelector(
+                                api_version="rbac.authorization.k8s.io/v1",
+                                kind="ClusterRole",
+                            )
+                        ],
+                        placement=dynamic_weight_placement(),
+                    ),
+                )
+            )
+            cp.settle()
+            crb = cp.store.get("ClusterResourceBinding", "ops-clusterrole")
+            assert crb is not None and crb.spec.clusters
+            # non-workload (replicas 0) lands on all clusters; kill one
+            placed_before = {tc.name for tc in crb.spec.clusters}
+            victim = sorted(placed_before)[0]
+            cp.members.get(victim).reachable = False
+            cp.settle()
+            crb = cp.store.get("ClusterResourceBinding", "ops-clusterrole")
+            assert victim not in {tc.name for tc in crb.spec.clusters}
+        finally:
+            feature_gate.set(FAILOVER, False)
+
+
+class TestJobCompletions:
+    def test_completions_divided_with_replicas(self):
+        cp = make_plane(2)
+        job = Resource(
+            api_version="batch/v1",
+            kind="Job",
+            meta=ObjectMeta(name="indexer", namespace="default"),
+            spec={
+                "parallelism": 6,
+                "completions": 12,
+                "template": {"spec": {"containers": [
+                    {"name": "work",
+                     "resources": {"requests": {"cpu": "100m"}}}]}},
+            },
+        )
+        cp.store.apply(job)
+        cp.store.apply(
+            PropagationPolicy(
+                meta=ObjectMeta(name="jobs", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="batch/v1", kind="Job")
+                    ],
+                    placement=dynamic_weight_placement(),
+                ),
+            )
+        )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/indexer-job")
+        assert rb.spec.replicas == 6  # parallelism is the replica field
+        total_parallelism = 0
+        total_completions = 0
+        for tc in rb.spec.clusters:
+            obj = cp.members.get(tc.name).get("batch/v1/Job", "default", "indexer")
+            assert obj is not None
+            total_parallelism += obj.spec["parallelism"]
+            # completions split proportionally (binding/common.go:287-299)
+            assert obj.spec["completions"] == -(-12 * tc.replicas // 6)
+            total_completions += obj.spec["completions"]
+        assert total_parallelism == 6
+        assert total_completions >= 12
