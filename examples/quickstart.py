@@ -1,6 +1,6 @@
 """Quickstart demo: the samples/nginx scenario end-to-end, then a failover.
 
-Run: PYTHONPATH=/root/repo python examples/quickstart.py
+Run from the repo root: PYTHONPATH=. python examples/quickstart.py
 (uses CPU JAX; the scheduler kernels are the same programs bench.py runs on
 TPU).
 """

@@ -185,8 +185,18 @@ class ExecutionController:
         self.store = store
         self.members = members
         self.watcher = ObjectWatcher(members, interpreter)
+        # deletes parked while a cluster is unreachable; retried when the
+        # cluster comes back (the asynchronous-retry analogue — burning
+        # requeue budget against a dead cluster helps nobody)
+        self._pending_deletes: dict[str, set[tuple[str, str, str]]] = {}
         self.worker = runtime.new_worker("execution", self._reconcile)
         store.watch("Work", self._on_work_event)
+        store.watch("Cluster", self._on_cluster_event)
+
+    def _on_cluster_event(self, event) -> None:
+        pending = self._pending_deletes.pop(event.key, None)
+        if pending:
+            self.worker.enqueue(("delete", event.key, tuple(sorted(pending))))
 
     def _on_work_event(self, event) -> None:
         if event.type == "Deleted":
@@ -212,7 +222,9 @@ class ExecutionController:
                 try:
                     self.watcher.delete(key_or_cluster, gvk, ns, name)
                 except UnreachableError:
-                    return REQUEUE
+                    self._pending_deletes.setdefault(key_or_cluster, set()).add(
+                        (gvk, ns, name)
+                    )
             return DONE
         key = key_or_cluster
         work = self.store.get("Work", key)

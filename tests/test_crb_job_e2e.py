@@ -16,7 +16,6 @@ def make_plane(n=3):
     for i in range(1, n + 1):
         member = cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
         member.api_enablements.append("rbac.authorization.k8s.io/v1/ClusterRole")
-        member.api_enablements.append("batch/v1/Job")
     cp.settle()
     return cp
 
@@ -70,7 +69,7 @@ class TestJobCompletions:
             meta=ObjectMeta(name="indexer", namespace="default"),
             spec={
                 "parallelism": 6,
-                "completions": 12,
+                "completions": 10,  # non-divisible: exercises the ceil path
                 "template": {"spec": {"containers": [
                     {"name": "work",
                      "resources": {"requests": {"cpu": "100m"}}}]}},
@@ -91,14 +90,15 @@ class TestJobCompletions:
         cp.settle()
         rb = cp.store.get("ResourceBinding", "default/indexer-job")
         assert rb.spec.replicas == 6  # parallelism is the replica field
+        # hand-computed ceil(10 * r / 6) per possible per-cluster share
+        expected_completions = {1: 2, 2: 4, 3: 5, 4: 7, 5: 9, 6: 10}
         total_parallelism = 0
         total_completions = 0
         for tc in rb.spec.clusters:
             obj = cp.members.get(tc.name).get("batch/v1/Job", "default", "indexer")
             assert obj is not None
             total_parallelism += obj.spec["parallelism"]
-            # completions split proportionally (binding/common.go:287-299)
-            assert obj.spec["completions"] == -(-12 * tc.replicas // 6)
+            assert obj.spec["completions"] == expected_completions[tc.replicas]
             total_completions += obj.spec["completions"]
         assert total_parallelism == 6
-        assert total_completions >= 12
+        assert total_completions >= 10  # ceil split over-provisions on ties
