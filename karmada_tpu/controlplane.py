@@ -91,10 +91,11 @@ class ControlPlane:
             self.store, self.runtime, clock=self.clock
         )
         extra = []
+        self._accurate_enabled = enable_accurate_estimator
         if enable_accurate_estimator:
-            self._accurate_enabled = True
-        else:
-            self._accurate_enabled = False
+            # node snapshots track member state (the estimator server's
+            # informer refresh); rebuilt each settle pass
+            self.runtime.add_ticker(self._refresh_estimators)
         self.scheduler = SchedulerController(
             self.store,
             self.runtime,
@@ -204,6 +205,16 @@ class ControlPlane:
         self.members.deregister(name)
         self.estimators.deregister(name)
         self.store.delete("Cluster", name)
+
+    def _refresh_estimators(self) -> None:
+        snap_dims = ["cpu", "memory", "pods", "ephemeral-storage"]
+        for name in self.members.names():
+            member = self.members.get(name)
+            est = self.estimators.get(name)
+            if member is None or est is None:
+                continue
+            est.snapshot = NodeSnapshot(member.nodes, snap_dims)
+            est.unschedulable = dict(member.unschedulable_replicas)
 
     # -- driving -----------------------------------------------------------
 

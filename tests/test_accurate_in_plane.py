@@ -1,0 +1,47 @@
+"""Accurate estimator wired into the control plane: node-level capacity
+bounds the schedule (the config-3 deployment shape: estimator per member)."""
+
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.estimator import NodeState
+from karmada_tpu.utils.builders import dynamic_weight_placement, new_cluster, new_deployment
+from karmada_tpu.utils.quantity import parse_resource_list
+
+
+def test_node_capacity_bounds_schedule():
+    cp = ControlPlane(enable_accurate_estimator=True)
+    # member1 summary says huge, but nodes only fit 2 x 1cpu replicas
+    m1 = cp.join_cluster(new_cluster("member1", cpu="1000", memory="4000Gi"))
+    m1.nodes = [
+        NodeState(
+            name="n0",
+            allocatable=parse_resource_list({"cpu": "2", "memory": "8Gi", "pods": 10}),
+        )
+    ]
+    m2 = cp.join_cluster(new_cluster("member2", cpu="1000", memory="4000Gi"))
+    m2.nodes = [
+        NodeState(
+            name="n0",
+            allocatable=parse_resource_list({"cpu": "64", "memory": "256Gi",
+                                             "pods": 100}),
+        )
+    ]
+    cp.settle()
+    cp.store.apply(new_deployment("app", replicas=10, cpu="1", memory="1Gi"))
+    cp.store.apply(
+        PropagationPolicy(
+            meta=ObjectMeta(name="p", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=dynamic_weight_placement(),
+            ),
+        )
+    )
+    cp.settle()
+    rb = cp.store.get("ResourceBinding", "default/app-deployment")
+    placed = {tc.name: tc.replicas for tc in rb.spec.clusters}
+    assert sum(placed.values()) == 10
+    assert placed.get("member1", 0) <= 2  # node-level cap, not the summary
