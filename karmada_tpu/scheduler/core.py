@@ -87,6 +87,7 @@ class TensorScheduler:
         chunk_size: int = 4096,
         extra_estimators: Sequence = (),
         disabled_plugins: Sequence[str] = (),
+        custom_filters: Sequence = (),
     ):
         self.snapshot = snapshot
         self.chunk_size = chunk_size
@@ -95,6 +96,11 @@ class TensorScheduler:
         self.extra_estimators = list(extra_estimators)
         # --plugins enable/disable list (scheduler.go:243-247)
         self.disabled_plugins = set(disabled_plugins)
+        # out-of-tree filter plugins (the plugin-registry seam,
+        # framework/runtime/registry.go): callables
+        # (snapshot, problems) -> bool[B, C] mask AND-composed with the
+        # in-tree filters — batched by construction
+        self.custom_filters = list(custom_filters)
         self._placement_cache: dict[int, CompiledPlacement] = {}
 
     # -- compilation -------------------------------------------------------
@@ -259,6 +265,8 @@ class TensorScheduler:
             feasible &= taint_pl[cp_idx] | prev_mask
         if "ClusterEviction" not in disabled:
             feasible &= ~evict
+        for custom in self.custom_filters:
+            feasible &= np.asarray(custom(snap, problems), bool)
         static_w = static_pl[cp_idx]
         return feasible, strategy, replicas, static_w, requests, prev, fresh
 
