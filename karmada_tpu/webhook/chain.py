@@ -169,6 +169,78 @@ def validate_resource_binding(rb) -> None:
     validate_placement(rb.spec.placement)
 
 
+def validate_federated_hpa(hpa) -> None:
+    if hpa.spec.min_replicas < 1:
+        raise ValidationError("minReplicas must be >= 1")
+    if hpa.spec.max_replicas < hpa.spec.min_replicas:
+        raise ValidationError("maxReplicas must be >= minReplicas")
+    if not hpa.spec.scale_target_ref.name:
+        raise ValidationError("scaleTargetRef.name is required")
+    for m in hpa.spec.metrics:
+        if (
+            m.target_average_utilization is not None
+            and not 1 <= m.target_average_utilization <= 100
+        ):
+            raise ValidationError("targetAverageUtilization must be in [1, 100]")
+
+
+def validate_cron_federated_hpa(cron) -> None:
+    from ..utils.cron import _parse_field
+
+    names = [r.name for r in cron.spec.rules]
+    if len(names) != len(set(names)):
+        raise ValidationError("rule names must be unique")
+    for rule in cron.spec.rules:
+        fields = rule.schedule.split()
+        if len(fields) != 5:
+            raise ValidationError(f"invalid cron schedule {rule.schedule!r}")
+        try:
+            for f, lo, hi in zip(fields, (0, 0, 1, 1, 0), (59, 23, 31, 12, 6)):
+                _parse_field(f, lo, hi)
+        except (ValueError, IndexError) as e:
+            raise ValidationError(f"invalid cron schedule {rule.schedule!r}: {e}")
+        if (
+            rule.target_replicas is None
+            and rule.target_min_replicas is None
+            and rule.target_max_replicas is None
+        ):
+            raise ValidationError(
+                f"rule {rule.name!r} must set targetReplicas or min/max bounds"
+            )
+
+
+def validate_multicluster_service(mcs) -> None:
+    valid_types = {"CrossCluster", "LoadBalancer"}
+    for t in mcs.spec.types:
+        if t not in valid_types:
+            raise ValidationError(f"invalid exposure type {t!r}")
+
+
+def validate_interpreter_customization(cr) -> None:
+    if not cr.target_api_version or not cr.target_kind:
+        raise ValidationError("customization target apiVersion/kind required")
+    for pred in cr.rules.health:
+        if pred.get("op", "==") not in ("==", ">=", "<="):
+            raise ValidationError(f"invalid health op {pred.get('op')!r}")
+    for fname, how in cr.rules.status_aggregation.items():
+        if how not in ("sum", "max", "min"):
+            raise ValidationError(f"invalid aggregation {how!r} for {fname!r}")
+
+
+def validate_workload_rebalancer(rebalancer) -> None:
+    if not rebalancer.spec.workloads:
+        raise ValidationError("workloads must not be empty")
+
+
+def validate_work(work) -> None:
+    if not work.spec.workload:
+        raise ValidationError("work must carry at least one manifest")
+    if work.spec.conflict_resolution not in ("Overwrite", "Abort"):
+        raise ValidationError(
+            f"invalid conflictResolution {work.spec.conflict_resolution!r}"
+        )
+
+
 def default_admission_chain() -> AdmissionChain:
     chain = AdmissionChain()
     for kind in ("PropagationPolicy", "ClusterPropagationPolicy"):
@@ -179,4 +251,12 @@ def default_admission_chain() -> AdmissionChain:
     chain.register_validator("FederatedResourceQuota", validate_federated_resource_quota)
     for kind in ("ResourceBinding", "ClusterResourceBinding"):
         chain.register_validator(kind, validate_resource_binding)
+    chain.register_validator("FederatedHPA", validate_federated_hpa)
+    chain.register_validator("CronFederatedHPA", validate_cron_federated_hpa)
+    chain.register_validator("MultiClusterService", validate_multicluster_service)
+    chain.register_validator(
+        "ResourceInterpreterCustomization", validate_interpreter_customization
+    )
+    chain.register_validator("WorkloadRebalancer", validate_workload_rebalancer)
+    chain.register_validator("Work", validate_work)
     return chain

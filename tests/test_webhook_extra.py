@@ -1,0 +1,98 @@
+"""Extended webhook handler coverage (HPA/cron/MCS/customization/work)."""
+
+import pytest
+
+from karmada_tpu.api.autoscaling import (
+    CronFederatedHPA,
+    CronFederatedHPARule,
+    CronFederatedHPASpec,
+    FederatedHPA,
+    FederatedHPASpec,
+    MetricSpec,
+    ScaleTargetRef,
+)
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.api.networking import MultiClusterService, MultiClusterServiceSpec
+from karmada_tpu.api.work import Work, WorkSpec
+from karmada_tpu.interpreter.declarative import (
+    CustomizationRules,
+    ResourceInterpreterCustomization,
+)
+from karmada_tpu.webhook import ValidationError, default_admission_chain
+
+
+@pytest.fixture
+def chain():
+    return default_admission_chain()
+
+
+def test_hpa_bounds(chain):
+    hpa = FederatedHPA(
+        meta=ObjectMeta(name="h", namespace="default"),
+        spec=FederatedHPASpec(
+            scale_target_ref=ScaleTargetRef(name="web"),
+            min_replicas=5, max_replicas=2,
+        ),
+    )
+    with pytest.raises(ValidationError, match="maxReplicas"):
+        chain.admit("FederatedHPA", hpa)
+
+
+def test_hpa_utilization_range(chain):
+    hpa = FederatedHPA(
+        meta=ObjectMeta(name="h", namespace="default"),
+        spec=FederatedHPASpec(
+            scale_target_ref=ScaleTargetRef(name="web"),
+            metrics=[MetricSpec(target_average_utilization=250)],
+        ),
+    )
+    with pytest.raises(ValidationError, match="targetAverageUtilization"):
+        chain.admit("FederatedHPA", hpa)
+
+
+def test_cron_schedule_validated(chain):
+    cron = CronFederatedHPA(
+        meta=ObjectMeta(name="c", namespace="default"),
+        spec=CronFederatedHPASpec(
+            rules=[CronFederatedHPARule(name="r", schedule="not a cron",
+                                        target_replicas=1)]
+        ),
+    )
+    with pytest.raises(ValidationError, match="cron schedule"):
+        chain.admit("CronFederatedHPA", cron)
+
+
+def test_cron_rule_needs_target(chain):
+    cron = CronFederatedHPA(
+        meta=ObjectMeta(name="c", namespace="default"),
+        spec=CronFederatedHPASpec(
+            rules=[CronFederatedHPARule(name="r", schedule="0 9 * * *")]
+        ),
+    )
+    with pytest.raises(ValidationError, match="targetReplicas"):
+        chain.admit("CronFederatedHPA", cron)
+
+
+def test_mcs_types(chain):
+    mcs = MultiClusterService(
+        meta=ObjectMeta(name="m", namespace="default"),
+        spec=MultiClusterServiceSpec(types=["Teleport"]),
+    )
+    with pytest.raises(ValidationError, match="exposure type"):
+        chain.admit("MultiClusterService", mcs)
+
+
+def test_customization_health_op(chain):
+    cr = ResourceInterpreterCustomization(
+        meta=ObjectMeta(name="c"),
+        target_api_version="example.io/v1",
+        target_kind="Thing",
+        rules=CustomizationRules(health=[{"path": "x", "op": "!=", "value": 1}]),
+    )
+    with pytest.raises(ValidationError, match="health op"):
+        chain.admit("ResourceInterpreterCustomization", cr)
+
+
+def test_empty_work_rejected(chain):
+    with pytest.raises(ValidationError, match="manifest"):
+        chain.admit("Work", Work(meta=ObjectMeta(name="w", namespace="karmada-es-x")))
