@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import ops  # noqa: F401  — enables x64 before the int64 kernel traces
 from ..api.work import ReplicaRequirements
 
 UNAUTHENTIC = -1

@@ -1,0 +1,1 @@
+from . import estimator_pb2  # noqa: F401

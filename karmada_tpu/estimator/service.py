@@ -6,11 +6,12 @@ MaxAvailableReplicas / GetUnschedulableReplicas), pb/types.go:26-119
 cache, naming-convention discovery {prefix}-{cluster}:port) and
 client/accurate.go:139-162 (concurrent fan-out under one deadline).
 
-The wire types are dataclasses with dict (de)serialization — the protobuf
-schema shape without generated code. Transports are pluggable: the in-proc
-transport calls the service object directly (this image ships no grpcio);
-a gRPC transport slots into ``EstimatorConnection.call`` without touching
-the scheduler side.
+The wire types are dataclasses mirroring the protobuf schema. Transports
+are pluggable behind the ``call(method, request)`` seam: the in-proc
+transport calls the service object directly; the real gRPC/protobuf
+transport (optionally mTLS) lives in :mod:`.grpc_transport` and drops into
+the same pool via the resolver, so the scheduler side never knows which
+wire it is on.
 """
 
 from __future__ import annotations
@@ -112,6 +113,15 @@ class EstimatorConnection:
         raise ValueError(f"unknown method {method}")
 
 
+def _close(conn) -> None:
+    close = getattr(conn, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:
+            pass
+
+
 class EstimatorClientPool:
     """Scheduler-side connection cache + service discovery
     (client/cache.go + client/service.go). Discovery resolves
@@ -136,14 +146,25 @@ class EstimatorClientPool:
         service = self.resolver(cluster)
         if service is None:
             return None
-        conn = EstimatorConnection(cluster, service)
+        # the resolver may hand back a ready connection (e.g. a
+        # GrpcEstimatorConnection) or a bare service to wrap in-proc
+        conn = service if hasattr(service, "call") else EstimatorConnection(cluster, service)
         with self._lock:
-            self._conns[cluster] = conn
-        return conn
+            winner = self._conns.setdefault(cluster, conn)
+        if winner is not conn:  # lost an insert race: drop the extra channel
+            _close(conn)
+        return winner
 
-    def evict(self, cluster: str) -> None:
+    def evict(self, cluster: str, conn=None) -> None:
+        """Drop a cached connection. When ``conn`` is given, evict only if it
+        is still the cached one — a late failure must not tear down a
+        channel a newer pass already re-resolved."""
         with self._lock:
-            self._conns.pop(cluster, None)
+            cached = self._conns.get(cluster)
+            if cached is None or (conn is not None and cached is not conn):
+                return
+            del self._conns[cluster]
+        _close(cached)
 
     def max_available_replicas(
         self,
@@ -162,12 +183,20 @@ class EstimatorClientPool:
             conn = self.connection(cluster)
             if conn is None:
                 return
-            resp = conn.call(
-                "MaxAvailableReplicas",
-                MaxAvailableReplicasRequest(
-                    cluster=cluster, resource_request=resource_request, **req_kw
-                ),
-            )
+            try:
+                resp = conn.call(
+                    "MaxAvailableReplicas",
+                    MaxAvailableReplicasRequest(
+                        cluster=cluster, resource_request=resource_request, **req_kw
+                    ),
+                )
+            except Exception:
+                # transport failure answers UnauthenticReplica and drops the
+                # cached channel — only if it is still this one, so a late
+                # straggler cannot tear down a re-resolved healthy channel
+                # (client/accurate.go error path + cache eviction)
+                self.evict(cluster, conn)
+                return
             results[cluster] = resp.max_replicas
 
         for c in clusters:
@@ -176,4 +205,6 @@ class EstimatorClientPool:
             threads.append(t)
         for t in threads:
             t.join(max(deadline - time.time(), 0.0))
-        return results
+        # snapshot: stragglers past the deadline keep writing to ``results``;
+        # the caller's view must be frozen at the deadline
+        return dict(results)

@@ -1,0 +1,254 @@
+"""gRPC estimator transport: wire round-trips, mTLS, pool fan-out.
+
+Ref behavior: pkg/estimator/server/server.go (mTLS serve),
+client/accurate.go:139-162 (fan-out, error -> UnauthenticReplica),
+client/cache.go (connection cache eviction on failure).
+"""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api.cluster import NO_SCHEDULE, Taint
+from karmada_tpu.estimator.accurate import AccurateEstimator, NodeSnapshot, NodeState
+from karmada_tpu.estimator.grpc_transport import (
+    EstimatorGrpcServer,
+    GrpcEstimatorConnection,
+    conventional_target,
+)
+from karmada_tpu.estimator.service import (
+    EstimatorClientPool,
+    EstimatorService,
+    MaxAvailableReplicasRequest,
+    UnschedulableReplicasRequest,
+)
+
+DIMS = ["cpu", "memory", "pods"]
+
+
+def make_service(cluster: str, cpu_free: int, n_nodes: int = 2) -> EstimatorService:
+    nodes = [
+        NodeState(
+            name=f"{cluster}-n{i}",
+            allocatable={"cpu": cpu_free, "memory": 1 << 32, "pods": 110},
+            requested={"cpu": 0, "memory": 0},
+        )
+        for i in range(n_nodes)
+    ]
+    est = AccurateEstimator(cluster, NodeSnapshot(nodes, DIMS))
+    est.unschedulable["default/web"] = 3
+    return EstimatorService(est)
+
+
+def test_insecure_round_trip():
+    svc = make_service("m1", cpu_free=4000)
+    server = EstimatorGrpcServer(svc)
+    port = server.start()
+    try:
+        conn = GrpcEstimatorConnection("m1", f"127.0.0.1:{port}")
+        resp = conn.call(
+            "MaxAvailableReplicas",
+            MaxAvailableReplicasRequest(cluster="m1", resource_request={"cpu": 1000}),
+        )
+        # 2 nodes x 4000/1000
+        assert resp.max_replicas == 8
+        un = conn.call(
+            "GetUnschedulableReplicas",
+            UnschedulableReplicasRequest(cluster="m1", namespace="default", name="web"),
+        )
+        assert un.unschedulable_replicas == 3
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_node_claim_survives_wire():
+    """node_selector + tolerations shape the estimate through the pb hop."""
+    nodes = [
+        NodeState(
+            name="gpu-node",
+            allocatable={"cpu": 8000, "memory": 1 << 33, "pods": 110},
+            labels={"accel": "tpu"},
+        ),
+        NodeState(
+            name="tainted",
+            allocatable={"cpu": 8000, "memory": 1 << 33, "pods": 110},
+            labels={"accel": "tpu"},
+            taints=[Taint(key="dedicated", value="infra", effect=NO_SCHEDULE)],
+        ),
+        NodeState(name="plain", allocatable={"cpu": 8000, "memory": 1 << 33, "pods": 110}),
+    ]
+    svc = EstimatorService(AccurateEstimator("m1", NodeSnapshot(nodes, DIMS)))
+    server = EstimatorGrpcServer(svc)
+    port = server.start()
+    try:
+        conn = GrpcEstimatorConnection("m1", f"127.0.0.1:{port}")
+        # selector only: tainted node excluded, plain node label-mismatched
+        resp = conn.call(
+            "MaxAvailableReplicas",
+            MaxAvailableReplicasRequest(
+                cluster="m1",
+                resource_request={"cpu": 2000},
+                node_selector={"accel": "tpu"},
+            ),
+        )
+        assert resp.max_replicas == 4
+        # toleration unlocks the tainted node
+        resp = conn.call(
+            "MaxAvailableReplicas",
+            MaxAvailableReplicasRequest(
+                cluster="m1",
+                resource_request={"cpu": 2000},
+                node_selector={"accel": "tpu"},
+                tolerations=[{"key": "dedicated", "operator": "Exists"}],
+            ),
+        )
+        assert resp.max_replicas == 8
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_pool_fanout_over_grpc_and_failure_unauthentic():
+    servers = {}
+    ports = {}
+    for name, cpu in [("m1", 2000), ("m2", 6000)]:
+        s = EstimatorGrpcServer(make_service(name, cpu))
+        ports[name] = s.start()
+        servers[name] = s
+
+    def resolver(cluster):
+        if cluster == "gone":  # unreachable member: refused connection
+            return GrpcEstimatorConnection(cluster, "127.0.0.1:1", timeout_seconds=0.5)
+        if cluster not in ports:
+            return None
+        return GrpcEstimatorConnection(cluster, f"127.0.0.1:{ports[cluster]}")
+
+    pool = EstimatorClientPool(resolver, timeout_seconds=5.0)
+    try:
+        got = pool.max_available_replicas(
+            ["m1", "m2", "gone", "unknown"], {"cpu": 1000}
+        )
+        assert got == {"m1": 4, "m2": 12, "gone": -1, "unknown": -1}
+        # failed channel was evicted so recovery re-resolves
+        assert pool.connection("m1") is not None
+        assert "gone" not in pool._conns
+    finally:
+        for s in servers.values():
+            s.stop()
+
+
+@pytest.fixture(scope="module")
+def mtls_certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pki")
+
+    def run(*args):
+        subprocess.run(args, check=True, capture_output=True, cwd=d)
+
+    run("openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes", "-keyout",
+        "ca.key", "-out", "ca.crt", "-days", "1", "-subj", "/CN=karmada-ca")
+    for who in ("server", "client"):
+        run("openssl", "req", "-newkey", "rsa:2048", "-nodes", "-keyout",
+            f"{who}.key", "-out", f"{who}.csr", "-subj", f"/CN={who}")
+        run("openssl", "x509", "-req", "-in", f"{who}.csr", "-CA", "ca.crt",
+            "-CAkey", "ca.key", "-CAcreateserial", "-out", f"{who}.crt",
+            "-days", "1", "-extfile", _ext_file(d, who))
+    return {p.name: p.read_bytes() for p in d.iterdir() if p.suffix in (".crt", ".key")}
+
+
+def _ext_file(d, who):
+    ext = d / f"{who}.ext"
+    ext.write_text("subjectAltName=IP:127.0.0.1,DNS:localhost\n")
+    return str(ext)
+
+
+def test_mtls_round_trip(mtls_certs):
+    """mTLS both ways (ref: grpcconnection/config.go — server cert+key,
+    client CA, require_client_auth)."""
+    svc = make_service("secure", cpu_free=3000)
+    server = EstimatorGrpcServer(
+        svc,
+        server_cert=mtls_certs["server.crt"],
+        server_key=mtls_certs["server.key"],
+        client_ca=mtls_certs["ca.crt"],
+    )
+    port = server.start()
+    try:
+        conn = GrpcEstimatorConnection(
+            "secure",
+            f"127.0.0.1:{port}",
+            root_ca=mtls_certs["ca.crt"],
+            client_cert=mtls_certs["client.crt"],
+            client_key=mtls_certs["client.key"],
+        )
+        resp = conn.call(
+            "MaxAvailableReplicas",
+            MaxAvailableReplicasRequest(cluster="secure", resource_request={"cpu": 500}),
+        )
+        assert resp.max_replicas == 12
+        conn.close()
+        # a client without a certificate is rejected by client-auth
+        bad = GrpcEstimatorConnection(
+            "secure", f"127.0.0.1:{port}", root_ca=mtls_certs["ca.crt"],
+            timeout_seconds=2.0,
+        )
+        with pytest.raises(Exception):
+            bad.call(
+                "MaxAvailableReplicas",
+                MaxAvailableReplicasRequest(cluster="secure", resource_request={"cpu": 500}),
+            )
+        bad.close()
+    finally:
+        server.stop()
+
+
+def test_conventional_target():
+    assert conventional_target("karmada-scheduler-estimator", "m1", 10352) == (
+        "karmada-scheduler-estimator-m1:10352"
+    )
+    assert conventional_target("est", "m2", 9000, host="127.0.0.1") == "127.0.0.1:9000"
+
+
+def test_batch_request_matches_single_over_wire():
+    """The wire path (single requests) agrees with the in-proc batch kernel."""
+    svc = make_service("m1", cpu_free=5000, n_nodes=3)
+    server = EstimatorGrpcServer(svc)
+    port = server.start()
+    try:
+        conn = GrpcEstimatorConnection("m1", f"127.0.0.1:{port}")
+        reqs = np.array([[1000, 1, 1], [2500, 1, 1], [7000, 1, 1]], np.int64)
+        batch = svc.estimator.max_available_replicas(None, reqs)
+        for row, expect in zip(reqs, batch):
+            resp = conn.call(
+                "MaxAvailableReplicas",
+                MaxAvailableReplicasRequest(
+                    cluster="m1",
+                    resource_request={"cpu": int(row[0]), "memory": int(row[1]), "pods": int(row[2])},
+                ),
+            )
+            assert resp.max_replicas == int(expect)
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_partial_tls_rejected(mtls_certs):
+    """Incomplete TLS material fails loudly — never silent plaintext."""
+    svc = make_service("m1", cpu_free=1000)
+    with pytest.raises(ValueError):
+        EstimatorGrpcServer(svc, server_cert=mtls_certs["server.crt"])
+    with pytest.raises(ValueError):
+        EstimatorGrpcServer(svc, client_ca=mtls_certs["ca.crt"])
+    with pytest.raises(ValueError):
+        GrpcEstimatorConnection("m1", "127.0.0.1:1", client_cert=mtls_certs["client.crt"])
+
+
+def test_bind_failure_raises():
+    svc = make_service("m1", cpu_free=1000)
+    s1 = EstimatorGrpcServer(svc, address="127.0.0.1:0")
+    try:
+        with pytest.raises(RuntimeError):
+            EstimatorGrpcServer(svc, address=f"127.0.0.1:{s1.port}")
+    finally:
+        s1.stop()
