@@ -19,9 +19,14 @@ from .facade import (  # noqa: F401
     ResourceInterpreter,
 )
 from .native import register_native_interpreters  # noqa: F401
+from .thirdparty import (  # noqa: F401
+    THIRDPARTY_CUSTOMIZATIONS,
+    register_thirdparty_interpreters,
+)
 
 
 def default_interpreter() -> ResourceInterpreter:
     interp = ResourceInterpreter()
     register_native_interpreters(interp)
+    register_thirdparty_interpreters(interp)
     return interp

@@ -14,12 +14,32 @@ programmatic extensions use ResourceInterpreter.register_customized
 
 DSL fields (all optional, per operation):
 - replica_path: dotted path to the replica count (GetReplicas/ReviseReplica)
+- replica_default: replica count when replica_path is unset on the object
+  (argo Workflow/BroadcastJob default to 1 when .spec.parallelism is nil)
 - requests_path: dotted path to a per-replica resource-request map
+- pod_requests_path: dotted spec path of a pod template whose container
+  requests form the per-replica requirements (kube.accuratePodRequirements)
 - status_paths: list of status fields to reflect (ReflectStatus)
-- health: list of {path, op (==|>=|<=), value} predicates, ANDed
-  (InterpretHealth)
-- status_aggregation: {field: "sum"|"max"|"min"} (AggregateStatus)
-- dependencies: list of {kind, api_version, name_path} (GetDependencies)
+- health: predicate list, ANDed (InterpretHealth). Forms:
+    {path, op (==|!=|>=|<=|in|exists), value}        — direct status field
+    {path, op, spec_path} / {path, op, status_path}  — compare two fields
+    {condition: type, status: "True", reason: r?}    — scan status.conditions
+    {observed_generation: true}                      — status.observedGeneration
+                                                       == metadata.generation
+    {any: [sub-predicates]}                          — OR group
+- status_aggregation: {field: "sum"|"max"|"min"|"last"|"and"|"or"}
+  ("last" = last non-empty, for revisions/selectors)
+- status_zero_fields: numeric fields zero-filled when no member statuses
+- aggregate_observed_generation: set status.observedGeneration to
+  metadata.generation once every member has observed its own generation
+- retain_paths: spec paths copied observed→desired (Retain; flux
+  spec.suspend carry-over pattern)
+- retain_status: carry the whole observed status into desired (argo)
+- dependencies: list of (GetDependencies):
+    {kind, api_version, name_path, namespace_path?}  — single ref
+    {list_path, name_field, kind | kind_field, api_version} — ref list
+    {pod_template_path}  — walk a pod template for configmaps/secrets/
+                           PVCs/serviceaccounts (kube.getPodDependencies)
 """
 
 from __future__ import annotations
@@ -38,6 +58,7 @@ from .facade import (
     GET_REPLICAS,
     INTERPRET_HEALTH,
     REFLECT_STATUS,
+    RETAIN,
     REVISE_REPLICA,
     DependentObjectReference,
     ResourceInterpreter,
@@ -72,10 +93,16 @@ def set_path(obj: dict, path: str, value: Any) -> None:
 @dataclass
 class CustomizationRules:
     replica_path: str = ""
+    replica_default: int = 0
     requests_path: str = ""
+    pod_requests_path: str = ""
     status_paths: list[str] = field(default_factory=list)
     health: list[dict] = field(default_factory=list)
     status_aggregation: dict[str, str] = field(default_factory=dict)
+    status_zero_fields: list[str] = field(default_factory=list)
+    aggregate_observed_generation: bool = False
+    retain_paths: list[str] = field(default_factory=list)
+    retain_status: bool = False
     dependencies: list[dict] = field(default_factory=list)
 
 
@@ -93,13 +120,64 @@ class ResourceInterpreterCustomization:
         return f"{self.target_api_version}/{self.target_kind}"
 
 
+def _check_predicate(pred: dict, obj: Resource) -> bool:
+    st = obj.status or {}
+    if "any" in pred:
+        return any(_check_predicate(p, obj) for p in pred["any"])
+    if pred.get("observed_generation"):
+        gen = obj.meta.generation if hasattr(obj.meta, "generation") else 0
+        return (st.get("observedGeneration") or 0) >= (gen or 0)
+    if "condition" in pred:
+        for cond in st.get("conditions") or []:
+            if cond.get("type") != pred["condition"]:
+                continue
+            if cond.get("status") != pred.get("status", "True"):
+                continue
+            if "reason" in pred and cond.get("reason") != pred["reason"]:
+                continue
+            return True
+        return False
+    value = get_path(st, pred["path"])
+    op = pred.get("op", "==")
+    if op == "exists":
+        return value is not None
+    if "spec_path" in pred:
+        want = get_path(obj.spec, pred["spec_path"])
+    elif "status_path" in pred:
+        want = get_path(st, pred["status_path"])
+    else:
+        want = pred.get("value")
+    if value is None:
+        return False
+    if op == "==":
+        return value == want
+    if op == "!=":
+        return value != want
+    if op == "in":
+        return value in (want or [])
+    if op == ">=":
+        return value >= want
+    if op == "<=":
+        return value <= want
+    return False
+
+
 def _compile(rules: CustomizationRules) -> dict[str, Any]:
     """Build operation callables from the DSL."""
     ops: dict[str, Any] = {}
-    if rules.replica_path:
+    if rules.replica_path or rules.replica_default:
 
         def get_replicas(obj: Resource):
-            replicas = int(get_path(obj.spec, rules.replica_path) or 0)
+            raw_replicas = (
+                get_path(obj.spec, rules.replica_path) if rules.replica_path else None
+            )
+            try:
+                replicas = int(raw_replicas)
+            except (TypeError, ValueError):
+                # unset, or an IntOrString like "50%" (kruise BroadcastJob
+                # parallelism) — fall back rather than wedge the reconciler
+                replicas = rules.replica_default
+
             reqs = None
             if rules.requests_path:
                 raw = get_path(obj.spec, rules.requests_path) or {}
@@ -107,47 +185,53 @@ def _compile(rules: CustomizationRules) -> dict[str, Any]:
                     resource_request=parse_resource_list(raw),
                     namespace=obj.meta.namespace,
                 )
+            elif rules.pod_requests_path:
+                template = get_path(obj.spec, rules.pod_requests_path) or {}
+                from .native import pod_requests
+
+                reqs = ReplicaRequirements(
+                    resource_request=pod_requests(template.get("spec") or {}),
+                    namespace=obj.meta.namespace,
+                )
             return replicas, reqs
 
-        def revise_replica(obj: Resource, replicas: int):
-            out = copy.deepcopy(obj)
-            set_path(out.spec, rules.replica_path, replicas)
-            return out
-
         ops[GET_REPLICAS] = get_replicas
-        ops[REVISE_REPLICA] = revise_replica
+        if rules.replica_path:
+
+            def revise_replica(obj: Resource, replicas: int):
+                out = copy.deepcopy(obj)
+                set_path(out.spec, rules.replica_path, replicas)
+                return out
+
+            ops[REVISE_REPLICA] = revise_replica
     if rules.status_paths:
 
         def reflect_status(obj: Resource):
-            if not obj.status:
-                return None
-            return {
-                p: get_path(obj.status, p)
-                for p in rules.status_paths
-                if get_path(obj.status, p) is not None
-            }
+            out: dict[str, Any] = {}
+            for p in rules.status_paths:
+                if p.startswith("meta."):
+                    # metadata projected into the reflected status (e.g.
+                    # meta.generation -> status["generation"], so aggregation
+                    # can compare member generation vs observedGeneration)
+                    value = getattr(obj.meta, p[len("meta."):], None)
+                else:
+                    value = get_path(obj.status or {}, p)
+                if value is not None:
+                    out[p.split(".", 1)[-1] if p.startswith("meta.") else p] = value
+            return out or None
 
         ops[REFLECT_STATUS] = reflect_status
     if rules.health:
 
         def interpret_health(obj: Resource) -> bool:
-            st = obj.status or {}
-            for pred in rules.health:
-                value = get_path(st, pred["path"])
-                want = pred.get("value")
-                op = pred.get("op", "==")
-                if value is None:
-                    return False
-                if op == "==" and value != want:
-                    return False
-                if op == ">=" and not value >= want:
-                    return False
-                if op == "<=" and not value <= want:
-                    return False
-            return True
+            return all(_check_predicate(p, obj) for p in rules.health)
 
         ops[INTERPRET_HEALTH] = interpret_health
-    if rules.status_aggregation:
+    if (
+        rules.status_aggregation
+        or rules.status_zero_fields
+        or rules.aggregate_observed_generation
+    ):
 
         def aggregate_status(obj: Resource, items: list[AggregatedStatusItem]):
             out = copy.deepcopy(obj)
@@ -156,9 +240,11 @@ def _compile(rules: CustomizationRules) -> dict[str, Any]:
                 values = [
                     (item.status or {}).get(fname)
                     for item in items
-                    if (item.status or {}).get(fname) is not None
+                    if (item.status or {}).get(fname) not in (None, "")
                 ]
                 if not values:
+                    if fname in rules.status_zero_fields:
+                        agg[fname] = 0
                     continue
                 if how == "sum":
                     agg[fname] = sum(values)
@@ -166,25 +252,97 @@ def _compile(rules: CustomizationRules) -> dict[str, Any]:
                     agg[fname] = max(values)
                 elif how == "min":
                     agg[fname] = min(values)
+                elif how == "last":
+                    agg[fname] = values[-1]
+                elif how == "and":
+                    agg[fname] = all(values)
+                elif how == "or":
+                    agg[fname] = any(values)
+            if rules.aggregate_observed_generation:
+                # advance only once every member observed its own generation
+                all_observed = all(
+                    (item.status or {}).get("observedGeneration", 0)
+                    >= (item.status or {}).get("generation", 0)
+                    for item in items
+                )
+                if all_observed:
+                    agg["observedGeneration"] = out.meta.generation or 0
             out.status = {**(out.status or {}), **agg}
             return out
 
         ops[AGGREGATE_STATUS] = aggregate_status
+    if rules.retain_paths or rules.retain_status:
+
+        def retain(desired: Resource, observed: Resource):
+            out = copy.deepcopy(desired)
+            for path in rules.retain_paths:
+                value = get_path(observed.spec, path)
+                if value is not None:
+                    set_path(out.spec, path, copy.deepcopy(value))
+            if rules.retain_status and observed.status is not None:
+                out.status = copy.deepcopy(observed.status)
+            return out
+
+        ops[RETAIN] = retain
     if rules.dependencies:
 
         def get_dependencies(obj: Resource):
             deps = []
             for rule in rules.dependencies:
-                name = get_path(obj.spec, rule.get("name_path", ""))
-                if name:
-                    deps.append(
-                        DependentObjectReference(
-                            api_version=rule.get("api_version", "v1"),
-                            kind=rule.get("kind", "ConfigMap"),
-                            namespace=obj.meta.namespace,
-                            name=str(name),
+                if rule.get("pod_template_path"):
+                    template = get_path(obj.spec, rule["pod_template_path"]) or {}
+                    from .native import pod_spec_dependencies
+
+                    deps.extend(
+                        pod_spec_dependencies(
+                            template.get("spec") or {}, obj.meta.namespace
                         )
                     )
+                elif rule.get("list_path"):
+                    for entry in get_path(obj.spec, rule["list_path"]) or []:
+                        if not isinstance(entry, dict):
+                            continue
+                        name = entry.get(rule.get("name_field", "name"))
+                        kind = (
+                            entry.get(rule["kind_field"])
+                            if rule.get("kind_field")
+                            else rule.get("kind", "ConfigMap")
+                        )
+                        if name and kind:
+                            deps.append(
+                                DependentObjectReference(
+                                    api_version=rule.get("api_version", "v1"),
+                                    kind=str(kind),
+                                    namespace=obj.meta.namespace,
+                                    name=str(name),
+                                )
+                            )
+                else:
+                    name = get_path(obj.spec, rule.get("name_path", ""))
+                    if name:
+                        namespace = (
+                            get_path(obj.spec, rule["namespace_path"])
+                            if rule.get("namespace_path")
+                            else None
+                        )
+                        # the referenced kind may live in the object itself
+                        # (flux sourceRef.kind), with a per-kind api group
+                        kind = (
+                            get_path(obj.spec, rule["kind_path"])
+                            if rule.get("kind_path")
+                            else None
+                        ) or rule.get("kind", "ConfigMap")
+                        api_version = rule.get("api_version_by_kind", {}).get(
+                            kind, rule.get("api_version", "v1")
+                        )
+                        deps.append(
+                            DependentObjectReference(
+                                api_version=api_version,
+                                kind=str(kind),
+                                namespace=str(namespace or obj.meta.namespace),
+                                name=str(name),
+                            )
+                        )
             return deps
 
         ops[GET_DEPENDENCIES] = get_dependencies

@@ -54,10 +54,17 @@ class ResourceInterpreter:
 
     def __init__(self) -> None:
         self._native: dict[tuple[str, str], Callable] = {}
+        self._thirdparty: dict[tuple[str, str], Callable] = {}
         self._customized: dict[tuple[str, str], Callable] = {}
 
     def register_native(self, gvk: str, operation: str, fn: Callable) -> None:
         self._native[(gvk, operation)] = fn
+
+    def register_thirdparty(self, gvk: str, operation: str, fn: Callable) -> None:
+        """Built-in customizations for third-party CRDs — override the native
+        defaults but yield to user-supplied customizations
+        (interpreter.go:120-143: declarative/webhook > thirdparty > native)."""
+        self._thirdparty[(gvk, operation)] = fn
 
     def register_customized(self, gvk: str, operation: str, fn: Callable) -> None:
         self._customized[(gvk, operation)] = fn
@@ -66,7 +73,7 @@ class ResourceInterpreter:
         self._customized.pop((gvk, operation), None)
 
     def _resolve(self, gvk: str, operation: str) -> Optional[Callable]:
-        for table in (self._customized, self._native):
+        for table in (self._customized, self._thirdparty, self._native):
             fn = table.get((gvk, operation)) or table.get(("*", operation))
             if fn is not None:
                 return fn

@@ -162,7 +162,14 @@ def _get_dependencies(obj: Resource) -> list[DependentObjectReference]:
     """Dependencies from the pod template: configmaps/secrets/PVCs/service
     account (default/native/dependencies.go)."""
     pod_spec = obj.spec if _gvk(obj) == POD else _template_pod_spec(obj)
-    ns = obj.meta.namespace
+    return pod_spec_dependencies(pod_spec, obj.meta.namespace)
+
+
+def pod_spec_dependencies(
+    pod_spec: dict, ns: str
+) -> list[DependentObjectReference]:
+    """Walk a bare pod spec for referenced objects — shared with the
+    declarative DSL's pod_template_path rule (kube.getPodDependencies)."""
     deps: list[DependentObjectReference] = []
     seen: set[tuple[str, str]] = set()
 

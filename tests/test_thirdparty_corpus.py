@@ -1,0 +1,327 @@
+"""Embedded third-party customization corpus (kruise/argo/flux/kyverno/flink).
+
+Ref: pkg/resourceinterpreter/default/thirdparty/resourcecustomizations/**
++ loader thirdparty.go; chain order interpreter.go:120-143 (user customized
+> thirdparty > native). Fixtures mirror the reference's testdata
+desired/observed pairs.
+"""
+
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.api.work import AggregatedStatusItem
+from karmada_tpu.interpreter import default_interpreter
+from karmada_tpu.interpreter.thirdparty import THIRDPARTY_CUSTOMIZATIONS
+
+
+def item(cluster, status):
+    return AggregatedStatusItem(cluster_name=cluster, status=status, applied=True)
+
+
+def cloneset(replicas=5, generation=3):
+    return Resource(
+        api_version="apps.kruise.io/v1alpha1",
+        kind="CloneSet",
+        meta=ObjectMeta(name="cs", namespace="default", generation=generation),
+        spec={
+            "replicas": replicas,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "app",
+                            "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}},
+                            "env": [
+                                {
+                                    "name": "CFG",
+                                    "valueFrom": {"configMapKeyRef": {"name": "cs-config"}},
+                                }
+                            ],
+                        }
+                    ]
+                }
+            },
+        },
+        status={},
+    )
+
+
+class TestKruise:
+    def test_cloneset_replicas_and_pod_requests(self):
+        interp = default_interpreter()
+        replicas, reqs = interp.get_replicas(cloneset(replicas=7))
+        assert replicas == 7
+        assert reqs.resource_request["cpu"] == 500
+        assert reqs.resource_request["memory"] == 1 << 30
+
+    def test_cloneset_revise_replica(self):
+        interp = default_interpreter()
+        out = interp.revise_replica(cloneset(replicas=7), 3)
+        assert out.spec["replicas"] == 3
+
+    def test_cloneset_aggregation_sums_and_revision_last(self):
+        interp = default_interpreter()
+        obj = cloneset(generation=4)
+        out = interp.aggregate_status(
+            obj,
+            [
+                item("m1", {"replicas": 3, "readyReplicas": 3, "updateRevision": "rev-a",
+                            "generation": 2, "observedGeneration": 2}),
+                item("m2", {"replicas": 2, "readyReplicas": 1, "updateRevision": "rev-b",
+                            "generation": 2, "observedGeneration": 2}),
+            ],
+        )
+        assert out.status["replicas"] == 5
+        assert out.status["readyReplicas"] == 4
+        assert out.status["updateRevision"] == "rev-b"
+        # every member observed its generation -> template observedGeneration
+        assert out.status["observedGeneration"] == 4
+
+    def test_cloneset_observed_generation_held_back(self):
+        interp = default_interpreter()
+        obj = cloneset(generation=4)
+        out = interp.aggregate_status(
+            obj,
+            [
+                item("m1", {"replicas": 3, "generation": 5, "observedGeneration": 4}),
+            ],
+        )
+        assert "observedGeneration" not in out.status or out.status[
+            "observedGeneration"
+        ] != 4
+
+    def test_cloneset_empty_zero_fill(self):
+        interp = default_interpreter()
+        out = interp.aggregate_status(cloneset(generation=2), [])
+        assert out.status["replicas"] == 0
+        assert out.status["availableReplicas"] == 0
+        assert out.status["observedGeneration"] == 2
+
+    def test_cloneset_health(self):
+        interp = default_interpreter()
+        obj = cloneset(replicas=2, generation=1)
+        obj.status = {"observedGeneration": 1, "updatedReplicas": 2,
+                      "replicas": 2, "readyReplicas": 2}
+        assert interp.interpret_health(obj)
+        obj.status["readyReplicas"] = 1
+        assert not interp.interpret_health(obj)
+
+    def test_cloneset_reflect_projects_member_generation(self):
+        """meta.generation is projected into the reflected status so the
+        aggregation hold-back sees real member generations."""
+        interp = default_interpreter()
+        obj = cloneset(generation=6)
+        obj.status = {"replicas": 3, "observedGeneration": 5}
+        reflected = interp.reflect_status(obj)
+        assert reflected["generation"] == 6
+        assert reflected["observedGeneration"] == 5
+
+    def test_broadcastjob_int_or_string_parallelism(self):
+        """IntOrString parallelism ('50%') must not wedge the reconciler."""
+        interp = default_interpreter()
+        bj = Resource(
+            api_version="apps.kruise.io/v1alpha1", kind="BroadcastJob",
+            meta=ObjectMeta(name="bj"),
+            spec={"parallelism": "50%", "template": {"spec": {}}},
+        )
+        replicas, _ = interp.get_replicas(bj)
+        assert replicas == 1  # falls back to the default
+
+    def test_cloneset_pod_dependencies(self):
+        interp = default_interpreter()
+        deps = interp.get_dependencies(cloneset())
+        assert {(d.kind, d.name) for d in deps} == {("ConfigMap", "cs-config")}
+
+    def test_broadcastjob_parallelism_default_and_health(self):
+        interp = default_interpreter()
+        bj = Resource(
+            api_version="apps.kruise.io/v1alpha1",
+            kind="BroadcastJob",
+            meta=ObjectMeta(name="bj", namespace="default"),
+            spec={"template": {"spec": {"containers": []}}},
+            status={"desired": 3, "failed": 0, "succeeded": 0, "active": 2},
+        )
+        replicas, _ = interp.get_replicas(bj)
+        assert replicas == 1  # no parallelism -> 1
+        assert interp.interpret_health(bj)
+        bj.status["failed"] = 1
+        assert not interp.interpret_health(bj)
+        bj.status = {"desired": 3, "failed": 0, "succeeded": 0, "active": 0}
+        assert not interp.interpret_health(bj)  # nothing running nor done
+
+    def test_broadcastjob_retains_member_template_labels(self):
+        interp = default_interpreter()
+        desired = Resource(
+            api_version="apps.kruise.io/v1alpha1", kind="BroadcastJob",
+            meta=ObjectMeta(name="bj"),
+            spec={"template": {"metadata": {}, "spec": {}}},
+        )
+        observed = Resource(
+            api_version="apps.kruise.io/v1alpha1", kind="BroadcastJob",
+            meta=ObjectMeta(name="bj"),
+            spec={"template": {"metadata": {"labels": {"ctrl": "owner"}}, "spec": {}}},
+        )
+        out = interp.retain(desired, observed)
+        assert out.spec["template"]["metadata"]["labels"] == {"ctrl": "owner"}
+
+
+class TestFlux:
+    def helmrelease(self):
+        return Resource(
+            api_version="helm.toolkit.fluxcd.io/v2beta1",
+            kind="HelmRelease",
+            meta=ObjectMeta(name="hr", namespace="apps"),
+            spec={
+                "chart": {"spec": {"sourceRef": {"kind": "HelmRepository",
+                                                 "name": "bitnami", "namespace": "flux-system"}}},
+                "valuesFrom": [
+                    {"kind": "ConfigMap", "name": "hr-values"},
+                    {"kind": "Secret", "name": "hr-secrets"},
+                ],
+            },
+            status={},
+        )
+
+    def test_suspend_retained(self):
+        interp = default_interpreter()
+        desired = self.helmrelease()
+        observed = self.helmrelease()
+        observed.spec["suspend"] = True
+        out = interp.retain(desired, observed)
+        assert out.spec["suspend"] is True
+        # nothing retained when the member hasn't written suspend
+        out2 = interp.retain(self.helmrelease(), self.helmrelease())
+        assert "suspend" not in out2.spec
+
+    def test_ready_condition_health(self):
+        interp = default_interpreter()
+        hr = self.helmrelease()
+        hr.status = {"conditions": [
+            {"type": "Ready", "status": "True", "reason": "ReconciliationSucceeded"}]}
+        assert interp.interpret_health(hr)
+        hr.status["conditions"][0]["reason"] = "ArtifactFailed"
+        assert not interp.interpret_health(hr)
+
+    def test_dependencies_follow_source_ref_kind(self):
+        interp = default_interpreter()
+        deps = interp.get_dependencies(self.helmrelease())
+        got = {(d.kind, d.api_version, d.namespace, d.name) for d in deps}
+        # the object actually referenced: sourceRef.kind, per-kind api group
+        assert (
+            "HelmRepository", "source.toolkit.fluxcd.io/v1beta2", "flux-system", "bitnami"
+        ) in got
+        assert ("ConfigMap", "v1", "apps", "hr-values") in got
+        assert ("Secret", "v1", "apps", "hr-secrets") in got
+
+    def test_kustomization_oci_source_kind(self):
+        interp = default_interpreter()
+        ks = Resource(
+            api_version="kustomize.toolkit.fluxcd.io/v1",
+            kind="Kustomization",
+            meta=ObjectMeta(name="infra", namespace="flux-system"),
+            spec={"sourceRef": {"kind": "OCIRepository", "name": "manifests"}},
+        )
+        deps = interp.get_dependencies(ks)
+        assert {(d.kind, d.api_version, d.name) for d in deps} == {
+            ("OCIRepository", "source.toolkit.fluxcd.io/v1beta2", "manifests")
+        }
+
+    def test_gitrepository_secret_dep_and_health(self):
+        interp = default_interpreter()
+        gr = Resource(
+            api_version="source.toolkit.fluxcd.io/v1",
+            kind="GitRepository",
+            meta=ObjectMeta(name="repo", namespace="flux-system"),
+            spec={"secretRef": {"name": "git-creds"}},
+            status={"conditions": [
+                {"type": "Ready", "status": "True", "reason": "Succeeded"}]},
+        )
+        assert interp.interpret_health(gr)
+        assert {(d.kind, d.name) for d in interp.get_dependencies(gr)} == {
+            ("Secret", "git-creds")
+        }
+
+
+class TestArgoFlinkKyverno:
+    def test_workflow_defaults_and_status_retention(self):
+        interp = default_interpreter()
+        wf = Resource(
+            api_version="argoproj.io/v1alpha1", kind="Workflow",
+            meta=ObjectMeta(name="wf", namespace="ci"),
+            spec={"parallelism": 4},
+            status={"phase": "Running"},
+        )
+        replicas, _ = interp.get_replicas(wf)
+        assert replicas == 4
+        assert interp.interpret_health(wf)
+        wf.status["phase"] = "Failed"
+        assert not interp.interpret_health(wf)
+        # member owns the whole status
+        desired = Resource(api_version="argoproj.io/v1alpha1", kind="Workflow",
+                           meta=ObjectMeta(name="wf"), spec={}, status={})
+        observed = Resource(api_version="argoproj.io/v1alpha1", kind="Workflow",
+                            meta=ObjectMeta(name="wf"), spec={"suspend": True},
+                            status={"phase": "Succeeded"})
+        out = interp.retain(desired, observed)
+        assert out.status == {"phase": "Succeeded"}
+        assert out.spec["suspend"] is True
+
+    def test_flink_health_states(self):
+        interp = default_interpreter()
+        fd = Resource(
+            api_version="flink.apache.org/v1beta1", kind="FlinkDeployment",
+            meta=ObjectMeta(name="fd"),
+            spec={}, status={"jobStatus": {"state": "RUNNING"}},
+        )
+        assert interp.interpret_health(fd)
+        fd.status = {"jobStatus": {"state": "RECONCILING"},
+                     "jobManagerDeploymentStatus": "READY"}
+        assert not interp.interpret_health(fd)
+        fd.status["jobManagerDeploymentStatus"] = "ERROR"
+        assert interp.interpret_health(fd)
+
+    def test_kyverno_ready_and_aggregation(self):
+        interp = default_interpreter()
+        pol = Resource(
+            api_version="kyverno.io/v1", kind="ClusterPolicy",
+            meta=ObjectMeta(name="require-labels"),
+            spec={}, status={"ready": True},
+        )
+        assert interp.interpret_health(pol)
+        out = interp.aggregate_status(
+            pol, [item("m1", {"ready": True}), item("m2", {"ready": False})]
+        )
+        assert out.status["ready"] is False
+
+
+class TestChainOrder:
+    def test_user_customization_overrides_thirdparty(self):
+        interp = default_interpreter()
+        gvk = "apps.kruise.io/v1alpha1/CloneSet"
+        interp.register_customized(
+            gvk, "GetReplicas", lambda obj: (42, None)
+        )
+        replicas, _ = interp.get_replicas(cloneset(replicas=7))
+        assert replicas == 42
+        interp.deregister_customized(gvk, "GetReplicas")
+        replicas, _ = interp.get_replicas(cloneset(replicas=7))
+        assert replicas == 7
+
+    def test_corpus_covers_reference_kinds(self):
+        expected = {
+            "apps.kruise.io/v1alpha1/AdvancedCronJob",
+            "apps.kruise.io/v1alpha1/BroadcastJob",
+            "apps.kruise.io/v1alpha1/CloneSet",
+            "apps.kruise.io/v1alpha1/DaemonSet",
+            "apps.kruise.io/v1beta1/StatefulSet",
+            "argoproj.io/v1alpha1/Workflow",
+            "flink.apache.org/v1beta1/FlinkDeployment",
+            "helm.toolkit.fluxcd.io/v2beta1/HelmRelease",
+            "kustomize.toolkit.fluxcd.io/v1/Kustomization",
+            "kyverno.io/v1/ClusterPolicy",
+            "kyverno.io/v1/Policy",
+            "source.toolkit.fluxcd.io/v1/GitRepository",
+            "source.toolkit.fluxcd.io/v1beta2/Bucket",
+            "source.toolkit.fluxcd.io/v1beta2/HelmChart",
+            "source.toolkit.fluxcd.io/v1beta2/HelmRepository",
+            "source.toolkit.fluxcd.io/v1beta2/OCIRepository",
+        }
+        assert expected <= set(THIRDPARTY_CUSTOMIZATIONS)
