@@ -170,6 +170,11 @@ class ControlPlane:
         self.interpreter_config = CustomizationConfigManager(
             self.store, self.runtime, self.interpreter
         )
+        from .interpreter.webhook import WebhookConfigManager
+
+        self.interpreter_webhooks = WebhookConfigManager(
+            self.store, self.runtime, self.interpreter
+        )
         self.agents: dict[str, object] = {}
 
     # -- cluster lifecycle (karmadactl join/unjoin analogue) ---------------

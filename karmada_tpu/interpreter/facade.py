@@ -55,6 +55,7 @@ class ResourceInterpreter:
     def __init__(self) -> None:
         self._native: dict[tuple[str, str], Callable] = {}
         self._thirdparty: dict[tuple[str, str], Callable] = {}
+        self._webhook: dict[tuple[str, str], Callable] = {}
         self._customized: dict[tuple[str, str], Callable] = {}
 
     def register_native(self, gvk: str, operation: str, fn: Callable) -> None:
@@ -66,6 +67,18 @@ class ResourceInterpreter:
         (interpreter.go:120-143: declarative/webhook > thirdparty > native)."""
         self._thirdparty[(gvk, operation)] = fn
 
+    def register_webhook(self, gvk: str, operation: str, fn: Callable) -> None:
+        """Remote interpreter webhooks — between in-process customizations
+        and the thirdparty corpus (interpreter.go chain order)."""
+        self._webhook[(gvk, operation)] = fn
+
+    def deregister_webhook(self, gvk: str, operation: str, fn: Callable = None) -> None:
+        """When ``fn`` is given, remove only if it is still the registered
+        handler — a stale owner must not clobber a newer registration."""
+        if fn is not None and self._webhook.get((gvk, operation)) is not fn:
+            return
+        self._webhook.pop((gvk, operation), None)
+
     def register_customized(self, gvk: str, operation: str, fn: Callable) -> None:
         self._customized[(gvk, operation)] = fn
 
@@ -73,7 +86,7 @@ class ResourceInterpreter:
         self._customized.pop((gvk, operation), None)
 
     def _resolve(self, gvk: str, operation: str) -> Optional[Callable]:
-        for table in (self._customized, self._thirdparty, self._native):
+        for table in (self._customized, self._webhook, self._thirdparty, self._native):
             fn = table.get((gvk, operation)) or table.get(("*", operation))
             if fn is not None:
                 return fn

@@ -42,7 +42,10 @@ def _flux_rules(reason: str = "Succeeded", extra_deps: list | None = None):
     return CustomizationRules(
         retain_paths=["suspend"],
         health=[{"condition": "Ready", "status": "True", "reason": reason}],
-        status_paths=["conditions", "observedGeneration", "artifact", "url"],
+        status_paths=[
+            "conditions", "observedGeneration", "artifact", "url",
+            "lastHandledReconcileAt",
+        ],
         status_aggregation={
             "observedGeneration": "min",
             "lastHandledReconcileAt": "last",

@@ -85,9 +85,18 @@ class SearchController:
     def __init__(
         self, store: Store, runtime: Runtime, members: MemberClientRegistry
     ) -> None:
+        from .backend import InvertedIndexBackend
+
         self.store = store
         self.members = members
         self.cache = MultiClusterCache()
+        # registries with spec.backend == "opensearch" additionally index
+        # into the document backend (backendstore/opensearch.go analogue)
+        self.indexer = InvertedIndexBackend()
+        # registry key -> doc keys it indexed last pass; the diff drives
+        # deletions so member-side removals and backend switches don't
+        # leave stale documents
+        self._indexed: dict[str, set[tuple[str, str, str, str]]] = {}
         self.worker = runtime.new_worker("search", self._reconcile)
         store.watch("ResourceRegistry", lambda e: self.worker.enqueue(e.key))
         runtime.add_ticker(self._sweep)
@@ -98,19 +107,40 @@ class SearchController:
 
     def _reconcile(self, key: str) -> Optional[str]:
         rr = self.store.get("ResourceRegistry", key)
-        if rr is None:
-            return DONE
-        for cluster in self.store.list("Cluster"):
-            if not rr.spec.target_cluster.matches(cluster):
-                continue
-            member = self.members.get(cluster.name)
-            if member is None or not member.reachable:
-                continue
-            for sel in rr.spec.resource_selectors:
-                gvk = f"{sel.get('apiVersion', 'v1')}/{sel.get('kind', '')}"
-                try:
-                    for obj in member.list(gvk):
-                        self.cache.put(cluster.name, obj)
-                except UnreachableError:
-                    self.cache.drop_cluster(cluster.name)
+        index = rr is not None and rr.spec.backend == "opensearch"
+        fresh: set[tuple[str, str, str, str]] = set()
+        if rr is not None:
+            for cluster in self.store.list("Cluster"):
+                if not rr.spec.target_cluster.matches(cluster):
+                    continue
+                member = self.members.get(cluster.name)
+                if member is None or not member.reachable:
+                    continue
+                for sel in rr.spec.resource_selectors:
+                    gvk = f"{sel.get('apiVersion', 'v1')}/{sel.get('kind', '')}"
+                    try:
+                        for obj in member.list(gvk):
+                            self.cache.put(cluster.name, obj)
+                            if index:
+                                self.indexer.upsert(cluster.name, obj)
+                                fresh.add(
+                                    (cluster.name, gvk, obj.meta.namespace, obj.meta.name)
+                                )
+                    except UnreachableError:
+                        self.cache.drop_cluster(cluster.name)
+                        self.indexer.drop_cluster(cluster.name)
+                        fresh = {d for d in fresh if d[0] != cluster.name}
+        # documents this registry indexed before but not this pass are gone
+        # from the members (or the backend/registry changed) — delete them.
+        # An overlapping registry that still wants one re-upserts next sweep.
+        for doc in self._indexed.get(key, set()) - fresh:
+            self.indexer.delete(*doc)
+        if fresh:
+            self._indexed[key] = fresh
+        else:
+            self._indexed.pop(key, None)
         return DONE
+
+    def search(self, query: str = "", **kw) -> list[dict]:
+        """Search the document backend (the search API surface)."""
+        return self.indexer.search(query, **kw)

@@ -1,0 +1,330 @@
+"""Interpreter webhook transport: HTTPS extension point for resource semantics.
+
+Ref: config/v1alpha1 ResourceInterpreterWebhookConfiguration +
+interpretercontext_types.go request/response contract;
+pkg/resourceinterpreter/customized/webhook client/configmanager.
+"""
+
+import subprocess
+
+import pytest
+
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.interpreter.webhook import (
+    InterpreterWebhook,
+    InterpreterWebhookServer,
+    ResourceInterpreterWebhookConfiguration,
+    RuleWithOperations,
+    WebhookClientConfig,
+    WebhookInterpreterClient,
+    apply_json_patch,
+)
+from karmada_tpu.utils.builders import new_cluster, static_weight_placement
+from karmada_tpu.utils.member import MemberCluster
+
+GVK = "example.io/v1/Canary"
+
+
+def canary(replicas=6):
+    return Resource(
+        api_version="example.io/v1",
+        kind="Canary",
+        meta=ObjectMeta(name="demo", namespace="default"),
+        spec={"workers": replicas, "configRef": "canary-conf"},
+        status={},
+    )
+
+
+def canary_handlers():
+    """The extension author's webhook logic."""
+
+    def interpret_replica(req):
+        obj = req["object"]
+        return {
+            "replicas": obj["spec"].get("workers", 0),
+            "replicaRequirements": {"resourceRequest": {"cpu": "100m"}},
+        }
+
+    def revise_replica(req):
+        return {
+            "patch": [
+                {"op": "replace", "path": "/spec/workers", "value": req["replicas"]}
+            ],
+            "patchType": "JSONPatch",
+        }
+
+    def interpret_health(req):
+        return {"healthy": (req["object"].get("status") or {}).get("phase") == "Ready"}
+
+    def interpret_dependency(req):
+        name = req["object"]["spec"].get("configRef")
+        return {
+            "dependencies": (
+                [{"apiVersion": "v1", "kind": "ConfigMap", "name": name}] if name else []
+            )
+        }
+
+    def aggregate_status(req):
+        total = sum(
+            (i.get("status") or {}).get("readyWorkers", 0)
+            for i in req.get("aggregatedStatus") or []
+        )
+        return {
+            "patch": [
+                {"op": "add", "path": "/status/readyWorkers", "value": total}
+            ],
+            "patchType": "JSONPatch",
+        }
+
+    def retain(req):
+        observed = req.get("observedObject") or {}
+        paused = (observed.get("spec") or {}).get("paused")
+        if paused is None:
+            return {}
+        return {
+            "patch": [{"op": "add", "path": "/spec/paused", "value": paused}],
+            "patchType": "JSONPatch",
+        }
+
+    return {
+        "InterpretReplica": interpret_replica,
+        "ReviseReplica": revise_replica,
+        "InterpretHealth": interpret_health,
+        "InterpretDependency": interpret_dependency,
+        "AggregateStatus": aggregate_status,
+        "Retain": retain,
+    }
+
+
+@pytest.fixture()
+def server():
+    s = InterpreterWebhookServer(canary_handlers())
+    s.start()
+    yield s
+    s.stop()
+
+
+def make_webhook(url, operations=("*",)):
+    return InterpreterWebhook(
+        name="canary.example.io",
+        client_config=WebhookClientConfig(url=url),
+        rules=[
+            RuleWithOperations(
+                operations=list(operations),
+                api_versions=["example.io/v1"],
+                kinds=["Canary"],
+            )
+        ],
+        timeout_seconds=5.0,
+    )
+
+
+class TestClientRoundTrip:
+    def test_get_replicas_and_requirements(self, server):
+        client = WebhookInterpreterClient(make_webhook(server.url))
+        replicas, reqs = client.get_replicas(canary(9))
+        assert replicas == 9
+        assert reqs.resource_request == {"cpu": 100}
+
+    def test_revise_replica_via_json_patch(self, server):
+        client = WebhookInterpreterClient(make_webhook(server.url))
+        out = client.revise_replica(canary(6), 2)
+        assert out.spec["workers"] == 2
+
+    def test_health_and_dependencies(self, server):
+        client = WebhookInterpreterClient(make_webhook(server.url))
+        obj = canary()
+        assert not client.interpret_health(obj)
+        obj.status = {"phase": "Ready"}
+        assert client.interpret_health(obj)
+        deps = client.get_dependencies(obj)
+        assert [(d.kind, d.name) for d in deps] == [("ConfigMap", "canary-conf")]
+
+    def test_retain_pulls_member_written_field(self, server):
+        client = WebhookInterpreterClient(make_webhook(server.url))
+        desired, observed = canary(), canary()
+        observed.spec["paused"] = True
+        out = client.retain(desired, observed)
+        assert out.spec["paused"] is True
+
+    def test_unsupported_operation_raises(self, server):
+        client = WebhookInterpreterClient(make_webhook(server.url))
+        server.handlers.pop("InterpretHealth")
+        with pytest.raises(RuntimeError, match="not supported"):
+            client.interpret_health(canary())
+
+
+class TestJsonPatch:
+    def test_add_replace_remove_nested_and_lists(self):
+        doc = {"spec": {"a": 1, "items": [1, 2, 3]}}
+        out = apply_json_patch(
+            doc,
+            [
+                {"op": "replace", "path": "/spec/a", "value": 5},
+                {"op": "add", "path": "/spec/b", "value": {"x": 1}},
+                {"op": "add", "path": "/spec/items/-", "value": 9},
+                {"op": "remove", "path": "/spec/items/0"},
+            ],
+        )
+        assert out == {"spec": {"a": 5, "b": {"x": 1}, "items": [2, 3, 9]}}
+        assert doc["spec"]["a"] == 1  # original untouched
+
+    def test_escaped_path_tokens(self):
+        doc = {"metadata": {"labels": {}}}
+        out = apply_json_patch(
+            doc,
+            [{"op": "add", "path": "/metadata/labels/app~1name", "value": "x"}],
+        )
+        assert out["metadata"]["labels"]["app/name"] == "x"
+
+
+class TestControlPlaneIntegration:
+    def test_webhook_drives_propagation(self, server):
+        """The full pipeline uses the webhook for an unknown CRD: replica
+        extraction, division revise, health — via the CR config manager."""
+        cp = ControlPlane()
+        for i in (1, 2):
+            member = MemberCluster(f"member{i}")
+            member.api_enablements.append(GVK)
+            cp.join_cluster(
+                new_cluster(f"member{i}", cpu="100", memory="200Gi"), member=member
+            )
+        cp.settle()
+        cp.store.apply(
+            ResourceInterpreterWebhookConfiguration(
+                meta=ObjectMeta(name="canary-hooks"),
+                webhooks=[make_webhook(server.url)],
+            )
+        )
+        cp.store.apply(canary(8))
+        cp.store.apply(
+            PropagationPolicy(
+                meta=ObjectMeta(name="canary-policy", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="example.io/v1", kind="Canary")
+                    ],
+                    placement=static_weight_placement({"member1": 3, "member2": 1}),
+                ),
+            )
+        )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/demo-canary")
+        assert rb.spec.replicas == 8  # webhook GetReplicas
+        placed = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        assert placed == {"member1": 6, "member2": 2}
+        # webhook ReviseReplica divided the member manifest via JSONPatch
+        obj = cp.members.get("member1").get(GVK, "default", "demo")
+        assert obj.spec["workers"] == 6
+
+    def test_config_deletion_deregisters(self, server):
+        cp = ControlPlane()
+        config = ResourceInterpreterWebhookConfiguration(
+            meta=ObjectMeta(name="canary-hooks"),
+            webhooks=[make_webhook(server.url)],
+        )
+        cp.store.apply(config)
+        cp.settle()
+        assert cp.interpreter.hook_enabled(GVK, "GetReplicas")
+        cp.store.delete(ResourceInterpreterWebhookConfiguration.KIND, "canary-hooks")
+        cp.settle()
+        assert not cp.interpreter.hook_enabled(GVK, "GetReplicas")
+
+
+@pytest.fixture(scope="module")
+def tls_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("webhook-pki")
+    ext = d / "san.ext"
+    ext.write_text("subjectAltName=IP:127.0.0.1,DNS:localhost\n")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(d / "srv.key"), "-out", str(d / "srv.crt"),
+         "-days", "1", "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        check=True, capture_output=True,
+    )
+    return d
+
+
+class TestHttps:
+    def test_https_round_trip_with_ca_bundle(self, tls_files):
+        server = InterpreterWebhookServer(
+            canary_handlers(),
+            certfile=str(tls_files / "srv.crt"),
+            keyfile=str(tls_files / "srv.key"),
+        )
+        server.start()
+        try:
+            webhook = make_webhook(server.url)
+            webhook.client_config.ca_bundle = (tls_files / "srv.crt").read_bytes()
+            client = WebhookInterpreterClient(webhook)
+            replicas, _ = client.get_replicas(canary(4))
+            assert replicas == 4
+        finally:
+            server.stop()
+
+
+class TestWildcardRules:
+    def test_wildcard_binds_gvk_appearing_later(self, server):
+        cp = ControlPlane()
+        cp.store.apply(
+            ResourceInterpreterWebhookConfiguration(
+                meta=ObjectMeta(name="wildcard-hooks"),
+                webhooks=[
+                    InterpreterWebhook(
+                        name="all.example.io",
+                        client_config=WebhookClientConfig(url=server.url),
+                        rules=[
+                            RuleWithOperations(
+                                operations=["InterpretReplica"],
+                                api_versions=["example.io/v1"],
+                                kinds=["*"],
+                            )
+                        ],
+                    )
+                ],
+            )
+        )
+        cp.settle()
+        assert not cp.interpreter.hook_enabled(GVK, "GetReplicas")
+        cp.store.apply(canary(3))  # the kind appears after the config
+        cp.settle()
+        assert cp.interpreter.hook_enabled(GVK, "GetReplicas")
+        replicas, _ = cp.interpreter.get_replicas(canary(3))
+        assert replicas == 3
+
+
+class TestOverlappingConfigs:
+    def test_deleting_one_config_keeps_the_overlapping_owner(self, server):
+        cp = ControlPlane()
+        cp.store.apply(canary(1))
+        for name in ("hooks-a", "hooks-b"):
+            cp.store.apply(
+                ResourceInterpreterWebhookConfiguration(
+                    meta=ObjectMeta(name=name),
+                    webhooks=[make_webhook(server.url)],
+                )
+            )
+        cp.settle()
+        assert cp.interpreter.hook_enabled(GVK, "GetReplicas")
+        # deleting A must not clobber B's live registration
+        cp.store.delete(ResourceInterpreterWebhookConfiguration.KIND, "hooks-a")
+        cp.settle()
+        assert cp.interpreter.hook_enabled(GVK, "GetReplicas")
+        replicas, _ = cp.interpreter.get_replicas(canary(5))
+        assert replicas == 5
+
+    def test_quantity_strings_in_replica_requirements(self, server):
+        server.handlers["InterpretReplica"] = lambda req: {
+            "replicas": 2,
+            "replicaRequirements": {
+                "resourceRequest": {"cpu": "500m", "memory": "1Gi"}
+            },
+        }
+        client = WebhookInterpreterClient(make_webhook(server.url))
+        replicas, reqs = client.get_replicas(canary())
+        assert replicas == 2
+        assert reqs.resource_request["cpu"] == 500
+        assert reqs.resource_request["memory"] == 1 << 30

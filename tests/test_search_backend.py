@@ -1,0 +1,145 @@
+"""Search backend store: inverted-index documents (opensearch.go analogue)."""
+
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.search.backend import InvertedIndexBackend
+from karmada_tpu.search.registry import ResourceRegistry, ResourceRegistrySpec
+from karmada_tpu.utils.builders import new_cluster
+
+
+def deploy(name, ns="default", labels=None):
+    return Resource(
+        api_version="apps/v1",
+        kind="Deployment",
+        meta=ObjectMeta(name=name, namespace=ns, labels=labels or {}),
+        spec={"replicas": 1},
+    )
+
+
+class TestInvertedIndex:
+    def test_upsert_search_and_field_scopes(self):
+        be = InvertedIndexBackend()
+        be.upsert("m1", deploy("web-frontend", labels={"app": "web"}))
+        be.upsert("m2", deploy("web-frontend", labels={"app": "web"}))
+        be.upsert("m1", deploy("db", ns="prod", labels={"app": "db"}))
+        assert be.count() == 3
+        assert len(be.search("web")) == 2
+        assert len(be.search("kind:deployment")) == 3
+        assert len(be.search("label:app=db")) == 1
+        assert [d["cluster"] for d in be.search("web cluster:m2")] == ["m2"]
+        assert len(be.search("namespace:prod")) == 1
+        # prefix
+        assert len(be.search("front*")) == 2
+        # conjunction with no overlap
+        assert be.search("web namespace:prod") == []
+
+    def test_upsert_replaces_and_delete_drops_terms(self):
+        be = InvertedIndexBackend()
+        be.upsert("m1", deploy("api", labels={"tier": "gold"}))
+        assert len(be.search("label:tier=gold")) == 1
+        be.upsert("m1", deploy("api", labels={"tier": "silver"}))
+        assert be.search("label:tier=gold") == []
+        assert len(be.search("label:tier=silver")) == 1
+        be.delete("m1", "apps/v1/Deployment", "default", "api")
+        assert be.count() == 0
+        assert be.search("api") == []
+
+    def test_drop_cluster(self):
+        be = InvertedIndexBackend()
+        be.upsert("m1", deploy("a"))
+        be.upsert("m2", deploy("a"))
+        be.drop_cluster("m1")
+        assert [d["cluster"] for d in be.search("a")] == ["m2"]
+
+    def test_cluster_scope_filter(self):
+        be = InvertedIndexBackend()
+        be.upsert("m1", deploy("a"))
+        be.upsert("m2", deploy("a"))
+        assert len(be.search("a", clusters=["m1"])) == 1
+
+
+class TestRegistryBackendRouting:
+    def test_opensearch_registry_feeds_indexer(self):
+        cp = ControlPlane()
+        cp.join_cluster(new_cluster("member1", cpu="10", memory="10Gi"))
+        cp.join_cluster(new_cluster("member2", cpu="10", memory="10Gi"))
+        cp.settle()
+        for name in ("member1", "member2"):
+            cp.members.get(name).apply(deploy(f"app-{name}", labels={"team": "core"}))
+        cp.store.apply(
+            ResourceRegistry(
+                meta=ObjectMeta(name="indexed"),
+                spec=ResourceRegistrySpec(
+                    resource_selectors=[{"apiVersion": "apps/v1", "kind": "Deployment"}],
+                    backend="opensearch",
+                ),
+            )
+        )
+        cp.settle()
+        hits = cp.search.search("label:team=core")
+        assert {d["cluster"] for d in hits} == {"member1", "member2"}
+        # tokenized name search: "app" AND "member1"
+        hits = cp.search.search("app member1 kind:deployment")
+        assert [d["name"] for d in hits] == ["app-member1"]
+
+    def test_cache_registry_does_not_index(self):
+        cp = ControlPlane()
+        cp.join_cluster(new_cluster("member1", cpu="10", memory="10Gi"))
+        cp.settle()
+        cp.members.get("member1").apply(deploy("plain"))
+        cp.store.apply(
+            ResourceRegistry(
+                meta=ObjectMeta(name="cached"),
+                spec=ResourceRegistrySpec(
+                    resource_selectors=[{"apiVersion": "apps/v1", "kind": "Deployment"}],
+                ),
+            )
+        )
+        cp.settle()
+        # cache serves it, the indexer stays empty
+        assert cp.search.cache.get("apps/v1/Deployment", "default", "plain") is not None
+        assert cp.search.search("plain") == []
+
+
+class TestIndexerLifecycle:
+    def test_member_deletion_removes_document(self):
+        cp = ControlPlane()
+        cp.join_cluster(new_cluster("member1", cpu="10", memory="10Gi"))
+        cp.settle()
+        member = cp.members.get("member1")
+        member.apply(deploy("ephemeral"))
+        cp.store.apply(
+            ResourceRegistry(
+                meta=ObjectMeta(name="idx"),
+                spec=ResourceRegistrySpec(
+                    resource_selectors=[{"apiVersion": "apps/v1", "kind": "Deployment"}],
+                    backend="opensearch",
+                ),
+            )
+        )
+        cp.settle()
+        assert len(cp.search.search("ephemeral")) == 1
+        member.delete("apps/v1/Deployment", "default", "ephemeral")
+        cp.search_controller_sweep() if hasattr(cp, "search_controller_sweep") else cp.search.worker.enqueue("idx")
+        cp.settle()
+        assert cp.search.search("ephemeral") == []
+
+    def test_registry_deletion_removes_documents(self):
+        cp = ControlPlane()
+        cp.join_cluster(new_cluster("member1", cpu="10", memory="10Gi"))
+        cp.settle()
+        cp.members.get("member1").apply(deploy("tracked"))
+        cp.store.apply(
+            ResourceRegistry(
+                meta=ObjectMeta(name="idx"),
+                spec=ResourceRegistrySpec(
+                    resource_selectors=[{"apiVersion": "apps/v1", "kind": "Deployment"}],
+                    backend="opensearch",
+                ),
+            )
+        )
+        cp.settle()
+        assert len(cp.search.search("tracked")) == 1
+        cp.store.delete("ResourceRegistry", "idx")
+        cp.settle()
+        assert cp.search.search("tracked") == []
