@@ -59,7 +59,10 @@ class ControlPlane:
         from .webhook import default_admission_chain
 
         self.admission = default_admission_chain()
-        self.store = Store(admission=self.admission.admit)
+        self.store = Store(
+            admission=self.admission.admit,
+            delete_admission=self.admission.admit_delete,
+        )
         self.runtime = Runtime()
         self.members = MemberClientRegistry()
         self.interpreter = default_interpreter()

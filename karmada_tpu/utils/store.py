@@ -51,14 +51,20 @@ class Store:
     single-threaded, which is the supported concurrency model; multi-threaded
     callers must tolerate reordered events, as with real informers."""
 
-    def __init__(self, admission: Optional[Callable[[str, Any], None]] = None) -> None:
+    def __init__(
+        self,
+        admission: Optional[Callable[[str, Any], None]] = None,
+        delete_admission: Optional[Callable[[str, Any], None]] = None,
+    ) -> None:
         self._lock = threading.RLock()
         self._buckets: dict[str, dict[str, Any]] = {}
         self._watchers: dict[str, list[WatchHandler]] = {}
         self._all_watchers: list[WatchHandler] = []
         self._rv = 0
-        # admission(kind, obj) raises to reject an apply (webhook seam)
+        # admission(kind, obj) raises to reject an apply (webhook seam);
+        # delete_admission likewise guards Delete operations
         self._admission = admission
+        self._delete_admission = delete_admission
 
     # -- mutation ----------------------------------------------------------
 
@@ -92,9 +98,15 @@ class Store:
     def delete(self, kind: str, key: str, *, force: bool = False) -> Optional[Any]:
         """Delete an object. With finalizers present (and not force), only
         marks deletion_timestamp and emits MODIFIED — controllers must strip
-        finalizers, after which the delete completes (kube semantics)."""
+        finalizers, after which the delete completes (kube semantics).
+        ``force`` is the internal finalizer-completion path and skips delete
+        admission, like a direct etcd removal."""
         import time
 
+        if not force and self._delete_admission is not None:
+            existing = self.get(kind, key)
+            if existing is not None:
+                self._delete_admission(kind, existing)
         with self._lock:
             bucket = self._buckets.get(kind, {})
             obj = bucket.get(key)

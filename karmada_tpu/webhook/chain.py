@@ -14,6 +14,9 @@ from ..api.policy import (
 )
 
 PERMANENT_ID_ANNOTATION = "policy.karmada.io/permanent-id"
+PERMANENT_ID_LABEL = "work.karmada.io/permanent-id"
+DELETION_PROTECTION_LABEL = "resourcetemplate.karmada.io/deletion-protected"
+DELETION_PROTECTION_ALWAYS = "Always"
 
 
 class ValidationError(Exception):
@@ -28,6 +31,7 @@ class AdmissionChain:
     def __init__(self) -> None:
         self._mutators: dict[str, list[Mutator]] = {}
         self._validators: dict[str, list[Validator]] = {}
+        self._delete_validators: dict[str, list[Validator]] = {}
 
     def register_mutator(self, kind: str, fn: Mutator) -> None:
         self._mutators.setdefault(kind, []).append(fn)
@@ -35,10 +39,21 @@ class AdmissionChain:
     def register_validator(self, kind: str, fn: Validator) -> None:
         self._validators.setdefault(kind, []).append(fn)
 
+    def register_delete_validator(self, kind: str, fn: Validator) -> None:
+        """Delete-operation admission ('*' = every kind); ref:
+        resourcedeletionprotection/validating.go handles only Delete."""
+        self._delete_validators.setdefault(kind, []).append(fn)
+
     def admit(self, kind: str, obj: Any) -> None:
         for fn in self._mutators.get(kind, []):
             fn(obj)
         for fn in self._validators.get(kind, []):
+            fn(obj)
+
+    def admit_delete(self, kind: str, obj: Any) -> None:
+        for fn in self._delete_validators.get(kind, []) + self._delete_validators.get(
+            "*", []
+        ):
             fn(obj)
 
 
@@ -56,6 +71,55 @@ def mutate_propagation_policy(policy: PropagationPolicy) -> None:
         policy.spec.scheduler_name = "default-scheduler"
     if not policy.spec.conflict_resolution:
         policy.spec.conflict_resolution = "Abort"
+
+
+def mutate_override_policy(policy) -> None:
+    """Default resource-selector namespaces to the policy's namespace
+    (overridepolicy/mutating.go)."""
+    for sel in policy.spec.resource_selectors:
+        if not getattr(sel, "namespace", "") and policy.meta.namespace:
+            sel.namespace = policy.meta.namespace
+
+
+def mutate_work(work) -> None:
+    """Permanent-ID label + prune runtime fields from manifests
+    (work/mutating.go: uuid label, prune.RemoveIrrelevantFields)."""
+    import copy
+
+    if not work.meta.labels.get(PERMANENT_ID_LABEL):
+        work.meta.labels[PERMANENT_ID_LABEL] = str(uuid.uuid4())
+    # prune on copies: controllers may alias live store objects into
+    # spec.workload, and mutating those in place would corrupt the store
+    pruned = []
+    for manifest in work.spec.workload:
+        manifest = copy.deepcopy(manifest)
+        manifest.status = {}
+        manifest.meta.uid = ""
+        manifest.meta.resource_version = 0
+        manifest.meta.creation_timestamp = 0.0
+        pruned.append(manifest)
+    work.spec.workload = pruned
+
+
+def mutate_binding_permanent_id(rb) -> None:
+    """resourcebinding/clusterresourcebinding mutating.go."""
+    if not rb.meta.labels.get(PERMANENT_ID_LABEL):
+        rb.meta.labels[PERMANENT_ID_LABEL] = str(uuid.uuid4())
+
+
+def mutate_multicluster_service(mcs) -> None:
+    """multiclusterservice/mutating.go: permanent-ID label."""
+    if not mcs.meta.labels.get(PERMANENT_ID_LABEL):
+        mcs.meta.labels[PERMANENT_ID_LABEL] = str(uuid.uuid4())
+
+
+def mutate_federated_hpa(hpa) -> None:
+    """federatedhpa/mutating.go → lifted.SetDefaultsFederatedHPA: default
+    only nil fields — an explicit invalid 0 must reach the validator."""
+    if hpa.spec.min_replicas is None:
+        hpa.spec.min_replicas = 1
+    if hpa.spec.stabilization_window_seconds is None:
+        hpa.spec.stabilization_window_seconds = 300
 
 
 # --- validators (ref: pkg/webhook/*/validating.go) ---------------------------
@@ -216,15 +280,87 @@ def validate_multicluster_service(mcs) -> None:
             raise ValidationError(f"invalid exposure type {t!r}")
 
 
+def _validate_health_predicate(pred: dict) -> None:
+    if "any" in pred:
+        for sub in pred["any"]:
+            _validate_health_predicate(sub)
+        return
+    if "condition" in pred or pred.get("observed_generation"):
+        return
+    if "path" not in pred:
+        raise ValidationError(f"health predicate needs a path: {pred!r}")
+    if pred.get("op", "==") not in ("==", "!=", ">=", "<=", "in", "exists"):
+        raise ValidationError(f"invalid health op {pred.get('op')!r}")
+
+
 def validate_interpreter_customization(cr) -> None:
     if not cr.target_api_version or not cr.target_kind:
         raise ValidationError("customization target apiVersion/kind required")
     for pred in cr.rules.health:
-        if pred.get("op", "==") not in ("==", ">=", "<="):
-            raise ValidationError(f"invalid health op {pred.get('op')!r}")
+        _validate_health_predicate(pred)
     for fname, how in cr.rules.status_aggregation.items():
-        if how not in ("sum", "max", "min"):
+        if how not in ("sum", "max", "min", "last", "and", "or"):
             raise ValidationError(f"invalid aggregation {how!r} for {fname!r}")
+
+
+SUPPORTED_INTERPRETER_OPERATIONS = {
+    "*", "InterpretReplica", "ReviseReplica", "Retain", "AggregateStatus",
+    "InterpretDependency", "InterpretStatus", "InterpretHealth",
+}
+
+
+def validate_interpreter_webhook_configuration(config) -> None:
+    """configuration/validating.go: unique hook names, resolvable client
+    config, known operations."""
+    seen = set()
+    for hook in config.webhooks:
+        if not hook.name:
+            raise ValidationError("webhook name is required")
+        if hook.name in seen:
+            raise ValidationError(f"duplicate webhook name {hook.name!r}")
+        seen.add(hook.name)
+        if not hook.client_config.url:
+            raise ValidationError(f"webhook {hook.name!r} needs clientConfig.url")
+        if not hook.rules:
+            raise ValidationError(f"webhook {hook.name!r} needs at least one rule")
+        for rule in hook.rules:
+            bad = set(rule.operations) - SUPPORTED_INTERPRETER_OPERATIONS
+            if bad:
+                raise ValidationError(
+                    f"webhook {hook.name!r}: unsupported operations {sorted(bad)}"
+                )
+            if not rule.api_versions or not rule.kinds:
+                raise ValidationError(
+                    f"webhook {hook.name!r}: rules need apiVersions and kinds"
+                )
+
+
+def validate_multicluster_ingress(mci) -> None:
+    """multiclusteringress/validating.go: ingress rule sanity."""
+    for rule in mci.spec.rules:
+        for path in (rule.get("http") or {}).get("paths", []):
+            # unset pathType defaults to ImplementationSpecific (k8s default)
+            ptype = path.get("pathType") or "ImplementationSpecific"
+            if ptype not in ("Exact", "Prefix", "ImplementationSpecific"):
+                raise ValidationError(f"invalid pathType {ptype!r}")
+            if ptype in ("Exact", "Prefix") and not str(
+                path.get("path", "")
+            ).startswith("/"):
+                raise ValidationError("ingress path must be absolute")
+            backend = path.get("backend") or {}
+            if not (backend.get("service") or {}).get("name"):
+                raise ValidationError("ingress backend service name required")
+
+
+def validate_deletion_protection(obj) -> None:
+    """resourcedeletionprotection/validating.go: deny Delete while the
+    protection label is Always."""
+    labels = getattr(obj.meta, "labels", None) or {}
+    if labels.get(DELETION_PROTECTION_LABEL) == DELETION_PROTECTION_ALWAYS:
+        raise ValidationError(
+            "this resource is protected, remove the label "
+            f"{DELETION_PROTECTION_LABEL} to delete it"
+        )
 
 
 def validate_workload_rebalancer(rebalancer) -> None:
@@ -242,21 +378,34 @@ def validate_work(work) -> None:
 
 
 def default_admission_chain() -> AdmissionChain:
+    """The full reference handler set (cmd/webhook/app/webhook.go:161-183;
+    /convert is N/A — no CRD versioning in-proc)."""
     chain = AdmissionChain()
     for kind in ("PropagationPolicy", "ClusterPropagationPolicy"):
         chain.register_mutator(kind, mutate_propagation_policy)
         chain.register_validator(kind, validate_propagation_policy)
+    chain.register_mutator("OverridePolicy", mutate_override_policy)
     for kind in ("OverridePolicy", "ClusterOverridePolicy"):
         chain.register_validator(kind, validate_override_policy)
     chain.register_validator("FederatedResourceQuota", validate_federated_resource_quota)
     for kind in ("ResourceBinding", "ClusterResourceBinding"):
+        chain.register_mutator(kind, mutate_binding_permanent_id)
         chain.register_validator(kind, validate_resource_binding)
+    chain.register_mutator("FederatedHPA", mutate_federated_hpa)
     chain.register_validator("FederatedHPA", validate_federated_hpa)
     chain.register_validator("CronFederatedHPA", validate_cron_federated_hpa)
+    chain.register_mutator("MultiClusterService", mutate_multicluster_service)
     chain.register_validator("MultiClusterService", validate_multicluster_service)
+    chain.register_validator("MultiClusterIngress", validate_multicluster_ingress)
     chain.register_validator(
         "ResourceInterpreterCustomization", validate_interpreter_customization
     )
+    chain.register_validator(
+        "ResourceInterpreterWebhookConfiguration",
+        validate_interpreter_webhook_configuration,
+    )
     chain.register_validator("WorkloadRebalancer", validate_workload_rebalancer)
+    chain.register_mutator("Work", mutate_work)
     chain.register_validator("Work", validate_work)
+    chain.register_delete_validator("*", validate_deletion_protection)
     return chain

@@ -87,7 +87,8 @@ def test_customization_health_op(chain):
         meta=ObjectMeta(name="c"),
         target_api_version="example.io/v1",
         target_kind="Thing",
-        rules=CustomizationRules(health=[{"path": "x", "op": "!=", "value": 1}]),
+        # "!=" became a supported op with the DSL extensions; "~=" stays invalid
+        rules=CustomizationRules(health=[{"path": "x", "op": "~=", "value": 1}]),
     )
     with pytest.raises(ValidationError, match="health op"):
         chain.admit("ResourceInterpreterCustomization", cr)

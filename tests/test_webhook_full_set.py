@@ -1,0 +1,194 @@
+"""The remaining reference admission handlers (webhook.go:161-183 full set):
+OP mutate, Work/RB/MCS permanent-id mutators + manifest prune, FederatedHPA
+defaults, MCI validation, interpreter-webhook-config validation, deletion
+protection on Delete."""
+
+import pytest
+
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.api.work import Work, WorkSpec
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.interpreter.webhook import (
+    InterpreterWebhook,
+    ResourceInterpreterWebhookConfiguration,
+    RuleWithOperations,
+    WebhookClientConfig,
+)
+from karmada_tpu.webhook.chain import (
+    DELETION_PROTECTION_LABEL,
+    PERMANENT_ID_LABEL,
+    ValidationError,
+    default_admission_chain,
+    mutate_work,
+    validate_interpreter_webhook_configuration,
+    validate_multicluster_ingress,
+)
+
+
+def webhook_config(**overrides):
+    kw = dict(
+        name="hooks.example.io",
+        client_config=WebhookClientConfig(url="https://hooks.example:8443/interpret"),
+        rules=[RuleWithOperations(operations=["InterpretHealth"])],
+    )
+    kw.update(overrides)
+    return ResourceInterpreterWebhookConfiguration(
+        meta=ObjectMeta(name="cfg"), webhooks=[InterpreterWebhook(**kw)]
+    )
+
+
+class TestInterpreterWebhookConfigValidation:
+    def test_valid_config_passes(self):
+        validate_interpreter_webhook_configuration(webhook_config())
+
+    def test_duplicate_names_denied(self):
+        config = webhook_config()
+        config.webhooks.append(config.webhooks[0])
+        with pytest.raises(ValidationError, match="duplicate"):
+            validate_interpreter_webhook_configuration(config)
+
+    def test_missing_url_denied(self):
+        with pytest.raises(ValidationError, match="clientConfig.url"):
+            validate_interpreter_webhook_configuration(
+                webhook_config(client_config=WebhookClientConfig())
+            )
+
+    def test_unknown_operation_denied(self):
+        with pytest.raises(ValidationError, match="unsupported operations"):
+            validate_interpreter_webhook_configuration(
+                webhook_config(rules=[RuleWithOperations(operations=["Mangle"])])
+            )
+
+
+class TestMultiClusterIngressValidation:
+    def test_valid_rules(self):
+        validate_multicluster_ingress(
+            type("MCI", (), {"spec": type("S", (), {"rules": [
+                {"http": {"paths": [{"path": "/api", "pathType": "Prefix",
+                                     "backend": {"service": {"name": "web"}}}]}}
+            ]})()})()
+        )
+
+    def test_bad_path_type_denied(self):
+        with pytest.raises(ValidationError, match="pathType"):
+            validate_multicluster_ingress(
+                type("MCI", (), {"spec": type("S", (), {"rules": [
+                    {"http": {"paths": [{"path": "/x", "pathType": "Regex",
+                                         "backend": {"service": {"name": "w"}}}]}}
+                ]})()})()
+            )
+
+    def test_relative_path_denied(self):
+        with pytest.raises(ValidationError, match="absolute"):
+            validate_multicluster_ingress(
+                type("MCI", (), {"spec": type("S", (), {"rules": [
+                    {"http": {"paths": [{"path": "x", "pathType": "Prefix",
+                                         "backend": {"service": {"name": "w"}}}]}}
+                ]})()})()
+            )
+
+
+class TestWorkMutation:
+    def test_permanent_id_and_manifest_prune(self):
+        manifest = Resource(
+            api_version="apps/v1", kind="Deployment",
+            meta=ObjectMeta(name="m", namespace="default", uid="uid-raw",
+                            resource_version=42, creation_timestamp=123.0),
+            spec={"replicas": 1},
+            status={"readyReplicas": 1},
+        )
+        work = Work(meta=ObjectMeta(name="w", namespace="exec-m1"),
+                    spec=WorkSpec(workload=[manifest]))
+        mutate_work(work)
+        assert work.meta.labels[PERMANENT_ID_LABEL]
+        first_id = work.meta.labels[PERMANENT_ID_LABEL]
+        # pruning acts on a copy in the work; the caller's object is intact
+        pruned = work.spec.workload[0]
+        assert pruned.status == {}
+        assert pruned.meta.uid == "" and pruned.meta.resource_version == 0
+        assert manifest.status == {"readyReplicas": 1}
+        assert manifest.meta.uid == "uid-raw"
+        mutate_work(work)  # idempotent: id sticks
+        assert work.meta.labels[PERMANENT_ID_LABEL] == first_id
+
+
+class TestDeletionProtection:
+    def test_protected_template_survives_delete(self):
+        cp = ControlPlane()
+        protected = Resource(
+            api_version="v1", kind="ConfigMap",
+            meta=ObjectMeta(name="keep", namespace="default",
+                            labels={DELETION_PROTECTION_LABEL: "Always"}),
+        )
+        cp.store.apply(protected)
+        with pytest.raises(ValidationError, match="protected"):
+            cp.store.delete("Resource", "default/keep")
+        assert cp.store.get("Resource", "default/keep") is not None
+        # removing the label unlocks deletion
+        protected.meta.labels.pop(DELETION_PROTECTION_LABEL)
+        cp.store.apply(protected)
+        cp.store.delete("Resource", "default/keep")
+        assert cp.store.get("Resource", "default/keep") is None
+
+    def test_lenient_value_allows_delete(self):
+        cp = ControlPlane()
+        obj = Resource(
+            api_version="v1", kind="ConfigMap",
+            meta=ObjectMeta(name="soft", namespace="default",
+                            labels={DELETION_PROTECTION_LABEL: "Never"}),
+        )
+        cp.store.apply(obj)
+        cp.store.delete("Resource", "default/soft")
+        assert cp.store.get("Resource", "default/soft") is None
+
+
+class TestPermanentIdMutators:
+    def test_binding_and_mcs_get_ids_through_the_chain(self):
+        chain = default_admission_chain()
+        from karmada_tpu.api.networking import MultiClusterService
+        from karmada_tpu.api.work import ResourceBinding
+
+        rb = ResourceBinding(meta=ObjectMeta(name="b", namespace="default"))
+        chain.admit("ResourceBinding", rb)
+        assert rb.meta.labels[PERMANENT_ID_LABEL]
+        mcs = MultiClusterService(meta=ObjectMeta(name="s", namespace="default"))
+        chain.admit("MultiClusterService", mcs)
+        assert mcs.meta.labels[PERMANENT_ID_LABEL]
+
+    def test_override_policy_selector_namespace_defaulted(self):
+        chain = default_admission_chain()
+        from karmada_tpu.api.policy import OverridePolicy
+
+        op = OverridePolicy(meta=ObjectMeta(name="op", namespace="team-a"))
+        sel = type("Sel", (), {"namespace": ""})()
+        op.spec.resource_selectors = [sel]
+        chain.admit("OverridePolicy", op)
+        assert sel.namespace == "team-a"
+
+
+class TestMutationSafety:
+    def test_work_prune_does_not_corrupt_aliased_store_object(self):
+        """NamespaceSync aliases live store objects into Work.spec.workload;
+        pruning must act on copies."""
+        cp = ControlPlane()
+        cp.join_cluster(__import__("karmada_tpu.utils.builders", fromlist=["new_cluster"]).new_cluster("member1", cpu="10", memory="10Gi"))
+        cp.settle()
+        ns = Resource(api_version="v1", kind="Namespace",
+                      meta=ObjectMeta(name="team-x"), status={"phase": "Active"})
+        cp.store.apply(ns)
+        cp.settle()
+        stored = cp.store.get("Resource", "team-x")
+        assert stored.meta.uid  # live object untouched by work pruning
+        assert stored.status == {"phase": "Active"}
+
+    def test_fhpa_explicit_zero_still_denied(self):
+        from karmada_tpu.api.autoscaling import FederatedHPA, FederatedHPASpec, ScaleTargetRef
+
+        chain = default_admission_chain()
+        hpa = FederatedHPA(
+            meta=ObjectMeta(name="h", namespace="default"),
+            spec=FederatedHPASpec(min_replicas=0, max_replicas=5,
+                                  scale_target_ref=ScaleTargetRef(name="web")),
+        )
+        with pytest.raises(ValidationError, match="minReplicas"):
+            chain.admit("FederatedHPA", hpa)
