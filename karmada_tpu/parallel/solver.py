@@ -88,11 +88,41 @@ def make_sharded_step(mesh: Mesh, *, shard_clusters: bool = False):
     )
 
 
-def default_mesh(n_devices: int | None = None, *, cluster_axis: int = 1) -> Mesh:
+def default_mesh(
+    n_devices: int | None = None,
+    *,
+    cluster_axis: int = 1,
+    allow_cpu_fallback: bool = False,
+) -> Mesh:
     """Mesh over the first n devices: ("b", "c") with the cluster axis sized
-    ``cluster_axis`` (1 = pure binding-parallel)."""
+    ``cluster_axis`` (1 = pure binding-parallel).
+
+    ``allow_cpu_fallback`` is for dry-runs only: when the default backend
+    exposes fewer than ``n_devices`` (e.g. one tunneled TPU chip) but enough
+    virtual CPU devices exist via --xla_force_host_platform_device_count, the
+    mesh is built over CPU devices instead. Perf-sensitive callers must leave
+    it off so a misconfigured accelerator fails loudly instead of silently
+    benchmarking CPU.
+    """
     devs = jax.devices()
+    if allow_cpu_fallback and n_devices and len(devs) < n_devices:
+        try:
+            cpu = jax.devices("cpu")
+        except RuntimeError:
+            cpu = []
+        if len(cpu) >= n_devices:
+            devs = cpu
     n = n_devices or len(devs)
+    if len(devs) < n:
+        raise ValueError(
+            f"default_mesh: {n} devices requested but only {len(devs)} visible "
+            "(set XLA_FLAGS=--xla_force_host_platform_device_count=N before "
+            "the first jax import to dry-run multi-chip on CPU)"
+        )
+    if n % cluster_axis:
+        raise ValueError(
+            f"default_mesh: {n} devices not divisible by cluster_axis={cluster_axis}"
+        )
     devs = devs[:n]
     b = n // cluster_axis
     import numpy as np
