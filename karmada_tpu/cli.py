@@ -67,10 +67,25 @@ def cmd_unjoin(cp: ControlPlane, name: str) -> None:
     cp.unjoin_cluster(name)
 
 
+def cmd_token_create(cp: ControlPlane) -> str:
+    """karmadactl token create: bootstrap token for pull-mode registration."""
+    return cp.authority.create_token().token
+
+
 def cmd_register(
-    cp: ControlPlane, name: str, member: Optional[MemberCluster] = None, **cluster_kw
+    cp: ControlPlane,
+    name: str,
+    member: Optional[MemberCluster] = None,
+    token: Optional[str] = None,
+    **cluster_kw,
 ) -> Cluster:
-    """Pull-mode register (pkg/karmadactl/register): deploys the agent."""
+    """Pull-mode register (pkg/karmadactl/register): kubeadm-style token ->
+    CSR -> signed agent cert, then deploys the agent. Without a token the
+    admin-kubeconfig path is used (direct join)."""
+    if token is not None:
+        record = cp.authority.submit_csr(name, token)
+        if record is None:
+            raise PermissionError(f"invalid or expired bootstrap token for {name}")
     cluster = new_cluster(name, **cluster_kw)
     cluster.spec.sync_mode = PULL
     cp.join_cluster(cluster, member)
@@ -219,19 +234,88 @@ def cmd_interpret(cp: ControlPlane, template, operation: str, **kw):
     raise ValueError(f"unknown operation {operation}")
 
 
+def cmd_logs(
+    cp: ControlPlane,
+    cluster: str,
+    namespace: str,
+    pod: str,
+    tail: Optional[int] = None,
+) -> list[str]:
+    """karmadactl logs: pod logs through the clusters/{name}/proxy
+    passthrough (pkg/karmadactl/logs)."""
+    resp = cp.proxy.connect(
+        ProxyRequest(
+            verb="logs", gvk="v1/Pod", namespace=namespace, name=pod,
+            cluster=cluster, options={"tail": tail},
+        )
+    )
+    if resp.error:
+        raise RuntimeError(resp.error)
+    return resp.data
+
+
+def cmd_exec(
+    cp: ControlPlane, cluster: str, namespace: str, pod: str, command: list[str]
+) -> dict:
+    """karmadactl exec: run a command in a member pod via the proxy
+    (pkg/karmadactl/exec)."""
+    resp = cp.proxy.connect(
+        ProxyRequest(
+            verb="exec", gvk="v1/Pod", namespace=namespace, name=pod,
+            cluster=cluster, options={"command": list(command)},
+        )
+    )
+    if resp.error:
+        raise RuntimeError(resp.error)
+    return resp.data
+
+
+def cmd_attach(
+    cp: ControlPlane, cluster: str, namespace: str, pod: str
+) -> list[str]:
+    """karmadactl attach: stream the pod's output (pkg/karmadactl/attach) —
+    in-proc this is the log stream from the runtime seam."""
+    return cmd_logs(cp, cluster, namespace, pod)
+
+
+ADDONS = (
+    "karmada-descheduler",
+    "karmada-scheduler-estimator",
+    "karmada-search",
+    "karmada-metrics-adapter",
+)
+
+
 def cmd_addons(cp: ControlPlane, enable: Sequence[str] = (), disable: Sequence[str] = ()):
     """Toggle optional components (pkg/karmadactl/addons: estimator,
     descheduler, search, metrics-adapter)."""
     from .controllers import Descheduler
+    from .metricsadapter import MetricsAdapter
 
     state = {}
     for name in enable:
+        if name not in ADDONS:
+            raise ValueError(f"unknown addon {name}")
         if name == "karmada-descheduler" and cp.descheduler is None:
-            cp.descheduler = Descheduler(cp.store, cp.runtime, cp.members)
+            cp.descheduler = Descheduler(cp.store, cp.runtime, cp.members, clock=cp.clock)
+        elif name == "karmada-scheduler-estimator":
+            cp.enable_accurate_estimators()
+        elif name == "karmada-metrics-adapter" and cp.metrics_adapter is None:
+            cp.metrics_adapter = MetricsAdapter(cp.members)
+        elif name == "karmada-search":
+            cp.search.resync()
         state[name] = "enabled"
     for name in disable:
+        if name not in ADDONS:
+            raise ValueError(f"unknown addon {name}")
         if name == "karmada-descheduler":
             cp.descheduler = None
+        elif name == "karmada-scheduler-estimator":
+            cp.disable_accurate_estimators()
+        elif name == "karmada-metrics-adapter":
+            cp.metrics_adapter = None
+        elif name == "karmada-search":
+            cp.search.disable()
         state[name] = "disabled"
     return state
 

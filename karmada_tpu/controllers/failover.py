@@ -194,9 +194,13 @@ class Descheduler:
         store: Store,
         runtime: Runtime,
         members,
+        clock=None,
     ) -> None:
+        import time as _time
+
         self.store = store
         self.members = members
+        self.clock = clock or _time.time
         runtime.add_ticker(self.deschedule_once)
 
     def deschedule_once(self) -> None:
@@ -204,6 +208,16 @@ class Descheduler:
         each target cluster's estimator for unschedulable replicas and shrink
         the schedule result accordingly (floor at 0); the scheduler then
         scale-reschedules the delta elsewhere."""
+        # GetUnschedulableReplicas inputs: pod-condition derived counts
+        # (PodScheduled=False/Unschedulable past the threshold) merged with
+        # simulation overrides — computed once per member per pass, not per
+        # (binding, cluster).
+        now = self.clock()
+        counts: dict[str, dict[str, int]] = {}
+        for name in self.members.names():
+            member = self.members.get(name)
+            if member is not None and member.reachable:
+                counts[name] = member.count_unschedulable(now)
         for kind in ("ResourceBinding", "ClusterResourceBinding"):
           for rb in self.store.list(kind):
             if rb.spec.replicas <= 0 or not rb.spec.clusters:
@@ -212,10 +226,7 @@ class Descheduler:
             new_targets = []
             changed = False
             for tc in rb.spec.clusters:
-                member = self.members.get(tc.name)
-                unschedulable = 0
-                if member is not None and member.reachable:
-                    unschedulable = member.unschedulable_replicas.get(workload_key, 0)
+                unschedulable = counts.get(tc.name, {}).get(workload_key, 0)
                 if unschedulable > 0:
                     reduced = max(tc.replicas - unschedulable, 0)
                     changed = True

@@ -69,7 +69,14 @@ class RemedyController:
             return True  # unconditional remedy
         for match in remedy.spec.decision_matches:
             for cond in cluster.status.conditions:
-                status = "True" if cond.status else "False"
+                # statuses are "True"/"False" strings or bools depending on
+                # the producer; normalize without truthiness ("False" is
+                # truthy as a string)
+                status = (
+                    cond.status
+                    if isinstance(cond.status, str)
+                    else ("True" if cond.status else "False")
+                )
                 if (
                     cond.type == match.cluster_condition_type
                     and status == match.cluster_condition_status
@@ -94,6 +101,60 @@ class RemedyController:
                 cluster.meta.annotations[REMEDY_ACTIONS_ANNOTATION] = wanted
             self.store.apply(cluster)
         return DONE
+
+
+SERVICE_DNS_CONDITION = "ServiceDomainNameResolutionReady"
+
+
+class ServiceNameResolutionDetector:
+    """In-cluster coredns-failure detector example
+    (pkg/servicenameresolutiondetector/, cmd/service-name-resolution-detector-
+    example): periodically probes service-name resolution inside one member
+    cluster and reports the ServiceDomainNameResolutionReady condition on the
+    Cluster object — the decision condition the Remedy controller matches on.
+
+    The probe is pluggable; the default resolves by checking that the
+    cluster's DNS Service (kube-system/kube-dns) exists and the member is
+    reachable — the in-proc stand-in for an A-record lookup through coredns.
+    """
+
+    def __init__(
+        self,
+        store: Store,
+        runtime: Runtime,
+        member: MemberCluster,
+        probe=None,
+    ) -> None:
+        self.store = store
+        self.member = member
+        self.probe = probe or self._default_probe
+        self.active = True  # cleared on unjoin/replacement (tickers are
+        # permanent, so deactivation is the deregistration mechanism)
+        runtime.add_ticker(self.detect_once)
+
+    def _default_probe(self) -> bool:
+        try:
+            return self.member.get("v1/Service", "kube-system", "kube-dns") is not None
+        except UnreachableError:
+            return False
+
+    def detect_once(self) -> None:
+        if not self.active:
+            return
+        cluster = self.store.get("Cluster", self.member.name)
+        if cluster is None:
+            return
+        healthy = bool(self.probe())
+        changed = set_condition(
+            cluster.status.conditions,
+            Condition(
+                type=SERVICE_DNS_CONDITION,
+                status=healthy,
+                reason="DomainNameResolved" if healthy else "DomainNameResolutionFailed",
+            ),
+        )
+        if changed:
+            self.store.apply(cluster)
 
 
 class ClusterDiscoveryController:

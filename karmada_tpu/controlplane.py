@@ -95,17 +95,17 @@ class ControlPlane:
         )
         extra = []
         self._accurate_enabled = enable_accurate_estimator
-        if enable_accurate_estimator:
-            # node snapshots track member state (the estimator server's
-            # informer refresh); rebuilt each settle pass
-            self.runtime.add_ticker(self._refresh_estimators)
+        # node snapshots track member state (the estimator server's informer
+        # refresh); rebuilt each settle pass. No-op while accurate estimators
+        # are disabled so the addon toggle works after construction.
+        self.runtime.add_ticker(self._refresh_estimators)
         self.scheduler = SchedulerController(
             self.store,
             self.runtime,
             extra_estimators=extra,
         )
         self.descheduler = (
-            Descheduler(self.store, self.runtime, self.members)
+            Descheduler(self.store, self.runtime, self.members, clock=self.clock)
             if enable_descheduler
             else None
         )
@@ -179,6 +179,16 @@ class ControlPlane:
             self.store, self.runtime, self.interpreter
         )
         self.agents: dict[str, object] = {}
+        from .utils.register import RegistrationAuthority
+
+        # token issuance + CSR approval + cert rotation for pull-mode agents
+        # (pkg/karmadactl/register, agent-CSR-approving controller,
+        # pkg/controllers/certificate/)
+        self.authority = RegistrationAuthority(clock=self.clock)
+        self.runtime.add_ticker(self._rotate_certificates)
+        # per-member coredns-failure detectors (deployed explicitly via
+        # add_sn_detector, like the reference's example binary)
+        self.sn_detectors: dict[str, object] = {}
 
     # -- cluster lifecycle (karmadactl join/unjoin analogue) ---------------
 
@@ -197,24 +207,69 @@ class ControlPlane:
             )
         self.work_status_controller.watch_member(member)
         if self._accurate_enabled:
-            snap_dims = ["cpu", "memory", "pods", "ephemeral-storage"]
-            est = AccurateEstimator(
-                cluster.name, NodeSnapshot(member.nodes, snap_dims)
-            )
-            self.estimators.register(est)
-            names = sorted(self.members.names())
-            self.scheduler.extra_estimators = [
-                self.estimators.make_batch_estimator(names)
-            ]
+            self._register_estimator(cluster.name, member)
         self.store.apply(cluster)
         return member
 
     def unjoin_cluster(self, name: str) -> None:
         self.members.deregister(name)
         self.estimators.deregister(name)
+        det = self.sn_detectors.pop(name, None)
+        if det is not None:
+            det.active = False
         self.store.delete("Cluster", name)
 
+    # -- optional components (karmadactl addons analogue) ------------------
+
+    def _register_estimator(self, cluster_name: str, member) -> None:
+        snap_dims = ["cpu", "memory", "pods", "ephemeral-storage"]
+        est = AccurateEstimator(cluster_name, NodeSnapshot(member.nodes, snap_dims))
+        self.estimators.register(est)
+        names = sorted(self.members.names())
+        self.scheduler.extra_estimators = [self.estimators.make_batch_estimator(names)]
+
+    def enable_accurate_estimators(self) -> None:
+        """addons enable karmada-scheduler-estimator: deploy one estimator
+        per member and point the scheduler's fan-out at them."""
+        if self._accurate_enabled:
+            return
+        self._accurate_enabled = True
+        for name in sorted(self.members.names()):
+            self._register_estimator(name, self.members.get(name))
+
+    def disable_accurate_estimators(self) -> None:
+        if not self._accurate_enabled:
+            return
+        self._accurate_enabled = False
+        for name in list(self.members.names()):
+            self.estimators.deregister(name)
+        self.scheduler.extra_estimators = []
+
+    def add_sn_detector(self, cluster_name: str, probe=None):
+        """Deploy the service-name-resolution detector into one member
+        (cmd/service-name-resolution-detector-example)."""
+        from .controllers.remedy import ServiceNameResolutionDetector
+
+        member = self.members.get(cluster_name)
+        if member is None:
+            raise KeyError(f"unknown cluster {cluster_name}")
+        prev = self.sn_detectors.get(cluster_name)
+        if prev is not None:
+            prev.active = False
+        det = ServiceNameResolutionDetector(
+            self.store, self.runtime, member, probe=probe
+        )
+        self.sn_detectors[cluster_name] = det
+        return det
+
+    def _rotate_certificates(self) -> None:
+        """cert-rotation controller sweep over registered agent certs."""
+        for cluster_name in list(self.authority.certificates):
+            self.authority.rotate_if_needed(cluster_name)
+
     def _refresh_estimators(self) -> None:
+        if not self._accurate_enabled:
+            return
         snap_dims = ["cpu", "memory", "pods", "ephemeral-storage"]
         for name in self.members.names():
             member = self.members.get(name)
@@ -222,7 +277,7 @@ class ControlPlane:
             if member is None or est is None:
                 continue
             est.snapshot = NodeSnapshot(member.nodes, snap_dims)
-            est.unschedulable = dict(member.unschedulable_replicas)
+            est.unschedulable = member.count_unschedulable(self.clock())
 
     # -- driving -----------------------------------------------------------
 

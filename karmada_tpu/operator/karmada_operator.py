@@ -133,7 +133,7 @@ class KarmadaOperator:
         from ..controllers import Descheduler
 
         cp = data["control_plane"]
-        cp.descheduler = Descheduler(cp.store, cp.runtime, cp.members)
+        cp.descheduler = Descheduler(cp.store, cp.runtime, cp.members, clock=cp.clock)
 
     def _join_members(self, data: dict) -> None:
         from ..utils.builders import new_cluster

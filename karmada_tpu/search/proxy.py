@@ -20,13 +20,15 @@ from .registry import MultiClusterCache
 
 @dataclass
 class ProxyRequest:
-    verb: str  # get | list
+    verb: str  # get | list | logs | exec
     gvk: str
     namespace: str = ""
     name: str = ""
     labels: dict[str, str] = field(default_factory=dict)
     # explicit member-cluster routing (clusters/{name}/proxy passthrough)
     cluster: Optional[str] = None
+    # subresource payload: logs tail, exec command
+    options: dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -35,6 +37,8 @@ class ProxyResponse:
     obj: Optional[Resource] = None
     items: list[tuple[str, Resource]] = field(default_factory=list)
     error: str = ""
+    # subresource result (log lines, exec output)
+    data: Any = None
 
 
 class CachePlugin:
@@ -44,6 +48,8 @@ class CachePlugin:
         self.cache = cache
 
     def connect(self, req: ProxyRequest) -> Optional[ProxyResponse]:
+        if req.verb not in ("get", "list"):
+            return None  # subresources always go to the member
         if req.verb == "get":
             hit = self.cache.get(req.gvk, req.namespace, req.name, req.cluster)
             if hit is not None:
@@ -81,6 +87,22 @@ class ClusterProxyPlugin:
                         served_by=self.name, error="not found"
                     )
                 return ProxyResponse(served_by=self.name, obj=obj)
+            if req.verb in ("logs", "exec"):
+                # pod subresources ride the same clusters/{name}/proxy
+                # passthrough that karmadactl logs/exec/attach uses
+                # (pkg/registry/cluster/storage/proxy.go:41-102)
+                try:
+                    if req.verb == "logs":
+                        data = member.pod_logs(
+                            req.namespace, req.name, tail=req.options.get("tail")
+                        )
+                    else:
+                        data = member.pod_exec(
+                            req.namespace, req.name, req.options.get("command", [])
+                        )
+                except KeyError as e:
+                    return ProxyResponse(served_by=self.name, error=str(e))
+                return ProxyResponse(served_by=self.name, data=data)
             items = [
                 (req.cluster, o)
                 for o in member.list(req.gvk)
@@ -101,6 +123,11 @@ class KarmadaPlugin:
         self.store = store
 
     def connect(self, req: ProxyRequest) -> Optional[ProxyResponse]:
+        if req.verb not in ("get", "list"):
+            return ProxyResponse(
+                served_by=self.name,
+                error=f"verb {req.verb} requires cluster routing",
+            )
         if req.verb == "get":
             key = f"{req.namespace}/{req.name}" if req.namespace else req.name
             obj = self.store.get("Resource", key)

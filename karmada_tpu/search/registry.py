@@ -49,6 +49,9 @@ class MultiClusterCache:
             k: v for k, v in self._items.items() if k[0] != cluster
         }
 
+    def clear(self) -> None:
+        self._items = {}
+
     def get(
         self, gvk: str, namespace: str, name: str, cluster: Optional[str] = None
     ) -> Optional[tuple[str, Resource]]:
@@ -97,15 +100,34 @@ class SearchController:
         # deletions so member-side removals and backend switches don't
         # leave stale documents
         self._indexed: dict[str, set[tuple[str, str, str, str]]] = {}
+        self.enabled = True  # addon toggle (karmada-search install state)
         self.worker = runtime.new_worker("search", self._reconcile)
         store.watch("ResourceRegistry", lambda e: self.worker.enqueue(e.key))
         runtime.add_ticker(self._sweep)
 
     def _sweep(self) -> None:
+        if not self.enabled:
+            return
         for rr in self.store.list("ResourceRegistry"):
             self.worker.enqueue(rr.meta.namespaced_name)
 
+    def resync(self) -> None:
+        """Re-enqueue every registry (addon enable / manual refresh)."""
+        self.enabled = True
+        self._sweep()
+
+    def disable(self) -> None:
+        """addon disable: stop refreshing and drop cached state (the
+        uninstall analogue — the aggregated API goes away)."""
+        self.enabled = False
+        for rr in list(self._indexed):
+            for doc in self._indexed.pop(rr, set()):
+                self.indexer.delete(*doc)
+        self.cache.clear()
+
     def _reconcile(self, key: str) -> Optional[str]:
+        if not self.enabled:
+            return DONE
         rr = self.store.get("ResourceRegistry", key)
         index = rr is not None and rr.spec.backend == "opensearch"
         fresh: set[tuple[str, str, str, str]] = set()

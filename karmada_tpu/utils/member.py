@@ -72,6 +72,9 @@ class MemberCluster:
         # workload-key -> metric sample {"pods", "ready_pods",
         # "cpu_utilization"} (metrics.k8s.io stand-in for the metrics adapter)
         self.pod_metrics: dict[str, dict] = {}
+        # pod runtime seam: log buffers + pluggable exec handler
+        self._pod_logs: dict[tuple[str, str], list[str]] = {}
+        self.exec_handler: Optional[Callable[[Resource, list], dict]] = None
 
     # -- client surface ----------------------------------------------------
 
@@ -120,6 +123,113 @@ class MemberCluster:
     def _notify(self, event: MemberEvent) -> None:
         for h in list(self._watchers):
             h(event)
+
+    # -- pod runtime seam (logs / exec / attach + unschedulable counting) --
+
+    def add_pod(
+        self,
+        namespace: str,
+        name: str,
+        *,
+        owner_key: str = "",
+        conditions: Optional[list[dict]] = None,
+        labels: Optional[dict[str, str]] = None,
+    ) -> Resource:
+        """Register a pod in the member state. Pods are ordinary "v1/Pod"
+        resources; ``owner_key`` links the pod to its workload (the stand-in
+        for the ownerRef/label-selector match in estimator
+        server/replica/replica.go:43-77)."""
+        from ..api.core import ObjectMeta
+
+        pod = Resource(
+            api_version="v1",
+            kind="Pod",
+            meta=ObjectMeta(namespace=namespace, name=name, labels=dict(labels or {})),
+            spec={"owner_key": owner_key},
+            status={"conditions": list(conditions or [])},
+        )
+        return self.apply(pod)
+
+    def mark_pod_unschedulable(
+        self, namespace: str, name: str, since: float
+    ) -> None:
+        """Set the PodScheduled=False/Unschedulable condition (the signal
+        GetUnschedulableReplicas counts)."""
+        pod = self.get("v1/Pod", namespace, name)
+        if pod is None:
+            return
+        conds = [
+            c
+            for c in pod.status.setdefault("conditions", [])
+            if c.get("type") != "PodScheduled"
+        ]
+        conds.append(
+            {
+                "type": "PodScheduled",
+                "status": "False",
+                "reason": "Unschedulable",
+                "last_transition": since,
+            }
+        )
+        pod.status["conditions"] = conds
+        self.apply(pod)
+
+    def count_unschedulable(
+        self, now: float, threshold_seconds: float = 60.0
+    ) -> dict[str, int]:
+        """workload-key -> replicas stuck PodScheduled=False/Unschedulable
+        for longer than the threshold (ref: server/replica/replica.go:43-77;
+        the threshold mirrors --unschedulable-threshold). Explicit
+        ``unschedulable_replicas`` entries (simulation overrides) are merged
+        in, taking the max per workload."""
+        counts: dict[str, int] = {}
+        for pod in self.list("v1/Pod"):
+            owner = (pod.spec or {}).get("owner_key", "")
+            if not owner:
+                continue
+            for cond in (pod.status or {}).get("conditions", []):
+                if (
+                    cond.get("type") == "PodScheduled"
+                    and cond.get("status") == "False"
+                    and cond.get("reason") == "Unschedulable"
+                    and now - cond.get("last_transition", now) >= threshold_seconds
+                ):
+                    counts[owner] = counts.get(owner, 0) + 1
+                    break
+        for key, n in self.unschedulable_replicas.items():
+            counts[key] = max(counts.get(key, 0), n)
+        return counts
+
+    def append_pod_log(self, namespace: str, name: str, line: str) -> None:
+        self._check()
+        with self._lock:
+            self._pod_logs.setdefault((namespace, name), []).append(line)
+
+    def pod_logs(
+        self, namespace: str, name: str, tail: Optional[int] = None
+    ) -> list[str]:
+        """kubectl logs analogue (karmadactl logs reaches this through the
+        clusters/{name}/proxy passthrough)."""
+        self._check()
+        if self.get("v1/Pod", namespace, name) is None:
+            raise KeyError(f"pod {namespace}/{name} not found in {self.name}")
+        with self._lock:
+            lines = list(self._pod_logs.get((namespace, name), []))
+        if tail is None:
+            return lines
+        return lines[-tail:] if tail > 0 else []
+
+    def pod_exec(self, namespace: str, name: str, command: list[str]) -> dict:
+        """kubectl exec/attach analogue. The runtime is pluggable via
+        ``exec_handler(pod, command) -> {"stdout", "rc"}``; the default echoes
+        (there is no container runtime in-proc)."""
+        self._check()
+        pod = self.get("v1/Pod", namespace, name)
+        if pod is None:
+            raise KeyError(f"pod {namespace}/{name} not found in {self.name}")
+        if self.exec_handler is not None:
+            return self.exec_handler(pod, command)
+        return {"stdout": " ".join(command), "rc": 0}
 
     # -- member-side simulation helpers (tests / failure injection) --------
 
