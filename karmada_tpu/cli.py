@@ -176,6 +176,11 @@ def cmd_describe(cp: ControlPlane, gvk: str, namespace: str, name: str) -> str:
 
 def cmd_top(cp: ControlPlane, workload_key: str):
     """Per-cluster + merged utilization (pkg/karmadactl/top)."""
+    if cp.metrics_adapter is None:
+        raise RuntimeError(
+            "metrics adapter not installed (enable the "
+            "karmada-metrics-adapter addon)"
+        )
     samples = cp.metrics_adapter.resource_metrics(workload_key)
     merged = cp.metrics_adapter.merged_utilization(workload_key)
     return {"clusters": {s.cluster: s.value for s in samples}, "merged": merged}
@@ -296,8 +301,12 @@ def cmd_addons(cp: ControlPlane, enable: Sequence[str] = (), disable: Sequence[s
     for name in enable:
         if name not in ADDONS:
             raise ValueError(f"unknown addon {name}")
-        if name == "karmada-descheduler" and cp.descheduler is None:
-            cp.descheduler = Descheduler(cp.store, cp.runtime, cp.members, clock=cp.clock)
+        if name == "karmada-descheduler":
+            if cp.descheduler is None:
+                cp.descheduler = Descheduler(
+                    cp.store, cp.runtime, cp.members, clock=cp.clock
+                )
+            cp.descheduler.active = True
         elif name == "karmada-scheduler-estimator":
             cp.enable_accurate_estimators()
         elif name == "karmada-metrics-adapter" and cp.metrics_adapter is None:
@@ -309,7 +318,10 @@ def cmd_addons(cp: ControlPlane, enable: Sequence[str] = (), disable: Sequence[s
         if name not in ADDONS:
             raise ValueError(f"unknown addon {name}")
         if name == "karmada-descheduler":
-            cp.descheduler = None
+            # the ticker registration is permanent; deactivate in place so
+            # disable actually stops reclaim and re-enable can't double-tick
+            if cp.descheduler is not None:
+                cp.descheduler.active = False
         elif name == "karmada-scheduler-estimator":
             cp.disable_accurate_estimators()
         elif name == "karmada-metrics-adapter":

@@ -201,6 +201,9 @@ class Descheduler:
         self.store = store
         self.members = members
         self.clock = clock or _time.time
+        #: addon on/off switch — the ticker registration is permanent
+        #: (Runtime has no removal), so disable must gate the pass itself
+        self.active = True
         runtime.add_ticker(self.deschedule_once)
 
     def deschedule_once(self) -> None:
@@ -208,16 +211,28 @@ class Descheduler:
         each target cluster's estimator for unschedulable replicas and shrink
         the schedule result accordingly (floor at 0); the scheduler then
         scale-reschedules the delta elsewhere."""
+        if not self.active:
+            return
         # GetUnschedulableReplicas inputs: pod-condition derived counts
         # (PodScheduled=False/Unschedulable past the threshold) merged with
-        # simulation overrides — computed once per member per pass, not per
-        # (binding, cluster).
+        # simulation overrides — memoized per member per pass, computed
+        # lazily on first reference so a tick with no bindings (or bindings
+        # touching few clusters) never pays a fleet-wide pod scan.
         now = self.clock()
         counts: dict[str, dict[str, int]] = {}
-        for name in self.members.names():
-            member = self.members.get(name)
-            if member is not None and member.reachable:
-                counts[name] = member.count_unschedulable(now)
+
+        def member_counts(name: str) -> dict[str, int]:
+            got = counts.get(name)
+            if got is None:
+                member = self.members.get(name)
+                got = (
+                    member.count_unschedulable(now)
+                    if member is not None and member.reachable
+                    else {}
+                )
+                counts[name] = got
+            return got
+
         for kind in ("ResourceBinding", "ClusterResourceBinding"):
           for rb in self.store.list(kind):
             if rb.spec.replicas <= 0 or not rb.spec.clusters:
@@ -226,7 +241,7 @@ class Descheduler:
             new_targets = []
             changed = False
             for tc in rb.spec.clusters:
-                unschedulable = counts.get(tc.name, {}).get(workload_key, 0)
+                unschedulable = member_counts(tc.name).get(workload_key, 0)
                 if unschedulable > 0:
                     reduced = max(tc.replicas - unschedulable, 0)
                     changed = True

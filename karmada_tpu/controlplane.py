@@ -218,6 +218,14 @@ class ControlPlane:
         if det is not None:
             det.active = False
         self.store.delete("Cluster", name)
+        # re-point the scheduler's estimator fan-out at the surviving
+        # members — a stale batch estimator keeps the old cluster-column
+        # layout and breaks the min-merge shape on the next reconcile
+        if self._accurate_enabled:
+            names = sorted(self.members.names())
+            self.scheduler.extra_estimators = (
+                [self.estimators.make_batch_estimator(names)] if names else []
+            )
 
     # -- optional components (karmadactl addons analogue) ------------------
 

@@ -7,14 +7,26 @@ karmada_tpu.refimpl.divider for the tie-break note).
 
 Shapes: one binding owns a length-C vector over the cluster axis; the batch
 kernels vmap over the binding axis. Everything is static-shaped and
-jit-friendly; a single ``lax.sort`` with three keys realizes the
-lexicographic order (TPU-native: one fused sort, no host control flow).
+jit-friendly.
 
-int64 is used only where products can overflow int32
-(weight * num_replicas and availability cumsums); storage stays int32.
+TPU-shaping notes:
+- The remainder hand-out does NOT scatter a permutation back: the +1 bonus
+  goes to the lexicographically-largest ``remain`` clusters, and because the
+  (weight, last, index) key is a strict total order the bonus set is exactly
+  "key >= key of the remain-th sorted element". One keys-only ``lax.sort``
+  followed by a [B] gather of the threshold tuple and an elementwise
+  3-way lexicographic compare replaces sort+scatter — the scatter was as
+  expensive as the sort itself on TPU.
+- ``wide=False`` selects an all-int32 kernel for workloads whose
+  weight x replica products provably fit in 31 bits (the packing layer
+  checks ``max(weights) * num <= INT32_MAX`` and ``sum(weights)`` bounds
+  host-side). int64 on TPU is emulated 32-bit pairs; the narrow path
+  roughly halves the kernel's ALU + memory traffic.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +38,7 @@ def take_by_weight(
     weights: jnp.ndarray,  # int32[C], >= 0 (0 = excluded from dispensing)
     last: jnp.ndarray,  # int32[C], previous replicas (tie-break inertia)
     init: jnp.ndarray,  # int32[C], initial result merged into the output
+    wide: bool = True,  # static: int64 accumulation (False = proven-int32 fast path)
 ) -> jnp.ndarray:
     """Returns int32[C] replica assignment == Dispenser result.
 
@@ -35,24 +48,87 @@ def take_by_weight(
     """
     c = weights.shape[0]
     idx = jnp.arange(c, dtype=jnp.int32)
+    acc = jnp.int64 if wide else jnp.int32
 
-    total = jnp.sum(weights.astype(jnp.int64))
+    total = jnp.sum(weights.astype(acc))
     safe_total = jnp.maximum(total, 1)
-    floors64 = weights.astype(jnp.int64) * num.astype(jnp.int64) // safe_total
-    floors = floors64.astype(jnp.int32)
-    remain = num - jnp.sum(floors).astype(jnp.int32)
-
-    # one fused lexicographic sort; payload = original index
-    _, _, _, perm = lax.sort(
-        (-weights, -last, idx, idx), num_keys=3, is_stable=False
+    floors = (weights.astype(acc) * num.astype(acc) // safe_total).astype(
+        jnp.int32
     )
-    # +1 to the first `remain` clusters in sort order
-    bonus_sorted = (jnp.arange(c, dtype=jnp.int32) < remain).astype(jnp.int32)
-    bonus = jnp.zeros((c,), jnp.int32).at[perm].set(bonus_sorted)
+    remain = num - jnp.sum(floors)
+
+    # keys-only sort; the bonus set is a lexicographic threshold compare.
+    # remain < #nonzero-weights <= C always (largest-remainder property),
+    # so position remain-1 is in range whenever remain > 0.
+    w_s, l_s, i_s = lax.sort((-weights, -last, idx), num_keys=3, is_stable=False)
+    pos = jnp.clip(remain - 1, 0, c - 1)
+    thr_w, thr_l, thr_i = -w_s[pos], -l_s[pos], i_s[pos]
+    ge_thr = (weights > thr_w) | (
+        (weights == thr_w)
+        & ((last > thr_l) | ((last == thr_l) & (idx <= thr_i)))
+    )
+    bonus = (ge_thr & (remain > 0)).astype(jnp.int32)
+
+    dispensed = jnp.where(total > 0, floors + bonus, 0)
+    return init + dispensed
+
+
+def take_by_weight_fast(
+    num: jnp.ndarray,  # int32 scalar
+    weights: jnp.ndarray,  # int32[C], >= 0, < 2^w_bits
+    last: jnp.ndarray,  # int32[C], >= 0, < 2^l_bits
+    init: jnp.ndarray,  # int32[C]
+    w_bits: int,  # static: bits(max weight); w_bits+l_bits+bits(C-1) <= 31
+    l_bits: int,  # static: bits(max last)
+    k_top: int,  # static: >= min(max num, C) — bounds the remainder rank
+    div_f32: bool,  # static: max(weights)*num < 2^24 (exact f32 products)
+) -> jnp.ndarray:
+    """``take_by_weight`` specialized for host-proven small ranges.
+
+    Two TPU-shaping substitutions, both exact under the static gates the
+    packing layer checks before choosing this path:
+    - the (weight desc, last desc, index asc) order packs into ONE int32 key
+      (strict total order), and the remainder bonus only needs the key of
+      rank ``remain`` <= num <= k_top, so a ``lax.top_k`` over the packed key
+      + one elementwise compare replaces the full 3-key sort (~10x cheaper
+      at 5k clusters);
+    - integer floor division lowers to slow emulation on the VPU; with
+      products < 2^24 the f32 reciprocal is exact after one +-1 fixup.
+    """
+    c = weights.shape[0]
+    i_bits = max(1, (c - 1).bit_length())
+    assert w_bits + l_bits + i_bits <= 31, (w_bits, l_bits, i_bits)
+    idx = jnp.arange(c, dtype=jnp.int32)
+
+    total = jnp.sum(weights)
+    safe_total = jnp.maximum(total, 1)
+    if div_f32:
+        prod = weights * num  # < 2^24, exact in f32
+        q = (prod.astype(jnp.float32) / safe_total.astype(jnp.float32)).astype(
+            jnp.int32
+        )
+        r = prod - q * safe_total  # |q*total - prod| <= total => int32-safe
+        floors = q + jnp.where(r >= safe_total, 1, 0) - jnp.where(r < 0, 1, 0)
+    else:
+        floors = weights * num // safe_total
+    remain = num - jnp.sum(floors)
+
+    key = (weights << (l_bits + i_bits)) | (last << i_bits) | (c - 1 - idx)
+    top_vals = lax.top_k(key, k_top)[0]
+    pos = jnp.clip(remain - 1, 0, k_top - 1)
+    thr = top_vals[pos]
+    bonus = ((key >= thr) & (remain > 0)).astype(jnp.int32)
 
     dispensed = jnp.where(total > 0, floors + bonus, 0)
     return init + dispensed
 
 
 # Batched over bindings: num[B], weights[B,C], last[B,C], init[B,C] -> [B,C]
-take_by_weight_batch = jax.vmap(take_by_weight, in_axes=(0, 0, 0, 0))
+_tbw_batch = {
+    w: jax.vmap(partial(take_by_weight, wide=w), in_axes=(0, 0, 0, 0))
+    for w in (False, True)
+}
+
+
+def take_by_weight_batch(num, weights, last, init, wide: bool = True):
+    return _tbw_batch[bool(wide)](num, weights, last, init)

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .dispense import take_by_weight
+from .dispense import take_by_weight, take_by_weight_fast
 
 # Strategy codes — shared with refimpl.divider
 DUPLICATED = 0
@@ -53,6 +53,7 @@ def _aggregated_prefix_mask(
     weights: jnp.ndarray,  # int32[C] availability in this mode
     is_prev: jnp.ndarray,  # bool[C] previously-scheduled (>0 replicas)
     target: jnp.ndarray,  # int32 scalar
+    wide: bool = True,  # static: int64 cumsum (False = proven-int32)
 ) -> jnp.ndarray:
     """bool[C]: minimal prefix of (prev desc, avail desc, idx asc) order whose
     cumulative availability reaches ``target``.
@@ -61,23 +62,29 @@ def _aggregated_prefix_mask(
     is replicas-desc (division_algorithm.go:31-36) and the resort is a stable
     partition by previously-used (assignment.go:146-173) — together one
     3-key sort.
+
+    Scatter-free: the kept set is a prefix of the sorted order, and the
+    (prev, weight, idx) key is a strict total order, so "position <= cutoff"
+    is equivalent to an elementwise lexicographic compare against the key
+    tuple gathered at the cutoff position.
     """
     c = weights.shape[0]
     idx = jnp.arange(c, dtype=jnp.int32)
-    _, _, _, perm = lax.sort(
-        (jnp.where(is_prev, 0, 1).astype(jnp.int32), -weights, idx, idx),
-        num_keys=3,
-        is_stable=False,
+    acc = jnp.int64 if wide else jnp.int32
+    prev_key = jnp.where(is_prev, 0, 1).astype(jnp.int32)
+    p_s, nw_s, i_s = lax.sort(
+        (prev_key, -weights, idx), num_keys=3, is_stable=False
     )
-    w_sorted = weights[perm]
-    cum = jnp.cumsum(w_sorted.astype(jnp.int64))
-    # keep positions up to and including the first where cum >= target
-    reached_before = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int64), cum[:-1]]
-    ) >= target.astype(jnp.int64)
-    keep_sorted = ~reached_before
-    keep = jnp.zeros((c,), bool).at[perm].set(keep_sorted)
-    return keep
+    cum_before = jnp.cumsum((-nw_s).astype(acc)) + nw_s.astype(acc)
+    # cutoff = last position whose preceding cumulative sum is < target
+    n_keep = jnp.sum((cum_before < target.astype(acc)).astype(jnp.int32))
+    pos = jnp.clip(n_keep - 1, 0, c - 1)
+    thr_p, thr_w, thr_i = p_s[pos], -nw_s[pos], i_s[pos]
+    le_thr = (prev_key < thr_p) | (
+        (prev_key == thr_p)
+        & ((weights > thr_w) | ((weights == thr_w) & (idx <= thr_i)))
+    )
+    return le_thr & (n_keep > 0)
 
 
 def _divide_one(
@@ -89,7 +96,12 @@ def _divide_one(
     prev: jnp.ndarray,  # int32[C] full previous assignment (spec.clusters)
     fresh: jnp.ndarray,  # bool scalar — reschedule triggered (Fresh mode)
     has_aggregated: bool = True,  # static: chunk contains Aggregated bindings
+    wide: bool = True,  # static: int64 accumulation (False = proven-int32)
+    fast: tuple | None = None,  # static (w_bits, l_bits, k_top, div_f32):
+    # packed-key top_k dispense for host-proven small ranges (see
+    # take_by_weight_fast); requires wide=False bounds to hold a fortiori
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    acc = jnp.int64 if wide else jnp.int32
     c = candidates.shape[0]
     prev_cand = jnp.where(candidates, prev, 0)  # buildScheduledClusters
     assigned = jnp.sum(prev_cand)
@@ -117,7 +129,7 @@ def _divide_one(
 
     # availability check precedes division (division_algorithm.go:76-78)
     unschedulable = is_dynamic & ~steady_noop & (
-        jnp.sum(w_dyn.astype(jnp.int64)) < target_dyn.astype(jnp.int64)
+        jnp.sum(w_dyn.astype(acc)) < target_dyn.astype(acc)
     )
 
     # aggregated prefix restriction; prior only exists in steady scale-up.
@@ -125,7 +137,7 @@ def _divide_one(
     # Aggregated bindings — one of the two kernel sorts disappears.
     if has_aggregated:
         is_prev_mask = (prev_cand > 0) & scale_up
-        keep = _aggregated_prefix_mask(w_dyn, is_prev_mask, target_dyn)
+        keep = _aggregated_prefix_mask(w_dyn, is_prev_mask, target_dyn, wide)
         w_dyn = jnp.where(
             (strategy == AGGREGATED) & keep | (strategy != AGGREGATED), w_dyn, 0
         )
@@ -143,7 +155,10 @@ def _divide_one(
     init = jnp.where(is_static, 0, init_dyn)
     w = jnp.where(is_dup | steady_noop | unschedulable, 0, w)  # no dispense
 
-    out = take_by_weight(num, w, last, init)
+    if fast is not None:
+        out = take_by_weight_fast(num, w, last, init, *fast)
+    else:
+        out = take_by_weight(num, w, last, init, wide)
 
     out = jnp.where(steady_noop, prev_cand, out)
     out = jnp.where(is_dup, jnp.where(candidates, replicas, 0), out)
@@ -153,12 +168,28 @@ def _divide_one(
     return out, unschedulable
 
 
-_divide_batch = jax.vmap(
-    _divide_one, in_axes=(0, 0, 0, 0, 0, 0, 0, None)
-)
+_batch_variants: dict = {}
 
 
-@partial(jax.jit, static_argnames=("has_aggregated",))
+def _divide_batch(
+    strategy, replicas, candidates, static_w, avail, prev, fresh,
+    has_aggregated=True, wide=True, fast=None,
+):
+    key = (has_aggregated, wide, fast)
+    fn = _batch_variants.get(key)
+    if fn is None:
+        fn = jax.vmap(
+            partial(
+                _divide_one,
+                has_aggregated=has_aggregated, wide=wide, fast=fast,
+            ),
+            in_axes=(0, 0, 0, 0, 0, 0, 0),
+        )
+        _batch_variants[key] = fn
+    return fn(strategy, replicas, candidates, static_w, avail, prev, fresh)
+
+
+@partial(jax.jit, static_argnames=("has_aggregated", "wide", "fast"))
 def divide_replicas(
     strategy: jnp.ndarray,  # int32[B]
     replicas: jnp.ndarray,  # int32[B]
@@ -168,12 +199,21 @@ def divide_replicas(
     prev: jnp.ndarray,  # int32[B, C]
     fresh: jnp.ndarray,  # bool[B]
     has_aggregated: bool = True,
+    wide: bool = True,
+    fast: tuple | None = None,
 ) -> DivideResult:
-    """Batched AssignReplicas over a binding chunk. Pass
-    ``has_aggregated=False`` (static) when the chunk is known to contain no
-    Aggregated-strategy bindings to skip the prefix sort."""
+    """Batched AssignReplicas over a binding chunk. Static specializations
+    the packing layer selects from host-known bounds:
+    - ``has_aggregated=False`` when the chunk has no Aggregated bindings —
+      skips the prefix sort entirely;
+    - ``wide=False`` when weight x replica products and availability sums
+      provably fit int32 (halves the integer-math cost);
+    - ``fast=(w_bits, l_bits, k_top, div_f32)`` when weights/lastReplicas
+      fit a packed 31-bit key and k_top >= min(max replicas, C) — replaces
+      the dispense sort with a packed-key top_k and (div_f32) the integer
+      floor-div with an exact f32 reciprocal (~10x cheaper dispense)."""
     out, unsched = _divide_batch(
         strategy, replicas, candidates, static_w, avail, prev, fresh,
-        has_aggregated,
+        has_aggregated, wide, fast,
     )
     return DivideResult(assignment=out, unschedulable=unsched)

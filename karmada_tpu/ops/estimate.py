@@ -38,6 +38,55 @@ def general_estimate(
     return jnp.minimum(best, jnp.int64(2**31 - 1)).astype(jnp.int32)
 
 
+def gather_profile_rows(
+    table: jnp.ndarray,  # int32[U, C]
+    idx: jnp.ndarray,  # int32[B]
+) -> jnp.ndarray:
+    """int32[B, C] = table[idx], expressed as a one-hot matmul.
+
+    A direct row gather with a [B]-sized index vector hangs XLA compilation
+    inside lax.scan on the tunneled TPU backend, and a gather is a bad fit
+    for the hardware anyway; one_hot(idx) @ table rides the MXU instead.
+    The 16-bit split below keeps the selection exact for EVERY int32 value
+    (sentinels included) — each half fits f32's mantissa and a one-hot row
+    selects a single entry, so there is no accumulation error."""
+    u = table.shape[0]
+    onehot = jax.nn.one_hot(idx, u, dtype=jnp.float32)  # [B, U]
+    # 16-bit split keeps every int32 exact in f32 (each half < 2^16 and the
+    # one-hot rows select a single entry, so no accumulation error); the
+    # arithmetic shift keeps negative sentinels (-1 no-answer) intact
+    lo = (table & 0xFFFF).astype(jnp.float32)
+    hi = (table >> 16).astype(jnp.float32)
+    # HIGHEST precision: the TPU MXU's default bf16 passes would round the
+    # 16-bit halves (8-bit mantissa); full-f32 passes keep them exact
+    lo_g = jnp.einsum(
+        "bu,uc->bc", onehot, lo, precision=jax.lax.Precision.HIGHEST
+    ).astype(jnp.int32)
+    hi_g = jnp.einsum(
+        "bu,uc->bc", onehot, hi, precision=jax.lax.Precision.HIGHEST
+    ).astype(jnp.int32)
+    return (hi_g << 16) | lo_g
+
+
+@jax.jit
+def general_estimate_interned(
+    available_cap: jnp.ndarray,  # int64[C, R]
+    profiles: jnp.ndarray,  # int64[U, R]: unique request rows
+    prof_idx: jnp.ndarray,  # int32[B]: row i uses profiles[prof_idx[i]]
+) -> jnp.ndarray:
+    """int32[B, C] — ``general_estimate`` with request-profile interning.
+
+    Real fleets carry few unique ReplicaRequirements (a handful of resource
+    T-shirt sizes), so the [B, C, R] integer divisions collapse to [U, C]
+    followed by a row gather: the estimator cost becomes O(U x C) instead of
+    O(B x C), the single biggest win for the 100k-binding hot path. The
+    packing layer produces (profiles, prof_idx) via np.unique over request
+    rows — exact, no semantic change (general.go:156-196 per-row math is
+    unchanged)."""
+    per_profile = general_estimate(available_cap, profiles)  # [U, C]
+    return gather_profile_rows(per_profile, prof_idx)
+
+
 @jax.jit
 def merge_estimates(
     replicas: jnp.ndarray,  # int32[B]

@@ -1,3 +1,8 @@
 """Device-mesh sharding of the scheduling solver."""
 
-from .solver import default_mesh, make_sharded_step, schedule_step  # noqa: F401
+from .solver import (  # noqa: F401
+    default_mesh,
+    make_sharded_step,
+    schedule_step,
+    schedule_step_interned,
+)

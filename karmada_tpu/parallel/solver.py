@@ -26,7 +26,26 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.divide import DivideResult, _divide_batch
-from ..ops.estimate import general_estimate, merge_estimates
+from ..ops.estimate import (
+    general_estimate,
+    general_estimate_interned,
+    merge_estimates,
+)
+
+
+def _merge_and_divide(
+    general, has_summary, strategy, replicas, candidates, static_w, prev,
+    fresh, has_aggregated, wide, fast,
+) -> DivideResult:
+    """Shared tail of both step variants: sentinel masking, estimator
+    min-merge, unified division."""
+    general = jnp.where(has_summary[None, :], general, jnp.int32(-1))
+    avail = merge_estimates(replicas, (general,))
+    out, unsched = _divide_batch(
+        strategy, replicas, candidates, static_w, avail, prev, fresh,
+        has_aggregated, wide, fast,
+    )
+    return DivideResult(assignment=out, unschedulable=unsched)
 
 
 def _schedule_step(
@@ -40,18 +59,49 @@ def _schedule_step(
     prev: jnp.ndarray,  # int32[B, C]
     fresh: jnp.ndarray,  # bool[B]
     has_aggregated: bool = True,
+    wide: bool = True,
+    fast: tuple | None = None,
 ) -> DivideResult:
     general = general_estimate(available_cap, requests)
-    general = jnp.where(has_summary[None, :], general, jnp.int32(-1))
-    avail = merge_estimates(replicas, (general,))
-    out, unsched = _divide_batch(
-        strategy, replicas, candidates, static_w, avail, prev, fresh,
-        has_aggregated,
+    return _merge_and_divide(
+        general, has_summary, strategy, replicas, candidates, static_w,
+        prev, fresh, has_aggregated, wide, fast,
     )
-    return DivideResult(assignment=out, unschedulable=unsched)
 
 
-schedule_step = jax.jit(_schedule_step, static_argnames=("has_aggregated",))
+schedule_step = jax.jit(
+    _schedule_step, static_argnames=("has_aggregated", "wide", "fast")
+)
+
+
+def _schedule_step_interned(
+    available_cap: jnp.ndarray,  # int64[C, R] cluster capacity
+    has_summary: jnp.ndarray,  # bool[C]
+    profiles: jnp.ndarray,  # int64[U, R] unique request rows
+    prof_idx: jnp.ndarray,  # int32[B]
+    strategy: jnp.ndarray,  # int32[B]
+    replicas: jnp.ndarray,  # int32[B]
+    candidates: jnp.ndarray,  # bool[B, C]
+    static_w: jnp.ndarray,  # int32[B, C]
+    prev: jnp.ndarray,  # int32[B, C]
+    fresh: jnp.ndarray,  # bool[B]
+    has_aggregated: bool = True,
+    wide: bool = True,
+    fast: tuple | None = None,
+) -> DivideResult:
+    """``schedule_step`` with request-profile interning: the estimator runs
+    per unique profile ([U, C] divisions) and the per-binding matrix is a
+    one-hot-matmul gather — see ``ops.estimate.general_estimate_interned``."""
+    general = general_estimate_interned(available_cap, profiles, prof_idx)
+    return _merge_and_divide(
+        general, has_summary, strategy, replicas, candidates, static_w,
+        prev, fresh, has_aggregated, wide, fast,
+    )
+
+
+schedule_step_interned = jax.jit(
+    _schedule_step_interned, static_argnames=("has_aggregated", "wide", "fast")
+)
 
 
 def make_sharded_step(mesh: Mesh, *, shard_clusters: bool = False):
@@ -84,7 +134,7 @@ def make_sharded_step(mesh: Mesh, *, shard_clusters: bool = False):
         _schedule_step,
         in_shardings=in_shardings,
         out_shardings=out_shardings,
-        static_argnames=("has_aggregated",),
+        static_argnames=("has_aggregated", "wide", "fast"),
     )
 
 

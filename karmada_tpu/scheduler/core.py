@@ -272,9 +272,15 @@ class TensorScheduler:
 
     def _availability(self, requests: np.ndarray, replicas: np.ndarray) -> jnp.ndarray:
         """calAvailableReplicas (core/util.go:54-104): min-merge over
-        registered estimators, sentinel clamped to spec.Replicas."""
+        registered estimators, sentinel clamped to spec.Replicas.
+
+        Request rows are interned host-side (np.unique): the general/model
+        estimators run per unique profile ([U, C]) and per-binding rows are a
+        gather — fleets carry few unique ReplicaRequirements, so this removes
+        the O(B x C x R) division hot loop."""
         snap = self.snapshot
-        req = jnp.asarray(requests)
+        profiles_np, prof_inv = np.unique(requests, axis=0, return_inverse=True)
+        req = jnp.asarray(profiles_np)
         reps = jnp.asarray(replicas)
         general = general_estimate(jnp.asarray(snap.available_cap), req)
         mp = snap.model_pack
@@ -308,9 +314,11 @@ class TensorScheduler:
         general = jnp.where(
             jnp.asarray(snap.has_summary)[None, :], general, jnp.int32(-1)
         )
-        estimates = [general]
+        # profile -> binding gather ([U, C] -> [B, C])
+        estimates = [general[jnp.asarray(prof_inv.astype(np.int32))]]
         for est in self.extra_estimators:
-            estimates.append(jnp.asarray(est(req, reps)))
+            # out-of-tree estimators see the full per-binding requests
+            estimates.append(jnp.asarray(est(jnp.asarray(requests), reps)))
         return merge_estimates(reps, tuple(estimates))
 
     def _schedule_chunk(
@@ -366,6 +374,40 @@ class TensorScheduler:
     def _assign(self, strategy, replicas, candidates, static_w, avail, prev, fresh):
         from ..ops.divide import AGGREGATED
 
+        # int32 fast path when every weight x target product and per-row
+        # weight sum provably fits 31 bits (weights can be avail, prev, the
+        # fresh-mode avail+prev sum, or static weights; targets <= replicas)
+        max_w = 2 * max(
+            int(jnp.max(avail)) if avail.size else 0,
+            int(static_w.max(initial=0)),
+            int(prev.max(initial=0)),
+            1,
+        )
+        max_n = int(replicas.max(initial=0))
+        c = candidates.shape[1] if candidates.ndim == 2 else 1
+        narrow = max_w * max(max_n, 1) < 2**31 and max_w * c < 2**31
+        # packed-key top_k dispense (take_by_weight_fast) when the key fits
+        # 31 bits and the remainder rank is small; k_top is rounded to a
+        # power of two so jit traces are reused across chunks
+        fast = None
+        if narrow:
+            w_bits = max(1, max_w.bit_length())
+            l_bits = max(1, int(prev.max(initial=0)).bit_length())
+            i_bits = max(1, (c - 1).bit_length())
+            k_top = min(c, 1 << max(1, max(1, max_n) - 1).bit_length())
+            div_f32 = max_w * max(max_n, 1) < 2**24 and max_n < 2**22
+            if w_bits + l_bits + i_bits <= 31 and k_top <= 1024:
+                # canonicalize the bit split so the static tuple (and hence
+                # the jit trace) does not churn as data maxima drift across
+                # power-of-two boundaries: l_bits snaps to a tier and w
+                # takes the whole remaining budget (containment only needs
+                # >=). One trace per (l tier, i_bits, k_top, div_f32).
+                for l_tier in (4, 8, 12, 16):
+                    if l_bits <= l_tier and w_bits <= 31 - i_bits - l_tier:
+                        l_bits = l_tier
+                        w_bits = 31 - i_bits - l_tier
+                        break
+                fast = (w_bits, l_bits, k_top, div_f32)
         return divide_replicas(
             jnp.asarray(strategy),
             jnp.asarray(replicas),
@@ -375,6 +417,8 @@ class TensorScheduler:
             jnp.asarray(prev),
             jnp.asarray(fresh),
             has_aggregated=bool((strategy == AGGREGATED).any()),
+            wide=not narrow,
+            fast=fast,
         )
 
     def _unpack(

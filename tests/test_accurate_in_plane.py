@@ -45,3 +45,48 @@ def test_node_capacity_bounds_schedule():
     placed = {tc.name: tc.replicas for tc in rb.spec.clusters}
     assert sum(placed.values()) == 10
     assert placed.get("member1", 0) <= 2  # node-level cap, not the summary
+
+
+def test_unjoin_repoints_estimator_fanout():
+    """unjoin must rebuild the scheduler's batch-estimator fan-out: a stale
+    one keeps the old cluster-column layout and breaks the estimator
+    min-merge shape on the next reconcile (found via addons enable +
+    unjoin)."""
+    cp = ControlPlane(enable_accurate_estimator=True)
+    for i in (1, 2, 3):
+        m = cp.join_cluster(new_cluster(f"member{i}", cpu="64", memory="256Gi"))
+        m.nodes = [
+            NodeState(
+                name="n0",
+                allocatable=parse_resource_list(
+                    {"cpu": "32", "memory": "128Gi", "pods": 50}
+                ),
+            )
+        ]
+    cp.settle()
+    cp.store.apply(new_deployment("app", replicas=6, cpu="1", memory="1Gi"))
+    cp.store.apply(
+        PropagationPolicy(
+            meta=ObjectMeta(name="p", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=dynamic_weight_placement(),
+            ),
+        )
+    )
+    cp.settle()
+    cp.unjoin_cluster("member2")
+    cp.settle()  # must not crash on a stale 3-column estimator
+    # Divided bindings do not auto-move on cluster removal (faithful to
+    # doScheduleBinding's gate); an explicit reschedule trigger must now
+    # succeed against the 2-column fan-out and drop member2
+    rb = next(iter(cp.store.list("ResourceBinding")))
+    rb.spec.reschedule_triggered_at = cp.clock() + 1
+    cp.store.apply(rb)
+    cp.settle()
+    rb = next(iter(cp.store.list("ResourceBinding")))
+    names = {tc.name for tc in rb.spec.clusters}
+    assert "member2" not in names and rb.spec.clusters
+    assert sum(tc.replicas for tc in rb.spec.clusters) == 6

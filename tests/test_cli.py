@@ -116,5 +116,11 @@ class TestAddons:
         state = cli.cmd_addons(cp, enable=["karmada-descheduler"])
         assert state["karmada-descheduler"] == "enabled"
         assert cp.descheduler is not None
+        first = cp.descheduler
         cli.cmd_addons(cp, disable=["karmada-descheduler"])
-        assert cp.descheduler is None
+        # the ticker registration is permanent, so disable deactivates in
+        # place (a None'd-out instance would keep ticking forever)
+        assert cp.descheduler is first and not cp.descheduler.active
+        cli.cmd_addons(cp, enable=["karmada-descheduler"])
+        # re-enable must reuse the registered instance, not double-register
+        assert cp.descheduler is first and cp.descheduler.active

@@ -19,7 +19,12 @@ from karmada_tpu.ops import (
 )
 
 
-def kernel_solve(problems: list[R.DivisionProblem], num_clusters: int):
+def kernel_solve(
+    problems: list[R.DivisionProblem],
+    num_clusters: int,
+    wide: bool = True,
+    fast: tuple | None = None,
+):
     """Pack oracle problems into dense arrays and run the batch kernel."""
     b = len(problems)
     c = num_clusters
@@ -44,7 +49,7 @@ def kernel_solve(problems: list[R.DivisionProblem], num_clusters: int):
     res = divide_replicas(
         jnp.asarray(strategy), jnp.asarray(replicas), jnp.asarray(cand),
         jnp.asarray(static_w), jnp.asarray(avail), jnp.asarray(prev),
-        jnp.asarray(fresh),
+        jnp.asarray(fresh), wide=wide, fast=fast,
     )
     return np.asarray(res.assignment), np.asarray(res.unschedulable)
 
@@ -93,6 +98,22 @@ class TestKernelOracleEquivalence:
         np.testing.assert_array_equal(got_unsched, want_unsched)
         np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_batches_narrow_fast(self, seed):
+        """The int32 fast path (wide=False) and the packed-key top_k
+        dispense (fast=...) must stay placement-identical under the bounds
+        the packing layer gates on: weights <= 40 (6b incl. fresh sums),
+        prev <= 14 (4b), c <= 12 (4b), replicas <= 39 -> k_top covers
+        min(max replicas, c)."""
+        rng = np.random.default_rng(1000 + seed)
+        c = int(rng.integers(2, 12))
+        problems = [random_problem(rng, c) for _ in range(64)]
+        want, want_unsched = oracle_solve(problems, c)
+        for fast in (None, (6, 4, c, True), (6, 4, c, False)):
+            got, got_unsched = kernel_solve(problems, c, wide=False, fast=fast)
+            np.testing.assert_array_equal(got_unsched, want_unsched)
+            np.testing.assert_array_equal(got, want)
+
     def test_large_values_no_overflow(self):
         # weight * replicas products beyond int32: 2e6 avail, 30k replicas
         p = R.DivisionProblem(
@@ -129,6 +150,33 @@ class TestDispenseBatch:
             np.testing.assert_array_equal(
                 got[i], [want.get(j, 0) for j in range(c)]
             )
+
+
+class TestProfileInterning:
+    def test_gather_matches_direct_indexing(self):
+        from karmada_tpu.ops.estimate import gather_profile_rows
+
+        rng = np.random.default_rng(3)
+        # include sentinel-like extremes: the 16-bit matmul split must keep
+        # every int32 exact (MAX_INT32, -1 no-answer, zeros)
+        table = rng.integers(0, 2**31 - 1, size=(6, 37), dtype=np.int32)
+        table[0, :3] = [2**31 - 1, -1, 0]
+        idx = rng.integers(0, 6, size=50).astype(np.int32)
+        got = np.asarray(gather_profile_rows(jnp.asarray(table), jnp.asarray(idx)))
+        np.testing.assert_array_equal(got, table[idx])
+
+    def test_interned_equals_plain_estimate(self):
+        from karmada_tpu.ops.estimate import general_estimate_interned
+
+        rng = np.random.default_rng(4)
+        cap = jnp.asarray(rng.integers(0, 1 << 40, size=(13, 4)), jnp.int64)
+        profiles = jnp.asarray(
+            rng.integers(1, 1 << 30, size=(5, 4)), jnp.int64
+        )
+        prof_idx = jnp.asarray(rng.integers(0, 5, size=29), jnp.int32)
+        got = np.asarray(general_estimate_interned(cap, profiles, prof_idx))
+        want = np.asarray(general_estimate(cap, profiles[prof_idx]))
+        np.testing.assert_array_equal(got, want)
 
 
 class TestEstimate:

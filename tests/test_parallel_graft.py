@@ -48,3 +48,24 @@ class TestShardedStep:
         np.testing.assert_array_equal(
             np.asarray(a.unschedulable), np.asarray(b.unschedulable)
         )
+
+    def test_interned_step_matches_plain(self):
+        import jax.numpy as jnp
+        from karmada_tpu.parallel import schedule_step, schedule_step_interned
+        import __graft_entry__ as g
+
+        args = g._example_args(b=64, c=32)
+        (available_cap, has_summary, requests), rest = args[:3], args[3:]
+        profiles, inv = np.unique(np.asarray(requests), axis=0,
+                                  return_inverse=True)
+        plain = schedule_step(*args)
+        interned = schedule_step_interned(
+            available_cap, has_summary, jnp.asarray(profiles),
+            jnp.asarray(inv.astype(np.int32)), *rest,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.assignment), np.asarray(interned.assignment)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain.unschedulable), np.asarray(interned.unschedulable)
+        )
