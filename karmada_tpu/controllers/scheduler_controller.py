@@ -30,10 +30,17 @@ class SchedulerController:
         runtime: Runtime,
         scheduler_name: str = DEFAULT_SCHEDULER,
         extra_estimators=(),
+        disabled_plugins=(),
+        custom_filters=(),
     ) -> None:
         self.store = store
         self.scheduler_name = scheduler_name
         self.extra_estimators = list(extra_estimators)
+        # --plugins enable/disable list + out-of-tree filter registry
+        # (scheduler.go:243-247, framework/runtime/registry.go); both reach
+        # the engine on every (re)build so flags apply live
+        self.disabled_plugins = tuple(disabled_plugins)
+        self.custom_filters = list(custom_filters)
         self._snapshot: Optional[ClusterSnapshot] = None
         self._engine: Optional[TensorScheduler] = None
         self.worker = runtime.new_worker("scheduler", self._reconcile)
@@ -66,7 +73,10 @@ class SchedulerController:
             clusters = sorted(self.store.list("Cluster"), key=lambda c: c.name)
             self._snapshot = ClusterSnapshot(clusters)
             self._engine = TensorScheduler(
-                self._snapshot, extra_estimators=self.extra_estimators
+                self._snapshot,
+                extra_estimators=self.extra_estimators,
+                disabled_plugins=self.disabled_plugins,
+                custom_filters=self.custom_filters,
             )
         return self._engine
 

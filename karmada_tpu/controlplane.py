@@ -52,6 +52,10 @@ class ControlPlane:
         enable_member_hpa_sync: bool = False,
         eviction_timeout: float = 600.0,
         clock=None,
+        # --plugins enable/disable list + out-of-tree filter plugins
+        # (cmd/scheduler/app/options/options.go:130-165 analogue)
+        disabled_scheduler_plugins=(),
+        scheduler_filter_plugins=(),
     ) -> None:
         import time as _time
 
@@ -103,6 +107,8 @@ class ControlPlane:
             self.store,
             self.runtime,
             extra_estimators=extra,
+            disabled_plugins=disabled_scheduler_plugins,
+            custom_filters=scheduler_filter_plugins,
         )
         self.descheduler = (
             Descheduler(self.store, self.runtime, self.members, clock=self.clock)

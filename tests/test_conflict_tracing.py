@@ -101,3 +101,57 @@ class TestPluginToggles:
         [r2] = lenient.schedule([problem])
         assert set(r1.clusters) == {"ok"}
         assert set(r2.clusters) == {"ok", "tainted"}
+
+
+class TestPluginFlagsPlumbing:
+    """--plugins enable/disable + out-of-tree filters reach the engine from
+    the control-plane constructor (options.go:130-165 analogue)."""
+
+    def _plane(self, **kw):
+        from karmada_tpu.api import (
+            PropagationPolicy, PropagationSpec, ResourceSelector)
+        from karmada_tpu.api.core import ObjectMeta
+        from karmada_tpu.api.cluster import Taint
+        from karmada_tpu.controlplane import ControlPlane
+        from karmada_tpu.utils.builders import (
+            dynamic_weight_placement, new_cluster, new_deployment)
+
+        cp = ControlPlane(**kw)
+        cp.join_cluster(new_cluster("plain"))
+        cp.join_cluster(new_cluster(
+            "salty", taints=[Taint(key="dedicated", effect="NoSchedule")]))
+        cp.settle()
+        cp.store.apply(new_deployment("app", replicas=4, cpu="100m"))
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="p", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[ResourceSelector(
+                    api_version="apps/v1", kind="Deployment")],
+                placement=dynamic_weight_placement(),
+            )))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        return {tc.name for tc in rb.spec.clusters}
+
+    def test_default_filters_tainted_cluster(self):
+        assert self._plane() == {"plain"}
+
+    def test_disable_taint_toleration_flag(self):
+        names = self._plane(disabled_scheduler_plugins=["TaintToleration"])
+        assert names == {"plain", "salty"}
+
+    def test_out_of_tree_filter_plugin(self):
+        import numpy as np
+
+        def no_salty(snap, problems):
+            mask = np.ones((len(problems), snap.num_clusters), bool)
+            for j, name in enumerate(snap.names):
+                if name == "plain":
+                    mask[:, j] = False
+            return mask
+
+        names = self._plane(
+            disabled_scheduler_plugins=["TaintToleration"],
+            scheduler_filter_plugins=[no_salty],
+        )
+        assert names == {"salty"}
