@@ -358,9 +358,11 @@ class TensorScheduler:
         from .spread import select_clusters_batch  # local import (cycle-free)
 
         with algo_timer.time(schedule_step="Select"):
+            # avail stays on device unless a row carries spread constraints
+            # (select pulls it lazily) — a constraint-free chunk does zero
+            # device->host traffic between estimate and assign
             candidates = select_clusters_batch(
-                snap, problems, compiled, term_round, feasible,
-                np.asarray(avail), prev,
+                snap, problems, compiled, term_round, feasible, avail, prev,
             )
 
         with algo_timer.time(schedule_step="AssignReplicas"):

@@ -120,7 +120,8 @@ def select_clusters_batch(
     compiled: Sequence[CompiledPlacement],
     term_round: int,
     feasible: np.ndarray,  # bool[B, C]
-    avail: np.ndarray,  # int32[B, C] estimator availability
+    avail,  # int32[B, C] estimator availability (numpy OR device array —
+    # only pulled to host when a row actually carries spread constraints)
     prev: np.ndarray,  # int32[B, C]
 ) -> np.ndarray:
     """SelectClusters stage over a chunk. Returns candidates bool[B, C]."""
@@ -135,11 +136,18 @@ def select_clusters_batch(
     if not rows_with_constraints:
         return out
 
+    avail = np.asarray(avail)
     score = np.where(prev > 0, LOCALITY_SCORE, 0)
     credited = avail.astype(np.int64) + prev.astype(np.int64)
 
     from .groups import select_by_topology_groups  # host group search
 
+    # the host group search is pure in (placement, need, replicas, and the
+    # row's score/credited/feasible vectors); fleets schedule many bindings
+    # that share all of those (same policy, same requests), so memoizing by
+    # row content collapses the per-binding DFS to one per distinct input —
+    # the "batch the binding axis" plan applied to the host stage
+    memo: dict = {}
     for i in rows_with_constraints:
         cp = compiled[i]
         pl = cp.placement
@@ -149,22 +157,29 @@ def select_clusters_batch(
             if should_ignore_available_resource(pl)
             else problems[i].replicas
         )
-        by_field = {sc.spread_by_field: sc for sc in cp.spread_constraints}
-        order = cluster_order(score[i], credited[i], feasible[i])
-        if "region" in by_field or "provider" in by_field or "zone" in by_field:
-            sel = select_by_topology_groups(
-                snap, by_field, order, score[i], credited[i], need,
-                duplicated=need == INVALID_REPLICAS,
-                replicas=problems[i].replicas,
-            )
-        elif "cluster" in by_field:
-            sel = select_by_cluster_constraint(
-                by_field["cluster"], order, credited[i], need
-            )
-        else:
-            sel = order  # label-based spread not yet grouped; keep feasible
-        row = np.zeros(snap.num_clusters, bool)
-        if sel is not None and sel.size > 0:
-            row[sel] = True
+        key = (
+            id(cp), need, problems[i].replicas,
+            score[i].tobytes(), credited[i].tobytes(), feasible[i].tobytes(),
+        )
+        row = memo.get(key)
+        if row is None:
+            by_field = {sc.spread_by_field: sc for sc in cp.spread_constraints}
+            order = cluster_order(score[i], credited[i], feasible[i])
+            if "region" in by_field or "provider" in by_field or "zone" in by_field:
+                sel = select_by_topology_groups(
+                    snap, by_field, order, score[i], credited[i], need,
+                    duplicated=need == INVALID_REPLICAS,
+                    replicas=problems[i].replicas,
+                )
+            elif "cluster" in by_field:
+                sel = select_by_cluster_constraint(
+                    by_field["cluster"], order, credited[i], need
+                )
+            else:
+                sel = order  # label-based spread not yet grouped; keep feasible
+            row = np.zeros(snap.num_clusters, bool)
+            if sel is not None and sel.size > 0:
+                row[sel] = True
+            memo[key] = row
         out[i] = row
     return out
