@@ -213,6 +213,32 @@ def main():
     i_bits = max(1, (c - 1).bit_length())
     fast = (12, 5, min(c, 128), True) if 12 + 5 + i_bits <= 31 else None
 
+    # ---- device mesh: shard the binding axis over every visible chip ------
+    # (the north-star target is v5e-8; on one chip this is a no-op, on a
+    # multi-chip slice GSPMD partitions generation + solve row-parallel with
+    # zero collectives — bindings are independent). Validated on the virtual
+    # 8-device CPU mesh by tests/test_parallel_graft.py.
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    mesh = None
+    if len(devs) > 1 and chunk % len(devs) == 0:
+        mesh = Mesh(np.array(devs), ("b",))
+        print(f"# mesh: {len(devs)} devices over the binding axis",
+              file=sys.stderr)
+
+    def shard_rows(*arrays):
+        """with_sharding_constraint over the leading (binding) axis."""
+        if mesh is None:
+            return arrays
+        out = []
+        for a in arrays:
+            spec = P("b", *([None] * (a.ndim - 1)))
+            out.append(
+                jax.lax.with_sharding_constraint(a, NamedSharding(mesh, spec))
+            )
+        return tuple(out)
+
     # NOTE: the fleet arrays (per_profile, tainted) are threaded through as
     # jit ARGUMENTS everywhere below — large captured device constants
     # inside a lax.scan body hang XLA compilation on the tunneled backend
@@ -237,7 +263,9 @@ def main():
         fresh = jax.random.uniform(k7, (chunk,)) < 0.05
         strategy = jnp.full((chunk,), 2, jnp.int32)  # DynamicWeight
         static_w = jnp.zeros((chunk, c), jnp.int32)
-        return prof_idx, strategy, replicas, candidates, static_w, prev, fresh
+        return shard_rows(
+            prof_idx, strategy, replicas, candidates, static_w, prev, fresh
+        )
 
     per_profile = general_estimate(available_cap, profiles)  # [8, C]
 

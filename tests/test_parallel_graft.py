@@ -69,3 +69,32 @@ class TestShardedStep:
         np.testing.assert_array_equal(
             np.asarray(plain.unschedulable), np.asarray(interned.unschedulable)
         )
+
+
+class TestBenchShardedStorm:
+    def test_config5_shards_on_virtual_mesh(self, tmp_path):
+        """bench.py config 5 must run sharded over the 8-device virtual CPU
+        mesh with identical placements (the v5e-8 deployment shape)."""
+        import os
+        import json
+        import subprocess
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        proc = subprocess.run(
+            [
+                sys.executable, "/root/repo/bench.py", "--cpu",
+                "--bindings", "512", "--chunk", "256", "--clusters", "64",
+                "--repeats", "1", "--sample", "48",
+            ],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "# mesh: 8 devices over the binding axis" in proc.stderr
+        assert "identical-placement check: 48/48 match" in proc.stderr
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result["unit"] == "s" and result["value"] > 0
