@@ -206,6 +206,9 @@ class PropagationSpec:
     failover: Optional["FailoverBehavior"] = None
     # scheduler to use; default scheduler name mirrors the reference default
     scheduler_name: str = "default-scheduler"
+    # "" (immediate) | "Lazy": policy changes defer until the resource
+    # template itself changes (propagation_types.go:159-178,653-660)
+    activation_preference: str = ""
 
 
 @dataclass

@@ -61,6 +61,10 @@ class ResourceDetector:
         self.store = store
         self.interpreter = interpreter
         self.worker = runtime.new_worker("detector", self._reconcile)
+        # keys whose pending reconcile was triggered ONLY by Karmada itself
+        # (policy events), not by a user template change — consumed by the
+        # lazy-activation gate (detector.go:444,529 resourceChangeByKarmada)
+        self._by_karmada: set[str] = set()
         store.watch("Resource", self._on_template_event)
         store.watch("PropagationPolicy", self._on_policy_event)
         store.watch("ClusterPropagationPolicy", self._on_policy_event)
@@ -68,17 +72,21 @@ class ResourceDetector:
     # -- events ------------------------------------------------------------
 
     def _on_template_event(self, event) -> None:
+        self._by_karmada.discard(event.key)  # a user change always syncs
         self.worker.enqueue(event.key)
 
     def _on_policy_event(self, event) -> None:
         # policy changes re-evaluate every template (conservative requeue;
         # the reference scopes by selector — optimization left with a marker)
         for template in self.store.list("Resource"):
+            self._by_karmada.add(template.meta.namespaced_name)
             self.worker.enqueue(template.meta.namespaced_name)
 
     # -- reconcile ---------------------------------------------------------
 
     def _reconcile(self, key: str) -> Optional[str]:
+        by_karmada = key in self._by_karmada
+        self._by_karmada.discard(key)
         template = self.store.get("Resource", key)
         if template is None:
             self._remove_binding_for(key)
@@ -88,7 +96,7 @@ class ResourceDetector:
             self._unclaim(template)
             return DONE
         self._claim(template, policy)
-        self._ensure_binding(template, policy)
+        self._ensure_binding(template, policy, by_karmada)
         return DONE
 
     def _match_policy(self, template: Resource):
@@ -150,7 +158,7 @@ class ResourceDetector:
             self.store.apply(template)
             self._remove_binding_for(template.meta.namespaced_name)
 
-    def _ensure_binding(self, template: Resource, policy) -> None:
+    def _ensure_binding(self, template: Resource, policy, by_karmada: bool = False) -> None:
         """BuildResourceBinding (detector.go:710-752). Cluster-scoped
         templates produce ClusterResourceBindings."""
         replicas, requirements = self.interpreter.get_replicas(template)
@@ -160,6 +168,17 @@ class ResourceDetector:
         )
         kind = "ResourceBinding" if template.meta.namespace else "ClusterResourceBinding"
         existing = self.store.get(kind, key)
+        # Lazy activation (detector.go:444-450): a reconcile that Karmada
+        # itself triggered (policy change) must not refresh an existing
+        # binding when the bound policy defers activation — the new policy
+        # content lands only when the USER next updates the template. The
+        # claim above still records the new policy id.
+        if (
+            existing is not None
+            and by_karmada
+            and getattr(policy.spec, "activation_preference", "") == "Lazy"
+        ):
+            return
         spec = ResourceBindingSpec(
             resource=template.object_reference(),
             replicas=replicas,

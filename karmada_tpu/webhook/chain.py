@@ -185,6 +185,12 @@ def validate_placement(pl) -> None:
 def validate_propagation_policy(policy: PropagationPolicy) -> None:
     if not policy.spec.resource_selectors:
         raise ValidationError("resourceSelectors must not be empty")
+    # kubebuilder enum on ActivationPreference (propagation_types.go:176)
+    if getattr(policy.spec, "activation_preference", "") not in ("", "Lazy"):
+        raise ValidationError(
+            f"invalid activationPreference "
+            f"{policy.spec.activation_preference!r} (must be Lazy or empty)"
+        )
     validate_placement(policy.spec.placement)
     fo = policy.spec.failover
     if fo is not None and fo.application is not None:

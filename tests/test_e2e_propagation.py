@@ -276,3 +276,56 @@ class TestDescheduler:
         rb = cp.store.get("ResourceBinding", "default/batchy-deployment")
         after = {tc.name: tc.replicas for tc in rb.spec.clusters}
         assert sum(after.values()) == 8  # scale-up rehomed the reclaimed 2
+
+
+class TestLazyActivationPolicy:
+    """ActivationPreference=Lazy: policy changes defer until the user next
+    updates the template (lazy_activation_policy_test.go analogue;
+    detector.go:444-450)."""
+
+    def _lazy_policy(self, placement):
+        p = nginx_policy(placement, name="lazy-policy")
+        p.spec.activation_preference = "Lazy"
+        return p
+
+    def test_policy_change_defers_until_template_update(self):
+        cp = make_plane(3)
+        cp.store.apply(new_deployment("web", replicas=6))
+        cp.store.apply(self._lazy_policy(static_weight_placement(
+            {"member1": 1, "member2": 1})))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member1", "member2"}
+
+        # policy update alone must NOT re-sync the binding
+        cp.store.apply(self._lazy_policy(static_weight_placement(
+            {"member3": 1})))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member1", "member2"}
+
+        # ... but the next USER template change activates the new placement
+        cp.store.apply(new_deployment("web", replicas=6, image="nginx:2"))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member3"}
+
+    def test_immediate_policy_still_syncs_on_policy_change(self):
+        cp = make_plane(3)
+        cp.store.apply(new_deployment("web", replicas=6))
+        cp.store.apply(nginx_policy(static_weight_placement({"member1": 1})))
+        cp.settle()
+        cp.store.apply(nginx_policy(static_weight_placement({"member2": 1})))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member2"}
+
+    def test_webhook_rejects_bad_activation_preference(self):
+        import pytest
+        from karmada_tpu.webhook import ValidationError
+
+        cp = make_plane(1)
+        bad = nginx_policy(duplicated_placement())
+        bad.spec.activation_preference = "Eventually"
+        with pytest.raises(ValidationError):
+            cp.store.apply(bad)
