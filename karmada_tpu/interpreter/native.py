@@ -135,6 +135,125 @@ def _aggregate_status_sum(obj: Resource, items: list[AggregatedStatusItem]) -> R
     return out
 
 
+def _aggregate_lb_ingress(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
+    """Service(LoadBalancer)/Ingress: concatenate every member's
+    status.loadBalancer.ingress, defaulting hostname to the member name so
+    consumers can tell where each VIP came from
+    (native/aggregatestatus.go:123-205). Non-LoadBalancer Services keep
+    their status untouched."""
+    out = copy.deepcopy(obj)
+    if _gvk(obj) == "v1/Service" and (obj.spec or {}).get("type") != "LoadBalancer":
+        return out
+    merged = []
+    for item in items:
+        for ing in ((item.status or {}).get("loadBalancer") or {}).get("ingress", []) or []:
+            ing = dict(ing)
+            if not ing.get("hostname"):
+                ing["hostname"] = item.cluster_name
+            merged.append(ing)
+    out.status = {**(out.status or {}), "loadBalancer": {"ingress": merged}}
+    return out
+
+
+#: final-phase precedence (aggregatestatus.go:444-456): any Failed member
+#: fails the whole pod; missing status counts as Pending
+_POD_PHASE_ORDER = ("Failed", "Pending", "Running", "Succeeded")
+
+
+def _aggregate_pod(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
+    out = copy.deepcopy(obj)
+    phases = set()
+    containers: list[dict] = []
+    init_containers: list[dict] = []
+    for item in items:
+        st = item.status
+        if not st:
+            phases.add("Pending")
+            continue
+        phases.add(st.get("phase", "Pending"))
+        for cs in st.get("containerStatuses", []) or []:
+            containers.append({"ready": cs.get("ready", False),
+                              "state": cs.get("state", {})})
+        for cs in st.get("initContainerStatuses", []) or []:
+            init_containers.append({"ready": cs.get("ready", False),
+                                    "state": cs.get("state", {})})
+    phase = next((p for p in _POD_PHASE_ORDER if p in phases), "Pending")
+    out.status = {
+        "phase": phase,
+        "containerStatuses": containers,
+        "initContainerStatuses": init_containers,
+    }
+    return out
+
+
+def _aggregate_pvc(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
+    """Bound only when every member is Bound; any Lost member loses the
+    claim outright (aggregatestatus.go:521-557)."""
+    out = copy.deepcopy(obj)
+    phase = "Bound"
+    for item in items:
+        p = (item.status or {}).get("phase")
+        if p == "Lost":
+            phase = "Lost"
+            break
+        if p and p != "Bound":
+            phase = p
+    out.status = {**(out.status or {}), "phase": phase}
+    return out
+
+
+def _aggregate_pdb(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
+    """Sum healthy/expected/allowed counters; disruptedPods are namespaced
+    by member name to stay distinguishable (aggregatestatus.go:559-600)."""
+    out = copy.deepcopy(obj)
+    agg = {"currentHealthy": 0, "desiredHealthy": 0, "expectedPods": 0,
+           "disruptionsAllowed": 0}
+    disrupted: dict[str, Any] = {}
+    for item in items:
+        st = item.status or {}
+        for f in agg:
+            agg[f] += int(st.get(f, 0))
+        for pod_name, when in (st.get("disruptedPods") or {}).items():
+            disrupted[f"{item.cluster_name}/{pod_name}"] = when
+    out.status = {**(out.status or {}), **agg, "disruptedPods": disrupted}
+    return out
+
+
+def _aggregate_hpa(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
+    out = copy.deepcopy(obj)
+    agg = {"currentReplicas": 0, "desiredReplicas": 0}
+    for item in items:
+        st = item.status or {}
+        for f in agg:
+            agg[f] += int(st.get(f, 0))
+    out.status = {**(out.status or {}), **agg}
+    return out
+
+
+def _aggregate_cronjob(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
+    """Concatenate active job refs, keep the latest schedule/success times
+    (RFC3339 strings compare chronologically) — aggregatestatus.go:232-271."""
+    out = copy.deepcopy(obj)
+    active: list = []
+    last_schedule = None
+    last_success = None
+    for item in items:
+        st = item.status or {}
+        active.extend(st.get("active", []) or [])
+        for field, cur in (("lastScheduleTime", last_schedule),
+                           ("lastSuccessfulTime", last_success)):
+            val = st.get(field)
+            if val and (cur is None or val > cur):
+                if field == "lastScheduleTime":
+                    last_schedule = val
+                else:
+                    last_success = val
+    out.status = {**(out.status or {}), "active": active,
+                  "lastScheduleTime": last_schedule,
+                  "lastSuccessfulTime": last_success}
+    return out
+
+
 def _retain_default(desired: Resource, observed: Resource) -> Resource:
     """Keep member-side mutated fields the control plane must not stomp
     (native/retain.go): nodeName on pods, clusterIP on services, and
@@ -218,6 +337,23 @@ def register_native_interpreters(interp: ResourceInterpreter) -> None:
         interp.register_native(gvk, REVISE_REPLICA, _revise_replica)
         interp.register_native(gvk, AGGREGATE_STATUS, _aggregate_status_sum)
         interp.register_native(gvk, GET_DEPENDENCIES, _get_dependencies)
+    # per-kind status aggregators beyond the counter sums
+    # (native/aggregatestatus.go:123-645)
+    interp.register_native("v1/Service", AGGREGATE_STATUS, _aggregate_lb_ingress)
+    interp.register_native(
+        "networking.k8s.io/v1/Ingress", AGGREGATE_STATUS, _aggregate_lb_ingress
+    )
+    interp.register_native(POD, AGGREGATE_STATUS, _aggregate_pod)
+    interp.register_native(
+        "v1/PersistentVolumeClaim", AGGREGATE_STATUS, _aggregate_pvc
+    )
+    interp.register_native(
+        "policy/v1/PodDisruptionBudget", AGGREGATE_STATUS, _aggregate_pdb
+    )
+    interp.register_native(
+        "autoscaling/v2/HorizontalPodAutoscaler", AGGREGATE_STATUS, _aggregate_hpa
+    )
+    interp.register_native("batch/v1/CronJob", AGGREGATE_STATUS, _aggregate_cronjob)
     interp.register_native("*", REFLECT_STATUS, _reflect_status)
     interp.register_native("*", RETAIN, _retain_default)
     interp.register_native(DEPLOYMENT, INTERPRET_HEALTH, _deployment_health)

@@ -1,0 +1,147 @@
+"""Per-kind native status aggregation (resource_test.go analogue;
+native/aggregatestatus.go:123-645): Service/Ingress LB merge, Pod phase
+precedence, PVC phase, PDB counter sums, HPA sums, CronJob actives."""
+
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.api.work import AggregatedStatusItem
+from karmada_tpu.interpreter import ResourceInterpreter
+from karmada_tpu.interpreter.native import register_native_interpreters
+
+
+def make_interp() -> ResourceInterpreter:
+    interp = ResourceInterpreter()
+    register_native_interpreters(interp)
+    return interp
+
+
+def res(api_version, kind, spec=None, status=None):
+    return Resource(
+        api_version=api_version, kind=kind,
+        meta=ObjectMeta(name="x", namespace="default"),
+        spec=spec or {}, status=status or {},
+    )
+
+
+def item(cluster, status):
+    return AggregatedStatusItem(cluster_name=cluster, status=status, applied=True)
+
+
+class TestLoadBalancerMerge:
+    def test_service_lb_collects_vips_with_member_hostname(self):
+        interp = make_interp()
+        svc = res("v1", "Service", spec={"type": "LoadBalancer"})
+        out = interp.aggregate_status(svc, [
+            item("m1", {"loadBalancer": {"ingress": [{"ip": "10.0.0.1"}]}}),
+            item("m2", {"loadBalancer": {"ingress": [
+                {"ip": "10.0.0.2", "hostname": "lb.example.com"}]}}),
+        ])
+        ing = out.status["loadBalancer"]["ingress"]
+        assert ing == [
+            {"ip": "10.0.0.1", "hostname": "m1"},
+            {"ip": "10.0.0.2", "hostname": "lb.example.com"},
+        ]
+
+    def test_clusterip_service_untouched(self):
+        interp = make_interp()
+        svc = res("v1", "Service", spec={"type": "ClusterIP"},
+                  status={"x": 1})
+        out = interp.aggregate_status(svc, [item("m1", {"loadBalancer": {}})])
+        assert out.status == {"x": 1}
+
+    def test_ingress_merges_like_service(self):
+        interp = make_interp()
+        ing = res("networking.k8s.io/v1", "Ingress")
+        out = interp.aggregate_status(ing, [
+            item("m1", {"loadBalancer": {"ingress": [{"ip": "1.2.3.4"}]}}),
+        ])
+        assert out.status["loadBalancer"]["ingress"][0]["hostname"] == "m1"
+
+
+class TestPodAggregate:
+    def test_phase_precedence_failed_wins(self):
+        interp = make_interp()
+        pod = res("v1", "Pod")
+        out = interp.aggregate_status(pod, [
+            item("m1", {"phase": "Running"}),
+            item("m2", {"phase": "Failed"}),
+        ])
+        assert out.status["phase"] == "Failed"
+
+    def test_missing_status_counts_pending(self):
+        interp = make_interp()
+        pod = res("v1", "Pod")
+        out = interp.aggregate_status(pod, [
+            item("m1", {"phase": "Running"}),
+            item("m2", None),
+        ])
+        assert out.status["phase"] == "Pending"
+
+    def test_container_statuses_concatenate(self):
+        interp = make_interp()
+        pod = res("v1", "Pod")
+        out = interp.aggregate_status(pod, [
+            item("m1", {"phase": "Running", "containerStatuses": [
+                {"ready": True, "state": {"running": {}}, "noise": 1}]}),
+            item("m2", {"phase": "Running", "initContainerStatuses": [
+                {"ready": False, "state": {"waiting": {}}}]}),
+        ])
+        assert out.status["containerStatuses"] == [
+            {"ready": True, "state": {"running": {}}}]
+        assert out.status["initContainerStatuses"] == [
+            {"ready": False, "state": {"waiting": {}}}]
+
+
+class TestPvcPdbHpaCron:
+    def test_pvc_lost_wins(self):
+        interp = make_interp()
+        pvc = res("v1", "PersistentVolumeClaim")
+        out = interp.aggregate_status(pvc, [
+            item("m1", {"phase": "Bound"}), item("m2", {"phase": "Lost"}),
+        ])
+        assert out.status["phase"] == "Lost"
+
+    def test_pvc_pending_propagates(self):
+        interp = make_interp()
+        pvc = res("v1", "PersistentVolumeClaim")
+        out = interp.aggregate_status(pvc, [
+            item("m1", {"phase": "Bound"}), item("m2", {"phase": "Pending"}),
+        ])
+        assert out.status["phase"] == "Pending"
+
+    def test_pdb_sums_and_namespaces_disrupted_pods(self):
+        interp = make_interp()
+        pdb = res("policy/v1", "PodDisruptionBudget")
+        out = interp.aggregate_status(pdb, [
+            item("m1", {"currentHealthy": 2, "desiredHealthy": 2,
+                        "expectedPods": 3, "disruptionsAllowed": 1,
+                        "disruptedPods": {"p1": "t1"}}),
+            item("m2", {"currentHealthy": 1, "desiredHealthy": 2,
+                        "expectedPods": 3, "disruptionsAllowed": 0}),
+        ])
+        assert out.status["currentHealthy"] == 3
+        assert out.status["expectedPods"] == 6
+        assert out.status["disruptedPods"] == {"m1/p1": "t1"}
+
+    def test_hpa_sums_replicas(self):
+        interp = make_interp()
+        hpa = res("autoscaling/v2", "HorizontalPodAutoscaler")
+        out = interp.aggregate_status(hpa, [
+            item("m1", {"currentReplicas": 3, "desiredReplicas": 4}),
+            item("m2", {"currentReplicas": 2, "desiredReplicas": 2}),
+        ])
+        assert out.status["currentReplicas"] == 5
+        assert out.status["desiredReplicas"] == 6
+
+    def test_cronjob_actives_and_latest_times(self):
+        interp = make_interp()
+        cj = res("batch/v1", "CronJob")
+        out = interp.aggregate_status(cj, [
+            item("m1", {"active": [{"name": "j1"}],
+                        "lastScheduleTime": "2026-07-30T01:00:00Z"}),
+            item("m2", {"active": [{"name": "j2"}],
+                        "lastScheduleTime": "2026-07-30T02:00:00Z",
+                        "lastSuccessfulTime": "2026-07-30T01:30:00Z"}),
+        ])
+        assert [a["name"] for a in out.status["active"]] == ["j1", "j2"]
+        assert out.status["lastScheduleTime"] == "2026-07-30T02:00:00Z"
+        assert out.status["lastSuccessfulTime"] == "2026-07-30T01:30:00Z"
