@@ -216,6 +216,10 @@ def cmd_promote(
                 placement=Placement(
                     cluster_affinity=ClusterAffinity(cluster_names=[cluster_name])
                 ),
+                # seamless takeover: adopt the live member object instead of
+                # refusing on conflict (promote.go:738-798 sets Overwrite on
+                # both the policy and the resource annotation)
+                conflict_resolution="Overwrite",
             ),
         )
     )

@@ -124,3 +124,50 @@ class TestAddons:
         cli.cmd_addons(cp, enable=["karmada-descheduler"])
         # re-enable must reuse the registered instance, not double-register
         assert cp.descheduler is first and cp.descheduler.active
+
+
+class TestMigrationAndRollback:
+    """Seamless migration + rollback (migration_and_rollback_test.go):
+    promote adopts the live member object (Overwrite), and rolling the
+    migration back with PreserveResourcesOnDeletion leaves it running."""
+
+    def _migrated_plane(self):
+        cp = cli.cmd_local_up(2)
+        member = cp.members.get("member1")
+        member.apply(new_deployment("legacy-app", replicas=3))
+        cli.cmd_promote(cp, "member1", "apps/v1/Deployment", "default",
+                        "legacy-app")
+        cp.settle()
+        return cp, member
+
+    def test_promote_adopts_with_overwrite(self):
+        cp, member = self._migrated_plane()
+        pp = cp.store.get("PropagationPolicy", "default/promote-legacy-app")
+        assert pp is not None and pp.spec.conflict_resolution == "Overwrite"
+        rb = cp.store.get("ResourceBinding", "default/legacy-app-deployment")
+        assert rb is not None
+        assert {tc.name for tc in rb.spec.clusters} == {"member1"}
+        # the live object is managed now, not deleted/recreated
+        assert member.get("apps/v1/Deployment", "default", "legacy-app") is not None
+
+    def test_rollback_preserves_member_resource(self):
+        cp, member = self._migrated_plane()
+        # flip the policy to preserve-on-deletion, then tear the
+        # migration down control-plane-side
+        pp = cp.store.get("PropagationPolicy", "default/promote-legacy-app")
+        pp.spec.preserve_resources_on_deletion = True
+        cp.store.apply(pp)
+        cp.settle()
+        cp.store.delete("Resource", "default/legacy-app")
+        cp.store.delete("PropagationPolicy", "default/promote-legacy-app")
+        cp.settle()
+        assert cp.store.get("ResourceBinding", "default/legacy-app-deployment") is None
+        # the member keeps serving the workload (rollback is seamless)
+        assert member.get("apps/v1/Deployment", "default", "legacy-app") is not None
+
+    def test_teardown_without_preserve_removes_member_resource(self):
+        cp, member = self._migrated_plane()
+        cp.store.delete("Resource", "default/legacy-app")
+        cp.store.delete("PropagationPolicy", "default/promote-legacy-app")
+        cp.settle()
+        assert member.get("apps/v1/Deployment", "default", "legacy-app") is None
