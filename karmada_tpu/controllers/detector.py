@@ -125,7 +125,14 @@ class ResourceDetector:
             return None
         best = pool[0]
         if claimed_by and claimed_by != best.meta.name:
-            if not feature_gate.enabled(POLICY_PREEMPTION):
+            # a higher-priority policy takes a claimed template only when the
+            # PolicyPreemption gate is on AND the policy itself declares
+            # spec.preemption Always (preemption.go: both are required)
+            may_preempt = (
+                feature_gate.enabled(POLICY_PREEMPTION)
+                and getattr(best.spec, "preemption", "Never") == "Always"
+            )
+            if not may_preempt:
                 # keep the existing claim unless it vanished
                 current = next((p for p in pool if p.meta.name == claimed_by), None)
                 if current is not None:

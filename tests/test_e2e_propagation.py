@@ -329,3 +329,135 @@ class TestLazyActivationPolicy:
         bad.spec.activation_preference = "Eventually"
         with pytest.raises(ValidationError):
             cp.store.apply(bad)
+
+
+class TestPolicyPreemption:
+    """preemption_test.go analogue: a higher-priority policy takes a claimed
+    template only when the gate is on AND it declares preemption Always."""
+
+    def _plane_with_claim(self):
+        cp = make_plane(2)
+        cp.store.apply(new_deployment("web", replicas=4))
+        low = nginx_policy(static_weight_placement({"member1": 1}), name="low")
+        low.spec.priority = 1
+        cp.store.apply(low)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member1"}
+        return cp
+
+    def _high(self, preemption):
+        high = nginx_policy(static_weight_placement({"member2": 1}), name="high")
+        high.spec.priority = 10
+        high.spec.preemption = preemption
+        return high
+
+    def test_preempts_with_always_and_gate(self):
+        from karmada_tpu.utils.features import POLICY_PREEMPTION, feature_gate
+
+        cp = self._plane_with_claim()
+        feature_gate.set(POLICY_PREEMPTION, True)
+        try:
+            cp.store.apply(self._high("Always"))
+            cp.settle()
+            rb = next(iter(cp.store.list("ResourceBinding")))
+            assert {tc.name for tc in rb.spec.clusters} == {"member2"}
+            template = cp.store.get("Resource", "default/web")
+            assert template.meta.labels.get(
+                "propagationpolicy.karmada.io/name") == "high"
+        finally:
+            feature_gate.set(POLICY_PREEMPTION, False)
+
+    def test_no_preemption_without_always(self):
+        from karmada_tpu.utils.features import POLICY_PREEMPTION, feature_gate
+
+        cp = self._plane_with_claim()
+        feature_gate.set(POLICY_PREEMPTION, True)
+        try:
+            cp.store.apply(self._high("Never"))
+            cp.settle()
+            rb = next(iter(cp.store.list("ResourceBinding")))
+            assert {tc.name for tc in rb.spec.clusters} == {"member1"}
+        finally:
+            feature_gate.set(POLICY_PREEMPTION, False)
+
+    def test_no_preemption_with_gate_off(self):
+        cp = self._plane_with_claim()
+        cp.store.apply(self._high("Always"))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member1"}
+
+
+class TestOrderedClusterAffinities:
+    """clusteraffinities_test.go: ordered failover groups — the scheduler
+    tries each ClusterAffinityTerm in order and records which one served
+    (scheduler.go:533-596)."""
+
+    def test_falls_through_to_second_group(self):
+        from karmada_tpu.api.policy import ClusterAffinityTerm, Placement
+
+        cp = make_plane(3)
+        placement = Placement(
+            cluster_affinities=[
+                ClusterAffinityTerm(affinity_name="primary",
+                                    cluster_names=["absent-cluster"]),
+                ClusterAffinityTerm(affinity_name="backup",
+                                    cluster_names=["member2"]),
+            ]
+        )
+        cp.store.apply(new_deployment("web", replicas=2))
+        cp.store.apply(nginx_policy(placement))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member2"}
+        assert rb.status.scheduler_observed_affinity_name == "backup"
+
+
+class TestFieldSelectorAffinity:
+    """fieldselector_test.go: ClusterAffinity.fieldSelector matches cluster
+    provider/region/zone fields."""
+
+    def test_region_field_selector(self):
+        from karmada_tpu.api.policy import (
+            ClusterAffinity, FieldSelector, LabelSelectorRequirement, Placement)
+
+        cp = ControlPlane()
+        cp.join_cluster(new_cluster("m-east", region="us-east1"))
+        cp.join_cluster(new_cluster("m-west", region="us-west1"))
+        cp.settle()
+        placement = Placement(
+            cluster_affinity=ClusterAffinity(
+                field_selector=FieldSelector(match_expressions=[
+                    LabelSelectorRequirement(
+                        key="region", operator="In", values=["us-east1"])
+                ])
+            )
+        )
+        cp.store.apply(new_deployment("web", replicas=2))
+        cp.store.apply(nginx_policy(placement))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"m-east"}
+
+    def test_notin_field_selector(self):
+        from karmada_tpu.api.policy import (
+            ClusterAffinity, FieldSelector, LabelSelectorRequirement, Placement)
+
+        cp = ControlPlane()
+        cp.join_cluster(new_cluster("m-east", region="us-east1"))
+        cp.join_cluster(new_cluster("m-west", region="us-west1"))
+        cp.settle()
+        placement = Placement(
+            cluster_affinity=ClusterAffinity(
+                field_selector=FieldSelector(match_expressions=[
+                    LabelSelectorRequirement(
+                        key="region", operator="NotIn", values=["us-east1"])
+                ])
+            )
+        )
+        cp.store.apply(new_deployment("web", replicas=2))
+        cp.store.apply(nginx_policy(placement))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"m-west"}
