@@ -54,6 +54,7 @@ def _aggregated_prefix_mask(
     is_prev: jnp.ndarray,  # bool[C] previously-scheduled (>0 replicas)
     target: jnp.ndarray,  # int32 scalar
     wide: bool = True,  # static: int64 cumsum (False = proven-int32)
+    w_bits: int | None = None,  # static: weights < 2^w_bits -> packed sort
 ) -> jnp.ndarray:
     """bool[C]: minimal prefix of (prev desc, avail desc, idx asc) order whose
     cumulative availability reaches ``target``.
@@ -72,6 +73,25 @@ def _aggregated_prefix_mask(
     idx = jnp.arange(c, dtype=jnp.int32)
     acc = jnp.int64 if wide else jnp.int32
     prev_key = jnp.where(is_prev, 0, 1).astype(jnp.int32)
+    if w_bits is not None:
+        # packed path (host-proven weights < 2^w_bits): the (prev, -w, idx)
+        # order fits one int32 key — prev takes 1 bit, so any engine `fast`
+        # layout (w_bits + l_bits + i_bits <= 31, l_bits >= 1) fits. A
+        # single-key sort roughly halves the sort cost of the 3-key form.
+        i_bits = max(1, (c - 1).bit_length())
+        assert 1 + w_bits + i_bits <= 31, (w_bits, i_bits)
+        wmax = (1 << w_bits) - 1
+        key = (
+            (prev_key << (w_bits + i_bits))
+            | ((wmax - weights) << i_bits)
+            | idx
+        )
+        k_s = lax.sort(key, is_stable=False)
+        w_sorted = wmax - ((k_s >> i_bits) & wmax)
+        cum_before = jnp.cumsum(w_sorted) - w_sorted
+        n_keep = jnp.sum((cum_before < target).astype(jnp.int32))
+        pos = jnp.clip(n_keep - 1, 0, c - 1)
+        return (key <= k_s[pos]) & (n_keep > 0)
     p_s, nw_s, i_s = lax.sort(
         (prev_key, -weights, idx), num_keys=3, is_stable=False
     )
@@ -137,7 +157,10 @@ def _divide_one(
     # Aggregated bindings — one of the two kernel sorts disappears.
     if has_aggregated:
         is_prev_mask = (prev_cand > 0) & scale_up
-        keep = _aggregated_prefix_mask(w_dyn, is_prev_mask, target_dyn, wide)
+        keep = _aggregated_prefix_mask(
+            w_dyn, is_prev_mask, target_dyn, wide,
+            fast[0] if fast is not None else None,
+        )
         w_dyn = jnp.where(
             (strategy == AGGREGATED) & keep | (strategy != AGGREGATED), w_dyn, 0
         )
