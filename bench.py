@@ -244,22 +244,24 @@ def main():
     # inside a lax.scan body hang XLA compilation on the tunneled backend
     def gen_chunk(i, tainted_arg):
         k = jax.random.fold_in(jax.random.key(42), i)
-        k1, k2, k3, k4, k5, k7 = jax.random.split(k, 6)
+        k1, k2, k3, k4, k5, k6, k7 = jax.random.split(k, 7)
         replicas = jax.random.randint(k1, (chunk,), 1, 100, dtype=jnp.int32)
         prof_idx = jax.random.randint(k2, (chunk,), 0, 8)
         tolerates = jax.random.uniform(k3, (chunk, 1)) < 0.30
         candidates = ~tainted_arg[None, :] | tolerates
-        # previous placements: ~70% of bindings hold replicas on ~4 clusters;
-        # site selection and replica count come from one uniform draw (the
-        # conditional u/p is again uniform, so counts ~ randint(1, 30))
+        # previous placements: ~70% of bindings hold replicas on up to 8
+        # clusters. Sites are drawn SPARSELY ([chunk, 8] indices scattered
+        # into the row) rather than via a [chunk, C] uniform — the dense
+        # draw was the single largest remaining cost in the fused program
         has_prev = jax.random.uniform(k4, (chunk, 1)) < 0.7
-        u = jax.random.uniform(k5, (chunk, c))
-        p_site = 4.0 / c
-        prev_sites = u < p_site
-        prev_counts = 1 + (u * (29.0 / p_site)).astype(jnp.int32)
-        prev = jnp.where(
-            has_prev & prev_sites & candidates, prev_counts, 0
+        sites = jax.random.randint(k5, (chunk, 8), 0, c)
+        cnts = jax.random.randint(k6, (chunk, 8), 1, 30, dtype=jnp.int32)
+        prev0 = (
+            jnp.zeros((chunk, c), jnp.int32)
+            .at[jnp.arange(chunk)[:, None], sites]
+            .set(cnts)
         )
+        prev = jnp.where(has_prev & candidates, prev0, 0)
         fresh = jax.random.uniform(k7, (chunk,)) < 0.05
         strategy = jnp.full((chunk,), 2, jnp.int32)  # DynamicWeight
         static_w = jnp.zeros((chunk, c), jnp.int32)
