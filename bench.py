@@ -39,6 +39,13 @@ def build_parser():
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--sample", type=int, default=512, help="oracle sample size")
     p.add_argument("--cpu", action="store_true", help="force CPU jax (debug)")
+    p.add_argument(
+        "--trace-dir",
+        default="",
+        help="capture a jax.profiler (xprof) trace of the timed passes into "
+        "this directory — the SURVEY section-5 tracing analogue of the "
+        "reference's slow-op trace + pprof endpoints",
+    )
     p.add_argument("--dims", type=int, default=4)
     p.add_argument(
         "--config",
@@ -304,7 +311,15 @@ def main():
     jax.block_until_ready((per_profile, tainted))
     # warm the trace (compile is ~40s first run, cached after)
     jax.tree.map(np.asarray, solve_all(per_profile, tainted))
-    for rep in range(args.repeats):
+    import contextlib
+
+    trace_ctx = (
+        jax.profiler.trace(args.trace_dir)
+        if args.trace_dir
+        else contextlib.nullcontext()
+    )
+    with trace_ctx:
+      for rep in range(args.repeats):
         t0 = time.perf_counter()
         outs = solve_all(per_profile, tainted)
         outs = jax.tree.map(np.asarray, outs)  # host fetch = full completion
