@@ -461,3 +461,88 @@ class TestFieldSelectorAffinity:
         cp.settle()
         rb = next(iter(cp.store.list("ResourceBinding")))
         assert {tc.name for tc in rb.spec.clusters} == {"m-west"}
+
+
+class TestClusterOverridePolicy:
+    """clusteroverridepolicy_test.go: cluster-scoped override policies apply
+    before namespaced ones, and a namespaced OverridePolicy wins on the
+    fields it also touches (applied second)."""
+
+    def _cop(self, name, registry):
+        from karmada_tpu.api.policy import ClusterOverridePolicy
+
+        return ClusterOverridePolicy(
+            meta=ObjectMeta(name=name),
+            spec=OverrideSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                override_rules=[
+                    RuleWithCluster(
+                        overriders=Overriders(
+                            image_overrider=[
+                                ImageOverrider(
+                                    component="Registry",
+                                    operator="replace",
+                                    value=registry,
+                                )
+                            ]
+                        ),
+                    )
+                ],
+            ),
+        )
+
+    def test_cluster_override_applies_to_all_clusters(self):
+        cp = make_plane(2)
+        cp.store.apply(new_deployment("app", replicas=1,
+                                      image="docker.io/nginx:1.25"))
+        cp.store.apply(nginx_policy(duplicated_placement()))
+        cp.store.apply(self._cop("global-registry", "mirror.example.com"))
+        cp.settle()
+        for m in ("member1", "member2"):
+            img = (
+                cp.members.get(m)
+                .get("apps/v1/Deployment", "default", "app")
+                .spec["template"]["spec"]["containers"][0]["image"]
+            )
+            assert img == "mirror.example.com/nginx:1.25", (m, img)
+
+    def test_namespaced_override_wins_over_cluster_override(self):
+        cp = make_plane(1)
+        cp.store.apply(new_deployment("app", replicas=1,
+                                      image="docker.io/nginx:1.25"))
+        cp.store.apply(nginx_policy(duplicated_placement()))
+        cp.store.apply(self._cop("global-registry", "mirror.example.com"))
+        cp.store.apply(
+            OverridePolicy(
+                meta=ObjectMeta(name="ns-registry", namespace="default"),
+                spec=OverrideSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="apps/v1", kind="Deployment")
+                    ],
+                    override_rules=[
+                        RuleWithCluster(
+                            overriders=Overriders(
+                                image_overrider=[
+                                    ImageOverrider(
+                                        component="Registry",
+                                        operator="replace",
+                                        value="team.example.com",
+                                    )
+                                ]
+                            ),
+                        )
+                    ],
+                ),
+            )
+        )
+        cp.settle()
+        img = (
+            cp.members.get("member1")
+            .get("apps/v1/Deployment", "default", "app")
+            .spec["template"]["spec"]["containers"][0]["image"]
+        )
+        # OverridePolicy is applied after ClusterOverridePolicy
+        # (overridemanager.go ordering), so it wins the same field
+        assert img == "team.example.com/nginx:1.25"
