@@ -1,0 +1,158 @@
+"""Golden tests for the region-DFS group selection quirks
+(select_groups.go:102-224, select_clusters_by_region.go:28-70,
+group_clusters.go:138-330)."""
+
+import numpy as np
+
+from karmada_tpu.api.policy import SpreadConstraint
+from karmada_tpu.scheduler.groups import (
+    _Group,
+    calc_group_score,
+    select_by_topology_groups,
+    select_groups,
+)
+from karmada_tpu.scheduler.snapshot import ClusterSnapshot
+from karmada_tpu.utils.builders import new_cluster
+
+
+def g(name, value, weight):
+    return _Group(name=name, value=value, weight=weight)
+
+
+class TestSelectGroups:
+    def test_min_groups_infeasible_returns_empty(self):
+        assert select_groups([g("r1", 2, 10)], min_c=2, max_c=3, target=0) == []
+
+    def test_shortest_sufficient_path_wins(self):
+        # subpath preference: with minGroups=1 satisfied by r1 alone, the
+        # heavier two-group superpath loses to its own prefix
+        got = select_groups(
+            [g("r1", 1, 30), g("r2", 1, 20), g("r3", 1, 10)],
+            min_c=1, max_c=2, target=0,
+        )
+        assert [x.name for x in got] == ["r1"]
+
+    def test_min_groups_forces_path_length(self):
+        got = select_groups(
+            [g("r1", 1, 30), g("r2", 1, 20), g("r3", 1, 10)],
+            min_c=2, max_c=2, target=0,
+        )
+        assert [x.name for x in got] == ["r1", "r2"]
+
+    def test_weight_dominates_value(self):
+        got = select_groups(
+            [g("small-heavy", 1, 100), g("big-light", 5, 10)],
+            min_c=1, max_c=1, target=0,
+        )
+        assert [x.name for x in got] == ["small-heavy"]
+
+    def test_subpath_preferred_over_superpath(self):
+        # both [r1] and [r1, r2] are feasible with equal weight when r2
+        # contributes nothing; the shorter matching prefix must win
+        got = select_groups(
+            [g("r1", 3, 50), g("r2", 1, 0)],
+            min_c=1, max_c=2, target=2,
+        )
+        assert [x.name for x in got] == ["r1"]
+
+    def test_target_cluster_count_forces_combination(self):
+        # one region alone cannot reach the cluster min-groups target
+        got = select_groups(
+            [g("r1", 1, 50), g("r2", 1, 40)],
+            min_c=1, max_c=2, target=2,
+        )
+        assert sorted(x.name for x in got) == ["r1", "r2"]
+
+
+class TestCalcGroupScore:
+    def test_duplicated_counts_covering_clusters(self):
+        score = np.asarray([100, 0, 0])
+        credited = np.asarray([10, 3, 10])
+        # replicas=5: clusters 0 and 2 cover it; avg score of valid = 50
+        assert calc_group_score(
+            [0, 1, 2], score, credited, duplicated=True, replicas=5,
+            group_min_groups=1, cluster_min_groups=1,
+        ) == 2 * 1000 + 50
+
+    def test_divided_walks_until_target_covered(self):
+        score = np.asarray([100, 100, 0])
+        credited = np.asarray([4, 4, 4])
+        # replicas=6, minGroups=2 -> per-group target ceil(6/2)=3: first
+        # cluster covers it, one valid member, score avg 100
+        assert calc_group_score(
+            [0, 1, 2], score, credited, duplicated=False, replicas=6,
+            group_min_groups=2, cluster_min_groups=1,
+        ) == 3 * 1000 + 100
+
+    def test_divided_insufficient_capacity_scores_by_sum(self):
+        score = np.asarray([10, 10])
+        credited = np.asarray([1, 1])
+        got = calc_group_score(
+            [0, 1], score, credited, duplicated=False, replicas=100,
+            group_min_groups=1, cluster_min_groups=1,
+        )
+        assert got == 2 * 1000 + 10  # sum_avail x unit + avg score
+
+
+class TestRegionAssembly:
+    def _snap(self):
+        clusters = [
+            new_cluster("a1", region="east"),
+            new_cluster("a2", region="east"),
+            new_cluster("b1", region="west"),
+            new_cluster("b2", region="west"),
+            new_cluster("nr"),  # no region -> excluded
+        ]
+        return ClusterSnapshot(clusters), clusters
+
+    def test_region_only_selects_one_cluster_per_region(self):
+        snap, clusters = self._snap()
+        order = np.asarray([0, 1, 2, 3, 4])
+        score = np.zeros(5)
+        credited = np.full(5, 10)
+        sel = select_by_topology_groups(
+            snap, {"region": SpreadConstraint(spread_by_field="region",
+                                              min_groups=2, max_groups=2)},
+            order, score, credited, need=4, duplicated=False, replicas=4,
+        )
+        # the reference's 0-max-groups quirk: exactly one (best) cluster
+        # per chosen region
+        names = sorted(clusters[j].name for j in sel)
+        assert names == ["a1", "b1"]
+
+    def test_cluster_constraint_fills_from_remainder(self):
+        snap, clusters = self._snap()
+        order = np.asarray([0, 1, 2, 3])
+        score = np.asarray([0, 100, 0, 0])
+        credited = np.full(5, 10)
+        sel = select_by_topology_groups(
+            snap,
+            {"region": SpreadConstraint(spread_by_field="region",
+                                        min_groups=2, max_groups=2),
+             "cluster": SpreadConstraint(spread_by_field="cluster",
+                                         min_groups=2, max_groups=3)},
+            order, score, credited, need=4, duplicated=False, replicas=4,
+        )
+        names = sorted(clusters[j].name for j in sel)
+        # one best per region + highest-score leftover (a2, score 100)
+        assert names == ["a1", "a2", "b1"]
+
+    def test_zone_without_region_is_fit_error(self):
+        snap, _ = self._snap()
+        sel = select_by_topology_groups(
+            snap, {"zone": SpreadConstraint(spread_by_field="zone",
+                                            min_groups=1)},
+            np.asarray([0, 1]), np.zeros(5), np.full(5, 10),
+            need=1, duplicated=False, replicas=1,
+        )
+        assert sel is None
+
+    def test_too_few_regions_is_fit_error(self):
+        snap, _ = self._snap()
+        sel = select_by_topology_groups(
+            snap, {"region": SpreadConstraint(spread_by_field="region",
+                                              min_groups=3)},
+            np.asarray([0, 1, 2, 3]), np.zeros(5), np.full(5, 10),
+            need=1, duplicated=False, replicas=1,
+        )
+        assert sel is None
