@@ -90,11 +90,16 @@ class ObjectReferenceSelector:
 @dataclass
 class WorkloadRebalancerSpec:
     workloads: list[ObjectReferenceSelector] = field(default_factory=list)
+    # lifetime after every workload finished; None = keep forever
+    # (workloadrebalancer_types.go:61-67)
+    ttl_seconds_after_finished: Optional[int] = None
 
 
 @dataclass
 class WorkloadRebalancerStatus:
     observed_workloads: list[dict] = field(default_factory=list)
+    observed_generation: int = 0
+    finish_time: Optional[float] = None
 
 
 @dataclass
@@ -115,6 +120,20 @@ class WorkloadRebalancerController:
         self.clock = clock
         self.worker = runtime.new_worker("workload-rebalancer", self._reconcile)
         store.watch("WorkloadRebalancer", lambda e: self.worker.enqueue(e.key))
+        runtime.add_ticker(self._sweep_expired)
+
+    def _sweep_expired(self) -> None:
+        """TTLSecondsAfterFinished cleanup
+        (workloadrebalancer_controller.go:99-107,295-298)."""
+        now = self.clock()
+        for r in list(self.store.list("WorkloadRebalancer")):
+            if (
+                r.spec.ttl_seconds_after_finished is not None
+                and r.status.finish_time is not None
+                and now - r.status.finish_time
+                >= r.spec.ttl_seconds_after_finished
+            ):
+                self.store.delete("WorkloadRebalancer", r.meta.namespaced_name)
 
     def _reconcile(self, key: str) -> Optional[str]:
         rebalancer = self.store.get("WorkloadRebalancer", key)
@@ -138,8 +157,19 @@ class WorkloadRebalancerController:
                 {"workload": f"{target.kind}/{target.namespace}/{target.name}",
                  "result": result}
             )
-        if rebalancer.status.observed_workloads != observed:
+        finished = all(o["result"] != "Pending" for o in observed)
+        finish_time = rebalancer.status.finish_time
+        if finished and finish_time is None:
+            finish_time = self.clock()
+        changed = (
+            rebalancer.status.observed_workloads != observed
+            or rebalancer.status.observed_generation != rebalancer.meta.generation
+            or rebalancer.status.finish_time != finish_time
+        )
+        if changed:
             rebalancer.status.observed_workloads = observed
+            rebalancer.status.observed_generation = rebalancer.meta.generation
+            rebalancer.status.finish_time = finish_time
             self.store.apply(rebalancer)
         return DONE
 

@@ -138,6 +138,35 @@ class TestWorkloadRebalancer:
         assert rb.status.last_scheduled_time is not None
         rebalancer = cp.store.get("WorkloadRebalancer", "rb1")
         assert rebalancer.status.observed_workloads[0]["result"] == "Successful"
+        assert rebalancer.status.finish_time == clock[0]
+
+    def test_ttl_after_finished_cleans_up(self):
+        clock = [5000.0]
+        cp = ControlPlane(clock=lambda: clock[0])
+        cp.join_cluster(new_cluster("member1", cpu="100", memory="200Gi"))
+        cp.store.apply(new_deployment("app", replicas=2))
+        cp.store.apply(nginx_policy(dynamic_weight_placement()))
+        cp.settle()
+        cp.store.apply(
+            WorkloadRebalancer(
+                meta=ObjectMeta(name="rb-ttl"),
+                spec=WorkloadRebalancerSpec(
+                    workloads=[ObjectReferenceSelector(kind="Deployment",
+                                                       name="app")],
+                    ttl_seconds_after_finished=60,
+                ),
+            )
+        )
+        cp.settle()
+        assert cp.store.get("WorkloadRebalancer", "rb-ttl") is not None
+        clock[0] += 59
+        cp.settle()
+        assert cp.store.get("WorkloadRebalancer", "rb-ttl") is not None
+        clock[0] += 2
+        cp.settle()
+        # TTL elapsed after finish -> auto-deleted
+        # (workloadrebalancer_controller.go:99-107)
+        assert cp.store.get("WorkloadRebalancer", "rb-ttl") is None
 
 
 class TestFederatedResourceQuota:
