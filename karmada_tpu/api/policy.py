@@ -202,6 +202,9 @@ class PropagationSpec:
     propagate_deps: bool = False
     conflict_resolution: str = CONFLICT_ABORT
     suspend_dispatching: bool = False
+    # suspend dispatching only to these member clusters
+    # (propagation_types.go:237-258 Suspension.DispatchingOnClusters)
+    suspend_dispatching_on_clusters: Optional[list[str]] = None
     preserve_resources_on_deletion: bool = False
     failover: Optional["FailoverBehavior"] = None
     # scheduler to use; default scheduler name mirrors the reference default

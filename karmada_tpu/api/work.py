@@ -119,6 +119,9 @@ class ResourceBindingSpec:
     failover: Optional[Any] = None  # FailoverBehavior snapshot from policy
     propagate_deps: bool = False
     suspend_dispatching: bool = False
+    # per-cluster dispatch suspension (Suspension.DispatchingOnClusters,
+    # binding_types.go:150-153)
+    suspend_dispatching_on_clusters: Optional[list[str]] = None
     preserve_resources_on_deletion: bool = False
     scheduler_name: str = "default-scheduler"
 

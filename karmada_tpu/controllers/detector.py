@@ -194,6 +194,9 @@ class ResourceDetector:
             conflict_resolution=policy.spec.conflict_resolution,
             propagate_deps=policy.spec.propagate_deps,
             suspend_dispatching=policy.spec.suspend_dispatching,
+            suspend_dispatching_on_clusters=getattr(
+                policy.spec, "suspend_dispatching_on_clusters", None
+            ),
             preserve_resources_on_deletion=policy.spec.preserve_resources_on_deletion,
             failover=policy.spec.failover,
             scheduler_name=policy.spec.scheduler_name,

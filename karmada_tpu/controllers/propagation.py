@@ -143,11 +143,16 @@ class BindingController:
         ns = execution_namespace(cluster)
         name = f"{rb.meta.namespace + '.' if rb.meta.namespace else ''}{rb.meta.name}"
         key = f"{ns}/{name}"
+        # per-target suspension: global flag OR the cluster is listed in
+        # DispatchingOnClusters (binding/common.go:305-318)
+        suspended = rb.spec.suspend_dispatching or (
+            cluster in (rb.spec.suspend_dispatching_on_clusters or ())
+        )
         existing = self.store.get("Work", key)
         if existing is not None and _work_signature(existing) == (
             workload.spec,
             workload.meta.labels,
-            rb.spec.suspend_dispatching,
+            suspended,
             rb.spec.preserve_resources_on_deletion,
         ):
             return  # no semantic change — avoid churn (idempotent reconcile)
@@ -157,7 +162,7 @@ class BindingController:
         )
         work.spec = WorkSpec(
             workload=[workload],
-            suspend_dispatching=rb.spec.suspend_dispatching,
+            suspend_dispatching=suspended,
             preserve_resources_on_deletion=rb.spec.preserve_resources_on_deletion,
             conflict_resolution=rb.spec.conflict_resolution,
         )

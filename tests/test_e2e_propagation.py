@@ -546,3 +546,26 @@ class TestClusterOverridePolicy:
         # OverridePolicy is applied after ClusterOverridePolicy
         # (overridemanager.go ordering), so it wins the same field
         assert img == "team.example.com/nginx:1.25"
+
+
+class TestPerClusterSuspension:
+    """Suspension.dispatchingOnClusters: only the listed member is held
+    back; the rest dispatch normally (binding/common.go:305-318)."""
+
+    def test_suspends_only_listed_cluster(self):
+        cp = make_plane(2)
+        cp.store.apply(new_deployment("app", replicas=2))
+        pol = nginx_policy(duplicated_placement())
+        pol.spec.suspend_dispatching_on_clusters = ["member2"]
+        cp.store.apply(pol)
+        cp.settle()
+        assert cp.members.get("member1").get(
+            "apps/v1/Deployment", "default", "app") is not None
+        assert cp.members.get("member2").get(
+            "apps/v1/Deployment", "default", "app") is None
+        # lifting the suspension dispatches the held Work
+        pol.spec.suspend_dispatching_on_clusters = None
+        cp.store.apply(pol)
+        cp.settle()
+        assert cp.members.get("member2").get(
+            "apps/v1/Deployment", "default", "app") is not None
