@@ -125,6 +125,23 @@ def mutate_federated_hpa(hpa) -> None:
 # --- validators (ref: pkg/webhook/*/validating.go) ---------------------------
 
 
+def _validate_field_selector(aff) -> None:
+    """util/validation.ValidatePolicyFieldSelector: only the cluster
+    provider/region/zone fields are matchable, with In/NotIn."""
+    if aff is None or aff.field_selector is None:
+        return
+    for req in aff.field_selector.match_expressions:
+        if req.key not in ("provider", "region", "zone"):
+            raise ValidationError(
+                f"unsupported fieldSelector key {req.key!r} "
+                "(only provider/region/zone)"
+            )
+        if req.operator not in ("In", "NotIn"):
+            raise ValidationError(
+                f"unsupported fieldSelector operator {req.operator!r}"
+            )
+
+
 def validate_placement(pl) -> None:
     if pl is None:
         return
@@ -132,6 +149,9 @@ def validate_placement(pl) -> None:
         raise ValidationError(
             "clusterAffinity and clusterAffinities are mutually exclusive"
         )
+    _validate_field_selector(pl.cluster_affinity)
+    for term in pl.cluster_affinities:
+        _validate_field_selector(term)
     names = [t.affinity_name for t in pl.cluster_affinities]
     if len(names) != len(set(names)):
         raise ValidationError("clusterAffinities names must be unique")

@@ -97,3 +97,42 @@ def test_customization_health_op(chain):
 def test_empty_work_rejected(chain):
     with pytest.raises(ValidationError, match="manifest"):
         chain.admit("Work", Work(meta=ObjectMeta(name="w", namespace="karmada-es-x")))
+
+
+class TestFieldSelectorValidation:
+    def test_bad_key_rejected(self):
+        import pytest
+        from karmada_tpu.api.policy import (
+            ClusterAffinity, FieldSelector, LabelSelectorRequirement, Placement)
+        from karmada_tpu.webhook import ValidationError
+        from karmada_tpu.webhook.chain import validate_placement
+
+        pl = Placement(cluster_affinity=ClusterAffinity(
+            field_selector=FieldSelector(match_expressions=[
+                LabelSelectorRequirement(key="name", operator="In",
+                                         values=["x"])])))
+        with pytest.raises(ValidationError):
+            validate_placement(pl)
+
+    def test_bad_operator_rejected(self):
+        import pytest
+        from karmada_tpu.api.policy import (
+            ClusterAffinity, FieldSelector, LabelSelectorRequirement, Placement)
+        from karmada_tpu.webhook import ValidationError
+        from karmada_tpu.webhook.chain import validate_placement
+
+        pl = Placement(cluster_affinity=ClusterAffinity(
+            field_selector=FieldSelector(match_expressions=[
+                LabelSelectorRequirement(key="region", operator="Exists")])))
+        with pytest.raises(ValidationError):
+            validate_placement(pl)
+
+    def test_valid_selector_passes(self):
+        from karmada_tpu.api.policy import (
+            ClusterAffinity, FieldSelector, LabelSelectorRequirement, Placement)
+        from karmada_tpu.webhook.chain import validate_placement
+
+        validate_placement(Placement(cluster_affinity=ClusterAffinity(
+            field_selector=FieldSelector(match_expressions=[
+                LabelSelectorRequirement(key="region", operator="NotIn",
+                                         values=["us-east1"])]))))
