@@ -18,7 +18,11 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from .dispense import take_by_weight, take_by_weight_batch  # noqa: E402,F401
+from .dispense import (  # noqa: E402,F401
+    take_by_weight,
+    take_by_weight_batch,
+    take_by_weight_fast,
+)
 from .divide import (  # noqa: E402,F401
     AGGREGATED,
     DUPLICATED,
@@ -27,5 +31,10 @@ from .divide import (  # noqa: E402,F401
     DivideResult,
     divide_replicas,
 )
-from .estimate import general_estimate, merge_estimates  # noqa: E402,F401
+from .estimate import (  # noqa: E402,F401
+    gather_profile_rows,
+    general_estimate,
+    general_estimate_interned,
+    merge_estimates,
+)
 from . import masks  # noqa: E402,F401
