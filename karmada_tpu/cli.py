@@ -63,6 +63,18 @@ def cmd_join(
     return cluster
 
 
+def cmd_deinit(cp: ControlPlane) -> None:
+    """Tear the control plane down (pkg/karmadactl/cmdinit deinit): unjoin
+    every member (draining execution spaces), then drop all control-plane
+    state so the instance can be garbage collected."""
+    for name in list(cp.members.names()):
+        cp.unjoin_cluster(name)
+    cp.settle()
+    for kind in list(cp.store.kinds()):
+        for obj in list(cp.store.list(kind)):
+            cp.store.delete(kind, obj.meta.namespaced_name)
+
+
 def cmd_unjoin(cp: ControlPlane, name: str) -> None:
     cp.unjoin_cluster(name)
 

@@ -171,3 +171,17 @@ class TestMigrationAndRollback:
         cp.store.delete("PropagationPolicy", "default/promote-legacy-app")
         cp.settle()
         assert member.get("apps/v1/Deployment", "default", "legacy-app") is None
+
+
+class TestDeinit:
+    def test_deinit_drains_members_and_clears_state(self):
+        cp = cli.cmd_local_up(2)
+        cp.store.apply(new_deployment("app", replicas=2))
+        cp.store.apply(policy(duplicated_placement()))
+        cp.settle()
+        assert cp.members.get("member1").get(
+            "apps/v1/Deployment", "default", "app") is not None
+        cli.cmd_deinit(cp)
+        assert list(cp.members.names()) == []
+        for kind in cp.store.kinds():
+            assert cp.store.list(kind) == [], kind
