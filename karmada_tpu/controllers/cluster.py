@@ -133,8 +133,9 @@ class TaintManager:
     through spec.gracefulEvictionTasks; otherwise the cluster entry is
     dropped immediately."""
 
-    def __init__(self, store: Store, runtime: Runtime) -> None:
+    def __init__(self, store: Store, runtime: Runtime, clock=None) -> None:
         self.store = store
+        self.clock = clock or time.time
         self.worker = runtime.new_worker("taint-manager", self._reconcile)
         store.watch("Cluster", lambda e: self.worker.enqueue(e.key))
 
@@ -168,6 +169,9 @@ class TaintManager:
                 producer=EVICTION_PRODUCER_TAINT_MANAGER,
                 message=f"cluster {cluster.name} has NoExecute taint "
                 f"{untolerated[0].key}",
+                # the injected clock must stamp eviction tasks, or the
+                # timeout-drain math mixes fake and wall time
+                now=self.clock(),
             )
             self.store.apply(rb)
         return DONE

@@ -89,7 +89,7 @@ class ControlPlane:
             self.store, self.runtime, self.members
         )
         self.cluster_controller = ClusterController(self.store, self.runtime)
-        self.taint_manager = TaintManager(self.store, self.runtime)
+        self.taint_manager = TaintManager(self.store, self.runtime, clock=self.clock)
         self.graceful_eviction = GracefulEvictionController(
             self.store, self.runtime, timeout_seconds=eviction_timeout,
             clock=self.clock,
