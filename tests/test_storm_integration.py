@@ -140,3 +140,47 @@ def test_fleet_storm_settles_consistently():
             assert cp.store.get("Cluster", f"member{i}") is None
     finally:
         feature_gate.set(FAILOVER, False)
+
+
+def test_dependencies_follow_moving_workload():
+    """propagateDeps + movement: when the parent workload's placement moves
+    (fresh rebalance after a new cluster joins), the attached dependency
+    bindings must re-shadow the NEW clusters and the dependency must land
+    on them (dependencies_distributor.go RequiredBy shadow updates)."""
+    clock = [20_000.0]
+    cp = cli.cmd_init(clock=lambda: clock[0])
+    cli.cmd_join(cp, "member1")
+    cp.settle()
+    cp.store.apply(Resource(
+        api_version="v1", kind="ConfigMap",
+        meta=ObjectMeta(name="web-config", namespace="default"),
+        spec={"data": {"k": "v"}},
+    ))
+    dep = new_deployment("web", replicas=2)
+    dep.spec["template"]["spec"]["volumes"] = [
+        {"name": "cfg", "configMap": {"name": "web-config"}}
+    ]
+    cp.store.apply(dep)
+    pol = policy("web-policy", static_weight_placement({"member1": 1}))
+    pol.spec.propagate_deps = True
+    cp.store.apply(pol)
+    cp.settle()
+    assert cp.members.get("member1").get(
+        "v1/ConfigMap", "default", "web-config") is not None
+
+    # placement moves to a newly joined cluster
+    cli.cmd_join(cp, "member2")
+    cp.settle()
+    pol.spec.placement = static_weight_placement({"member2": 1})
+    cp.store.apply(pol)
+    cp.settle()
+    assert cp.members.get("member2").get(
+        "apps/v1/Deployment", "default", "web") is not None
+    # the dependency followed the workload to member2
+    assert cp.members.get("member2").get(
+        "v1/ConfigMap", "default", "web-config") is not None
+    # ... and was withdrawn from the abandoned cluster along with the parent
+    assert cp.members.get("member1").get(
+        "apps/v1/Deployment", "default", "web") is None
+    assert cp.members.get("member1").get(
+        "v1/ConfigMap", "default", "web-config") is None
