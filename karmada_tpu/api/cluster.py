@@ -134,3 +134,17 @@ class Cluster:
     @property
     def name(self) -> str:
         return self.meta.name
+
+
+@dataclass
+class Lease:
+    """Agent heartbeat for Pull clusters (coordination.k8s.io Lease
+    analogue; cluster_status_controller.go:210-213 + the cluster
+    controller's monitorClusterHealth lease observation). The agent renews
+    ``renew_time``; the control plane judges freshness — it cannot probe a
+    Pull cluster directly."""
+
+    KIND = "Lease"
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    renew_time: float = 0.0

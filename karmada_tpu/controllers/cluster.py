@@ -45,15 +45,33 @@ UNREACHABLE_EXECUTE_TAINT = Taint(key=TAINT_CLUSTER_UNREACHABLE, effect=NO_EXECU
 class ClusterStatusController:
     """Periodic member heartbeat -> Cluster.Status (run as a runtime ticker)."""
 
+    #: how stale an agent lease may be before a Pull cluster degrades
+    #: (ClusterLeaseDuration x renew fraction analogue)
+    LEASE_GRACE_SECONDS = 120.0
+
     def __init__(
         self,
         store: Store,
         runtime: Runtime,
         members: MemberClientRegistry,
+        clock=None,
+        lease_grace_seconds: float = LEASE_GRACE_SECONDS,
     ) -> None:
         self.store = store
         self.members = members
+        self.clock = clock or time.time
+        self.lease_grace = lease_grace_seconds
         runtime.add_ticker(self.collect_all)
+        # a lease renewal re-judges its cluster immediately — tickers run in
+        # registration order, and the agent's renewal ticker registers after
+        # this controller, so without this a recovered agent would stay
+        # NotReady for a full extra settle pass
+        store.watch("Lease", self._on_lease)
+
+    def _on_lease(self, event) -> None:
+        cluster = self.store.get("Cluster", event.obj.meta.name)
+        if cluster is not None:
+            self.collect(cluster)
 
     def collect_all(self) -> None:
         for cluster in self.store.list("Cluster"):
@@ -61,14 +79,25 @@ class ClusterStatusController:
 
     def collect(self, cluster: Cluster) -> None:
         member = self.members.get(cluster.name)
+        if cluster.spec.sync_mode == "Pull":
+            # the plane cannot probe Pull members; Ready is lease freshness
+            # ALONE (monitorClusterHealth over the agent-renewed Lease) — a
+            # dead agent degrades only after the grace period, by design
+            lease = self.store.get("Lease", cluster.name)
+            ready = (
+                lease is not None
+                and self.clock() - lease.renew_time < self.lease_grace
+            )
+            reason = "AgentLeaseRenewed" if ready else "AgentLeaseExpired"
+        else:
+            ready = member is not None and member.reachable
+            reason = "ClusterReady" if ready else "ClusterNotReachable"
+        # status collection still needs a live client regardless of how
+        # Ready was judged
         reachable = member is not None and member.reachable
         changed = set_condition(
             cluster.status.conditions,
-            Condition(
-                type="Ready",
-                status=reachable,
-                reason="ClusterReady" if reachable else "ClusterNotReachable",
-            ),
+            Condition(type="Ready", status=ready, reason=reason),
         )
         if reachable:
             summary_alloc = member.summary_allocatable()

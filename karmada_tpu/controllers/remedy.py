@@ -188,14 +188,34 @@ class KarmadaAgent:
         runtime: Runtime,
         member: MemberCluster,
         interpreter,
+        clock=None,
     ) -> None:
+        import time as _time
+
         self.store = store
         self.member = member
         self.interpreter = interpreter
+        self.clock = clock or _time.time
         self.ns = execution_namespace(member.name)
         self.worker = runtime.new_worker(f"agent-{member.name}", self._reconcile)
         store.watch("Work", self._on_work_event)
         member.watch(self._on_member_event)
+        runtime.add_ticker(self._renew_lease)
+
+    def _renew_lease(self) -> None:
+        """Heartbeat: the agent renews its cluster Lease while it can reach
+        the control plane; the cluster-status controller derives Pull-mode
+        Ready from this freshness (the plane cannot probe a Pull member)."""
+        if not self.member.reachable:
+            return
+        from ..api.cluster import Lease
+        from ..api.core import ObjectMeta
+
+        lease = self.store.get("Lease", self.member.name) or Lease(
+            meta=ObjectMeta(name=self.member.name)
+        )
+        lease.renew_time = self.clock()
+        self.store.apply(lease)
 
     def _on_work_event(self, event) -> None:
         if event.obj.meta.namespace == self.ns:

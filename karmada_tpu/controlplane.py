@@ -86,7 +86,7 @@ class ControlPlane:
             self.store, self.runtime, self.detector
         )
         self.cluster_status_controller = ClusterStatusController(
-            self.store, self.runtime, self.members
+            self.store, self.runtime, self.members, clock=self.clock
         )
         self.cluster_controller = ClusterController(self.store, self.runtime)
         self.taint_manager = TaintManager(self.store, self.runtime, clock=self.clock)
@@ -209,7 +209,8 @@ class ControlPlane:
 
             self.agents = getattr(self, "agents", {})
             self.agents[cluster.name] = KarmadaAgent(
-                self.store, self.runtime, member, self.interpreter
+                self.store, self.runtime, member, self.interpreter,
+                clock=self.clock,
             )
         self.work_status_controller.watch_member(member)
         if self._accurate_enabled:

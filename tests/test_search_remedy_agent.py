@@ -161,3 +161,54 @@ class TestMetricsAdapter:
         cp.members.get("member1").custom_metrics = {"queue_depth": 5}
         cp.members.get("member2").custom_metrics = {"queue_depth": 7}
         assert cp.metrics_adapter.external_metric_sum("queue_depth") == 12
+
+
+class TestPullClusterLease:
+    """Lease-based failure detection for Pull clusters: the plane never
+    probes the member; Ready degrades only when the agent's lease goes
+    stale past the grace period, and recovers on the next renewal."""
+
+    def _pull_plane(self):
+        from karmada_tpu import cli
+
+        clock = [50_000.0]
+        cp = cli.cmd_init(clock=lambda: clock[0])
+        cli.cmd_join(cp, "pusher")
+        token = cli.cmd_token_create(cp)
+        cli.cmd_register(cp, "puller", token=token)
+        cp.settle()
+        return cp, clock
+
+    def test_lease_renewed_keeps_ready(self, ):
+        cp, clock = self._pull_plane()
+        lease = cp.store.get("Lease", "puller")
+        assert lease is not None and lease.renew_time == clock[0]
+        cluster = cp.store.get("Cluster", "puller")
+        ready = next(c for c in cluster.status.conditions if c.type == "Ready")
+        assert ready.status and ready.reason == "AgentLeaseRenewed"
+
+    def test_dead_agent_degrades_after_grace_only(self):
+        cp, clock = self._pull_plane()
+        cp.members.get("puller").reachable = False  # agent cut off
+        # within the grace period the plane still believes the lease
+        clock[0] += 60
+        cp.settle()
+        cluster = cp.store.get("Cluster", "puller")
+        ready = next(c for c in cluster.status.conditions if c.type == "Ready")
+        assert ready.status
+        # past the grace period the cluster degrades and gets tainted
+        clock[0] += 120
+        cp.settle()
+        cluster = cp.store.get("Cluster", "puller")
+        ready = next(c for c in cluster.status.conditions if c.type == "Ready")
+        assert not ready.status and ready.reason == "AgentLeaseExpired"
+        assert any(t.key == "cluster.karmada.io/not-ready"
+                   for t in cluster.spec.taints)
+        # agent comes back -> lease renews -> Ready + untainted
+        cp.members.get("puller").reachable = True
+        clock[0] += 10
+        cp.settle()
+        cluster = cp.store.get("Cluster", "puller")
+        ready = next(c for c in cluster.status.conditions if c.type == "Ready")
+        assert ready.status and ready.reason == "AgentLeaseRenewed"
+        assert not cluster.spec.taints
