@@ -1,9 +1,14 @@
 """Quickstart demo: the samples/nginx scenario end-to-end, then a failover.
 
-Run from the repo root: PYTHONPATH=. python examples/quickstart.py
+Run from anywhere: python examples/quickstart.py
 (uses CPU JAX; the scheduler kernels are the same programs bench.py runs on
 TPU).
 """
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
