@@ -3,6 +3,7 @@ assignment — mirroring the reference's scheduler core test strategy
 (fabricated clusters, exact TargetCluster assertions)."""
 
 import numpy as np
+import pytest
 
 from karmada_tpu.api import (
     ClusterAffinity,
@@ -302,3 +303,74 @@ class TestBatch:
             [got] = TensorScheduler(snap).schedule([p])
             assert got.clusters == want.clusters, p.key
             assert got.error == want.error, p.key
+
+
+class TestRandomizedBatchIsolation:
+    """Fuzz: batched scheduling must equal per-binding scheduling for ANY
+    mix of strategies, spread constraints, affinities, prev placements and
+    evictions — catches cross-binding contamination in the batched kernels
+    and the fast-path gates (which are chosen from CHUNK maxima and must
+    never change per-binding results)."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_fleet_and_policies(self, seed):
+        rng = np.random.default_rng(200 + seed)
+        fleet = synthetic_fleet(int(rng.integers(8, 30)), seed=seed)
+        snap = ClusterSnapshot(fleet)
+        names = [c.name for c in fleet]
+
+        def random_placement():
+            kind = rng.integers(0, 5)
+            if kind == 0:
+                return duplicated_placement()
+            if kind == 1:
+                weights = {
+                    n: int(rng.integers(1, 6))
+                    for n in rng.choice(names, size=rng.integers(1, 5),
+                                        replace=False)
+                }
+                return static_weight_placement(weights)
+            if kind == 2:
+                return dynamic_weight_placement()
+            if kind == 3:
+                return aggregated_placement()
+            return dynamic_weight_placement(
+                spread_constraints=[
+                    SpreadConstraint(spread_by_field="cluster",
+                                     min_groups=1,
+                                     max_groups=int(rng.integers(1, 6))),
+                ]
+            )
+
+        placements = [random_placement() for _ in range(6)]
+        problems = []
+        for i in range(48):
+            prev = {}
+            if rng.random() < 0.5:
+                for n in rng.choice(names, size=rng.integers(1, 4),
+                                    replace=False):
+                    prev[str(n)] = int(rng.integers(1, 9))
+            problems.append(BindingProblem(
+                key=f"b{i}",
+                placement=placements[int(rng.integers(0, len(placements)))],
+                replicas=int(rng.integers(0, 30)),
+                requests=REQ,
+                gvk="apps/v1/Deployment",
+                prev=prev,
+                evict_clusters=tuple(
+                    rng.choice(names, size=rng.integers(0, 2), replace=False)
+                ),
+                fresh=bool(rng.random() < 0.2),
+            ))
+
+        batch = TensorScheduler(snap).schedule(problems)
+        for p, want in zip(problems, batch):
+            [got] = TensorScheduler(snap).schedule([p])
+            assert got.clusters == want.clusters, (seed, p.key)
+            assert got.error == want.error, (seed, p.key)
+            rs = p.placement.replica_scheduling if p.placement else None
+            divided = rs is not None and rs.replica_scheduling_type == "Divided"
+            if want.success and p.replicas > 0 and want.clusters and divided:
+                # Divided placements preserve the replica total; Duplicated
+                # broadcasts the full count everywhere by design
+                assert sum(want.clusters.values()) == p.replicas, (seed, p.key)
