@@ -76,11 +76,20 @@ class ResourceDetector:
         self.worker.enqueue(event.key)
 
     def _on_policy_event(self, event) -> None:
-        # policy changes re-evaluate every template (conservative requeue;
-        # the reference scopes by selector — optimization left with a marker)
+        # scope the requeue the way the reference does: templates matching
+        # the (new) selectors, plus templates currently claimed by this
+        # policy (they may need to unbind after a selector change)
+        policy = event.obj
+        selectors = policy.spec.resource_selectors
+        pname = policy.meta.name
         for template in self.store.list("Resource"):
-            self._by_karmada.add(template.meta.namespaced_name)
-            self.worker.enqueue(template.meta.namespaced_name)
+            claimed = (
+                template.meta.labels.get(POLICY_LABEL) == pname
+                or template.meta.labels.get(CLUSTER_POLICY_LABEL) == pname
+            )
+            if claimed or policy_matches(template, selectors):
+                self._by_karmada.add(template.meta.namespaced_name)
+                self.worker.enqueue(template.meta.namespaced_name)
 
     # -- reconcile ---------------------------------------------------------
 

@@ -176,7 +176,10 @@ def select_clusters_batch(
                     by_field["cluster"], order, credited[i], need
                 )
             else:
-                sel = order  # label-based spread not yet grouped; keep feasible
+                # spreadByLabel-only constraints: the reference refuses
+                # ("just support cluster and region spread constraint",
+                # select_clusters.go:58) -> FitError, not silent pass-through
+                sel = None
             row = np.zeros(snap.num_clusters, bool)
             if sel is not None and sel.size > 0:
                 row[sel] = True

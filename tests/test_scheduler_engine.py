@@ -374,3 +374,23 @@ class TestRandomizedBatchIsolation:
                 # Divided placements preserve the replica total; Duplicated
                 # broadcasts the full count everywhere by design
                 assert sum(want.clusters.values()) == p.replicas, (seed, p.key)
+
+
+class TestLabelOnlySpreadRefused:
+    def test_spread_by_label_is_fit_error(self):
+        # the reference supports only cluster/region grouping
+        # (select_clusters.go:58); label-only constraints must FitError,
+        # not silently pass every feasible cluster
+        fleet = synthetic_fleet(6, seed=9)
+        snap = ClusterSnapshot(fleet)
+        placement = dynamic_weight_placement(
+            spread_constraints=[
+                SpreadConstraint(spread_by_label="topology.io/rack",
+                                 min_groups=2),
+            ]
+        )
+        [res] = TensorScheduler(snap).schedule([
+            BindingProblem(key="b", placement=placement, replicas=4,
+                           requests=REQ, gvk="apps/v1/Deployment")
+        ])
+        assert not res.success
