@@ -426,13 +426,23 @@ class TensorScheduler:
     def _unpack(
         self, problems, compiled, term_round, candidates, assignment, unschedulable
     ) -> list[ScheduleResult]:
+        """Vectorized result building: one np.nonzero over the whole chunk
+        replaces per-binding scans, and the feasible-cluster tuple is only
+        materialized for zero-replica (non-workload) bindings — its sole
+        consumer (the scheduler controller writes all feasible clusters as
+        the schedule of a non-workload binding)."""
         snap = self.snapshot
+        names = snap.names
+        b = len(problems)
+        has_candidates = candidates[:b].any(axis=1)
+        rows, cols = np.nonzero(assignment[:b] > 0)
+        boundaries = np.searchsorted(rows, np.arange(1, b))
+        per_row = np.split(cols, boundaries)
         out = []
         for i, p in enumerate(problems):
             term_idx = min(term_round, len(compiled[i].terms) - 1)
             term_name = compiled[i].terms[term_idx][0]
-            cand_idx = np.flatnonzero(candidates[i])
-            if cand_idx.size == 0:
+            if not has_candidates[i]:
                 out.append(
                     ScheduleResult(
                         key=p.key,
@@ -451,14 +461,17 @@ class TensorScheduler:
                 )
                 continue
             row = assignment[i]
-            placed = {
-                snap.names[j]: int(row[j]) for j in np.flatnonzero(row > 0)
-            }
+            placed = {names[j]: int(row[j]) for j in per_row[i]}
+            feasible = (
+                tuple(names[j] for j in np.flatnonzero(candidates[i]))
+                if p.replicas == 0
+                else ()
+            )
             out.append(
                 ScheduleResult(
                     key=p.key,
                     clusters=placed,
-                    feasible=tuple(snap.names[j] for j in cand_idx),
+                    feasible=feasible,
                     affinity_name=term_name,
                 )
             )
