@@ -265,6 +265,28 @@ class PlaintextOverrider:
 
 
 @dataclass
+class FieldPatchOperation:
+    """One operation inside an embedded document
+    (override_types.go:287-310 JSONPatchOperation/YAMLPatchOperation)."""
+
+    sub_path: str = ""  # RFC 6901 path within the embedded document
+    operator: str = "replace"  # add | remove | replace
+    value: Any = None
+
+
+@dataclass
+class FieldOverrider:
+    """Patch a STRING field whose value is an embedded JSON or YAML
+    document (e.g. a ConfigMap data key): parse, apply the operations at
+    their sub-paths, re-serialize (override_types.go:266-285). A single
+    instance carries either json or yaml operations, not both."""
+
+    field_path: str = ""  # RFC 6901 path to the string field
+    json: list[FieldPatchOperation] = field(default_factory=list)
+    yaml: list[FieldPatchOperation] = field(default_factory=list)
+
+
+@dataclass
 class ImageOverrider:
     component: str = "Registry"  # Registry | Repository | Tag
     operator: str = "replace"
@@ -293,6 +315,7 @@ class Overriders:
     args_overrider: list[CommandArgsOverrider] = field(default_factory=list)
     labels_overrider: list[LabelAnnotationOverrider] = field(default_factory=list)
     annotations_overrider: list[LabelAnnotationOverrider] = field(default_factory=list)
+    field_overrider: list[FieldOverrider] = field(default_factory=list)
 
 
 @dataclass

@@ -130,6 +130,40 @@ def apply_overriders(obj: Resource, overriders: Overriders) -> None:
         _apply_map_overrider(obj.meta.labels, lo.operator, lo.value)
     for ano in overriders.annotations_overrider:
         _apply_map_overrider(obj.meta.annotations, ano.operator, ano.value)
+    for fo in getattr(overriders, "field_overrider", []):
+        _apply_field_overrider(obj, fo)
+
+
+def _apply_field_overrider(obj: Resource, fo) -> None:
+    """FieldOverrider (override_types.go:266-310): the field at field_path
+    holds an embedded JSON/YAML document as a string — parse it, patch at
+    each operation's sub-path, re-serialize in the same format."""
+    import json as _json
+
+    import yaml as _yaml
+
+    doc = {"spec": obj.spec, "metadata": {"labels": obj.meta.labels,
+                                          "annotations": obj.meta.annotations}}
+    parent, leaf = _resolve_parent(doc, fo.field_path)
+    current = parent[leaf] if isinstance(parent, dict) else parent[int(leaf)]
+    if not isinstance(current, str):
+        raise ValueError(
+            f"fieldOverrider path {fo.field_path!r} must point at an "
+            "embedded-document string"
+        )
+    is_json = bool(fo.json)
+    embedded = _json.loads(current) if is_json else _yaml.safe_load(current)
+    for op in fo.json or fo.yaml:
+        apply_json_patch(embedded, op.operator, op.sub_path, op.value)
+    rendered = (
+        _json.dumps(embedded)
+        if is_json
+        else _yaml.safe_dump(embedded, default_flow_style=False)
+    )
+    if isinstance(parent, dict):
+        parent[leaf] = rendered
+    else:
+        parent[int(leaf)] = rendered
 
 
 def _edit(current: str, op: str, value: str) -> str:

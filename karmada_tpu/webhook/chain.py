@@ -231,6 +231,21 @@ def validate_override_policy(policy) -> None:
         for io in rule.overriders.image_overrider:
             if io.component not in ("Registry", "Repository", "Tag"):
                 raise ValidationError(f"invalid image component {io.component!r}")
+        for fo in getattr(rule.overriders, "field_overrider", []):
+            # one instance processes either JSON or YAML, never both
+            # (override_types.go:270)
+            if fo.json and fo.yaml:
+                raise ValidationError(
+                    "fieldOverrider carries either json or yaml operations, "
+                    "not both"
+                )
+            if not fo.field_path.startswith("/"):
+                raise ValidationError("fieldOverrider fieldPath must start with '/'")
+            for op in fo.json + fo.yaml:
+                if op.operator not in ("add", "remove", "replace"):
+                    raise ValidationError(
+                        f"invalid fieldOverrider operator {op.operator!r}"
+                    )
 
 
 def validate_federated_resource_quota(frq) -> None:

@@ -569,3 +569,104 @@ class TestPerClusterSuspension:
         cp.settle()
         assert cp.members.get("member2").get(
             "apps/v1/Deployment", "default", "app") is not None
+
+
+class TestFieldOverrider:
+    """FieldOverrider: patch embedded JSON/YAML documents inside string
+    fields (the ConfigMap data-key case, override_types.go:266-310)."""
+
+    def _plane_with_configmap(self, data):
+        from karmada_tpu.api.core import Resource
+
+        cp = make_plane(1)
+        cp.store.apply(Resource(
+            api_version="v1", kind="ConfigMap",
+            meta=ObjectMeta(name="db-config", namespace="default"),
+            spec={"data": data},
+        ))
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="cm-policy", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[ResourceSelector(api_version="v1",
+                                                     kind="ConfigMap")],
+                placement=duplicated_placement(),
+            ),
+        ))
+        return cp
+
+    def test_yaml_document_patch(self):
+        from karmada_tpu.api.policy import FieldOverrider, FieldPatchOperation
+
+        cp = self._plane_with_configmap(
+            {"db.yaml": "host: db.local\nport: 5432\n"})
+        cp.store.apply(OverridePolicy(
+            meta=ObjectMeta(name="db-override", namespace="default"),
+            spec=OverrideSpec(
+                resource_selectors=[ResourceSelector(api_version="v1",
+                                                     kind="ConfigMap")],
+                override_rules=[RuleWithCluster(overriders=Overriders(
+                    field_overrider=[FieldOverrider(
+                        field_path="/spec/data/db.yaml",
+                        yaml=[FieldPatchOperation(
+                            sub_path="/host", operator="replace",
+                            value="db.member1.local")],
+                    )]
+                ))],
+            ),
+        ))
+        cp.settle()
+        import yaml as _yaml
+
+        got = cp.members.get("member1").get("v1/ConfigMap", "default",
+                                            "db-config")
+        doc = _yaml.safe_load(got.spec["data"]["db.yaml"])
+        assert doc == {"host": "db.member1.local", "port": 5432}
+
+    def test_json_document_patch_add(self):
+        from karmada_tpu.api.policy import FieldOverrider, FieldPatchOperation
+
+        cp = self._plane_with_configmap({"cfg.json": '{"replicas": 1}'})
+        cp.store.apply(OverridePolicy(
+            meta=ObjectMeta(name="cfg-override", namespace="default"),
+            spec=OverrideSpec(
+                resource_selectors=[ResourceSelector(api_version="v1",
+                                                     kind="ConfigMap")],
+                override_rules=[RuleWithCluster(overriders=Overriders(
+                    field_overrider=[FieldOverrider(
+                        field_path="/spec/data/cfg.json",
+                        json=[FieldPatchOperation(
+                            sub_path="/debug", operator="add", value=True)],
+                    )]
+                ))],
+            ),
+        ))
+        cp.settle()
+        import json as _json
+
+        got = cp.members.get("member1").get("v1/ConfigMap", "default",
+                                            "db-config")
+        assert _json.loads(got.spec["data"]["cfg.json"]) == {
+            "replicas": 1, "debug": True}
+
+    def test_webhook_rejects_json_and_yaml_together(self):
+        import pytest
+        from karmada_tpu.api.policy import FieldOverrider, FieldPatchOperation
+        from karmada_tpu.webhook import ValidationError
+
+        cp = make_plane(1)
+        bad = OverridePolicy(
+            meta=ObjectMeta(name="bad", namespace="default"),
+            spec=OverrideSpec(
+                resource_selectors=[ResourceSelector(api_version="v1",
+                                                     kind="ConfigMap")],
+                override_rules=[RuleWithCluster(overriders=Overriders(
+                    field_overrider=[FieldOverrider(
+                        field_path="/spec/data/x",
+                        json=[FieldPatchOperation(sub_path="/a")],
+                        yaml=[FieldPatchOperation(sub_path="/b")],
+                    )]
+                ))],
+            ),
+        )
+        with pytest.raises(ValidationError):
+            cp.store.apply(bad)
