@@ -670,3 +670,35 @@ class TestFieldOverrider:
         )
         with pytest.raises(ValidationError):
             cp.store.apply(bad)
+
+
+class TestSchedulerNameFilter:
+    """event_handler.go:93-113: a binding addressed to a different scheduler
+    is left untouched by the default scheduler instance."""
+
+    def test_foreign_scheduler_name_is_ignored(self):
+        cp = make_plane(2)
+        pol = nginx_policy(dynamic_weight_placement())
+        pol.spec.scheduler_name = "my-custom-scheduler"
+        cp.store.apply(new_deployment("web", replicas=4))
+        cp.store.apply(pol)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert rb.spec.scheduler_name == "my-custom-scheduler"
+        assert rb.spec.clusters == []  # nobody scheduled it
+
+    def test_second_scheduler_instance_picks_it_up(self):
+        from karmada_tpu.controllers.scheduler_controller import (
+            SchedulerController,
+        )
+
+        cp = make_plane(2)
+        SchedulerController(cp.store, cp.runtime,
+                            scheduler_name="my-custom-scheduler")
+        pol = nginx_policy(dynamic_weight_placement())
+        pol.spec.scheduler_name = "my-custom-scheduler"
+        cp.store.apply(new_deployment("web", replicas=4))
+        cp.store.apply(pol)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert sum(tc.replicas for tc in rb.spec.clusters) == 4
