@@ -407,18 +407,35 @@ class BindingStatusController:
             cluster = cluster_of_execution_namespace(work.meta.namespace)
             if cluster is None:
                 continue
-            applied = any(
-                c.type == WORK_APPLIED and c.status for c in work.status.conditions
+            applied_cond = next(
+                (c for c in work.status.conditions if c.type == WORK_APPLIED),
+                None,
             )
+            applied = applied_cond is not None and applied_cond.status
             if applied:
                 applied_clusters.add(cluster)
-            for ms in work.status.manifest_statuses:
+            if work.status.manifest_statuses:
+                for ms in work.status.manifest_statuses:
+                    items.append(
+                        AggregatedStatusItem(
+                            cluster_name=cluster,
+                            status=ms.status,
+                            applied=applied,
+                            health=ms.health,
+                        )
+                    )
+            elif applied_cond is not None and not applied:
+                # a Work that failed to apply (conflict, unreachable) never
+                # reports manifest statuses — the failure must still be
+                # visible in the binding aggregation (the reference emits
+                # per-manifest items with Applied=false + AppliedMessage)
                 items.append(
                     AggregatedStatusItem(
                         cluster_name=cluster,
-                        status=ms.status,
-                        applied=applied,
-                        health=ms.health,
+                        status=None,
+                        applied=False,
+                        health="Unknown",
+                        applied_message=applied_cond.message,
                     )
                 )
         items.sort(key=lambda i: i.cluster_name)

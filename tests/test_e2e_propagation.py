@@ -702,3 +702,43 @@ class TestSchedulerNameFilter:
         cp.settle()
         rb = next(iter(cp.store.list("ResourceBinding")))
         assert sum(tc.replicas for tc in rb.spec.clusters) == 4
+
+
+class TestPortingWorkloads:
+    """porting_workloads_test.go: a member already holds an unmanaged
+    same-named object. Default Abort conflict resolution refuses that
+    cluster (others proceed); Overwrite adopts it."""
+
+    def _plane(self):
+        cp = make_plane(2)
+        from karmada_tpu.api.core import Resource
+
+        legacy = new_deployment("web", replicas=9)  # diverged legacy content
+        cp.members.get("member1").apply(legacy)
+        return cp
+
+    def test_abort_refuses_conflicting_cluster_only(self):
+        cp = self._plane()
+        cp.store.apply(new_deployment("web", replicas=2))
+        cp.store.apply(nginx_policy(duplicated_placement()))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        applied = {i.cluster_name: i.applied for i in rb.status.aggregated_status}
+        assert applied.get("member2") is True
+        assert applied.get("member1") is False  # conflict: unmanaged object
+        # the legacy object was not stomped
+        got = cp.members.get("member1").get("apps/v1/Deployment", "default", "web")
+        assert got.spec["replicas"] == 9
+
+    def test_overwrite_adopts_conflicting_object(self):
+        cp = self._plane()
+        cp.store.apply(new_deployment("web", replicas=2))
+        pol = nginx_policy(duplicated_placement())
+        pol.spec.conflict_resolution = "Overwrite"
+        cp.store.apply(pol)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        applied = {i.cluster_name: i.applied for i in rb.status.aggregated_status}
+        assert applied.get("member1") is True
+        got = cp.members.get("member1").get("apps/v1/Deployment", "default", "web")
+        assert got.spec["replicas"] == 2  # adopted and converged
