@@ -113,6 +113,7 @@ def take_by_weight_fast(
         floors = weights * num // safe_total
     remain = num - jnp.sum(floors)
 
+    k_top = min(k_top, c)  # callers size k_top from replicas; small fleets clamp
     key = (weights << (l_bits + i_bits)) | (last << i_bits) | (c - 1 - idx)
     top_vals = lax.top_k(key, k_top)[0]
     pos = jnp.clip(remain - 1, 0, k_top - 1)

@@ -208,3 +208,62 @@ class TestEstimate:
             R.merge_estimates(7, [[-1, -1], [-1, 3]], 2),
         ]
         np.testing.assert_array_equal(got, want)
+
+
+class TestFastPathBoundaries:
+    """Adversarial inputs at the packed-key bit boundaries: max-value
+    weights, all-equal ties, remainder rank at k_top, zero weights."""
+
+    def _compare(self, num, w, last, wide_ref=True, fast=None):
+        from karmada_tpu.ops import take_by_weight, take_by_weight_fast
+
+        c = len(w)
+        args = (
+            jnp.asarray(num, jnp.int32), jnp.asarray(w, jnp.int32),
+            jnp.asarray(last, jnp.int32), jnp.zeros(c, jnp.int32),
+        )
+        want = np.asarray(take_by_weight(*args, wide_ref))
+        got = np.asarray(take_by_weight_fast(*args, *fast))
+        np.testing.assert_array_equal(got, want)
+
+    def test_weights_at_bit_ceiling(self):
+        # w_bits=10: every weight at 1023 (max representable), heavy ties
+        c = 17
+        self._compare(100, [1023] * c, [0] * c, fast=(10, 4, 16, True))
+
+    def test_remainder_rank_equals_k_top(self):
+        # num chosen so remain lands exactly at the k_top boundary
+        w = [7, 7, 7, 7, 7, 7, 7, 7]
+        self._compare(12, w, [0] * 8, fast=(4, 4, 8, True))
+
+    def test_last_tiebreak_at_ceiling(self):
+        w = [5] * 12
+        last = [15, 0, 15, 0, 15, 0, 15, 0, 15, 0, 15, 0]  # l_bits=4 max
+        self._compare(7, w, last, fast=(4, 4, 8, True))
+
+    def test_all_zero_weights_return_init(self):
+        self._compare(9, [0] * 6, [3] * 6, fast=(4, 4, 8, True))
+
+    def test_int32_div_path_without_f32(self):
+        # div_f32=False exercises the plain integer floor-div in the fast
+        # kernel (products above 2^24 would use it)
+        self._compare(1000, [900, 800, 700, 600], [0] * 4,
+                      fast=(10, 4, 4, False))
+
+    def test_randomized_boundary_sweep(self):
+        rng = np.random.default_rng(77)
+        for _ in range(40):
+            c = int(rng.integers(1, 33))
+            w_bits = int(rng.integers(1, 12))
+            l_bits = int(rng.integers(1, 8))
+            if w_bits + l_bits + max(1, (c - 1).bit_length()) > 31:
+                continue
+            wmax = (1 << w_bits) - 1
+            lmax = (1 << l_bits) - 1
+            w = rng.integers(0, wmax + 1, size=c)
+            last = rng.integers(0, lmax + 1, size=c)
+            num = int(rng.integers(0, 2 * wmax + 2))
+            k_top = min(c, 1 << max(1, max(1, num) - 1).bit_length())
+            div_f32 = wmax * max(num, 1) < 2**24
+            self._compare(num, w.tolist(), last.tolist(),
+                          fast=(w_bits, l_bits, k_top, div_f32))
