@@ -742,3 +742,44 @@ class TestPortingWorkloads:
         assert applied.get("member1") is True
         got = cp.members.get("member1").get("apps/v1/Deployment", "default", "web")
         assert got.spec["replicas"] == 2  # adopted and converged
+
+
+class TestClusterPropagationPolicy:
+    """clusterpropagationpolicy_test.go: a CPP serves namespaced templates
+    when no namespaced policy matches, and a namespaced PP outranks it."""
+
+    def _cpp(self, placement, name="cpp"):
+        from karmada_tpu.api import ClusterPropagationPolicy
+
+        return ClusterPropagationPolicy(
+            meta=ObjectMeta(name=name),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=placement,
+            ),
+        )
+
+    def test_cpp_binds_namespaced_template(self):
+        cp = make_plane(2)
+        cp.store.apply(new_deployment("web", replicas=4))
+        cp.store.apply(self._cpp(static_weight_placement({"member1": 1})))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member1"}
+        template = cp.store.get("Resource", "default/web")
+        assert template.meta.labels.get(
+            "clusterpropagationpolicy.karmada.io/name") == "cpp"
+
+    def test_namespaced_pp_outranks_cpp(self):
+        cp = make_plane(2)
+        cp.store.apply(new_deployment("web", replicas=4))
+        cp.store.apply(self._cpp(static_weight_placement({"member1": 1})))
+        cp.store.apply(nginx_policy(static_weight_placement({"member2": 1})))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member2"}
+        template = cp.store.get("Resource", "default/web")
+        assert template.meta.labels.get(
+            "propagationpolicy.karmada.io/name") == "nginx-policy"
