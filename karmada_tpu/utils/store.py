@@ -147,6 +147,46 @@ class Store:
             objs = [o for o in objs if o.meta.namespace == namespace]
         return objs
 
+    # -- durability (checkpoint/resume; SURVEY.md section 5) ---------------
+
+    def checkpoint(self, path: str) -> int:
+        """Serialize every object to ``path`` (the etcd-snapshot analogue:
+        the store is the single source of truth, controllers and the solver
+        are stateless, so a snapshot + replay IS resume). Returns the number
+        of objects written."""
+        import pickle
+
+        with self._lock:
+            payload = {
+                kind: dict(bucket) for kind, bucket in self._buckets.items()
+            }
+            rv = self._rv
+        with open(path, "wb") as f:
+            pickle.dump({"rv": rv, "buckets": payload}, f)
+        return sum(len(b) for b in payload.values())
+
+    def restore(self, path: str) -> int:
+        """Load a checkpoint into this (fresh) store, replaying every object
+        through the watch bus as Added so already-registered controllers
+        rebuild their working state — the reconcile-from-listing pattern the
+        reference relies on after an apiserver restart. Admission is NOT
+        re-run: the snapshot was admitted when it was written."""
+        import pickle
+
+        with open(path, "rb") as f:
+            snap = pickle.load(f)
+        events = []
+        with self._lock:
+            self._rv = max(self._rv, snap["rv"])
+            for kind, bucket in snap["buckets"].items():
+                dst = self._buckets.setdefault(kind, {})
+                for key, obj in bucket.items():
+                    dst[key] = obj
+                    events.append(Event(ADDED, kind, key, obj))
+        for event in events:
+            self._deliver(event)
+        return len(events)
+
     def kinds(self) -> Iterable[str]:
         with self._lock:
             return list(self._buckets.keys())
