@@ -418,10 +418,27 @@ def validate_work(work) -> None:
         )
 
 
+def mutate_cluster(cluster) -> None:
+    """Cluster defaulting (apis/cluster/mutation/mutation.go): when the
+    CustomizedClusterResourceModeling gate is on, an empty resourceModels
+    gets the nine default cpu/memory grades; declared models standardize
+    (grade-sorted, first min 0, last max open)."""
+    from ..api.cluster import default_resource_models, standardize_resource_models
+    from ..utils.features import CUSTOMIZED_CLUSTER_RESOURCE_MODELING, feature_gate
+
+    if not feature_gate.enabled(CUSTOMIZED_CLUSTER_RESOURCE_MODELING):
+        return
+    if not cluster.spec.resource_models:
+        cluster.spec.resource_models = default_resource_models()
+    else:
+        standardize_resource_models(cluster.spec.resource_models)
+
+
 def default_admission_chain() -> AdmissionChain:
     """The full reference handler set (cmd/webhook/app/webhook.go:161-183;
     /convert is N/A — no CRD versioning in-proc)."""
     chain = AdmissionChain()
+    chain.register_mutator("Cluster", mutate_cluster)
     for kind in ("PropagationPolicy", "ClusterPropagationPolicy"):
         chain.register_mutator(kind, mutate_propagation_policy)
         chain.register_validator(kind, validate_propagation_policy)

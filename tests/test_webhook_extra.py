@@ -136,3 +136,52 @@ class TestFieldSelectorValidation:
             field_selector=FieldSelector(match_expressions=[
                 LabelSelectorRequirement(key="region", operator="NotIn",
                                          values=["us-east1"])]))))
+
+
+class TestClusterResourceModelDefaulting:
+    def test_empty_models_get_nine_default_grades(self):
+        from karmada_tpu.utils.builders import new_cluster
+        from karmada_tpu.webhook.chain import mutate_cluster
+
+        cl = new_cluster("m1")
+        assert cl.spec.resource_models == []
+        mutate_cluster(cl)
+        grades = [m.grade for m in cl.spec.resource_models]
+        assert grades == list(range(9))
+        first, last = cl.spec.resource_models[0], cl.spec.resource_models[-1]
+        assert all(r.min == 0 for r in first.ranges)
+        assert all(r.max == 2**63 - 1 for r in last.ranges)
+        cpu1 = next(r for r in cl.spec.resource_models[1].ranges
+                    if r.name == "cpu")
+        assert (cpu1.min, cpu1.max) == (1000, 2000)  # canonical milli units
+
+    def test_declared_models_standardize(self):
+        from karmada_tpu.api.cluster import ResourceModel, ResourceModelRange
+        from karmada_tpu.utils.builders import new_cluster
+        from karmada_tpu.webhook.chain import mutate_cluster
+
+        cl = new_cluster("m1")
+        cl.spec.resource_models = [
+            ResourceModel(grade=1, ranges=[
+                ResourceModelRange(name="cpu", min=2000, max=4000)]),
+            ResourceModel(grade=0, ranges=[
+                ResourceModelRange(name="cpu", min=500, max=2000)]),
+        ]
+        mutate_cluster(cl)
+        assert [m.grade for m in cl.spec.resource_models] == [0, 1]
+        assert cl.spec.resource_models[0].ranges[0].min == 0  # first min -> 0
+        assert cl.spec.resource_models[-1].ranges[0].max == 2**63 - 1
+
+    def test_gate_off_leaves_models_alone(self):
+        from karmada_tpu.utils.builders import new_cluster
+        from karmada_tpu.utils.features import (
+            CUSTOMIZED_CLUSTER_RESOURCE_MODELING, feature_gate)
+        from karmada_tpu.webhook.chain import mutate_cluster
+
+        feature_gate.set(CUSTOMIZED_CLUSTER_RESOURCE_MODELING, False)
+        try:
+            cl = new_cluster("m1")
+            mutate_cluster(cl)
+            assert cl.spec.resource_models == []
+        finally:
+            feature_gate.set(CUSTOMIZED_CLUSTER_RESOURCE_MODELING, True)
