@@ -434,11 +434,59 @@ def mutate_cluster(cluster) -> None:
         standardize_resource_models(cluster.spec.resource_models)
 
 
+def validate_cluster(cluster) -> None:
+    """Cluster invariants (apis/cluster/validation/validation.go): DNS-ish
+    name <= 48 chars, a supported sync mode, and a contiguous gapless model
+    ladder (same resource set per grade, max > min, each min = previous
+    max, first mins 0, last maxes MaxInt64). Runs after mutate_cluster, so
+    standardized/defaulted models must pass."""
+    import re
+
+    from ..api.cluster import MAX_INT64
+
+    name = cluster.meta.name
+    if not name or len(name) > 48 or not re.fullmatch(
+        r"[a-z0-9]([-a-z0-9]*[a-z0-9])?", name
+    ):
+        raise ValidationError(
+            f"invalid cluster name {name!r} (DNS-1123 label, max 48 chars)"
+        )
+    if cluster.spec.sync_mode not in ("Push", "Pull"):
+        raise ValidationError(
+            f"invalid syncMode {cluster.spec.sync_mode!r} (Push or Pull)"
+        )
+    models = cluster.spec.resource_models
+    for i, model in enumerate(models):
+        if i and model.grade == models[i - 1].grade:
+            raise ValidationError("model grades must be distinct")
+        if i and len(models[i - 1].ranges) != len(model.ranges):
+            raise ValidationError("models must cover the same resource count")
+        for j, rng in enumerate(model.ranges):
+            if rng.max <= rng.min:
+                raise ValidationError("model range max must exceed min")
+            if i == 0:
+                if rng.min != 0:
+                    raise ValidationError("first grade minimums must be 0")
+            else:
+                prev = models[i - 1].ranges[j]
+                if prev.name != rng.name:
+                    raise ValidationError(
+                        "models must cover the same resources in order"
+                    )
+                if prev.max != rng.min:
+                    raise ValidationError(
+                        "model intervals must be contiguous and non-overlapping"
+                    )
+            if i == len(models) - 1 and rng.max != MAX_INT64:
+                raise ValidationError("last grade maximums must be MaxInt64")
+
+
 def default_admission_chain() -> AdmissionChain:
     """The full reference handler set (cmd/webhook/app/webhook.go:161-183;
     /convert is N/A — no CRD versioning in-proc)."""
     chain = AdmissionChain()
     chain.register_mutator("Cluster", mutate_cluster)
+    chain.register_validator("Cluster", validate_cluster)
     for kind in ("PropagationPolicy", "ClusterPropagationPolicy"):
         chain.register_mutator(kind, mutate_propagation_policy)
         chain.register_validator(kind, validate_propagation_policy)

@@ -185,3 +185,57 @@ class TestClusterResourceModelDefaulting:
             assert cl.spec.resource_models == []
         finally:
             feature_gate.set(CUSTOMIZED_CLUSTER_RESOURCE_MODELING, True)
+
+
+class TestClusterValidation:
+    def _cluster(self):
+        from karmada_tpu.utils.builders import new_cluster
+
+        return new_cluster("ok-name")
+
+    def test_bad_name_rejected(self):
+        import pytest
+        from karmada_tpu.webhook import ValidationError
+        from karmada_tpu.webhook.chain import validate_cluster
+
+        cl = self._cluster()
+        cl.meta.name = "Bad_Name!"
+        with pytest.raises(ValidationError):
+            validate_cluster(cl)
+        cl.meta.name = "x" * 49
+        with pytest.raises(ValidationError):
+            validate_cluster(cl)
+
+    def test_bad_sync_mode_rejected(self):
+        import pytest
+        from karmada_tpu.webhook import ValidationError
+        from karmada_tpu.webhook.chain import validate_cluster
+
+        cl = self._cluster()
+        cl.spec.sync_mode = "Sideways"
+        with pytest.raises(ValidationError):
+            validate_cluster(cl)
+
+    def test_non_contiguous_models_rejected(self):
+        import pytest
+        from karmada_tpu.api.cluster import (
+            MAX_INT64, ResourceModel, ResourceModelRange)
+        from karmada_tpu.webhook import ValidationError
+        from karmada_tpu.webhook.chain import validate_cluster
+
+        cl = self._cluster()
+        cl.spec.resource_models = [
+            ResourceModel(grade=0, ranges=[
+                ResourceModelRange(name="cpu", min=0, max=1000)]),
+            ResourceModel(grade=1, ranges=[
+                ResourceModelRange(name="cpu", min=1500, max=MAX_INT64)]),
+        ]
+        with pytest.raises(ValidationError):
+            validate_cluster(cl)  # gap 1000..1500
+
+    def test_defaulted_models_pass(self):
+        from karmada_tpu.webhook.chain import mutate_cluster, validate_cluster
+
+        cl = self._cluster()
+        mutate_cluster(cl)
+        validate_cluster(cl)  # the nine default grades are self-consistent
