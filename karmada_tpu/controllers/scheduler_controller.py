@@ -32,9 +32,14 @@ class SchedulerController:
         extra_estimators=(),
         disabled_plugins=(),
         custom_filters=(),
+        clock=None,
     ) -> None:
         self.store = store
         self.scheduler_name = scheduler_name
+        # last_scheduled_time is compared against rescheduleTriggeredAt,
+        # which other controllers stamp from the plane clock — both sides
+        # must share one time base or Fresh triggers silently degrade
+        self.clock = clock or time.time
         self.extra_estimators = list(extra_estimators)
         # --plugins enable/disable list + out-of-tree filter registry
         # (scheduler.go:243-247, framework/runtime/registry.go); both reach
@@ -157,13 +162,13 @@ class SchedulerController:
                 ]
             if [(tc.name, tc.replicas) for tc in rb.spec.clusters] != before:
                 changed = True
-                rb.status.last_scheduled_time = time.time()
+                rb.status.last_scheduled_time = self.clock()
             rb.status.scheduler_observed_generation = rb.meta.generation
             if rb.status.scheduler_observed_affinity_name != result.affinity_name:
                 rb.status.scheduler_observed_affinity_name = result.affinity_name
                 changed = True
             if rb.status.last_scheduled_time is None:
-                rb.status.last_scheduled_time = time.time()
+                rb.status.last_scheduled_time = self.clock()
                 changed = True
             if set_condition(
                 rb.status.conditions,

@@ -109,6 +109,7 @@ class ControlPlane:
             extra_estimators=extra,
             disabled_plugins=disabled_scheduler_plugins,
             custom_filters=scheduler_filter_plugins,
+            clock=self.clock,
         )
         self.descheduler = (
             Descheduler(self.store, self.runtime, self.members, clock=self.clock)

@@ -248,3 +248,35 @@ class TestClusterScopedBindings:
                 )
                 is not None
             )
+
+    def test_fresh_uses_plane_clock(self):
+        """Regression: last_scheduled_time must come from the plane clock.
+        With wall time leaking in, a fake-clock rescheduleTriggeredAt could
+        never exceed it and Fresh silently degraded to a steady no-op."""
+        clock = [7000.0]
+        cp = ControlPlane(clock=lambda: clock[0])
+        cp.join_cluster(new_cluster("small", cpu="4", memory="200Gi"))
+        cp.store.apply(new_deployment("app", replicas=4, cpu="1"))
+        cp.store.apply(nginx_policy(dynamic_weight_placement()))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert {tc.name for tc in rb.spec.clusters} == {"small"}
+
+        # a much larger cluster joins; Steady mode keeps placements...
+        cp.join_cluster(new_cluster("big", cpu="400", memory="800Gi"))
+        clock[0] += 10
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert {tc.name for tc in rb.spec.clusters} == {"small"}
+
+        # ...until a rebalancer triggers Fresh, which must actually fire
+        # (fake trigger time > fake last_scheduled_time) and redistribute
+        cp.store.apply(WorkloadRebalancer(
+            meta=ObjectMeta(name="go-fresh"),
+            spec=WorkloadRebalancerSpec(workloads=[
+                ObjectReferenceSelector(kind="Deployment", name="app")]),
+        ))
+        clock[0] += 10
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert "big" in {tc.name for tc in rb.spec.clusters}
