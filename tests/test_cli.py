@@ -185,3 +185,20 @@ class TestDeinit:
         assert list(cp.members.names()) == []
         for kind in cp.store.kinds():
             assert cp.store.list(kind) == [], kind
+
+
+class TestGetAcrossClusters:
+    def test_get_resolves_from_members_and_karmada(self):
+        cp = cli.cmd_local_up(2)
+        cp.store.apply(new_deployment("app", replicas=2))
+        cp.store.apply(policy(duplicated_placement()))
+        cp.settle()
+        # proxy chain answers from the cache first
+        resp = cli.cmd_get(cp, "apps/v1/Deployment", "default", "app")
+        assert resp.error == "" and resp.obj is not None
+        assert resp.obj.spec["replicas"] == 2
+        # single-cluster scope goes to that member
+        one = cli.cmd_get(cp, "apps/v1/Deployment", "default", "app",
+                          cluster="member2")
+        assert one.error == "" and one.obj is not None
+        assert one.served_by in ("cluster", "cache")
