@@ -63,8 +63,13 @@ class ResourceDetector:
         self.worker = runtime.new_worker("detector", self._reconcile)
         # keys whose pending reconcile was triggered ONLY by Karmada itself
         # (policy events), not by a user template change — consumed by the
-        # lazy-activation gate (detector.go:444,529 resourceChangeByKarmada)
+        # lazy-activation gate (detector.go:444,529 resourceChangeByKarmada).
+        # _user_pending tracks queued template-event keys so a policy event
+        # arriving AFTER a user change (but before the worker drains) cannot
+        # re-mark the coalesced reconcile as Karmada-triggered and swallow
+        # the user's update under a Lazy policy.
         self._by_karmada: set[str] = set()
+        self._user_pending: set[str] = set()
         store.watch("Resource", self._on_template_event)
         store.watch("PropagationPolicy", self._on_policy_event)
         store.watch("ClusterPropagationPolicy", self._on_policy_event)
@@ -73,6 +78,7 @@ class ResourceDetector:
 
     def _on_template_event(self, event) -> None:
         self._by_karmada.discard(event.key)  # a user change always syncs
+        self._user_pending.add(event.key)
         self.worker.enqueue(event.key)
 
     def _on_policy_event(self, event) -> None:
@@ -88,14 +94,17 @@ class ResourceDetector:
                 or template.meta.labels.get(CLUSTER_POLICY_LABEL) == pname
             )
             if claimed or policy_matches(template, selectors):
-                self._by_karmada.add(template.meta.namespaced_name)
-                self.worker.enqueue(template.meta.namespaced_name)
+                key = template.meta.namespaced_name
+                if key not in self._user_pending:
+                    self._by_karmada.add(key)
+                self.worker.enqueue(key)
 
     # -- reconcile ---------------------------------------------------------
 
     def _reconcile(self, key: str) -> Optional[str]:
         by_karmada = key in self._by_karmada
         self._by_karmada.discard(key)
+        self._user_pending.discard(key)
         template = self.store.get("Resource", key)
         if template is None:
             self._remove_binding_for(key)
@@ -112,7 +121,6 @@ class ResourceDetector:
         """Priority + preemption matching. Namespaced policies outrank
         cluster-scoped ones for namespaced resources (detector.go ordering:
         PropagationPolicy first, then ClusterPropagationPolicy)."""
-        claimed_by = template.meta.labels.get(POLICY_LABEL)
         candidates = [
             p
             for p in self.store.list("PropagationPolicy", template.meta.namespace or None)
@@ -120,6 +128,7 @@ class ResourceDetector:
             and policy_matches(template, p.spec.resource_selectors)
         ]
         pool = sorted(candidates, key=lambda p: _policy_priority(p, template))
+        claimed_by = template.meta.labels.get(POLICY_LABEL)
         if not pool:
             cluster_pool = sorted(
                 (
@@ -130,6 +139,10 @@ class ResourceDetector:
                 key=lambda p: _policy_priority(p, template),
             )
             pool = cluster_pool
+            # the preemption gate guards whichever claim kind this pool
+            # competes for — a CPP-claimed template is protected from other
+            # CPPs exactly like a PP-claimed one from other PPs
+            claimed_by = template.meta.labels.get(CLUSTER_POLICY_LABEL)
         if not pool:
             return None
         best = pool[0]

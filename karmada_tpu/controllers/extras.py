@@ -161,6 +161,10 @@ class WorkloadRebalancerController:
         finish_time = rebalancer.status.finish_time
         if finished and finish_time is None:
             finish_time = self.clock()
+        elif not finished:
+            # new unfinished work (e.g. a spec update added workloads) must
+            # clear the stamp, or the TTL sweep deletes a pending rebalancer
+            finish_time = None
         changed = (
             rebalancer.status.observed_workloads != observed
             or rebalancer.status.observed_generation != rebalancer.meta.generation

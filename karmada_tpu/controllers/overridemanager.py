@@ -142,6 +142,8 @@ def _apply_field_overrider(obj: Resource, fo) -> None:
 
     import yaml as _yaml
 
+    if not fo.json and not fo.yaml:
+        return  # no operations: never parse/re-serialize (format-preserving)
     doc = {"spec": obj.spec, "metadata": {"labels": obj.meta.labels,
                                           "annotations": obj.meta.annotations}}
     parent, leaf = _resolve_parent(doc, fo.field_path)

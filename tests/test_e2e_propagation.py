@@ -783,3 +783,76 @@ class TestClusterPropagationPolicy:
         template = cp.store.get("Resource", "default/web")
         assert template.meta.labels.get(
             "propagationpolicy.karmada.io/name") == "nginx-policy"
+
+
+class TestLazyGateRaces:
+    def test_user_update_survives_concurrent_lazy_policy_event(self):
+        """A user template update queued BEFORE a lazy-policy event in the
+        same settle batch must still sync (the coalesced reconcile may not
+        be marked Karmada-triggered)."""
+        cp = make_plane(3)
+        lazy = nginx_policy(static_weight_placement({"member1": 1}),
+                            name="lazy")
+        lazy.spec.activation_preference = "Lazy"
+        cp.store.apply(new_deployment("web", replicas=4))
+        cp.store.apply(lazy)
+        cp.settle()
+        # same batch: user bumps replicas, THEN the policy changes
+        cp.store.apply(new_deployment("web", replicas=8))
+        lazy2 = nginx_policy(static_weight_placement({"member2": 1}),
+                             name="lazy")
+        lazy2.spec.activation_preference = "Lazy"
+        cp.store.apply(lazy2)
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        # the user's replica change applied (and with it the new placement,
+        # since the template edit activates the pending policy content)
+        assert rb.spec.replicas == 8
+
+
+class TestCppPreemptionGate:
+    def test_cpp_claim_protected_like_pp_claim(self):
+        from karmada_tpu.api import ClusterPropagationPolicy
+
+        def cpp(name, placement, priority=0, preemption="Never"):
+            p = ClusterPropagationPolicy(
+                meta=ObjectMeta(name=name),
+                spec=PropagationSpec(
+                    resource_selectors=[ResourceSelector(
+                        api_version="apps/v1", kind="Deployment")],
+                    placement=placement,
+                ),
+            )
+            p.spec.priority = priority
+            p.spec.preemption = preemption
+            return p
+
+        cp = make_plane(2)
+        cp.store.apply(new_deployment("web", replicas=4))
+        cp.store.apply(cpp("a", static_weight_placement({"member1": 1})))
+        cp.settle()
+        # higher-priority CPP without preemption=Always (gate off anyway)
+        # must NOT steal the claim
+        cp.store.apply(cpp("b", static_weight_placement({"member2": 1}),
+                           priority=10))
+        cp.settle()
+        rb = next(iter(cp.store.list("ResourceBinding")))
+        assert {tc.name for tc in rb.spec.clusters} == {"member1"}
+        template = cp.store.get("Resource", "default/web")
+        assert template.meta.labels.get(
+            "clusterpropagationpolicy.karmada.io/name") == "a"
+
+
+class TestFieldOverriderNoOps:
+    def test_empty_operation_lists_preserve_document_format(self):
+        from karmada_tpu.api.core import Resource
+        from karmada_tpu.api.policy import FieldOverrider, Overriders
+        from karmada_tpu.controllers.overridemanager import apply_overriders
+
+        obj = Resource(api_version="v1", kind="ConfigMap",
+                       meta=ObjectMeta(name="c", namespace="default"),
+                       spec={"data": {"cfg.json": '{"a": 1}'}})
+        apply_overriders(obj, Overriders(field_overrider=[
+            FieldOverrider(field_path="/spec/data/cfg.json")]))
+        # no ops -> the embedded JSON must NOT be re-serialized as YAML
+        assert obj.spec["data"]["cfg.json"] == '{"a": 1}'
