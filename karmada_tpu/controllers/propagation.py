@@ -70,6 +70,62 @@ def _work_signature(work: Work):
     )
 
 
+class WorkIndex:
+    """Incremental indexes over Work objects, maintained from watch events
+    (the informer-indexer analogue). Kills the O(bindings x works) scans
+    the binding/status controllers would otherwise pay per reconcile:
+    - by binding label (orphan cleanup, status aggregation)
+    - by propagated target (cluster, gvk, namespace, name) for member-event
+      routing in the work-status controller."""
+
+    def __init__(self, store: Store) -> None:
+        self.store = store
+        self._by_binding: dict[str, set[str]] = {}
+        self._by_target: dict[tuple, str] = {}
+        self._work_meta: dict[str, tuple] = {}  # work key -> (ref, targets)
+        store.watch("Work", self._on_event)
+
+    def _on_event(self, event) -> None:
+        key = event.key
+        old_ref, old_targets = self._work_meta.pop(key, (None, ()))
+        if old_ref is not None:
+            self._by_binding.get(old_ref, set()).discard(key)
+        for t in old_targets:
+            if self._by_target.get(t) == key:
+                del self._by_target[t]
+        if event.type == "Deleted":
+            return
+        work = event.obj
+        ref = work.meta.labels.get(WORK_BINDING_LABEL)
+        cluster = cluster_of_execution_namespace(work.meta.namespace)
+        targets = (
+            tuple(
+                (cluster, f"{w.api_version}/{w.kind}",
+                 w.meta.namespace, w.meta.name)
+                for w in work.spec.workload
+            )
+            if cluster is not None
+            else ()
+        )
+        if ref:
+            self._by_binding.setdefault(ref, set()).add(key)
+        for t in targets:
+            self._by_target[t] = key
+        self._work_meta[key] = (ref, targets)
+
+    def works_for(self, binding_ref: str) -> list:
+        out = []
+        for key in sorted(self._by_binding.get(binding_ref, ())):
+            work = self.store.get("Work", key)
+            if work is not None:
+                out.append(work)
+        return out
+
+    def work_for_target(self, cluster: str, gvk: str, namespace: str, name: str):
+        key = self._by_target.get((cluster, gvk, namespace, name))
+        return self.store.get("Work", key) if key else None
+
+
 class BindingController:
     """ResourceBinding -> per-target-cluster Work objects."""
 
@@ -78,9 +134,11 @@ class BindingController:
         store: Store,
         runtime: Runtime,
         interpreter: ResourceInterpreter,
+        work_index: Optional[WorkIndex] = None,
     ) -> None:
         self.store = store
         self.interpreter = interpreter
+        self.work_index = work_index or WorkIndex(store)
         self.overrides = OverrideManager(store)
         self.worker = runtime.new_worker("binding", self._reconcile)
         for kind in BINDING_KINDS:
@@ -169,9 +227,7 @@ class BindingController:
         self.store.apply(work)
 
     def _cleanup_works(self, binding_key: str, keep_clusters: set[str]) -> None:
-        for work in self.store.list("Work"):
-            if work.meta.labels.get(WORK_BINDING_LABEL) != binding_key:
-                continue
+        for work in self.work_index.works_for(binding_key):
             cluster = cluster_of_execution_namespace(work.meta.namespace)
             if cluster not in keep_clusters:
                 self.store.delete("Work", work.meta.namespaced_name)
@@ -289,10 +345,12 @@ class WorkStatusController:
         runtime: Runtime,
         members: MemberClientRegistry,
         interpreter: ResourceInterpreter,
+        work_index: Optional[WorkIndex] = None,
     ) -> None:
         self.store = store
         self.members = members
         self.interpreter = interpreter
+        self.work_index = work_index or WorkIndex(store)
         self.worker = runtime.new_worker("work-status", self._reconcile)
         for name in members.names():
             client = members.get(name)
@@ -308,8 +366,8 @@ class WorkStatusController:
         )
 
     def _find_work(self, cluster: str, gvk: str, namespace: str, name: str):
-        ns = execution_namespace(cluster)
-        for work in self.store.list("Work", ns):
+        work = self.work_index.work_for_target(cluster, gvk, namespace, name)
+        if work is not None:
             for workload in work.spec.workload:
                 if (
                     f"{workload.api_version}/{workload.kind}" == gvk
@@ -381,9 +439,11 @@ class BindingStatusController:
         store: Store,
         runtime: Runtime,
         detector,
+        work_index: Optional[WorkIndex] = None,
     ) -> None:
         self.store = store
         self.detector = detector
+        self.work_index = work_index or WorkIndex(store)
         self.worker = runtime.new_worker("binding-status", self._reconcile)
         store.watch("Work", self._on_work_event)
 
@@ -401,9 +461,7 @@ class BindingStatusController:
             return DONE
         items: list[AggregatedStatusItem] = []
         applied_clusters = set()
-        for work in self.store.list("Work"):
-            if work.meta.labels.get(WORK_BINDING_LABEL) != ref:
-                continue
+        for work in self.work_index.works_for(ref):
             cluster = cluster_of_execution_namespace(work.meta.namespace)
             if cluster is None:
                 continue

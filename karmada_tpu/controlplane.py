@@ -72,18 +72,26 @@ class ControlPlane:
         self.interpreter = default_interpreter()
         self.estimators = EstimatorRegistry()
 
+        from .controllers.propagation import WorkIndex
+
         self.detector = ResourceDetector(self.store, self.runtime, self.interpreter)
+        # one shared Work index (informer-indexer analogue) serves the
+        # binding, work-status and binding-status controllers
+        self.work_index = WorkIndex(self.store)
         self.binding_controller = BindingController(
-            self.store, self.runtime, self.interpreter
+            self.store, self.runtime, self.interpreter,
+            work_index=self.work_index,
         )
         self.execution_controller = ExecutionController(
             self.store, self.runtime, self.members, self.interpreter
         )
         self.work_status_controller = WorkStatusController(
-            self.store, self.runtime, self.members, self.interpreter
+            self.store, self.runtime, self.members, self.interpreter,
+            work_index=self.work_index,
         )
         self.binding_status_controller = BindingStatusController(
-            self.store, self.runtime, self.detector
+            self.store, self.runtime, self.detector,
+            work_index=self.work_index,
         )
         self.cluster_status_controller = ClusterStatusController(
             self.store, self.runtime, self.members, clock=self.clock

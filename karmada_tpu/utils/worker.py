@@ -26,9 +26,24 @@ class Worker:
 
     MAX_RETRIES = 16
 
-    def __init__(self, name: str, reconcile: Callable[[Hashable], Optional[str]]):
+    def __init__(
+        self,
+        name: str,
+        reconcile: Callable[[Hashable], Optional[str]],
+        *,
+        reconcile_batch: Optional[
+            Callable[[list[Hashable]], dict[Hashable, Optional[str]]]
+        ] = None,
+        batch_size: int = 1024,
+    ):
         self.name = name
         self.reconcile = reconcile
+        # optional vectorized drain: given up to batch_size queued keys,
+        # returns per-key results (missing keys count as DONE). Lets batch
+        # engines (the tensor scheduler) amortize one kernel pass over every
+        # queued item instead of paying per-key packing/dispatch.
+        self.reconcile_batch = reconcile_batch
+        self.batch_size = batch_size
         self._queue: collections.deque[Hashable] = collections.deque()
         self._queued: set[Hashable] = set()
         self._retries: collections.Counter = collections.Counter()
@@ -42,9 +57,25 @@ class Worker:
         return len(self._queue)
 
     def process_one(self) -> bool:
-        """Pop and reconcile one key. Returns True if work was done."""
+        """Pop and reconcile one key (or one batch when a batch reconciler
+        is installed and multiple keys are queued). Returns True if work was
+        done."""
         if not self._queue:
             return False
+        if self.reconcile_batch is not None and len(self._queue) > 1:
+            keys = []
+            while self._queue and len(keys) < self.batch_size:
+                k = self._queue.popleft()
+                self._queued.discard(k)
+                keys.append(k)
+            try:
+                results = self.reconcile_batch(keys)
+            except Exception:  # noqa: BLE001 — batch failure requeues all
+                log.exception("worker %s: batch reconcile failed", self.name)
+                results = {k: REQUEUE for k in keys}
+            for k in keys:
+                self._finish(k, results.get(k, DONE))
+            return True
         key = self._queue.popleft()
         self._queued.discard(key)
         try:
@@ -52,6 +83,10 @@ class Worker:
         except Exception:  # noqa: BLE001 — reconcile errors requeue, like workqueue
             log.exception("worker %s: reconcile %r failed", self.name, key)
             result = REQUEUE
+        self._finish(key, result)
+        return True
+
+    def _finish(self, key: Hashable, result: Optional[str]) -> None:
         if result == REQUEUE:
             self._retries[key] += 1
             if self._retries[key] <= self.MAX_RETRIES:
@@ -61,7 +96,6 @@ class Worker:
                 del self._retries[key]
         else:
             self._retries.pop(key, None)
-        return True
 
 
 class Runtime:
@@ -75,8 +109,8 @@ class Runtime:
         self.workers: list[Worker] = []
         self._tickers: list[Callable[[], None]] = []
 
-    def new_worker(self, name: str, reconcile) -> Worker:
-        w = Worker(name, reconcile)
+    def new_worker(self, name: str, reconcile, **kw) -> Worker:
+        w = Worker(name, reconcile, **kw)
         self.workers.append(w)
         return w
 
