@@ -89,9 +89,21 @@ def mutate_work(work) -> None:
     if not work.meta.labels.get(PERMANENT_ID_LABEL):
         work.meta.labels[PERMANENT_ID_LABEL] = str(uuid.uuid4())
     # prune on copies: controllers may alias live store objects into
-    # spec.workload, and mutating those in place would corrupt the store
+    # spec.workload, and mutating those in place would corrupt the store.
+    # Already-pruned manifests (every re-apply of an existing Work — e.g.
+    # condition updates) skip the copy entirely: nothing would change, so
+    # there is nothing to protect. This runs on EVERY Work apply and the
+    # deepcopy was the single largest cost of a propagation storm.
     pruned = []
     for manifest in work.spec.workload:
+        if (
+            not manifest.status
+            and not manifest.meta.uid
+            and manifest.meta.resource_version == 0
+            and manifest.meta.creation_timestamp == 0.0
+        ):
+            pruned.append(manifest)
+            continue
         manifest = copy.deepcopy(manifest)
         manifest.status = {}
         manifest.meta.uid = ""
