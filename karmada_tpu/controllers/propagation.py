@@ -83,6 +83,8 @@ class WorkIndex:
         self._by_binding: dict[str, set[str]] = {}
         self._by_target: dict[tuple, str] = {}
         self._work_meta: dict[str, tuple] = {}  # work key -> (ref, targets)
+        # watch(replay=True) synthesizes Added for Works already in the store,
+        # so the index seeds correctly against a populated store.
         store.watch("Work", self._on_event)
 
     def _on_event(self, event) -> None:

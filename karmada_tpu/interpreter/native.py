@@ -12,6 +12,7 @@ dicts (spec.replicas, spec.template.spec.containers[*].resources.requests).
 from __future__ import annotations
 
 import copy
+from datetime import datetime, timezone
 from typing import Any, Optional
 
 from ..api.core import Resource
@@ -230,9 +231,23 @@ def _aggregate_hpa(obj: Resource, items: list[AggregatedStatusItem]) -> Resource
     return out
 
 
+def _ts_sort_key(val: str):
+    """Parse an RFC3339 timestamp for chronological comparison. Raw string
+    comparison is only chronological when every member emits identical
+    formatting (Z vs +00:00, fractional seconds) — the reference compares
+    parsed metav1.Time values (aggregatestatus.go:232-271)."""
+    try:
+        dt = datetime.fromisoformat(val.replace("Z", "+00:00"))
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        return dt
+    except ValueError:
+        return datetime.min.replace(tzinfo=timezone.utc)
+
+
 def _aggregate_cronjob(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
-    """Concatenate active job refs, keep the latest schedule/success times
-    (RFC3339 strings compare chronologically) — aggregatestatus.go:232-271."""
+    """Concatenate active job refs, keep the chronologically latest
+    schedule/success times — aggregatestatus.go:232-271."""
     out = copy.deepcopy(obj)
     active: list = []
     last_schedule = None
@@ -243,7 +258,7 @@ def _aggregate_cronjob(obj: Resource, items: list[AggregatedStatusItem]) -> Reso
         for field, cur in (("lastScheduleTime", last_schedule),
                            ("lastSuccessfulTime", last_success)):
             val = st.get(field)
-            if val and (cur is None or val > cur):
+            if val and (cur is None or _ts_sort_key(val) > _ts_sort_key(cur)):
                 if field == "lastScheduleTime":
                     last_schedule = val
                 else:

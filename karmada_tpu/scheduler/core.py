@@ -101,16 +101,32 @@ class TensorScheduler:
         # (snapshot, problems) -> bool[B, C] mask AND-composed with the
         # in-tree filters — batched by construction
         self.custom_filters = list(custom_filters)
-        self._placement_cache: dict[int, CompiledPlacement] = {}
+        # id(placement) -> (placement, compiled), LRU-bounded. The strong
+        # reference to the Placement keeps its id() from being reused by a
+        # new object after GC — without it a fresh Placement landing at a
+        # recycled address would silently reuse a stale compiled mask.
+        # Eviction is safe (pin and compiled mask leave together) and bounds
+        # memory under sustained binding churn against a long-lived engine.
+        from collections import OrderedDict
+
+        self._placement_cache: OrderedDict[
+            int, tuple[Optional[Placement], CompiledPlacement]
+        ] = OrderedDict()
+
+    PLACEMENT_CACHE_CAP = 8192
 
     # -- compilation -------------------------------------------------------
 
     def _compiled(self, placement: Optional[Placement]) -> CompiledPlacement:
         key = id(placement) if placement is not None else 0
-        cp = self._placement_cache.get(key)
-        if cp is None:
-            cp = compile_placement(placement, self.snapshot)
-            self._placement_cache[key] = cp
+        hit = self._placement_cache.get(key)
+        if hit is not None:
+            self._placement_cache.move_to_end(key)
+            return hit[1]
+        cp = compile_placement(placement, self.snapshot)
+        self._placement_cache[key] = (placement, cp)
+        if len(self._placement_cache) > self.PLACEMENT_CACHE_CAP:
+            self._placement_cache.popitem(last=False)
         return cp
 
     # -- public API --------------------------------------------------------

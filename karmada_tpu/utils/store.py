@@ -156,13 +156,16 @@ class Store:
         of objects written."""
         import pickle
 
+        # Serialize while holding the lock: the bucket copies are shallow, so
+        # pickling after release could tear the snapshot if a concurrent
+        # writer mutates an object mid-dump.
         with self._lock:
             payload = {
                 kind: dict(bucket) for kind, bucket in self._buckets.items()
             }
-            rv = self._rv
+            blob = pickle.dumps({"rv": self._rv, "buckets": payload})
         with open(path, "wb") as f:
-            pickle.dump({"rv": rv, "buckets": payload}, f)
+            f.write(blob)
         return sum(len(b) for b in payload.values())
 
     def restore(self, path: str) -> int:

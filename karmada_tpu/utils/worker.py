@@ -68,11 +68,7 @@ class Worker:
                 k = self._queue.popleft()
                 self._queued.discard(k)
                 keys.append(k)
-            try:
-                results = self.reconcile_batch(keys)
-            except Exception:  # noqa: BLE001 — batch failure requeues all
-                log.exception("worker %s: batch reconcile failed", self.name)
-                results = {k: REQUEUE for k in keys}
+            results = self._drain_batch(keys)
             for k in keys:
                 self._finish(k, results.get(k, DONE))
             return True
@@ -85,6 +81,58 @@ class Worker:
             result = REQUEUE
         self._finish(key, result)
         return True
+
+    #: poisoned keys tolerated per drain before the failure is treated as
+    #: systemic (whole engine down, not bad keys); each poisoned key costs
+    #: ~log2(batch) failing sub-batch calls down its bisect path
+    POISON_TOLERANCE = 4
+
+    def _drain_batch(self, keys: list[Hashable]) -> dict[Hashable, Optional[str]]:
+        """Run reconcile_batch with poisoned-key isolation.
+
+        A batch-wide REQUEUE on exception would make every key in the batch
+        burn retries together with the one bad key (all dropped together at
+        MAX_RETRIES). Instead, bisect the failing batch: healthy halves stay
+        batched, and only genuinely failing keys pay a retry. A failure
+        budget caps the fan-out when the failure is systemic (every sub-call
+        failing) so a batch-wide transient costs O(budget) calls and one
+        logged traceback, not O(batch) of each."""
+        results: dict[Hashable, Optional[str]] = {}
+        failures = 0
+        budget = self.POISON_TOLERANCE * max(1, len(keys).bit_length())
+
+        def run(ks: list[Hashable]) -> None:
+            nonlocal failures
+            if failures > budget:
+                for k in ks:
+                    results[k] = REQUEUE
+                return
+            try:
+                if len(ks) == 1:
+                    results[ks[0]] = self.reconcile(ks[0])
+                else:
+                    results.update(self.reconcile_batch(ks))
+                return
+            except Exception:  # noqa: BLE001
+                failures += 1
+                if failures == 1:
+                    log.exception(
+                        "worker %s: batch reconcile failed; bisecting", self.name
+                    )
+                else:
+                    log.error(
+                        "worker %s: reconcile of %d key(s) failed (failure %d)",
+                        self.name, len(ks), failures,
+                    )
+                if len(ks) == 1:
+                    results[ks[0]] = REQUEUE
+                    return
+            mid = len(ks) // 2
+            run(ks[:mid])
+            run(ks[mid:])
+
+        run(keys)
+        return results
 
     def _finish(self, key: Hashable, result: Optional[str]) -> None:
         if result == REQUEUE:

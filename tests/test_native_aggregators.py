@@ -145,3 +145,15 @@ class TestPvcPdbHpaCron:
         assert [a["name"] for a in out.status["active"]] == ["j1", "j2"]
         assert out.status["lastScheduleTime"] == "2026-07-30T02:00:00Z"
         assert out.status["lastSuccessfulTime"] == "2026-07-30T01:30:00Z"
+
+    def test_cronjob_times_mixed_rfc3339_formats(self):
+        # members may emit Z vs +00:00 offsets or fractional seconds;
+        # comparison must be chronological, not lexicographic ("+" < "Z"
+        # would make the offset form always lose against Z)
+        interp = make_interp()
+        cj = res("batch/v1", "CronJob")
+        out = interp.aggregate_status(cj, [
+            item("m1", {"lastScheduleTime": "2026-07-30T03:00:00+00:00"}),
+            item("m2", {"lastScheduleTime": "2026-07-30T02:59:59.500Z"}),
+        ])
+        assert out.status["lastScheduleTime"] == "2026-07-30T03:00:00+00:00"

@@ -394,3 +394,49 @@ class TestLabelOnlySpreadRefused:
                            requests=REQ, gvk="apps/v1/Deployment")
         ])
         assert not res.success
+
+
+class TestPlacementCacheLifetime:
+    def test_cache_pins_placement_against_id_reuse(self):
+        # Regression: the compiled-placement cache is keyed by id(placement).
+        # If the cache did not hold a strong reference, a GC'd Placement's
+        # address could be reused by a NEW Placement, silently serving the
+        # stale compiled mask. Holding the reference makes reuse impossible.
+        import gc
+        import weakref
+
+        snap = make_snapshot([new_cluster(f"m{i}") for i in range(4)])
+        sched = TensorScheduler(snap)
+        pl = duplicated_placement(
+            cluster_affinity=ClusterAffinity(cluster_names=["m1"])
+        )
+        ref = weakref.ref(pl)
+        [res] = sched.schedule(
+            [BindingProblem(key="b", placement=pl, replicas=1,
+                            gvk="apps/v1/Deployment")]
+        )
+        assert res.clusters == {"m1": 1}
+        del pl, res
+        gc.collect()
+        assert ref() is not None, "cache must pin the Placement it compiled"
+
+    def test_fresh_placements_never_reuse_stale_masks(self):
+        # churn placements aggressively; every new Placement must compile its
+        # own mask (under the old id()-keyed cache without pinning, CPython's
+        # allocator reuse made this flaky-wrong)
+        import gc
+
+        snap = make_snapshot([new_cluster(f"m{i}") for i in range(4)])
+        sched = TensorScheduler(snap)
+        for i in range(20):
+            want = f"m{i % 4}"
+            pl = duplicated_placement(
+                cluster_affinity=ClusterAffinity(cluster_names=[want])
+            )
+            [res] = sched.schedule(
+                [BindingProblem(key="b", placement=pl, replicas=1,
+                                gvk="apps/v1/Deployment")]
+            )
+            assert res.clusters == {want: 1}, i
+            del pl
+            gc.collect()

@@ -77,6 +77,33 @@ class TestRuntime:
         rt.run_until_settled()
         assert len(attempts) == 3
 
+    def test_batch_failure_isolates_poisoned_key(self):
+        # One bad key in a batch must not burn retries for (or drop) the
+        # healthy keys riding in the same batch.
+        from karmada_tpu.utils.worker import DONE, REQUEUE, Runtime
+
+        done = []
+
+        def reconcile(key):
+            if key == "poison":
+                raise RuntimeError("bad binding")
+            done.append(key)
+            return DONE
+
+        def reconcile_batch(keys):
+            if "poison" in keys:
+                raise RuntimeError("engine pass blew up")
+            return {k: reconcile(k) for k in keys}
+
+        rt = Runtime()
+        w = rt.new_worker("batch", reconcile, reconcile_batch=reconcile_batch)
+        for k in ("a", "poison", "b", "c"):
+            w.enqueue(k)
+        rt.run_until_settled()
+        assert sorted(done) == ["a", "b", "c"]
+        # healthy keys were reconciled exactly once, not retried to death
+        assert len(done) == 3
+
 
 class TestCheckpointResume:
     """SURVEY §5 checkpoint/resume: the store is the durable source of
