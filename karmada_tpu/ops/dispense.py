@@ -82,6 +82,8 @@ def take_by_weight_fast(
     l_bits: int,  # static: bits(max last)
     k_top: int,  # static: >= min(max num, C) — bounds the remainder rank
     div_f32: bool,  # static: max(weights)*num < 2^24 (exact f32 products)
+    with_idx: bool = True,  # static: cluster index fits the packed key
+    return_sites: bool = False,  # static: also return the top-k site indices
 ) -> jnp.ndarray:
     """``take_by_weight`` specialized for host-proven small ranges.
 
@@ -94,10 +96,33 @@ def take_by_weight_fast(
       at 5k clusters);
     - integer floor division lowers to slow emulation on the VPU; with
       products < 2^24 the f32 reciprocal is exact after one +-1 fixup.
+
+    ``with_idx=False`` handles fleets where the (weight, last, index) triple
+    does not fit one int32 (w_bits + l_bits + bits(C-1) > 31 but
+    w_bits + l_bits <= 31): the key packs only (weight, last), the bonus
+    threshold comes from its top_k, and the index tie-break among
+    threshold-equal clusters is recovered exactly with a second top_k over
+    the (negated) indices of the tie set — the remain - #{key > thr}
+    tie winners are precisely the lowest-indexed ties. Two [C] top_ks
+    instead of one still beat the full 3-key sort.
+
+    With ``return_sites`` the kernel also returns the int32[k_top] cluster
+    indices of the top-k keys (recovered from the packed key, or the top_k
+    index output in the no-idx mode). When ``k_top >= num`` every cluster
+    the dispense can touch is in this set: floors_i > 0 implies
+    w_i >= total/num, and at most num clusters satisfy that, so all of them
+    (and every bonus site) rank inside the top num <= k_top keys — for the
+    no-idx mode the winning ties are the lowest-indexed ones, exactly the
+    ones lax.top_k keeps first. Compaction layers exploit this to avoid a
+    full-width scan of the result (the basis of the fleet result stream,
+    scheduler/fleet.py).
     """
     c = weights.shape[0]
     i_bits = max(1, (c - 1).bit_length())
-    assert w_bits + l_bits + i_bits <= 31, (w_bits, l_bits, i_bits)
+    if with_idx:
+        assert w_bits + l_bits + i_bits <= 31, (w_bits, l_bits, i_bits)
+    else:
+        assert w_bits + l_bits <= 31, (w_bits, l_bits)
     idx = jnp.arange(c, dtype=jnp.int32)
 
     total = jnp.sum(weights)
@@ -114,14 +139,38 @@ def take_by_weight_fast(
     remain = num - jnp.sum(floors)
 
     k_top = min(k_top, c)  # callers size k_top from replicas; small fleets clamp
-    key = (weights << (l_bits + i_bits)) | (last << i_bits) | (c - 1 - idx)
-    top_vals = lax.top_k(key, k_top)[0]
-    pos = jnp.clip(remain - 1, 0, k_top - 1)
-    thr = top_vals[pos]
-    bonus = ((key >= thr) & (remain > 0)).astype(jnp.int32)
+    sites = None
+    if with_idx:
+        key = (weights << (l_bits + i_bits)) | (last << i_bits) | (c - 1 - idx)
+        top_vals = lax.top_k(key, k_top)[0]
+        pos = jnp.clip(remain - 1, 0, k_top - 1)
+        thr = top_vals[pos]
+        bonus = ((key >= thr) & (remain > 0)).astype(jnp.int32)
+        if return_sites:
+            sites = (c - 1) - (top_vals & ((1 << i_bits) - 1))
+    else:
+        key = (weights << l_bits) | last
+        top_vals, top_pos = lax.top_k(key, k_top)
+        pos = jnp.clip(remain - 1, 0, k_top - 1)
+        thr = top_vals[pos]
+        n_gt = jnp.sum((key > thr).astype(jnp.int32))
+        n_tie_win = remain - n_gt  # >= 1 whenever remain > 0
+        tie = key == thr
+        tie_key = jnp.where(tie, -idx, jnp.int32(-(1 << 30)))
+        tie_top = lax.top_k(tie_key, k_top)[0]
+        idx_cut = -tie_top[jnp.clip(n_tie_win - 1, 0, k_top - 1)]
+        bonus = (
+            ((key > thr) | (tie & (idx <= idx_cut) & (n_tie_win > 0)))
+            & (remain > 0)
+        ).astype(jnp.int32)
+        if return_sites:
+            sites = top_pos.astype(jnp.int32)
 
     dispensed = jnp.where(total > 0, floors + bonus, 0)
-    return init + dispensed
+    out = init + dispensed
+    if return_sites:
+        return out, sites
+    return out
 
 
 # Batched over bindings: num[B], weights[B,C], last[B,C], init[B,C] -> [B,C]

@@ -120,7 +120,10 @@ def _divide_one(
     fast: tuple | None = None,  # static (w_bits, l_bits, k_top, div_f32):
     # packed-key top_k dispense for host-proven small ranges (see
     # take_by_weight_fast); requires wide=False bounds to hold a fortiori
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    want_sites: bool = False,  # static: also return the dispense top-k site
+    # indices (requires fast; every non-previous placed cluster is in them
+    # when k_top >= num — see take_by_weight_fast)
+) -> tuple[jnp.ndarray, ...]:
     acc = jnp.int64 if wide else jnp.int32
     c = candidates.shape[0]
     prev_cand = jnp.where(candidates, prev, 0)  # buildScheduledClusters
@@ -157,9 +160,14 @@ def _divide_one(
     # Aggregated bindings — one of the two kernel sorts disappears.
     if has_aggregated:
         is_prev_mask = (prev_cand > 0) & scale_up
+        # the prefix sort packs (prev-bit, weight, index) into one int32;
+        # usable only when that triple fits (the dispense key may fit via
+        # the no-idx two-stage mode while this one does not)
+        agg_w_bits = None
+        if fast is not None and 1 + fast[0] + max(1, (c - 1).bit_length()) <= 31:
+            agg_w_bits = fast[0]
         keep = _aggregated_prefix_mask(
-            w_dyn, is_prev_mask, target_dyn, wide,
-            fast[0] if fast is not None else None,
+            w_dyn, is_prev_mask, target_dyn, wide, agg_w_bits,
         )
         w_dyn = jnp.where(
             (strategy == AGGREGATED) & keep | (strategy != AGGREGATED), w_dyn, 0
@@ -178,9 +186,15 @@ def _divide_one(
     init = jnp.where(is_static, 0, init_dyn)
     w = jnp.where(is_dup | steady_noop | unschedulable, 0, w)  # no dispense
 
+    sites = None
     if fast is not None:
-        out = take_by_weight_fast(num, w, last, init, *fast)
+        out = take_by_weight_fast(
+            num, w, last, init, *fast, return_sites=want_sites
+        )
+        if want_sites:
+            out, sites = out
     else:
+        assert not want_sites, "want_sites requires the fast dispense"
         out = take_by_weight(num, w, last, init, wide)
 
     out = jnp.where(steady_noop, prev_cand, out)
@@ -188,6 +202,8 @@ def _divide_one(
     out = jnp.where(unschedulable, 0, out)
     # a zero-replica binding assigns all candidates with replicas 0 upstream
     out = jnp.where(replicas == 0, jnp.zeros((c,), jnp.int32), out)
+    if want_sites:
+        return out, unschedulable, sites
     return out, unschedulable
 
 
@@ -196,15 +212,16 @@ _batch_variants: dict = {}
 
 def _divide_batch(
     strategy, replicas, candidates, static_w, avail, prev, fresh,
-    has_aggregated=True, wide=True, fast=None,
+    has_aggregated=True, wide=True, fast=None, want_sites=False,
 ):
-    key = (has_aggregated, wide, fast)
+    key = (has_aggregated, wide, fast, want_sites)
     fn = _batch_variants.get(key)
     if fn is None:
         fn = jax.vmap(
             partial(
                 _divide_one,
                 has_aggregated=has_aggregated, wide=wide, fast=fast,
+                want_sites=want_sites,
             ),
             in_axes=(0, 0, 0, 0, 0, 0, 0),
         )

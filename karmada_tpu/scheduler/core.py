@@ -40,6 +40,48 @@ from .snapshot import ClusterSnapshot, CompiledPlacement, compile_placement
 LOCALITY_SCORE = 100  # cluster_locality.go:43-56
 
 
+def kernel_variant(
+    avail_max: int, static_max: int, prev_max: int, max_n: int, c: int
+) -> tuple[bool, Optional[tuple]]:
+    """Choose the divide-kernel specialization from host-known bounds.
+
+    Returns ``(wide, fast)`` for divide_replicas: int32 fast path when every
+    weight x target product and per-row weight sum provably fits 31 bits
+    (weights can be avail, prev, the fresh-mode avail+prev sum, or static
+    weights; targets <= replicas), and the packed-key top_k dispense when
+    the (weight, lastReplicas, index) key fits 31 bits with a small
+    remainder rank. The bit split snaps to tiers so the static tuple (and
+    hence the jit trace) does not churn as data maxima drift."""
+    max_w = 2 * max(avail_max, static_max, prev_max, 1)
+    narrow = max_w * max(max_n, 1) < 2**31 and max_w * c < 2**31
+    fast = None
+    if narrow:
+        w_bits = max(1, max_w.bit_length())
+        l_bits = max(1, int(prev_max).bit_length())
+        i_bits = max(1, (c - 1).bit_length())
+        k_top = min(c, 1 << max(1, max(1, max_n) - 1).bit_length())
+        div_f32 = max_w * max(max_n, 1) < 2**24 and max_n < 2**22
+        if k_top <= 1024:
+            if w_bits + l_bits + i_bits <= 31:
+                for l_tier in (4, 8, 12, 16):
+                    if l_bits <= l_tier and w_bits <= 31 - i_bits - l_tier:
+                        l_bits = l_tier
+                        w_bits = 31 - i_bits - l_tier
+                        break
+                fast = (w_bits, l_bits, k_top, div_f32, True)
+            elif w_bits + l_bits <= 31:
+                # (weight, last) alone fits: the two-stage top_k dispense
+                # (take_by_weight_fast with_idx=False) recovers index
+                # tie-breaks without packing the index
+                for l_tier in (4, 8, 12, 16):
+                    if l_bits <= l_tier and w_bits <= 31 - l_tier:
+                        l_bits = l_tier
+                        w_bits = 31 - l_tier
+                        break
+                fast = (w_bits, l_bits, k_top, div_f32, False)
+    return (not narrow), fast
+
+
 @dataclass
 class BindingProblem:
     """Engine-level scheduling unit (decoupled from the API object; the
@@ -112,8 +154,16 @@ class TensorScheduler:
         self._placement_cache: OrderedDict[
             int, tuple[Optional[Placement], CompiledPlacement]
         ] = OrderedDict()
+        # device-resident fleet table (scheduler.fleet): engaged for large
+        # batches of fleet-eligible bindings; generation counter lets the
+        # table detect in-place snapshot swaps (update_snapshot)
+        self._fleet = None
+        self._snapshot_gen = 0
 
     PLACEMENT_CACHE_CAP = 8192
+    #: minimum eligible-batch size before the device-resident path engages
+    #: (below it, per-pass dispatch overhead beats the host packing cost)
+    fleet_threshold = 1024
 
     # -- compilation -------------------------------------------------------
 
@@ -131,10 +181,86 @@ class TensorScheduler:
 
     # -- public API --------------------------------------------------------
 
+    def update_snapshot(self, snapshot: ClusterSnapshot) -> bool:
+        """Swap in a refreshed snapshot over the SAME cluster set (the
+        informer-cache delta case: capacity/taints/enablements drifted but
+        no cluster joined or left). Returns False when the cluster set or
+        resource dims changed — callers must rebuild the engine then.
+
+        Keeps the device-resident fleet table's binding rows valid (cluster
+        indices are stable), so a fleet-wide storm after a status heartbeat
+        costs mask/estimator table rebuilds instead of a full repack."""
+        if (
+            snapshot.names != self.snapshot.names
+            or snapshot.dims != self.snapshot.dims
+        ):
+            return False
+        self.snapshot = snapshot
+        self._placement_cache.clear()
+        self._snapshot_gen += 1
+        return True
+
+    def _fleet_eligible(self, p: BindingProblem, cp: CompiledPlacement) -> bool:
+        from ..ops.divide import DUPLICATED as S_DUPLICATED
+        from .fleet import K_PREV, MAX_REPLICAS_FAST
+        from .spread import should_ignore_spread_constraint
+
+        return (
+            len(cp.terms) == 1
+            and (
+                not cp.spread_constraints
+                or should_ignore_spread_constraint(cp.placement or Placement())
+            )
+            and not p.evict_clusters
+            and len(p.prev) <= K_PREV
+            and (
+                # Duplicated rides the feasible-bitset path, any replicas
+                cp.strategy == S_DUPLICATED
+                or p.replicas <= MAX_REPLICAS_FAST
+            )
+        )
+
     def schedule(self, problems: Sequence[BindingProblem]) -> list[ScheduleResult]:
-        snap = self.snapshot
-        results: list[Optional[ScheduleResult]] = [None] * len(problems)
         compiled = [self._compiled(p.placement) for p in problems]
+        # engine-level features that the device-resident path does not
+        # model force the general host path for the whole batch
+        if not (
+            self.custom_filters or self.extra_estimators or self.disabled_plugins
+        ):
+            fast_idx = [
+                i
+                for i, (p, cp) in enumerate(zip(problems, compiled))
+                if self._fleet_eligible(p, cp)
+            ]
+            if len(fast_idx) >= self.fleet_threshold:
+                from .fleet import FleetTable
+
+                if self._fleet is None or self._fleet.slots_exhausted:
+                    self._fleet = FleetTable(self)
+                fast_res = self._fleet.schedule(
+                    [problems[i] for i in fast_idx],
+                    [compiled[i] for i in fast_idx],
+                )
+                results: list = [None] * len(problems)
+                for i, res in zip(fast_idx, fast_res):
+                    results[i] = res
+                slow_idx = [i for i in range(len(problems)) if results[i] is None]
+                if slow_idx:
+                    slow_res = self._schedule_host(
+                        [problems[i] for i in slow_idx],
+                        [compiled[i] for i in slow_idx],
+                    )
+                    for i, res in zip(slow_idx, slow_res):
+                        results[i] = res
+                return results
+        return self._schedule_host(problems, compiled)
+
+    def _schedule_host(
+        self,
+        problems: Sequence[BindingProblem],
+        compiled: list[CompiledPlacement],
+    ) -> list[ScheduleResult]:
+        results: list[Optional[ScheduleResult]] = [None] * len(problems)
         max_terms = max((len(cp.terms) for cp in compiled), default=1)
 
         pending = list(range(len(problems)))
@@ -286,18 +412,12 @@ class TensorScheduler:
         static_w = static_pl[cp_idx]
         return feasible, strategy, replicas, static_w, requests, prev, fresh
 
-    def _availability(self, requests: np.ndarray, replicas: np.ndarray) -> jnp.ndarray:
-        """calAvailableReplicas (core/util.go:54-104): min-merge over
-        registered estimators, sentinel clamped to spec.Replicas.
-
-        Request rows are interned host-side (np.unique): the general/model
-        estimators run per unique profile ([U, C]) and per-binding rows are a
-        gather — fleets carry few unique ReplicaRequirements, so this removes
-        the O(B x C x R) division hot loop."""
+    def _profile_table(self, profiles_np: np.ndarray) -> jnp.ndarray:
+        """int32[P, C] general+model availability per unique request profile
+        (-1 where the cluster gives no answer). The shared estimator core of
+        _availability and the device-resident fleet path (scheduler.fleet)."""
         snap = self.snapshot
-        profiles_np, prof_inv = np.unique(requests, axis=0, return_inverse=True)
         req = jnp.asarray(profiles_np)
-        reps = jnp.asarray(replicas)
         general = general_estimate(jnp.asarray(snap.available_cap), req)
         mp = snap.model_pack
         if feature_gate.enabled(CUSTOMIZED_CLUSTER_RESOURCE_MODELING) and mp.has_models.any():
@@ -327,9 +447,21 @@ class TensorScheduler:
             use_model = jnp.asarray(mp.has_models)[None, :] & applicable
             general = jnp.where(use_model, model_avail, general)
         # clusters with no ResourceSummary give no answer (UnauthenticReplica)
-        general = jnp.where(
+        return jnp.where(
             jnp.asarray(snap.has_summary)[None, :], general, jnp.int32(-1)
         )
+
+    def _availability(self, requests: np.ndarray, replicas: np.ndarray) -> jnp.ndarray:
+        """calAvailableReplicas (core/util.go:54-104): min-merge over
+        registered estimators, sentinel clamped to spec.Replicas.
+
+        Request rows are interned host-side (np.unique): the general/model
+        estimators run per unique profile ([U, C]) and per-binding rows are a
+        gather — fleets carry few unique ReplicaRequirements, so this removes
+        the O(B x C x R) division hot loop."""
+        profiles_np, prof_inv = np.unique(requests, axis=0, return_inverse=True)
+        reps = jnp.asarray(replicas)
+        general = self._profile_table(profiles_np)
         # profile -> binding gather ([U, C] -> [B, C])
         estimates = [general[jnp.asarray(prof_inv.astype(np.int32))]]
         for est in self.extra_estimators:
@@ -392,40 +524,15 @@ class TensorScheduler:
     def _assign(self, strategy, replicas, candidates, static_w, avail, prev, fresh):
         from ..ops.divide import AGGREGATED
 
-        # int32 fast path when every weight x target product and per-row
-        # weight sum provably fits 31 bits (weights can be avail, prev, the
-        # fresh-mode avail+prev sum, or static weights; targets <= replicas)
-        max_w = 2 * max(
+        max_n = int(replicas.max(initial=0))
+        c = candidates.shape[1] if candidates.ndim == 2 else 1
+        wide, fast = kernel_variant(
             int(jnp.max(avail)) if avail.size else 0,
             int(static_w.max(initial=0)),
             int(prev.max(initial=0)),
-            1,
+            max_n,
+            c,
         )
-        max_n = int(replicas.max(initial=0))
-        c = candidates.shape[1] if candidates.ndim == 2 else 1
-        narrow = max_w * max(max_n, 1) < 2**31 and max_w * c < 2**31
-        # packed-key top_k dispense (take_by_weight_fast) when the key fits
-        # 31 bits and the remainder rank is small; k_top is rounded to a
-        # power of two so jit traces are reused across chunks
-        fast = None
-        if narrow:
-            w_bits = max(1, max_w.bit_length())
-            l_bits = max(1, int(prev.max(initial=0)).bit_length())
-            i_bits = max(1, (c - 1).bit_length())
-            k_top = min(c, 1 << max(1, max(1, max_n) - 1).bit_length())
-            div_f32 = max_w * max(max_n, 1) < 2**24 and max_n < 2**22
-            if w_bits + l_bits + i_bits <= 31 and k_top <= 1024:
-                # canonicalize the bit split so the static tuple (and hence
-                # the jit trace) does not churn as data maxima drift across
-                # power-of-two boundaries: l_bits snaps to a tier and w
-                # takes the whole remaining budget (containment only needs
-                # >=). One trace per (l tier, i_bits, k_top, div_f32).
-                for l_tier in (4, 8, 12, 16):
-                    if l_bits <= l_tier and w_bits <= 31 - i_bits - l_tier:
-                        l_bits = l_tier
-                        w_bits = 31 - i_bits - l_tier
-                        break
-                fast = (w_bits, l_bits, k_top, div_f32)
         return divide_replicas(
             jnp.asarray(strategy),
             jnp.asarray(replicas),
@@ -435,7 +542,7 @@ class TensorScheduler:
             jnp.asarray(prev),
             jnp.asarray(fresh),
             has_aggregated=bool((strategy == AGGREGATED).any()),
-            wide=not narrow,
+            wide=wide,
             fast=fast,
         )
 

@@ -1,0 +1,703 @@
+"""Device-resident fleet scheduling: the informer->cache analogue.
+
+Ref: pkg/scheduler/cache/cache.go:42-62 — the reference keeps a cluster
+cache fed by informers so each scheduling attempt touches only deltas.
+This module is that idea taken device-side: per-binding state (placement
+slot, request profile slot, previous assignment sites, replicas, flags)
+lives in HBM between scheduling passes, and each pass is
+
+    host delta scatter  ->  ONE fused XLA dispatch  ->  ONE compact fetch.
+
+Why this exists: round 1's engine packed every BindingProblem from scratch
+per pass (Python loops over sparse entries + per-chunk np.pad + per-chunk
+device syncs), which capped the engine at ~4k bindings/s while the kernel
+alone did 100k x 5k in 0.74 s. The fleet table removes all per-pass O(B)
+host packing for unchanged bindings and all but one device round-trip.
+
+Tunnel-aware design (measured on the v5e tunnel: ~20-30 MB/s transfers with
+~0.4-0.8 s fixed cost per transfer, ~100 ms per dispatch):
+
+- all per-row state is gathered ON DEVICE from resident arrays (`rows` is
+  the only per-pass index upload, and the all-rows storm case keeps even
+  that cached on device);
+- placement/taint/static-weight masks are interned per unique placement and
+  gathered per chunk via the one-hot-matmul row gather
+  (ops.estimate.gather_profile_rows) — plain [B]-index gathers inside
+  lax.scan hang XLA compilation on the tunneled backend;
+- results come back as ONE flat int32 array: a compacted
+  (site << 8 | count) entry stream plus one metadata word per row; feasible
+  bitsets ride a second, lazily-fetched output only when the batch contains
+  Duplicated or zero-replica bindings.
+
+Eligibility: a binding rides the fleet path when its placement has a single
+affinity term, no spread-constraint selection (or the static-weight ignore
+rule, select_clusters.go:63-78), no eviction tasks, <= K_PREV previous
+sites, and (for Divided strategies) replicas <= MAX_REPLICAS_FAST so the
+per-row top_k bound holds. Everything else takes the general host path —
+the two paths are differentially fuzz-tested for identical placements.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.divide import AGGREGATED, DUPLICATED as S_DUPLICATED, _divide_batch
+from ..ops.estimate import MAX_INT32, gather_profile_rows, merge_estimates
+
+K_PREV = 16  # max previous-assignment sites on the fast path
+MAX_REPLICAS_FAST = 128  # divided-strategy replica cap (bounds top_k)
+MAX_SLOTS = 4096  # unique placements/gvks/profiles before table rebuild
+E_ROUND = 1 << 18  # entry-buffer quantum (bounds trace churn)
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+# --------------------------------------------------------------------------
+# fused solve
+# --------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "chunk", "n_chunks", "k_out", "e_cap", "wide", "fast",
+        "has_aggregated", "need_bits",
+    ),
+)
+def _fleet_solve(
+    cp_table,  # int32[U, 3C]: [aff&spread_field | taint | static_w]
+    gvk_table,  # int32[G, C]
+    prof_table,  # int32[P, C] general availability (-1 = no answer)
+    incomplete_en,  # bool[C] — ~CompleteAPIEnablements
+    rows,  # int32[n_pad] table rows (-1 = padding)
+    cp_idx, gvk_idx, prof_idx,  # int32[cap]
+    replicas, strategy,  # int32[cap]
+    fresh,  # bool[cap]
+    prev_sites, prev_counts,  # int32[cap, K_PREV]
+    *,
+    chunk: int,
+    n_chunks: int,
+    k_out: int,
+    e_cap: int,
+    wide: bool,
+    fast: Optional[tuple],
+    has_aggregated: bool,
+    need_bits: bool,
+):
+    c = gvk_table.shape[1]
+    valid = rows >= 0
+    r = jnp.maximum(rows, 0)
+    # compact per-pass state ([n_pad]), gathered outside the scan
+    cp = cp_idx[r]
+    gv = gvk_idx[r]
+    pf = prof_idx[r]
+    reps = jnp.where(valid, replicas[r], 0)
+    st = strategy[r]
+    fr = fresh[r] & valid
+    ps = prev_sites[r]
+    pc = jnp.where(valid[:, None], prev_counts[r], 0)
+
+    def body(carry, i):
+        sl = lambda a: lax.dynamic_slice_in_dim(a, i * chunk, chunk, axis=0)
+        cpc, gvc, pfc = sl(cp), sl(gv), sl(pf)
+        repsc, stc, frc, vc = sl(reps), sl(st), sl(fr), sl(valid)
+        psc, pcc = sl(ps), sl(pc)
+        prev = (
+            jnp.zeros((chunk, c), jnp.int32)
+            .at[jnp.arange(chunk)[:, None], psc]
+            .add(pcc)
+        )
+        prev_mask = prev > 0
+        cp_rows = gather_profile_rows(cp_table, cpc)  # [chunk, 3C]
+        aff_m = cp_rows[:, :c] != 0
+        taint_m = cp_rows[:, c : 2 * c] != 0
+        static_w = cp_rows[:, 2 * c :]
+        gvk_m = gather_profile_rows(gvk_table, gvc) != 0
+        general = gather_profile_rows(prof_table, pfc)
+        # mask composition — same algebra as TensorScheduler._pack_chunk
+        feasible = (
+            aff_m
+            & (gvk_m | (prev_mask & incomplete_en[None, :]))
+            & (taint_m | prev_mask)
+            & vc[:, None]
+        )
+        avail = merge_estimates(repsc, (general,))
+        rix = jnp.arange(chunk)[:, None]
+        if fast is not None:
+            # the dispense's packed-key top_k already identifies every
+            # cluster the division can touch outside the previous sites
+            # (take_by_weight_fast return_sites note); gathering at those
+            # k_top + K_PREV sites replaces a full-width top_k
+            assignment, unsched, tk_sites = _divide_batch(
+                stc, repsc, feasible, static_w, avail, prev, frc,
+                has_aggregated, wide, fast, want_sites=True,
+            )
+            # Duplicated rows are represented by the feasible bitset (their
+            # count is just `replicas` everywhere feasible); zero their
+            # dense rows so the entry stream carries only Divided placements
+            assignment = jnp.where(
+                (stc == S_DUPLICATED)[:, None], 0, assignment
+            )
+            g_tk = assignment[rix, tk_sites]
+            g_pv = assignment[rix, psc]
+            # previous sites already covered by the top-k set emit there
+            dup_prev = (psc[:, :, None] == tk_sites[:, None, :]).any(-1)
+            g_pv = jnp.where(dup_prev | (pcc <= 0), 0, g_pv)
+            idx = jnp.concatenate([tk_sites, psc], axis=1)
+            vals = jnp.concatenate([g_tk, g_pv], axis=1)
+        else:
+            assignment, unsched = _divide_batch(
+                stc, repsc, feasible, static_w, avail, prev, frc,
+                has_aggregated, wide, fast,
+            )
+            assignment = jnp.where(
+                (stc == S_DUPLICATED)[:, None], 0, assignment
+            )
+            vals, idx = lax.top_k(assignment, k_out)
+        n_placed = (vals > 0).sum(axis=1).astype(jnp.int32)
+        has_cand = feasible.any(axis=1)
+        outs = (idx.astype(jnp.int32), vals, n_placed, unsched, has_cand)
+        if need_bits:
+            pad = (-c) % 32
+            f = jnp.pad(feasible, ((0, 0), (0, pad)))
+            w32 = f.reshape(chunk, -1, 32).astype(jnp.uint32)
+            shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+            outs = outs + ((w32 << shifts).sum(axis=-1, dtype=jnp.uint32),)
+        return carry, outs
+
+    _, outs = lax.scan(body, 0, jnp.arange(n_chunks))
+    width = outs[0].shape[-1]
+    sites = outs[0].reshape(-1, width)
+    counts = outs[1].reshape(-1, width)
+    n_placed = outs[2].reshape(-1)
+    unsched = outs[3].reshape(-1)
+    has_cand = outs[4].reshape(-1)
+
+    # compact the (site, count) pairs into one row-major entry stream;
+    # positions with a zero count are the padding the site lists carry
+    valid_e = (counts > 0).reshape(-1)
+    offs = jnp.cumsum(valid_e.astype(jnp.int32)) - valid_e
+    total = offs[-1] + valid_e[-1].astype(jnp.int32)
+    packed = (sites.reshape(-1) << 8) | counts.reshape(-1)
+    write = jnp.where(valid_e & (offs < e_cap), offs, e_cap)
+    buf = jnp.zeros((e_cap + 1,), jnp.int32).at[write].set(packed)
+
+    # one metadata word per row: n_placed | unsched<<8 | has_cand<<9
+    meta = (
+        n_placed
+        | (unsched.astype(jnp.int32) << 8)
+        | (has_cand.astype(jnp.int32) << 9)
+    )
+    flat = jnp.concatenate([total[None], meta, buf[:e_cap]])
+    if need_bits:
+        return flat, outs[5].reshape(-1, outs[5].shape[-1])
+    return flat, None
+
+
+# --------------------------------------------------------------------------
+# results
+# --------------------------------------------------------------------------
+
+
+class _FleetBatch:
+    """Shared fetched outputs for one fleet pass (results hold views)."""
+
+    __slots__ = ("names", "entries", "starts", "_bits_dev", "_bits_np")
+
+    def __init__(self, names, entries, starts, bits_dev):
+        self.names = names
+        self.entries = entries  # int32[total] (site << 8 | count)
+        self.starts = starts  # int64[n_pad] entry offsets per position
+        self._bits_dev = bits_dev  # device uint32[n_pad, W] or None
+        self._bits_np = None
+
+    def feasible_names(self, pos: int) -> tuple:
+        if self._bits_np is None:
+            self._bits_np = np.ascontiguousarray(np.asarray(self._bits_dev))
+        row = self._bits_np[pos]
+        idx = np.nonzero(
+            np.unpackbits(row.view(np.uint8), bitorder="little")
+        )[0]
+        names = self.names
+        return tuple(names[j] for j in idx if j < len(names))
+
+
+class FleetResult:
+    """Lazy ScheduleResult-compatible view over a fleet batch.
+
+    `clusters`/`feasible` materialize on first access: the scheduling data
+    already sits in host numpy arrays; building 100k Python dicts eagerly
+    would cost more than the whole device pass."""
+
+    __slots__ = (
+        "key", "affinity_name", "error",
+        "_batch", "_pos", "_n", "_dup_replicas", "_zero",
+        "_clusters", "_feasible",
+    )
+
+    def __init__(self, key, affinity_name, error, batch, pos, n,
+                 dup_replicas, zero):
+        self.key = key
+        self.affinity_name = affinity_name
+        self.error = error
+        self._batch = batch
+        self._pos = pos
+        self._n = n
+        self._dup_replicas = dup_replicas  # Duplicated row: count everywhere
+        self._zero = zero  # zero-replica (non-workload) row
+        self._clusters = None
+        self._feasible = None
+
+    @property
+    def success(self) -> bool:
+        return not self.error
+
+    @property
+    def clusters(self) -> dict:
+        if self._clusters is None:
+            if not self.success:
+                self._clusters = {}
+            elif self._dup_replicas is not None:
+                self._clusters = {
+                    n: self._dup_replicas
+                    for n in self._batch.feasible_names(self._pos)
+                }
+            else:
+                b = self._batch
+                start = int(b.starts[self._pos])
+                names = b.names
+                self._clusters = {
+                    names[int(e) >> 8]: int(e) & 0xFF
+                    for e in b.entries[start : start + self._n]
+                }
+        return self._clusters
+
+    @property
+    def feasible(self) -> tuple:
+        if self._feasible is None:
+            self._feasible = (
+                self._batch.feasible_names(self._pos)
+                if (self._zero and self.success)
+                else ()
+            )
+        return self._feasible
+
+
+# --------------------------------------------------------------------------
+# the table
+# --------------------------------------------------------------------------
+
+_STATE_FIELDS = (
+    "cp_idx", "gvk_idx", "prof_idx", "replicas", "strategy", "fresh",
+    "prev_sites", "prev_counts",
+)
+
+
+@jax.jit
+def _scatter_rows(state, rows, vals):
+    return tuple(a.at[rows].set(v) for a, v in zip(state, vals))
+
+
+class FleetTable:
+    """Device-resident binding table bound to one TensorScheduler."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.chunk = engine.chunk_size
+        self.cap = 0
+        self.n_rows = 0
+        self._key_row: dict[str, int] = {}
+        self._problems: list = []
+        self._fps: list = []
+        self._terms: list = []  # affinity term name per row
+        self._row_last_used: list[int] = []  # pass counter per row
+        self._pass = 0
+        # interning slots
+        self._cp_slot: dict[int, int] = {}
+        self._cp_pl: list = []  # slot -> (placement, compiled) pinned
+        self._gvk_slot: dict[str, int] = {}
+        self._gvk_list: list[str] = []
+        self._prof_slot: dict[bytes, int] = {}
+        self._profiles: list[np.ndarray] = []
+        # host staging
+        self._st: dict[str, np.ndarray] = {}
+        # device
+        self._dev_state: Optional[tuple] = None
+        self._dev_tables: Optional[tuple] = None
+        self._all_rows_dev = None
+        self._all_rows_n = -1
+        self._dirty: set[int] = set()
+        self._tables_dirty = True
+        self._avail_max = 0
+        self._static_max = 0
+        self._snapshot_gen = getattr(engine, "_snapshot_gen", 0)
+        # last observed entry total: tunes the fetched buffer well below the
+        # worst-case sum(replicas) bound (mean placed clusters per binding is
+        # far under max replicas); overflow falls back to the safe bound
+        self._last_total = 0
+
+    # -- rows --------------------------------------------------------------
+
+    COMPACT_IDLE_PASSES = 4  # rows unused this many passes are evictable
+
+    def _compact(self) -> bool:
+        """Drop rows whose keys haven't been scheduled recently (deleted
+        bindings leave stale rows behind — without eviction a create/delete
+        churn workload grows the table and its pinned problems without
+        bound). Returns True if at least half the rows were reclaimed."""
+        cutoff = self._pass - self.COMPACT_IDLE_PASSES
+        keep = [
+            row
+            for row in range(self.n_rows)
+            if self._row_last_used[row] >= cutoff
+        ]
+        if len(keep) * 2 > self.n_rows:
+            return False
+        for k in ("_problems", "_fps", "_terms"):
+            setattr(self, k, [getattr(self, k)[r] for r in keep])
+        self._row_last_used = [self._row_last_used[r] for r in keep]
+        idx = np.asarray(keep, np.int64)
+        for name, arr in self._st.items():
+            arr[: len(keep)] = arr[idx]
+        self._key_row = {p.key: i for i, p in enumerate(self._problems)}
+        self.n_rows = len(keep)
+        self._dirty.clear()
+        self._dev_state = None  # full re-upload with the compacted layout
+        self._all_rows_n = -1
+        return True
+
+    def _grow(self, need: int) -> None:
+        new_cap = max(self.chunk, _pow2(need))
+        st = {
+            "cp_idx": np.zeros(new_cap, np.int32),
+            "gvk_idx": np.zeros(new_cap, np.int32),
+            "prof_idx": np.zeros(new_cap, np.int32),
+            "replicas": np.zeros(new_cap, np.int32),
+            "strategy": np.zeros(new_cap, np.int32),
+            "fresh": np.zeros(new_cap, bool),
+            "prev_sites": np.zeros((new_cap, K_PREV), np.int32),
+            "prev_counts": np.zeros((new_cap, K_PREV), np.int32),
+        }
+        for k, a in self._st.items():
+            st[k][: self.cap] = a
+        self._st = st
+        self.cap = new_cap
+        self._dev_state = None  # full re-upload
+
+    @staticmethod
+    def _fingerprint(p) -> tuple:
+        return (
+            id(p.placement), p.replicas, p.gvk, p.fresh,
+            tuple(p.requests.items()), tuple(p.prev.items()),
+        )
+
+    def upsert(self, problem, compiled) -> int:
+        row = self._key_row.get(problem.key)
+        if row is not None:
+            self._row_last_used[row] = self._pass
+            if self._problems[row] is problem:
+                return row
+            fp = self._fingerprint(problem)
+            if fp == self._fps[row]:
+                self._problems[row] = problem
+                return row
+        else:
+            if self.n_rows + 1 > self.cap:
+                self._grow(self.n_rows + 1)
+            row = self.n_rows
+            self.n_rows = row + 1
+            self._key_row[problem.key] = row
+            self._problems.append(problem)
+            self._fps.append(None)
+            self._terms.append("")
+            self._row_last_used.append(self._pass)
+        self._pack_row(row, problem, compiled)
+        return row
+
+    def _pack_row(self, row: int, problem, compiled) -> None:
+        snap = self.engine.snapshot
+        st = self._st
+        # placement slot
+        slot = self._cp_slot.get(id(compiled))
+        if slot is None:
+            slot = len(self._cp_pl)
+            self._cp_slot[id(compiled)] = slot
+            self._cp_pl.append((problem.placement, compiled))
+            self._static_max = max(
+                self._static_max, int(compiled.static_weights.max(initial=0))
+            )
+            self._tables_dirty = True
+        st["cp_idx"][row] = slot
+        # gvk slot
+        gslot = self._gvk_slot.get(problem.gvk)
+        if gslot is None:
+            gslot = len(self._gvk_list)
+            self._gvk_slot[problem.gvk] = gslot
+            self._gvk_list.append(problem.gvk)
+            self._tables_dirty = True
+        st["gvk_idx"][row] = gslot
+        # request profile slot (pods-dim adjustment applied BEFORE interning,
+        # mirroring _pack_chunk: each replica occupies a pod)
+        vec = np.zeros(len(snap.dims), np.int64)
+        for d, q in problem.requests.items():
+            j = snap.dim_index(d)
+            if j is not None:
+                vec[j] = q
+        pods = snap.dim_index("pods")
+        if pods is not None and problem.replicas > 0:
+            vec[pods] = max(vec[pods], 1)
+        pkey = vec.tobytes()
+        pslot = self._prof_slot.get(pkey)
+        if pslot is None:
+            pslot = len(self._profiles)
+            self._prof_slot[pkey] = pslot
+            self._profiles.append(vec)
+            self._tables_dirty = True
+        st["prof_idx"][row] = pslot
+        st["replicas"][row] = problem.replicas
+        st["strategy"][row] = compiled.strategy
+        st["fresh"][row] = problem.fresh
+        sites = np.zeros(K_PREV, np.int32)
+        cnts = np.zeros(K_PREV, np.int32)
+        k = 0
+        for name, reps_prev in problem.prev.items():
+            j = snap.index.get(name)
+            if j is not None:
+                sites[k] = j
+                cnts[k] = reps_prev
+                k += 1
+        st["prev_sites"][row] = sites
+        st["prev_counts"][row] = cnts
+        self._fps[row] = self._fingerprint(problem)
+        self._terms[row] = compiled.terms[0][0]
+        self._dirty.add(row)
+
+    @property
+    def slots_exhausted(self) -> bool:
+        return (
+            len(self._cp_pl) > MAX_SLOTS
+            or len(self._gvk_list) > MAX_SLOTS
+            or len(self._profiles) > MAX_SLOTS
+        )
+
+    # -- device sync -------------------------------------------------------
+
+    def _rebuild_tables(self) -> None:
+        snap = self.engine.snapshot
+        gen = getattr(self.engine, "_snapshot_gen", 0)
+        if gen != self._snapshot_gen:
+            # snapshot swapped in place (same cluster set): recompile each
+            # slot's placement against the new snapshot, order-preserving so
+            # row cp_idx values stay valid
+            self._snapshot_gen = gen
+            self._cp_slot.clear()
+            self._static_max = 0
+            for i, (pl, _) in enumerate(self._cp_pl):
+                cp = self.engine._compiled(pl)
+                self._cp_pl[i] = (pl, cp)
+                self._cp_slot[id(cp)] = i
+                self._static_max = max(
+                    self._static_max, int(cp.static_weights.max(initial=0))
+                )
+        c = snap.num_clusters
+        aff = np.stack(
+            [
+                (cp.terms[0][1] & cp.spread_field_ok).astype(np.int32)
+                for _, cp in self._cp_pl
+            ]
+        )
+        taint = np.stack(
+            [cp.taint_ok.astype(np.int32) for _, cp in self._cp_pl]
+        )
+        static = np.stack(
+            [cp.static_weights.astype(np.int32) for _, cp in self._cp_pl]
+        )
+        cp_table = np.concatenate([aff, taint, static], axis=1)  # [U, 3C]
+        gvk_rows = []
+        for g in self._gvk_list:
+            gid = snap.gvk_vocab.get(g) if g else None
+            if gid is None:
+                mask = (
+                    np.zeros(c, bool)
+                    if g and len(snap.gvk_vocab) > 0
+                    else np.ones(c, bool)
+                )
+            else:
+                word, bit = gid // 32, gid % 32
+                mask = (snap.gvk_bits[:, word] >> np.uint32(bit)) & 1 != 0
+            gvk_rows.append(mask.astype(np.int32))
+        gvk_table = np.stack(gvk_rows)
+        prof_table = self.engine._profile_table(np.stack(self._profiles))
+        self._avail_max = int(
+            jnp.max(
+                jnp.where(
+                    (prof_table == MAX_INT32) | (prof_table == -1),
+                    0,
+                    prof_table,
+                )
+            )
+        )
+        self._dev_tables = (
+            jnp.asarray(cp_table),
+            jnp.asarray(gvk_table),
+            prof_table,
+            jnp.asarray(~snap.complete_enablements),
+        )
+        self._tables_dirty = False
+
+    def _sync_device(self) -> None:
+        if self._tables_dirty or (
+            getattr(self.engine, "_snapshot_gen", 0) != self._snapshot_gen
+        ):
+            self._rebuild_tables()
+        if self._dev_state is None:
+            self._dev_state = tuple(
+                jnp.asarray(self._st[k]) for k in _STATE_FIELDS
+            )
+            self._dirty.clear()
+        elif self._dirty:
+            rows = np.fromiter(self._dirty, np.int64, len(self._dirty))
+            if len(rows) > self.cap // 2:
+                self._dev_state = tuple(
+                    jnp.asarray(self._st[k]) for k in _STATE_FIELDS
+                )
+            else:
+                vals = tuple(self._st[k][rows] for k in _STATE_FIELDS)
+                self._dev_state = _scatter_rows(
+                    self._dev_state, jnp.asarray(rows), vals
+                )
+            self._dirty.clear()
+
+    # -- scheduling --------------------------------------------------------
+
+    def schedule(self, problems: Sequence, compiled: Sequence) -> list:
+        self._pass += 1
+        # reclaim rows of deleted/idle bindings before the table would grow
+        # (compaction reindexes rows, so it must run before any upsert of
+        # this pass hands out indices). Gated on ACTUAL new keys so the
+        # steady all-rows storm pays one dict sweep at capacity pressure,
+        # not an O(n_rows) compaction scan per pass.
+        if self.n_rows + len(problems) > self.cap:
+            new_keys = sum(1 for p in problems if p.key not in self._key_row)
+            if self.n_rows + new_keys > self.cap:
+                self._compact()
+        rows_np = np.fromiter(
+            (self.upsert(p, cp) for p, cp in zip(problems, compiled)),
+            np.int32,
+            len(problems),
+        )
+        self._sync_device()
+        n = len(rows_np)
+        n_pad = max(self.chunk, -(-n // self.chunk) * self.chunk)
+        n_chunks = n_pad // self.chunk
+        st = self._st
+        # all-rows storm mode: the row-index upload is cached on device
+        is_all = n == self.n_rows and np.array_equal(
+            rows_np, np.arange(n, dtype=np.int32)
+        )
+        if is_all:
+            if self._all_rows_n != n or self._all_rows_dev is None or (
+                self._all_rows_dev.shape[0] != n_pad
+            ):
+                ar = np.full(n_pad, -1, np.int32)
+                ar[:n] = np.arange(n, dtype=np.int32)
+                self._all_rows_dev = jnp.asarray(ar)
+                self._all_rows_n = n
+            rows_dev = self._all_rows_dev
+        else:
+            ar = np.full(n_pad, -1, np.int32)
+            ar[:n] = rows_np
+            rows_dev = jnp.asarray(ar)
+
+        reps_sel = st["replicas"][rows_np]
+        strat_sel = st["strategy"][rows_np]
+        max_n = int(reps_sel.max(initial=0))
+        max_prev = int(st["prev_counts"][rows_np].max(initial=0))
+        has_agg = bool((strat_sel == AGGREGATED).any())
+        c = self.engine.snapshot.num_clusters
+        from .core import kernel_variant
+
+        wide, fast = kernel_variant(
+            max(self._avail_max, max_n), self._static_max, max_prev, max_n, c
+        )
+        k_out = min(max(1, c), _pow2(max(max_n, 1)))
+        is_dup = strat_sel == S_DUPLICATED
+        need_bits = bool(is_dup.any() or (reps_sel == 0).any())
+        safe = int(
+            np.minimum(np.where(is_dup, 0, reps_sel), k_out).sum()
+        )
+
+        def cap_round(v: int) -> int:
+            v = max(v, 1)
+            return (
+                -(-v // E_ROUND) * E_ROUND if v > E_ROUND else _pow2(max(v, 1024))
+            )
+
+        # fetched bytes scale with e_cap, so tune it to ~1.25x the last
+        # observed total; the safe bound can never overflow and is the
+        # first-pass / fallback trace
+        e_cap = cap_round(safe)
+        if 0 < self._last_total and self._last_total * 5 // 4 < safe:
+            e_cap = min(e_cap, cap_round(self._last_total * 5 // 4))
+
+        for attempt in range(2):
+            flat, bits = _fleet_solve(
+                *self._dev_tables,
+                rows_dev,
+                *self._dev_state,
+                chunk=self.chunk,
+                n_chunks=n_chunks,
+                k_out=k_out,
+                e_cap=e_cap,
+                wide=wide,
+                fast=fast,
+                has_aggregated=has_agg,
+                need_bits=need_bits,
+            )
+            arr = np.asarray(flat)  # the ONE device->host fetch
+            total = int(arr[0])
+            if total <= e_cap:
+                break
+            e_cap = cap_round(safe)  # overflow: rerun with the safe bound
+        assert total <= e_cap, (total, e_cap)  # safe bound guarantees this
+        self._last_total = total
+        meta = arr[1 : 1 + n_pad]
+        entries = arr[1 + n_pad :]
+        n_placed = (meta & 0xFF).astype(np.int64)
+        starts = np.zeros(n_pad, np.int64)
+        np.cumsum(n_placed[:-1], out=starts[1:])
+        unsched = (meta >> 8) & 1
+        has_cand = (meta >> 9) & 1
+
+        batch = _FleetBatch(
+            self.engine.snapshot.names, entries, starts, bits
+        )
+        out = []
+        for i, p in enumerate(problems):
+            term = self._terms[rows_np[i]]
+            if not has_cand[i]:
+                err = "no clusters fit the placement"
+            elif unsched[i]:
+                err = "clusters available replicas are not enough"
+            else:
+                err = ""
+            dup = (
+                p.replicas
+                if (is_dup[i] and p.replicas > 0 and not err)
+                else None
+            )
+            out.append(
+                FleetResult(
+                    p.key, term, err, batch, i, int(n_placed[i]), dup,
+                    p.replicas == 0,
+                )
+            )
+        return out

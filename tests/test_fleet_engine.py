@@ -1,0 +1,242 @@
+"""Device-resident fleet path: differential equivalence with the host path.
+
+The fleet table (scheduler/fleet.py) re-implements Filter+Assign as one
+fused resident-state program; these tests pin it to the general host path
+(_schedule_host) — same placements, same errors, same feasible sets — over
+randomized mixed-strategy fleets, plus the no-idx dispense mode, snapshot
+swap-in-place, and the entry-buffer overflow fallback."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import karmada_tpu.scheduler.fleet as fleet_mod
+from karmada_tpu.ops.dispense import take_by_weight, take_by_weight_fast
+from karmada_tpu.scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+from karmada_tpu.utils.builders import (
+    aggregated_placement,
+    duplicated_placement,
+    dynamic_weight_placement,
+    static_weight_placement,
+    synthetic_fleet,
+)
+from karmada_tpu.utils.quantity import parse_resource_list
+
+
+REQ = parse_resource_list({"cpu": "250m", "memory": "512Mi"})
+
+
+def _mixed_problems(clusters, n, seed):
+    rng = np.random.default_rng(seed)
+    pls = [
+        dynamic_weight_placement(),
+        duplicated_placement(),
+        static_weight_placement(
+            {c.name: (i % 3) + 1 for i, c in enumerate(clusters[:10])}
+        ),
+        aggregated_placement(),
+    ]
+    out = []
+    for i in range(n):
+        prev_n = int(rng.integers(0, 5))
+        prev_idx = rng.choice(len(clusters), prev_n, replace=False)
+        out.append(
+            BindingProblem(
+                key=f"b{i}",
+                placement=pls[i % 4],
+                replicas=int(rng.integers(0, 40)),
+                requests=REQ,
+                gvk="apps/v1/Deployment",
+                prev={
+                    clusters[j].name: int(rng.integers(1, 9)) for j in prev_idx
+                },
+                fresh=bool(rng.random() < 0.2),
+            )
+        )
+    return out
+
+
+def _assert_same(slow, fast):
+    for s, f in zip(slow, fast):
+        assert s.success == f.success, (s.key, s.error, f.error)
+        assert s.error == f.error, s.key
+        assert s.clusters == f.clusters, (s.key, s.clusters, f.clusters)
+        assert sorted(s.feasible) == sorted(f.feasible), s.key
+        assert s.affinity_name == f.affinity_name, s.key
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_fleet_matches_host_path_mixed_strategies(seed):
+    clusters = synthetic_fleet(50, seed=7)
+    snap = ClusterSnapshot(clusters)
+    problems = _mixed_problems(clusters, 300, seed)
+    host = TensorScheduler(snap)
+    slow = host._schedule_host(
+        problems, [host._compiled(p.placement) for p in problems]
+    )
+    eng = TensorScheduler(snap)
+    eng.fleet_threshold = 1
+    fast = eng.schedule(problems)
+    assert eng._fleet is not None, "fleet path did not engage"
+    _assert_same(slow, fast)
+    # repeat pass: identity fast path must return identical placements
+    again = eng.schedule(problems)
+    _assert_same(fast, again)
+    # rebuilt problem objects (the controller case): fingerprint dedupe
+    rebuilt = [
+        BindingProblem(
+            key=p.key, placement=p.placement, replicas=p.replicas,
+            requests=p.requests, gvk=p.gvk, prev=p.prev, fresh=p.fresh,
+        )
+        for p in problems
+    ]
+    _assert_same(fast, eng.schedule(rebuilt))
+
+
+def test_fleet_incremental_update_changes_only_touched_rows():
+    clusters = synthetic_fleet(50, seed=7)
+    snap = ClusterSnapshot(clusters)
+    problems = _mixed_problems(clusters, 200, 3)
+    eng = TensorScheduler(snap)
+    eng.fleet_threshold = 1
+    first = eng.schedule(problems)
+    # mutate a handful of bindings (replicas change)
+    changed = []
+    for i in (5, 17, 101):
+        p = problems[i]
+        changed.append(
+            BindingProblem(
+                key=p.key, placement=p.placement,
+                replicas=max(1, p.replicas + 3), requests=p.requests,
+                gvk=p.gvk, prev=p.prev, fresh=p.fresh,
+            )
+        )
+    problems2 = list(problems)
+    for p in changed:
+        problems2[int(p.key[1:])] = p
+    second = eng.schedule(problems2)
+    host = TensorScheduler(snap)
+    want = host._schedule_host(
+        problems2, [host._compiled(p.placement) for p in problems2]
+    )
+    _assert_same(want, second)
+
+
+def test_update_snapshot_keeps_fleet_valid():
+    clusters = synthetic_fleet(40, seed=9)
+    snap = ClusterSnapshot(clusters)
+    problems = _mixed_problems(clusters, 150, 4)
+    eng = TensorScheduler(snap)
+    eng.fleet_threshold = 1
+    eng.schedule(problems)
+    fleet_before = eng._fleet
+    # capacity drift on the same cluster set
+    for cl in clusters:
+        rs = cl.status.resource_summary
+        for d in list(rs.allocated):
+            rs.allocated[d] = int(rs.allocated[d] * 1.5) + 1
+    snap2 = ClusterSnapshot(clusters)
+    assert eng.update_snapshot(snap2)
+    got = eng.schedule(problems)
+    assert eng._fleet is fleet_before  # table survived the swap
+    fresh_engine = TensorScheduler(snap2)
+    want = fresh_engine._schedule_host(
+        problems, [fresh_engine._compiled(p.placement) for p in problems]
+    )
+    _assert_same(want, got)
+    # cluster-set change must refuse the in-place swap
+    snap3 = ClusterSnapshot(clusters[:-1])
+    assert not eng.update_snapshot(snap3)
+
+
+def test_entry_buffer_overflow_falls_back_to_safe_bound(monkeypatch):
+    clusters = synthetic_fleet(30, seed=5)
+    snap = ClusterSnapshot(clusters)
+    problems = _mixed_problems(clusters, 120, 6)
+    monkeypatch.setattr(fleet_mod, "E_ROUND", 16)
+    eng = TensorScheduler(snap)
+    eng.fleet_threshold = 1
+    first = eng.schedule(problems)
+    # lie about the last total so the tuned cap must overflow and retry
+    eng._fleet._last_total = 1
+    second = eng.schedule(problems)
+    _assert_same(first, second)
+
+
+def test_dispense_no_idx_mode_matches_sort_dispense():
+    """Tie-heavy fuzz of with_idx=False (two-stage top_k) vs the exact
+    3-key sort, including placed-site coverage of the returned top-k."""
+    rng = np.random.default_rng(0)
+    for trial in range(60):
+        c = int(rng.integers(3, 120))
+        num = int(rng.integers(0, 60))
+        w = rng.choice(
+            [0, 1, 2, 5, 7], size=c, p=[0.2, 0.3, 0.2, 0.2, 0.1]
+        ).astype(np.int32)
+        last = rng.integers(0, 4, c).astype(np.int32)
+        init = np.zeros(c, np.int32)
+        ref = np.asarray(
+            take_by_weight(
+                jnp.int32(num), jnp.asarray(w), jnp.asarray(last),
+                jnp.asarray(init), True,
+            )
+        )
+        k_top = min(c, 1 << max(1, max(1, num) - 1).bit_length())
+        got, sites = take_by_weight_fast(
+            jnp.int32(num), jnp.asarray(w), jnp.asarray(last),
+            jnp.asarray(init), 23, 8, k_top, True,
+            with_idx=False, return_sites=True,
+        )
+        got, sites = np.asarray(got), np.asarray(sites)
+        assert np.array_equal(ref, got), (trial, c, num)
+        placed = set(np.flatnonzero(got).tolist())
+        assert placed <= set(sites.tolist()), (trial, placed)
+
+
+def test_fleet_compacts_rows_of_deleted_bindings():
+    """Create/delete churn must not grow the table without bound: rows idle
+    past the compaction window are reclaimed before the table grows."""
+    clusters = synthetic_fleet(10, seed=1)
+    snap = ClusterSnapshot(clusters)
+    eng = TensorScheduler(snap, chunk_size=64)
+    eng.fleet_threshold = 1
+    pl = dynamic_weight_placement()
+
+    def gen(tag, n):
+        return [
+            BindingProblem(
+                key=f"{tag}-{i}", placement=pl, replicas=3, requests=REQ,
+                gvk="apps/v1/Deployment",
+            )
+            for i in range(n)
+        ]
+
+    caps = []
+    for gen_i in range(12):  # each generation uses entirely fresh keys
+        res = eng.schedule(gen(f"g{gen_i}", 48))
+        assert all(r.success for r in res)
+        caps.append(eng._fleet.cap)
+    # without eviction cap would reach >= 12*48 rounded up; with the
+    # 4-pass idle window it stays bounded by a few live generations
+    assert eng._fleet.cap <= 512, caps
+    assert eng._fleet.n_rows <= 48 * (eng._fleet.COMPACT_IDLE_PASSES + 2)
+
+
+def test_fleet_lazy_results_expose_schedule_result_surface():
+    clusters = synthetic_fleet(20, seed=2)
+    snap = ClusterSnapshot(clusters)
+    problems = [
+        BindingProblem(
+            key="w", placement=dynamic_weight_placement(), replicas=6,
+            requests=REQ, gvk="apps/v1/Deployment",
+        ),
+        # zero-replica (non-workload): all feasible clusters, no counts
+        BindingProblem(key="cfg", placement=duplicated_placement(),
+                       replicas=0, requests={}, gvk="apps/v1/Deployment"),
+    ]
+    eng = TensorScheduler(snap)
+    eng.fleet_threshold = 1
+    res = eng.schedule(problems)
+    assert res[0].success and sum(res[0].clusters.values()) == 6
+    assert res[1].success and res[1].clusters == {}
+    assert len(res[1].feasible) > 0

@@ -87,6 +87,7 @@ class TestBenchShardedStorm:
         proc = subprocess.run(
             [
                 sys.executable, "/root/repo/bench.py", "--cpu",
+                "--kernel-only",
                 "--bindings", "512", "--chunk", "256", "--clusters", "64",
                 "--repeats", "1", "--sample", "48",
             ],
@@ -95,6 +96,27 @@ class TestBenchShardedStorm:
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "# mesh: 8 devices over the binding axis" in proc.stderr
-        assert "identical-placement check: 48/48 match" in proc.stderr
         result = json.loads(proc.stdout.strip().splitlines()[-1])
         assert result["unit"] == "s" and result["value"] > 0
+
+    def test_engine_bench_verifies_on_cpu(self):
+        """bench.py config 5 engine path at toy scale: every verification
+        tier (numpy full-set, oracle sample, mixed strategies) must be
+        mismatch-free."""
+        import os
+        import json
+        import subprocess
+
+        proc = subprocess.run(
+            [
+                sys.executable, "/root/repo/bench.py", "--cpu",
+                "--bindings", "512", "--chunk", "256", "--clusters", "64",
+                "--repeats", "1", "--sample", "48", "--mix-sample", "64",
+            ],
+            capture_output=True, text=True, timeout=600,
+            env=dict(os.environ), cwd="/root/repo",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result["verified_mismatches"] == 0
+        assert result["verified_rows"] >= 512 + 48 + 64
