@@ -30,14 +30,34 @@ class DependenciesDistributor:
         self.store = store
         self.interpreter = interpreter
         self.worker = runtime.new_worker("dependencies", self._reconcile)
+        # parent binding key -> attached binding keys; an informer-style
+        # index replacing the full-store scans the cleanup paths ran per
+        # reconcile (O(bindings) per event drowned propagation storms).
+        # Pre-existing attachments are seeded by the watch's replay of
+        # ADDED events (informer initial-list semantics). The reverse map
+        # prunes the index when a binding loses or changes its depended-by
+        # label (adoption / re-parenting), so cleanup never deletes a
+        # binding that is no longer attached.
+        self._attached: dict[str, set[str]] = {}
+        self._attached_parent: dict[str, str] = {}
         store.watch("ResourceBinding", self._on_binding_event)
 
     def _on_binding_event(self, event) -> None:
         rb = event.obj
-        # skip attached bindings driving themselves; everything else may need
-        # (re)distribution or cleanup (e.g. propagateDeps turned off)
-        if DEPENDED_BY_LABEL not in rb.meta.labels:
-            self.worker.enqueue(event.key)
+        # attached bindings don't drive themselves, but they feed the index;
+        # everything else may need (re)distribution or cleanup (e.g.
+        # propagateDeps turned off)
+        parent = rb.meta.labels.get(DEPENDED_BY_LABEL)
+        old = self._attached_parent.get(event.key)
+        if old is not None and (event.type == "Deleted" or old != parent):
+            self._attached.get(old, set()).discard(event.key)
+            del self._attached_parent[event.key]
+        if parent is not None:
+            if event.type != "Deleted":
+                self._attached.setdefault(parent, set()).add(event.key)
+                self._attached_parent[event.key] = parent
+            return
+        self.worker.enqueue(event.key)
 
     def _reconcile(self, key: str) -> Optional[str]:
         rb = self.store.get("ResourceBinding", key)
@@ -97,12 +117,9 @@ class DependenciesDistributor:
             self._sync_clusters(attached)
             self.store.apply(attached)
         # drop stale attachments no longer in the dependency set
-        for other in self.store.list("ResourceBinding"):
-            if (
-                other.meta.labels.get(DEPENDED_BY_LABEL) == key
-                and other.meta.namespaced_name not in seen_keys
-            ):
-                self.store.delete("ResourceBinding", other.meta.namespaced_name)
+        for akey in list(self._attached.get(key, ())) :
+            if akey not in seen_keys:
+                self.store.delete("ResourceBinding", akey)
         return DONE
 
     def _merge_required_by(self, binding: ResourceBinding, snap: BindingSnapshot) -> bool:
@@ -138,6 +155,5 @@ class DependenciesDistributor:
         ]
 
     def _cleanup_attached(self, parent_key: str) -> None:
-        for other in self.store.list("ResourceBinding"):
-            if other.meta.labels.get(DEPENDED_BY_LABEL) == parent_key:
-                self.store.delete("ResourceBinding", other.meta.namespaced_name)
+        for akey in list(self._attached.get(parent_key, ())):
+            self.store.delete("ResourceBinding", akey)

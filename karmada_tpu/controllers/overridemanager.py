@@ -8,7 +8,7 @@ cluster-scoped policies applied before namespaced ones, each sorted by name
 
 from __future__ import annotations
 
-import copy
+from ..utils.clone import clone_resource
 from typing import Any, Optional, Sequence
 
 from ..api.cluster import Cluster
@@ -212,7 +212,6 @@ class OverrideManager:
         self.store = store
 
     def apply_overrides(self, obj: Resource, cluster: Cluster) -> Resource:
-        out = copy.deepcopy(obj)
         cops = sorted(
             self.store.list("ClusterOverridePolicy"), key=lambda p: p.meta.name
         )
@@ -224,13 +223,20 @@ class OverrideManager:
             ),
             key=lambda p: p.meta.name,
         )
+        # clone lazily: most (resource, cluster) pairs match no rule, and
+        # the unconditional copy was a top propagation-storm cost. Callers
+        # treat an identical return as "no overrides applied".
+        out = None
         for policy in list(cops) + list(ops):
-            if not resource_matches_selectors(out, policy.spec.resource_selectors):
+            cur = out if out is not None else obj
+            if not resource_matches_selectors(cur, policy.spec.resource_selectors):
                 continue
             for rule in policy.spec.override_rules:
                 if rule.target_cluster is not None and not rule.target_cluster.matches(
                     cluster
                 ):
                     continue
+                if out is None:
+                    out = clone_resource(obj)
                 apply_overriders(out, rule.overriders)
-        return out
+        return out if out is not None else obj

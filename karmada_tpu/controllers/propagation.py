@@ -16,7 +16,7 @@ Ref:
 
 from __future__ import annotations
 
-import copy
+from ..utils.clone import clone_resource
 import math
 from typing import Optional
 
@@ -179,9 +179,15 @@ class BindingController:
             and rb.spec.placement.replica_scheduling_type() == DIVIDED
         )
         for cluster_name, replicas in targets.items():
-            workload = copy.deepcopy(template)
+            # every transform below (revise_replica, apply_overrides)
+            # returns a fresh object, so the template is cloned lazily:
+            # exactly ONE copy per Work, never three (the redundant
+            # deepcopy chain dominated propagation-storm wall time)
+            workload = template
             if divided and rb.spec.replicas > 0:
                 workload = self.interpreter.revise_replica(workload, replicas)
+                if workload is template:
+                    workload = clone_resource(template)
                 # Job completions division (binding/common.go:287-299)
                 if workload.kind == "Job" and "completions" in workload.spec:
                     total = int(workload.spec["completions"])
@@ -191,6 +197,8 @@ class BindingController:
             cluster_obj = self.store.get("Cluster", cluster_name)
             if cluster_obj is not None:
                 workload = self.overrides.apply_overrides(workload, cluster_obj)
+            if workload is template:
+                workload = clone_resource(template)
             self._create_or_update_work(rb, kind, cluster_name, workload)
         self._cleanup_works(
             binding_ref(kind, key), keep_clusters=set(targets) | evicting

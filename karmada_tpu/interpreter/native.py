@@ -11,7 +11,7 @@ dicts (spec.replicas, spec.template.spec.containers[*].resources.requests).
 
 from __future__ import annotations
 
-import copy
+from ..utils.clone import clone_json, clone_resource
 from datetime import datetime, timezone
 from typing import Any, Optional
 
@@ -80,7 +80,7 @@ def _get_replicas_workload(obj: Resource) -> tuple[int, Optional[ReplicaRequirem
 
 
 def _revise_replica(obj: Resource, replicas: int) -> Resource:
-    out = copy.deepcopy(obj)
+    out = clone_resource(obj)
     if _gvk(out) == JOB and "parallelism" in out.spec:
         out.spec["parallelism"] = replicas
     else:
@@ -125,7 +125,7 @@ _SUM_FIELDS = {
 def _aggregate_status_sum(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
     """Per-kind numeric status aggregation across member clusters
     (native/aggregatestatus.go pattern: sum counters into the template)."""
-    out = copy.deepcopy(obj)
+    out = clone_resource(obj)
     fields = _SUM_FIELDS.get(_gvk(obj), ())
     agg: dict[str, Any] = {f: 0 for f in fields}
     for item in items:
@@ -142,7 +142,7 @@ def _aggregate_lb_ingress(obj: Resource, items: list[AggregatedStatusItem]) -> R
     consumers can tell where each VIP came from
     (native/aggregatestatus.go:123-205). Non-LoadBalancer Services keep
     their status untouched."""
-    out = copy.deepcopy(obj)
+    out = clone_resource(obj)
     if _gvk(obj) == "v1/Service" and (obj.spec or {}).get("type") != "LoadBalancer":
         return out
     merged = []
@@ -162,7 +162,7 @@ _POD_PHASE_ORDER = ("Failed", "Pending", "Running", "Succeeded")
 
 
 def _aggregate_pod(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
-    out = copy.deepcopy(obj)
+    out = clone_resource(obj)
     phases = set()
     containers: list[dict] = []
     init_containers: list[dict] = []
@@ -190,7 +190,7 @@ def _aggregate_pod(obj: Resource, items: list[AggregatedStatusItem]) -> Resource
 def _aggregate_pvc(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
     """Bound only when every member is Bound; any Lost member loses the
     claim outright (aggregatestatus.go:521-557)."""
-    out = copy.deepcopy(obj)
+    out = clone_resource(obj)
     phase = "Bound"
     for item in items:
         p = (item.status or {}).get("phase")
@@ -206,7 +206,7 @@ def _aggregate_pvc(obj: Resource, items: list[AggregatedStatusItem]) -> Resource
 def _aggregate_pdb(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
     """Sum healthy/expected/allowed counters; disruptedPods are namespaced
     by member name to stay distinguishable (aggregatestatus.go:559-600)."""
-    out = copy.deepcopy(obj)
+    out = clone_resource(obj)
     agg = {"currentHealthy": 0, "desiredHealthy": 0, "expectedPods": 0,
            "disruptionsAllowed": 0}
     disrupted: dict[str, Any] = {}
@@ -221,7 +221,7 @@ def _aggregate_pdb(obj: Resource, items: list[AggregatedStatusItem]) -> Resource
 
 
 def _aggregate_hpa(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
-    out = copy.deepcopy(obj)
+    out = clone_resource(obj)
     agg = {"currentReplicas": 0, "desiredReplicas": 0}
     for item in items:
         st = item.status or {}
@@ -248,7 +248,7 @@ def _ts_sort_key(val: str):
 def _aggregate_cronjob(obj: Resource, items: list[AggregatedStatusItem]) -> Resource:
     """Concatenate active job refs, keep the chronologically latest
     schedule/success times — aggregatestatus.go:232-271."""
-    out = copy.deepcopy(obj)
+    out = clone_resource(obj)
     active: list = []
     last_schedule = None
     last_success = None
@@ -274,7 +274,7 @@ def _retain_default(desired: Resource, observed: Resource) -> Resource:
     (native/retain.go): nodeName on pods, clusterIP on services, and
     member-HPA-owned replica counts (the hpaScaleTargetMarker label marks
     workloads whose replicas belong to the members)."""
-    out = copy.deepcopy(desired)
+    out = clone_resource(desired)
     if _gvk(desired) == POD:
         node_name = observed.spec.get("nodeName")
         if node_name:

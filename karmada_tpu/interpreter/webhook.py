@@ -20,7 +20,7 @@ JSONPatch, same as the reference (we apply add/replace/remove).
 
 from __future__ import annotations
 
-import copy
+from ..utils.clone import clone_json
 import json
 import ssl
 import threading
@@ -59,8 +59,8 @@ def resource_to_dict(obj: Resource) -> dict:
             "annotations": dict(obj.meta.annotations),
             "generation": obj.meta.generation,
         },
-        "spec": copy.deepcopy(obj.spec),
-        "status": copy.deepcopy(obj.status),
+        "spec": clone_json(obj.spec),
+        "status": clone_json(obj.status),
     }
 
 
@@ -84,7 +84,7 @@ def resource_from_dict(d: dict) -> Resource:
 def apply_json_patch(doc: dict, patch: list[dict]) -> dict:
     """RFC 6902 add/replace/remove over a JSON document (the subset the
     reference consumes for interpreter responses)."""
-    out = copy.deepcopy(doc)
+    out = clone_json(doc)
     for op in patch:
         path = op.get("path", "")
         parts = [p.replace("~1", "/").replace("~0", "~") for p in path.split("/")[1:]]

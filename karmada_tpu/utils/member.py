@@ -21,6 +21,7 @@ from typing import Any, Callable, Iterable, Optional
 
 from ..api.core import Resource
 from ..estimator.accurate import NodeState
+from .clone import clone_resource
 
 
 class UnreachableError(Exception):
@@ -283,6 +284,13 @@ class ObjectWatcher:
         self.members = members
         self.interpreter = interpreter
         self._versions: dict[tuple[str, str, str, str], int] = {}
+        # (cluster, gvk, ns, name) -> (desired manifest pin, applied rv,
+        # conflict_resolution): re-applying the SAME manifest object onto an
+        # un-drifted member is a no-op, and the execution controller echoes
+        # one such apply per Work condition update — the pin (a strong ref,
+        # so the id cannot be recycled) collapses that loop. Any member
+        # drift changes the observed resource_version and misses the cache.
+        self._applied: dict[tuple[str, str, str, str], tuple] = {}
 
     def create_or_update(
         self, cluster: str, desired: Resource, conflict_resolution: str = "Overwrite"
@@ -291,9 +299,17 @@ class ObjectWatcher:
         if member is None:
             raise UnreachableError(f"no client for cluster {cluster}")
         gvk = f"{desired.api_version}/{desired.kind}"
+        vkey = (cluster, gvk, desired.meta.namespace, desired.meta.name)
         observed = member.get(gvk, desired.meta.namespace, desired.meta.name)
-        to_apply = copy.deepcopy(desired)
-        to_apply.meta.annotations[MANAGED_ANNOTATION] = "true"
+        cached = self._applied.get(vkey)
+        if (
+            cached is not None
+            and cached[0] is desired
+            and observed is not None
+            and observed.meta.resource_version == cached[1]
+            and conflict_resolution == cached[2]
+        ):
+            return observed
         if observed is not None:
             # an unmanaged pre-existing object is a conflict
             # (execution_controller + objectwatcher ConflictResolution)
@@ -305,14 +321,23 @@ class ObjectWatcher:
                     f"{gvk} {desired.meta.namespaced_name} already exists in "
                     f"{cluster} and is not managed"
                 )
-            to_apply = self.interpreter.retain(to_apply, observed)
+            # retain() tiers return a fresh object; clone only if a no-hook
+            # tier passed `desired` straight through (one copy per apply,
+            # not two — the copy chain was the storm's dominant cost)
+            to_apply = self.interpreter.retain(desired, observed)
+            if to_apply is desired:
+                to_apply = clone_resource(desired)
             to_apply.meta.annotations[MANAGED_ANNOTATION] = "true"
             to_apply.meta.resource_version = observed.meta.resource_version
             # member status is owned by the member; never push it down
             to_apply.status = observed.status
+        else:
+            to_apply = clone_resource(desired)
+            to_apply.meta.annotations[MANAGED_ANNOTATION] = "true"
         applied = member.apply(to_apply)
-        self._versions[(cluster, gvk, desired.meta.namespace, desired.meta.name)] = (
-            applied.meta.resource_version
+        self._versions[vkey] = applied.meta.resource_version
+        self._applied[vkey] = (
+            desired, applied.meta.resource_version, conflict_resolution,
         )
         return applied
 
@@ -322,6 +347,7 @@ class ObjectWatcher:
             return
         member.delete(gvk, namespace, name)
         self._versions.pop((cluster, gvk, namespace, name), None)
+        self._applied.pop((cluster, gvk, namespace, name), None)
 
     def needs_update(self, cluster: str, desired: Resource) -> bool:
         gvk = f"{desired.api_version}/{desired.kind}"

@@ -84,7 +84,7 @@ def mutate_override_policy(policy) -> None:
 def mutate_work(work) -> None:
     """Permanent-ID label + prune runtime fields from manifests
     (work/mutating.go: uuid label, prune.RemoveIrrelevantFields)."""
-    import copy
+    from ..utils.clone import clone_resource
 
     if not work.meta.labels.get(PERMANENT_ID_LABEL):
         work.meta.labels[PERMANENT_ID_LABEL] = str(uuid.uuid4())
@@ -104,7 +104,7 @@ def mutate_work(work) -> None:
         ):
             pruned.append(manifest)
             continue
-        manifest = copy.deepcopy(manifest)
+        manifest = clone_resource(manifest)
         manifest.status = {}
         manifest.meta.uid = ""
         manifest.meta.resource_version = 0

@@ -91,6 +91,33 @@ class TestDependenciesDistributor:
         cp.settle()
         assert cp.store.get("ResourceBinding", "default/c1-configmap") is None
 
+    def test_adopted_binding_survives_parent_cleanup(self):
+        """A binding that loses its depended-by label (adopted as an
+        independent binding) must drop out of the attachment index — parent
+        cleanup may not delete it."""
+        from karmada_tpu.controllers.dependencies import DEPENDED_BY_LABEL
+
+        cp = make_plane(1)
+        dep = new_deployment("app", replicas=1)
+        dep.spec["template"]["spec"]["volumes"] = [
+            {"name": "cfg", "configMap": {"name": "c1"}}
+        ]
+        cp.store.apply(
+            Resource(api_version="v1", kind="ConfigMap",
+                     meta=ObjectMeta(name="c1", namespace="default"))
+        )
+        cp.store.apply(dep)
+        cp.store.apply(nginx_policy(duplicated_placement(), propagate_deps=True))
+        cp.settle()
+        attached = cp.store.get("ResourceBinding", "default/c1-configmap")
+        assert attached is not None
+        # adoption: the label is removed, the binding becomes independent
+        del attached.meta.labels[DEPENDED_BY_LABEL]
+        cp.store.apply(attached)
+        cp.store.delete("Resource", "default/app")
+        cp.settle()
+        assert cp.store.get("ResourceBinding", "default/c1-configmap") is not None
+
 
 class TestNamespaceSync:
     def test_namespace_propagates_to_all_members(self):
