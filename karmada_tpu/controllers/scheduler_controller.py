@@ -33,9 +33,17 @@ class SchedulerController:
         disabled_plugins=(),
         custom_filters=(),
         clock=None,
+        solver=None,
     ) -> None:
         self.store = store
         self.scheduler_name = scheduler_name
+        # out-of-process solver sidecar (karmada_tpu.solver.RemoteSolver):
+        # when set, scheduling goes over its gRPC channel instead of the
+        # in-proc engine, with cluster state pushed on cluster events
+        self.solver = solver
+        self._solver_synced = False
+        if solver is not None:
+            solver._cluster_source = self._sorted_clusters
         # last_scheduled_time is compared against rescheduleTriggeredAt,
         # which other controllers stamp from the plane clock — both sides
         # must share one time base or Fresh triggers silently degrade
@@ -48,9 +56,12 @@ class SchedulerController:
         self.custom_filters = list(custom_filters)
         self._snapshot: Optional[ClusterSnapshot] = None
         self._engine: Optional[TensorScheduler] = None
+        # the batch cap bounds ONE engine pass; the device-resident fleet
+        # path amortizes per-pass dispatch+fetch costs over the whole batch,
+        # so a storm should drain in as few passes as possible
         self.worker = runtime.new_worker(
             "scheduler", self._reconcile,
-            reconcile_batch=self._reconcile_batch, batch_size=4096,
+            reconcile_batch=self._reconcile_batch, batch_size=131072,
         )
         store.watch("ResourceBinding", self._on_binding_event)
         store.watch("ClusterResourceBinding", self._on_binding_event)
@@ -68,7 +79,7 @@ class SchedulerController:
 
     def _on_cluster_event(self, event) -> None:
         self._snapshot = None  # invalidate; rebuild lazily
-        self._engine = None
+        self._solver_synced = False  # sidecar re-sync before next schedule
         for kind in ("ResourceBinding", "ClusterResourceBinding"):
             for rb in self.store.list(kind):
                 if rb.spec.scheduler_name == self.scheduler_name:
@@ -76,16 +87,31 @@ class SchedulerController:
 
     # -- engine ------------------------------------------------------------
 
-    def _get_engine(self) -> TensorScheduler:
-        if self._engine is None:
-            clusters = sorted(self.store.list("Cluster"), key=lambda c: c.name)
-            self._snapshot = ClusterSnapshot(clusters)
-            self._engine = TensorScheduler(
-                self._snapshot,
-                extra_estimators=self.extra_estimators,
-                disabled_plugins=self.disabled_plugins,
-                custom_filters=self.custom_filters,
-            )
+    def _sorted_clusters(self):
+        return sorted(self.store.list("Cluster"), key=lambda c: c.name)
+
+    def _get_engine(self):
+        if self.solver is not None:
+            if not self._solver_synced:
+                self.solver.sync_clusters(self._sorted_clusters())
+                self._solver_synced = True
+            return self.solver
+        if self._snapshot is None:
+            clusters = self._sorted_clusters()
+            snap = ClusterSnapshot(clusters)
+            # same cluster set: swap the snapshot in place so the engine's
+            # device-resident binding table survives status heartbeats
+            # (the informer-cache delta case); rebuild only on join/leave
+            if self._engine is not None and self._engine.update_snapshot(snap):
+                self._snapshot = snap
+            else:
+                self._snapshot = snap
+                self._engine = TensorScheduler(
+                    self._snapshot,
+                    extra_estimators=self.extra_estimators,
+                    disabled_plugins=self.disabled_plugins,
+                    custom_filters=self.custom_filters,
+                )
         return self._engine
 
     # -- reconcile ---------------------------------------------------------

@@ -56,6 +56,9 @@ class ControlPlane:
         # (cmd/scheduler/app/options/options.go:130-165 analogue)
         disabled_scheduler_plugins=(),
         scheduler_filter_plugins=(),
+        # out-of-process solver sidecar (karmada_tpu.solver.RemoteSolver):
+        # routes Score/Assign over gRPC instead of the in-proc engine
+        solver=None,
     ) -> None:
         import time as _time
 
@@ -118,6 +121,7 @@ class ControlPlane:
             disabled_plugins=disabled_scheduler_plugins,
             custom_filters=scheduler_filter_plugins,
             clock=self.clock,
+            solver=solver,
         )
         self.descheduler = (
             Descheduler(self.store, self.runtime, self.members, clock=self.clock)

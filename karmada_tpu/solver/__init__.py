@@ -1,0 +1,12 @@
+"""Out-of-process solver sidecar (gRPC Score/Assign service).
+
+``python -m karmada_tpu.solver --address 127.0.0.1:PORT`` runs the server
+process; the scheduler controller connects with ``RemoteSolver``.
+"""
+
+from .client import RemoteScheduleResult, RemoteSolver  # noqa: F401
+from .service import (  # noqa: F401
+    SolverGrpcServer,
+    SolverService,
+    StaleSnapshotError,
+)

@@ -1,0 +1,40 @@
+"""Solver sidecar process entry: ``python -m karmada_tpu.solver``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .service import SolverGrpcServer, SolverService
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="karmada-tpu solver sidecar")
+    p.add_argument("--address", default="127.0.0.1:0")
+    p.add_argument("--server-cert", default="", help="PEM file (TLS)")
+    p.add_argument("--server-key", default="", help="PEM file (TLS)")
+    p.add_argument("--client-ca", default="", help="PEM file (mTLS client auth)")
+    args = p.parse_args(argv)
+
+    def read(path):
+        return open(path, "rb").read() if path else None
+
+    server = SolverGrpcServer(
+        SolverService(),
+        args.address,
+        server_cert=read(args.server_cert),
+        server_key=read(args.server_key),
+        client_ca=read(args.client_ca),
+    )
+    port = server.start()
+    # the parent process scrapes this line to learn the bound port
+    print(f"solver listening on port {port}", flush=True)
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Control-plane side of the solver sidecar channel.
+
+``RemoteSolver`` satisfies the engine seam the scheduler controller uses
+(``schedule(problems) -> results``) over gRPC, with snapshot-version
+fencing: cluster events push SyncClusters, ScoreAndAssign carries the
+pushed version, and a FAILED_PRECONDITION answer (solver restarted, missed
+sync) triggers one re-sync + retry. Mirrors the estimator client pattern
+(estimator/grpc_transport.py; ref pkg/estimator/client/cache.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import grpc
+
+from ..scheduler import BindingProblem
+from .proto import solver_pb2 as pb
+from .service import SERVICE_NAME, cluster_to_state, encode_problems
+
+
+@dataclass
+class RemoteScheduleResult:
+    """Wire-decoded ScheduleResult (same surface the engine returns)."""
+
+    key: str
+    clusters: dict = field(default_factory=dict)
+    feasible: tuple = ()
+    affinity_name: str = ""
+    error: str = ""
+
+    @property
+    def success(self) -> bool:
+        return not self.error
+
+
+class RemoteSolver:
+    def __init__(
+        self,
+        target: str,
+        *,
+        root_ca: Optional[bytes] = None,
+        client_cert: Optional[bytes] = None,
+        client_key: Optional[bytes] = None,
+        timeout_seconds: float = 120.0,
+        cluster_source=None,  # () -> list[Cluster]; used for re-sync
+    ):
+        if (client_cert or client_key) and not (root_ca and client_cert and client_key):
+            raise ValueError(
+                "incomplete client TLS config: client_cert/client_key require "
+                "each other and root_ca"
+            )
+        opts = [("grpc.max_receive_message_length", 256 << 20),
+                ("grpc.max_send_message_length", 256 << 20)]
+        if root_ca is not None:
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=root_ca,
+                private_key=client_key,
+                certificate_chain=client_cert,
+            )
+            self._channel = grpc.secure_channel(target, creds, options=opts)
+        else:
+            self._channel = grpc.insecure_channel(target, options=opts)
+        self.timeout = timeout_seconds
+        self._version = 0
+        self._cluster_source = cluster_source
+        self._sync = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/SyncClusters",
+            request_serializer=pb.SyncClustersRequest.SerializeToString,
+            response_deserializer=pb.SyncClustersResponse.FromString,
+        )
+        self._score = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/ScoreAndAssign",
+            request_serializer=pb.ScoreAndAssignRequest.SerializeToString,
+            response_deserializer=pb.ScoreAndAssignResponse.FromString,
+        )
+
+    # -- snapshot channel --------------------------------------------------
+
+    def sync_clusters(self, clusters) -> int:
+        self._version += 1
+        req = pb.SyncClustersRequest(snapshot_version=self._version)
+        for cl in clusters:
+            req.clusters.append(cluster_to_state(cl))
+        resp = self._sync(req, timeout=self.timeout)
+        return resp.snapshot_version
+
+    # -- engine seam -------------------------------------------------------
+
+    def schedule(self, problems: Sequence[BindingProblem]) -> list:
+        req = encode_problems(problems)
+        req.snapshot_version = self._version
+        try:
+            resp = self._score(req, timeout=self.timeout)
+        except grpc.RpcError as e:
+            if (
+                e.code() == grpc.StatusCode.FAILED_PRECONDITION
+                and self._cluster_source is not None
+            ):
+                # solver restarted or missed a sync: push state and retry once
+                self.sync_clusters(self._cluster_source())
+                req.snapshot_version = self._version
+                resp = self._score(req, timeout=self.timeout)
+            else:
+                raise
+        return [
+            RemoteScheduleResult(
+                key=m.key,
+                clusters={tc.name: tc.replicas for tc in m.clusters},
+                feasible=tuple(m.feasible),
+                affinity_name=m.affinity_name,
+                error=m.error,
+            )
+            for m in resp.results
+        ]
+
+    def close(self) -> None:
+        self._channel.close()

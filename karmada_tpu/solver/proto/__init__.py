@@ -1,0 +1,3 @@
+"""Generated protobuf messages for the solver sidecar wire contract."""
+
+from . import solver_pb2  # noqa: F401

@@ -1,0 +1,316 @@
+"""Solver sidecar: the scheduler's Score/Assign subtree as a gRPC service.
+
+Ref: SURVEY.md section 7 ("a gRPC sidecar wrapper (mirroring service.proto)
+for out-of-tree use per the north star") and the estimator transport
+pattern (estimator/grpc_transport.py; pkg/estimator/service/
+service.proto:26-29). The sidecar owns a TensorScheduler (and therefore the
+TPU and the device-resident fleet table); the control plane pushes cluster
+state through SyncClusters on cluster events and calls ScoreAndAssign with
+binding batches. Snapshot versions fence the two: scheduling against a
+version the solver doesn't hold fails FAILED_PRECONDITION and the caller
+re-syncs — placements are never computed against stale capacity.
+
+Placements travel as canonical JSON of the Placement CR, interned per
+request AND cached by-content server-side so the engine's id()-keyed
+compile caches (and the fleet table's slots) keep hitting across calls.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from concurrent import futures
+from typing import Optional, Sequence
+
+import grpc
+
+from ..api.cluster import (
+    AllocatableModeling,
+    Cluster,
+    ClusterSpec,
+    ClusterStatus,
+    ResourceModel,
+    ResourceModelRange,
+    ResourceSummary,
+    Taint,
+)
+from ..api.core import Condition, ObjectMeta
+from ..api.policy import Placement
+from ..scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+from ..utils.codec import from_jsonable, to_jsonable
+from .proto import solver_pb2 as pb
+
+SERVICE_NAME = "karmada_tpu.solver.Solver"
+
+
+# -- cluster state <-> wire -------------------------------------------------
+
+
+def cluster_to_state(cl: Cluster) -> pb.ClusterState:
+    msg = pb.ClusterState(
+        name=cl.name,
+        provider=cl.spec.provider,
+        region=cl.spec.region,
+        zone=cl.spec.zones[0] if cl.spec.zones else "",
+        api_enablements=list(cl.status.api_enablements),
+        complete_enablements=any(
+            c.type == "CompleteAPIEnablements" and c.status
+            for c in cl.status.conditions
+        ),
+    )
+    for k, v in cl.meta.labels.items():
+        msg.labels[k] = v
+    for t in cl.spec.taints:
+        msg.taints.add(key=t.key, value=t.value, effect=t.effect)
+    rs = cl.status.resource_summary
+    for k, v in rs.allocatable.items():
+        msg.allocatable[k] = int(v)
+    for k, v in rs.allocated.items():
+        msg.allocated[k] = int(v)
+    for k, v in rs.allocating.items():
+        msg.allocating[k] = int(v)
+    for rm in cl.spec.resource_models:
+        m = msg.resource_models.add(grade=rm.grade)
+        for r in rm.ranges:
+            m.ranges.add(name=r.name, min=int(r.min), max=int(r.max))
+    for am in rs.allocatable_modelings:
+        msg.allocatable_modelings.add(grade=am.grade, count=am.count)
+    return msg
+
+
+def state_to_cluster(msg: pb.ClusterState) -> Cluster:
+    conditions = [Condition(type="Ready", status=True)]
+    if msg.complete_enablements:
+        conditions.append(Condition(type="CompleteAPIEnablements", status=True))
+    return Cluster(
+        meta=ObjectMeta(name=msg.name, labels=dict(msg.labels)),
+        spec=ClusterSpec(
+            provider=msg.provider,
+            region=msg.region,
+            zones=[msg.zone] if msg.zone else [],
+            taints=[
+                Taint(key=t.key, value=t.value, effect=t.effect)
+                for t in msg.taints
+            ],
+            resource_models=[
+                ResourceModel(
+                    grade=m.grade,
+                    ranges=[
+                        ResourceModelRange(name=r.name, min=r.min, max=r.max)
+                        for r in m.ranges
+                    ],
+                )
+                for m in msg.resource_models
+            ],
+        ),
+        status=ClusterStatus(
+            api_enablements=list(msg.api_enablements),
+            conditions=conditions,
+            resource_summary=ResourceSummary(
+                allocatable=dict(msg.allocatable),
+                allocated=dict(msg.allocated),
+                allocating=dict(msg.allocating),
+                allocatable_modelings=[
+                    AllocatableModeling(grade=a.grade, count=a.count)
+                    for a in msg.allocatable_modelings
+                ],
+            ),
+        ),
+    )
+
+
+# -- problems/results <-> wire ----------------------------------------------
+
+
+def placement_json(pl: Optional[Placement]) -> str:
+    return (
+        json.dumps(to_jsonable(pl), sort_keys=True, separators=(",", ":"))
+        if pl is not None
+        else ""
+    )
+
+
+def encode_problems(problems: Sequence[BindingProblem]) -> pb.ScoreAndAssignRequest:
+    req = pb.ScoreAndAssignRequest()
+    interned: dict[int, int] = {}
+    json_slot: dict[str, int] = {}
+    for p in problems:
+        if p.placement is None:
+            idx = -1
+        else:
+            idx = interned.get(id(p.placement))
+            if idx is None:
+                js = placement_json(p.placement)
+                idx = json_slot.get(js)
+                if idx is None:
+                    idx = len(req.placement_jsons)
+                    req.placement_jsons.append(js)
+                    json_slot[js] = idx
+                interned[id(p.placement)] = idx
+        msg = req.problems.add(
+            key=p.key,
+            placement_idx=idx,
+            replicas=p.replicas,
+            gvk=p.gvk,
+            evict_clusters=list(p.evict_clusters),
+            fresh=p.fresh,
+        )
+        for k, v in p.requests.items():
+            msg.requests[k] = int(v)
+        for k, v in p.prev.items():
+            msg.prev[k] = int(v)
+    return req
+
+
+class SolverService:
+    """In-proc core of the sidecar: snapshot custody + engine dispatch."""
+
+    PLACEMENT_JSON_CACHE = 8192
+
+    def __init__(self, engine_factory=None):
+        self._engine: Optional[TensorScheduler] = None
+        self._version = 0
+        self._engine_factory = engine_factory or TensorScheduler
+        # canonical-JSON -> Placement object, LRU: stable identity across
+        # calls keeps the engine's id()-keyed compile caches warm
+        self._placements: OrderedDict[str, Placement] = OrderedDict()
+
+    @property
+    def snapshot_version(self) -> int:
+        return self._version
+
+    def sync_clusters(self, clusters: Sequence[Cluster], version: int) -> int:
+        snap = ClusterSnapshot(sorted(clusters, key=lambda c: c.name))
+        if self._engine is None or not self._engine.update_snapshot(snap):
+            self._engine = self._engine_factory(snap)
+        self._version = version
+        return self._version
+
+    def _placement(self, js: str) -> Placement:
+        pl = self._placements.get(js)
+        if pl is None:
+            pl = from_jsonable(Placement, json.loads(js))
+            self._placements[js] = pl
+            if len(self._placements) > self.PLACEMENT_JSON_CACHE:
+                self._placements.popitem(last=False)
+        else:
+            self._placements.move_to_end(js)
+        return pl
+
+    def score_and_assign(self, request: pb.ScoreAndAssignRequest) -> pb.ScoreAndAssignResponse:
+        if self._engine is None:
+            raise StaleSnapshotError("solver holds no cluster snapshot")
+        if request.snapshot_version != self._version:
+            raise StaleSnapshotError(
+                f"snapshot version mismatch: caller {request.snapshot_version} "
+                f"!= solver {self._version}"
+            )
+        placements = [self._placement(js) for js in request.placement_jsons]
+        problems = [
+            BindingProblem(
+                key=m.key,
+                placement=placements[m.placement_idx] if m.placement_idx >= 0 else None,
+                replicas=m.replicas,
+                requests=dict(m.requests),
+                gvk=m.gvk,
+                prev=dict(m.prev),
+                evict_clusters=tuple(m.evict_clusters),
+                fresh=m.fresh,
+            )
+            for m in request.problems
+        ]
+        results = self._engine.schedule(problems)
+        resp = pb.ScoreAndAssignResponse(snapshot_version=self._version)
+        for r in results:
+            msg = resp.results.add(
+                key=r.key, affinity_name=r.affinity_name, error=r.error
+            )
+            if r.success:
+                for name, n in sorted(r.clusters.items()):
+                    msg.clusters.add(name=name, replicas=n)
+                msg.feasible.extend(sorted(r.feasible))
+        return resp
+
+
+class StaleSnapshotError(Exception):
+    pass
+
+
+class SolverGrpcServer:
+    """Serves a SolverService over gRPC, optionally mTLS (same credential
+    contract as the estimator server, grpcconnection/config.go)."""
+
+    def __init__(
+        self,
+        service: SolverService,
+        address: str = "127.0.0.1:0",
+        *,
+        server_cert: Optional[bytes] = None,
+        server_key: Optional[bytes] = None,
+        client_ca: Optional[bytes] = None,
+        max_workers: int = 4,
+    ):
+        self._service = service
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.so_reuseport", 0),
+                     ("grpc.max_receive_message_length", 256 << 20),
+                     ("grpc.max_send_message_length", 256 << 20)],
+        )
+
+        def sync(request: pb.SyncClustersRequest, context):
+            version = self._service.sync_clusters(
+                [state_to_cluster(m) for m in request.clusters],
+                request.snapshot_version,
+            )
+            return pb.SyncClustersResponse(snapshot_version=version)
+
+        def score(request: pb.ScoreAndAssignRequest, context):
+            try:
+                return self._service.score_and_assign(request)
+            except StaleSnapshotError as e:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+
+        handlers = {
+            "SyncClusters": grpc.unary_unary_rpc_method_handler(
+                sync,
+                request_deserializer=pb.SyncClustersRequest.FromString,
+                response_serializer=pb.SyncClustersResponse.SerializeToString,
+            ),
+            "ScoreAndAssign": grpc.unary_unary_rpc_method_handler(
+                score,
+                request_deserializer=pb.ScoreAndAssignRequest.FromString,
+                response_serializer=pb.ScoreAndAssignResponse.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        )
+        if bool(server_cert) != bool(server_key) or (
+            client_ca and not (server_cert and server_key)
+        ):
+            raise ValueError(
+                "incomplete server TLS config: server_cert and server_key are "
+                "both required (and client_ca implies them)"
+            )
+        if server_cert and server_key:
+            creds = grpc.ssl_server_credentials(
+                [(server_key, server_cert)],
+                root_certificates=client_ca,
+                require_client_auth=client_ca is not None,
+            )
+            self.port = self._server.add_secure_port(address, creds)
+        else:
+            self.port = self._server.add_insecure_port(address)
+        if self.port == 0:
+            raise RuntimeError(f"solver gRPC server failed to bind {address}")
+
+    def start(self) -> int:
+        self._server.start()
+        return self.port
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._server.stop(grace)
+
+    def wait(self) -> None:
+        self._server.wait_for_termination()

@@ -1,0 +1,154 @@
+"""Solver sidecar: out-of-process Score/Assign over gRPC.
+
+- loopback: RemoteSolver against an in-thread SolverGrpcServer returns
+  placements identical to the in-proc engine;
+- version fencing: scheduling against a stale snapshot version re-syncs;
+- full propagation e2e with the solver in a REAL separate process
+  (python -m karmada_tpu.solver) — the control plane schedules everything
+  through the wire. Ref: pkg/estimator/service/service.proto:26-29 (the
+  contract shape), SURVEY.md section 7 (sidecar north star).
+"""
+
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from karmada_tpu.scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
+from karmada_tpu.solver import RemoteSolver, SolverGrpcServer, SolverService
+from karmada_tpu.utils.builders import (
+    duplicated_placement,
+    dynamic_weight_placement,
+    static_weight_placement,
+    synthetic_fleet,
+)
+from karmada_tpu.utils.quantity import parse_resource_list
+
+REQ = parse_resource_list({"cpu": "250m", "memory": "512Mi"})
+
+
+def _problems(clusters, n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    pls = [
+        dynamic_weight_placement(),
+        duplicated_placement(),
+        static_weight_placement({clusters[0].name: 2, clusters[1].name: 1}),
+    ]
+    return [
+        BindingProblem(
+            key=f"b{i}",
+            placement=pls[i % 3],
+            replicas=int(rng.integers(0, 20)),
+            requests=REQ,
+            gvk="apps/v1/Deployment",
+            prev={clusters[int(j)].name: int(rng.integers(1, 5))
+                  for j in rng.choice(len(clusters), 2, replace=False)},
+            fresh=bool(rng.random() < 0.2),
+        )
+        for i in range(n)
+    ]
+
+
+@pytest.fixture(scope="module")
+def loopback():
+    service = SolverService()
+    server = SolverGrpcServer(service, "127.0.0.1:0")
+    port = server.start()
+    client = RemoteSolver(f"127.0.0.1:{port}")
+    yield client, service
+    client.close()
+    server.stop()
+
+
+def test_loopback_matches_in_proc_engine(loopback):
+    client, _ = loopback
+    clusters = synthetic_fleet(12, seed=3)
+    problems = _problems(clusters)
+    client.sync_clusters(clusters)
+    remote = client.schedule(problems)
+    local = TensorScheduler(ClusterSnapshot(sorted(clusters, key=lambda c: c.name))).schedule(problems)
+    for r, l in zip(remote, local):
+        assert r.success == l.success and r.error == l.error, r.key
+        assert r.clusters == l.clusters, r.key
+        assert sorted(r.feasible) == sorted(l.feasible), r.key
+        assert r.affinity_name == l.affinity_name
+
+
+def test_stale_snapshot_resyncs(loopback):
+    client, service = loopback
+    clusters = synthetic_fleet(8, seed=4)
+    client.sync_clusters(clusters)
+    # simulate a solver restart losing the snapshot
+    service._engine = None
+    service._version = 0
+    client._cluster_source = lambda: clusters
+    results = client.schedule(_problems(clusters, n=5))
+    assert all(r.key.startswith("b") for r in results)
+    assert service.snapshot_version == client._version
+
+
+def test_propagation_e2e_with_out_of_process_solver():
+    """The full control plane drives scheduling through a solver running in
+    a separate OS process."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "karmada_tpu.solver", "--address", "127.0.0.1:0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"port (\d+)", line)
+        assert m, f"no port line from solver process: {line!r}"
+        port = int(m.group(1))
+
+        from karmada_tpu import cli
+        from karmada_tpu.api import (
+            PropagationPolicy,
+            PropagationSpec,
+            ResourceSelector,
+        )
+        from karmada_tpu.api.core import ObjectMeta
+        from karmada_tpu.controllers import execution_namespace
+        from karmada_tpu.utils.builders import new_deployment
+
+        solver = RemoteSolver(f"127.0.0.1:{port}")
+        cp = cli.cmd_init(solver=solver)
+        for i in range(1, 4):
+            cli.cmd_join(cp, f"member{i}")
+        cp.store.apply(
+            PropagationPolicy(
+                meta=ObjectMeta(name="web-policy", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(
+                            api_version="apps/v1", kind="Deployment", name="web"
+                        )
+                    ],
+                    placement=dynamic_weight_placement(),
+                ),
+            )
+        )
+        cp.store.apply(new_deployment("web", replicas=6))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        assert rb is not None and rb.spec.clusters
+        assert sum(tc.replicas for tc in rb.spec.clusters) == 6
+        # Works landed in execution namespaces via the remote placements
+        works = [
+            w
+            for w in cp.store.list("Work")
+            if w.meta.namespace.startswith("karmada-es-")
+        ]
+        assert works
+        solver.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
