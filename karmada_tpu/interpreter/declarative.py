@@ -44,7 +44,7 @@ DSL fields (all optional, per operation):
 
 from __future__ import annotations
 
-import copy
+from ..utils.clone import clone_json, clone_resource
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -104,6 +104,20 @@ class CustomizationRules:
     retain_paths: list[str] = field(default_factory=list)
     retain_status: bool = False
     dependencies: list[dict] = field(default_factory=list)
+    # --- expression tier (mirrors the reference CR's luaScript slots,
+    # config/v1alpha1 CustomizationTarget: replicaResource/replicaRevision/
+    # retention/statusAggregation/healthInterpretation/statusReflection/
+    # dependencyInterpretation). A script field, when set, OVERRIDES the
+    # path-DSL for that operation; syntax is the sandboxed expression
+    # language of interpreter/exprlang.py with the same entry-point names
+    # the reference's Lua VM dispatches to (lua.go:46-316).
+    replica_resource_script: str = ""  # GetReplicas(observedObj)
+    replica_revision_script: str = ""  # ReviseReplica(desiredObj, replica)
+    retention_script: str = ""  # Retain(desiredObj, observedObj)
+    status_aggregation_script: str = ""  # AggregateStatus(desiredObj, items)
+    health_script: str = ""  # InterpretHealth(observedObj)
+    status_reflection_script: str = ""  # ReflectStatus(observedObj)
+    dependency_script: str = ""  # GetDependencies(desiredObj)
 
 
 @dataclass
@@ -199,7 +213,7 @@ def _compile(rules: CustomizationRules) -> dict[str, Any]:
         if rules.replica_path:
 
             def revise_replica(obj: Resource, replicas: int):
-                out = copy.deepcopy(obj)
+                out = clone_resource(obj)
                 set_path(out.spec, rules.replica_path, replicas)
                 return out
 
@@ -234,7 +248,7 @@ def _compile(rules: CustomizationRules) -> dict[str, Any]:
     ):
 
         def aggregate_status(obj: Resource, items: list[AggregatedStatusItem]):
-            out = copy.deepcopy(obj)
+            out = clone_resource(obj)
             agg: dict[str, Any] = {}
             for fname, how in rules.status_aggregation.items():
                 values = [
@@ -274,13 +288,13 @@ def _compile(rules: CustomizationRules) -> dict[str, Any]:
     if rules.retain_paths or rules.retain_status:
 
         def retain(desired: Resource, observed: Resource):
-            out = copy.deepcopy(desired)
+            out = clone_resource(desired)
             for path in rules.retain_paths:
                 value = get_path(observed.spec, path)
                 if value is not None:
-                    set_path(out.spec, path, copy.deepcopy(value))
+                    set_path(out.spec, path, clone_json(value))
             if rules.retain_status and observed.status is not None:
-                out.status = copy.deepcopy(observed.status)
+                out.status = clone_json(observed.status)
             return out
 
         ops[RETAIN] = retain
@@ -346,7 +360,124 @@ def _compile(rules: CustomizationRules) -> dict[str, Any]:
             return deps
 
         ops[GET_DEPENDENCIES] = get_dependencies
+    _compile_scripts(rules, ops)
     return ops
+
+
+def _compile_scripts(rules: CustomizationRules, ops: dict[str, Any]) -> None:
+    """Overlay the expression-tier scripts (exprlang) onto the op map —
+    scripts override the path-DSL for their operation. Entry-point names
+    and call shapes mirror the reference Lua VM (luavm/lua.go:46-316)."""
+    from .exprlang import ExprVM
+    from .webhook import resource_from_dict, resource_to_dict
+
+    def vm_for(source: str) -> ExprVM:
+        return ExprVM(source)  # raises ScriptError on invalid scripts
+
+    if rules.replica_resource_script:
+        vm = vm_for(rules.replica_resource_script)
+
+        def get_replicas_script(obj: Resource, vm=vm):
+            out = vm.call("GetReplicas", resource_to_dict(obj))
+            if isinstance(out, tuple):
+                replicas, requires = (list(out) + [None])[:2]
+            else:
+                replicas, requires = out, None
+            reqs = None
+            if isinstance(requires, dict):
+                claim = requires.get("nodeClaim") or {}
+                from ..api.work import NodeClaim
+
+                reqs = ReplicaRequirements(
+                    resource_request=parse_resource_list(
+                        requires.get("resourceRequest") or {}
+                    ),
+                    node_claim=(
+                        NodeClaim(
+                            node_selector=claim.get("nodeSelector") or {},
+                            tolerations=claim.get("tolerations") or [],
+                            hard_node_affinity=claim.get("hardNodeAffinity"),
+                        )
+                        if claim
+                        else None
+                    ),
+                    namespace=str(requires.get("namespace") or obj.meta.namespace),
+                    priority_class_name=str(
+                        requires.get("priorityClassName") or ""
+                    ),
+                )
+            return int(replicas or 0), reqs
+
+        ops[GET_REPLICAS] = get_replicas_script
+    if rules.replica_revision_script:
+        vm = vm_for(rules.replica_revision_script)
+
+        def revise_replica_script(obj: Resource, replicas: int, vm=vm):
+            out = vm.call("ReviseReplica", resource_to_dict(obj), replicas)
+            return resource_from_dict(out)
+
+        ops[REVISE_REPLICA] = revise_replica_script
+    if rules.retention_script:
+        vm = vm_for(rules.retention_script)
+
+        def retain_script(desired: Resource, observed: Resource, vm=vm):
+            out = vm.call(
+                "Retain", resource_to_dict(desired), resource_to_dict(observed)
+            )
+            return resource_from_dict(out)
+
+        ops[RETAIN] = retain_script
+    if rules.status_aggregation_script:
+        vm = vm_for(rules.status_aggregation_script)
+
+        def aggregate_script(obj: Resource, items: list[AggregatedStatusItem], vm=vm):
+            wire_items = [
+                {
+                    "clusterName": it.cluster_name,
+                    "status": it.status,
+                    "applied": it.applied,
+                    "health": it.health,
+                }
+                for it in items
+            ]
+            out = vm.call(
+                "AggregateStatus", resource_to_dict(obj), wire_items
+            )
+            return resource_from_dict(out)
+
+        ops[AGGREGATE_STATUS] = aggregate_script
+    if rules.health_script:
+        vm = vm_for(rules.health_script)
+
+        def health_script(obj: Resource, vm=vm) -> bool:
+            return bool(vm.call("InterpretHealth", resource_to_dict(obj)))
+
+        ops[INTERPRET_HEALTH] = health_script
+    if rules.status_reflection_script:
+        vm = vm_for(rules.status_reflection_script)
+
+        def reflect_script(obj: Resource, vm=vm):
+            out = vm.call("ReflectStatus", resource_to_dict(obj))
+            return out if out else None
+
+        ops[REFLECT_STATUS] = reflect_script
+    if rules.dependency_script:
+        vm = vm_for(rules.dependency_script)
+
+        def dependencies_script(obj: Resource, vm=vm):
+            out = vm.call("GetDependencies", resource_to_dict(obj)) or []
+            return [
+                DependentObjectReference(
+                    api_version=str(d.get("apiVersion", "v1")),
+                    kind=str(d.get("kind", "")),
+                    namespace=str(d.get("namespace") or obj.meta.namespace),
+                    name=str(d.get("name", "")),
+                )
+                for d in out
+                if isinstance(d, dict)
+            ]
+
+        ops[GET_DEPENDENCIES] = dependencies_script
 
 
 class CustomizationConfigManager:
