@@ -142,6 +142,22 @@ class BindingController:
         self.interpreter = interpreter
         self.work_index = work_index or WorkIndex(store)
         self.overrides = OverrideManager(store)
+        # binding ref -> (global fingerprint, {cluster: replicas}) of the
+        # last ensureWork pass: an incremental storm (scale +1) changes one
+        # target's count, so only that Work is rebuilt instead of revising/
+        # overriding/cloning the template once per target per reconcile.
+        # Keyed on template (uid, generation) — the plane's spec-change
+        # discipline (the scheduler gate relies on generation the same way).
+        self._built: dict[str, tuple] = {}
+        # (template uid, replica-exclusion flag) -> ((generation,
+        # resource_version), content hash): a scale storm bumps every
+        # template's generation while changing only the replica fields the
+        # per-target revise overwrites anyway, so generation alone would
+        # void the build cache fleet-wide each wave
+        self._template_hashes: dict[tuple, tuple] = {}
+        # Works this controller deleted itself (orphan cleanup): their
+        # Deleted events must not void the freshly written cache entry
+        self._own_deletes: set[str] = set()
         self.worker = runtime.new_worker("binding", self._reconcile)
         for kind in BINDING_KINDS:
             store.watch(
@@ -149,20 +165,44 @@ class BindingController:
             )
         store.watch("OverridePolicy", self._requeue_all)
         store.watch("ClusterOverridePolicy", self._requeue_all)
+        # interpreter customizations change revise/retain semantics: the
+        # cached build fingerprints are meaningless across such a change
+        store.watch(
+            "ResourceInterpreterCustomization", self._requeue_all,
+            replay=False,
+        )
+        store.watch("Work", self._on_work_event, replay=False)
+
+    def _on_work_event(self, event) -> None:
+        # an externally deleted Work must be rebuilt even though the build
+        # cache says nothing changed
+        if event.type != "Deleted":
+            return
+        if event.key in self._own_deletes:
+            self._own_deletes.discard(event.key)
+            return
+        ref = event.obj.meta.labels.get(WORK_BINDING_LABEL)
+        if ref and self._built.pop(ref, None) is not None:
+            kind, _, key = ref.partition(":")
+            self.worker.enqueue((kind, key))
 
     def _requeue_all(self, _event) -> None:
+        self._built.clear()  # override policies changed: full rebuild
         for kind in BINDING_KINDS:
             for rb in self.store.list(kind):
                 self.worker.enqueue((kind, rb.meta.namespaced_name))
 
     def _reconcile(self, kind_key) -> Optional[str]:
         kind, key = kind_key
+        ref = binding_ref(kind, key)
         rb = self.store.get(kind, key)
         if rb is None:
-            self._cleanup_works(binding_ref(kind, key), keep_clusters=set())
+            self._built.pop(ref, None)
+            self._cleanup_works(ref, keep_clusters=set())
             return DONE
         template = self.store.get("Resource", rb.spec.resource.namespaced_key)
         if template is None:
+            self._built.pop(ref, None)
             return DONE
         # target set: scheduled clusters + clusters still draining eviction
         # tasks (their Works must survive until eviction completes,
@@ -178,7 +218,27 @@ class BindingController:
             rb.spec.placement is not None
             and rb.spec.placement.replica_scheduling_type() == DIVIDED
         )
+        fp_global = (
+            template.meta.uid,
+            self._template_token(template, divided),
+            divided,
+            # the binding's TOTAL replicas only shape a target's manifest
+            # through the Job completions split; for every other kind the
+            # manifest depends on the per-target count alone, and a scale
+            # storm must not void every target's cache entry
+            rb.spec.replicas
+            if (template.kind == "Job" and "completions" in template.spec)
+            else 0,
+            rb.spec.suspend_dispatching,
+            tuple(sorted(rb.spec.suspend_dispatching_on_clusters or ())),
+            rb.spec.preserve_resources_on_deletion,
+            rb.spec.conflict_resolution,
+        )
+        prev_global, prev_targets = self._built.get(ref, (None, None))
+        unchanged = prev_global == fp_global and prev_targets is not None
         for cluster_name, replicas in targets.items():
+            if unchanged and prev_targets.get(cluster_name, -1) == replicas:
+                continue  # this target's Work is already up to date
             # every transform below (revise_replica, apply_overrides)
             # returns a fresh object, so the template is cloned lazily:
             # exactly ONE copy per Work, never three (the redundant
@@ -200,10 +260,50 @@ class BindingController:
             if workload is template:
                 workload = clone_resource(template)
             self._create_or_update_work(rb, kind, cluster_name, workload)
-        self._cleanup_works(
-            binding_ref(kind, key), keep_clusters=set(targets) | evicting
-        )
+        self._cleanup_works(ref, keep_clusters=set(targets) | evicting)
+        self._built[ref] = (fp_global, dict(targets))
         return DONE
+
+    # replica fields the per-target ReviseReplica pass overwrites; a
+    # template change confined to them cannot alter an unchanged target's
+    # manifest (its value is re-derived from the binding's division)
+    _REPLICA_FIELDS = ("replicas", "parallelism", "completions")
+
+    def _template_token(self, template: Resource, divided: bool) -> int:
+        """Build-cache content token for the template. A hash over the
+        manifest-shaping fields (spec + labels + annotations) rather than
+        the generation: metadata-only edits don't bump generation, and
+        resource_version bumps on status-only writes — neither is a valid
+        cache key alone. For divided bindings whose kind has no custom
+        ReviseReplica hook the top-level replica fields are excluded, so a
+        fleet-wide scale storm (only replica counts change) keeps unchanged
+        targets cached; custom-revise kinds hash the full spec (their hooks
+        may derive arbitrary fields from the template's replica count)."""
+        gvk = f"{template.api_version}/{template.kind}"
+        exclude = divided and not self.interpreter.has_custom_revise(gvk)
+        key = (template.meta.uid, exclude)
+        ver = (template.meta.generation, template.meta.resource_version)
+        cached = self._template_hashes.get(key)
+        if cached is not None and cached[0] == ver:
+            return cached[1]
+        spec_view = (
+            {
+                k: v
+                for k, v in template.spec.items()
+                if k not in self._REPLICA_FIELDS
+            }
+            if exclude
+            else template.spec
+        )
+        token = hash(
+            (
+                repr(spec_view),
+                repr(sorted(template.meta.labels.items())),
+                repr(sorted(template.meta.annotations.items())),
+            )
+        )
+        self._template_hashes[key] = (ver, token)
+        return token
 
     def _create_or_update_work(
         self, rb: ResourceBinding, kind: str, cluster: str, workload: Resource
@@ -240,6 +340,7 @@ class BindingController:
         for work in self.work_index.works_for(binding_key):
             cluster = cluster_of_execution_namespace(work.meta.namespace)
             if cluster not in keep_clusters:
+                self._own_deletes.add(work.meta.namespaced_name)
                 self.store.delete("Work", work.meta.namespaced_name)
 
 

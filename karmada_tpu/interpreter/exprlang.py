@@ -167,7 +167,9 @@ _SAFE_BUILTINS: dict[str, Any] = {
     "str": lambda x="": "" if _is_nil(x) else str(x),
     "bool": lambda x=False: bool(x),
     "sorted": sorted,
-    "range": range,
+    # the fuel counter meters AST steps; an unbounded range handed to
+    # sum/list/sorted would run at C speed outside it
+    "range": lambda *a: _bounded_range(*a),
     "enumerate": enumerate,
     "any": any,
     "all": all,
@@ -258,7 +260,7 @@ class ExprVM:
         except SyntaxError as e:
             raise ScriptError(f"script syntax error: {e}") from e
         self._validate(tree)
-        self.fuel = 0
+        self.fuel = MAX_FUEL  # top-level statements run at registration
         self.globals = _Env()
         self.globals.vars["kube"] = _KubeNamespace()
         if extra_globals:
@@ -412,12 +414,28 @@ class ExprVM:
     def _eval_target(self, target: ast.expr, env: _Env) -> Any:
         return self._eval(target, env)
 
+    @staticmethod
+    def _size_guard(left: Any, right: Any) -> None:
+        """C-speed blowup guard: fuel meters AST steps, not the cost of one
+        step, so big-int growth and sequence repetition must be bounded
+        explicitly (x = x * x doubles digit count per fuel unit)."""
+        if isinstance(left, int) and isinstance(right, int):
+            if left.bit_length() + right.bit_length() > 1 << 16:
+                raise ScriptError("integer operands too large")
+        elif isinstance(left, (str, list, tuple)) and isinstance(right, int):
+            if len(left) * max(right, 1) > 10**7:
+                raise ScriptError("sequence repetition too large")
+        elif isinstance(right, (str, list, tuple)) and isinstance(left, int):
+            if len(right) * max(left, 1) > 10**7:
+                raise ScriptError("sequence repetition too large")
+
     def _apply_binop(self, op: ast.operator, left: Any, right: Any) -> Any:
         if isinstance(op, ast.Add):
             return left + right
         if isinstance(op, ast.Sub):
             return left - right
         if isinstance(op, ast.Mult):
+            self._size_guard(left, right)
             return left * right
         if isinstance(op, ast.Div):
             return left / right
@@ -428,6 +446,7 @@ class ExprVM:
         if isinstance(op, ast.Pow):
             if abs(_num(right)) > 64:
                 raise ScriptError("exponent too large")
+            self._size_guard(left, left)
             return left ** right
         raise ScriptError(f"unsupported operator {type(op).__name__}")
 
@@ -525,7 +544,9 @@ class ExprVM:
             parts = []
             for value in node.values:
                 if isinstance(value, ast.FormattedValue):
-                    parts.append(str(_de_nil(self._eval(value.value, env)) or ""))
+                    v = _de_nil(self._eval(value.value, env))
+                    # only nil renders empty (Lua semantics); 0/False print
+                    parts.append("" if v is None else str(v))
                 else:
                     parts.append(str(self._eval(value, env)))
             return "".join(parts)
@@ -638,3 +659,10 @@ def _num(v: Any) -> float:
         return float(v)
     except (TypeError, ValueError):
         return 0.0
+
+
+def _bounded_range(*args) -> range:
+    r = range(*args)
+    if len(r) > MAX_ITERATIONS:
+        raise ScriptError("range too large")
+    return r

@@ -95,6 +95,15 @@ class ResourceInterpreter:
     def hook_enabled(self, gvk: str, operation: str) -> bool:
         return self._resolve(gvk, operation) is not None
 
+    def has_custom_revise(self, gvk: str) -> bool:
+        """True when a non-native tier owns ReviseReplica for this kind —
+        such hooks may derive arbitrary manifest fields from the replica
+        count, so callers must not assume the native replicas-only write."""
+        for table in (self._customized, self._webhook, self._thirdparty):
+            if (gvk, REVISE_REPLICA) in table or ("*", REVISE_REPLICA) in table:
+                return True
+        return False
+
     # -- typed operation wrappers -----------------------------------------
 
     def get_replicas(self, obj: Resource) -> tuple[int, Optional[ReplicaRequirements]]:

@@ -63,6 +63,10 @@ def kernel_variant(
         div_f32 = max_w * max(max_n, 1) < 2**24 and max_n < 2**22
         if k_top <= 1024:
             if w_bits + l_bits + i_bits <= 31:
+                # every tier is one bounded, persistently-cached trace; a
+                # floor above 4 would push tight-budget fleets (large
+                # i_bits + moderate w_bits) off the snap entirely and churn
+                # traces with every data-maxima drift
                 for l_tier in (4, 8, 12, 16):
                     if l_bits <= l_tier and w_bits <= 31 - i_bits - l_tier:
                         l_bits = l_tier
@@ -162,8 +166,10 @@ class TensorScheduler:
 
     PLACEMENT_CACHE_CAP = 8192
     #: minimum eligible-batch size before the device-resident path engages
-    #: (below it, per-pass dispatch overhead beats the host packing cost)
-    fleet_threshold = 1024
+    #: (below it, per-pass dispatch overhead beats the host packing cost).
+    #: Kept low enough that a storm's straggler batches ride the same
+    #: already-compiled fleet trace instead of fresh host-path chunk shapes
+    fleet_threshold = 256
 
     # -- compilation -------------------------------------------------------
 

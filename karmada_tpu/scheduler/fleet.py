@@ -50,7 +50,9 @@ from jax import lax
 from ..ops.divide import AGGREGATED, DUPLICATED as S_DUPLICATED, _divide_batch
 from ..ops.estimate import MAX_INT32, gather_profile_rows, merge_estimates
 
-K_PREV = 16  # max previous-assignment sites on the fast path
+K_PREV = 32  # max previous-assignment sites on the fast path (small fleets
+# legitimately spread one binding over dozens of clusters; rows beyond this
+# take the general host path)
 MAX_REPLICAS_FAST = 128  # divided-strategy replica cap (bounds top_k)
 MAX_SLOTS = 4096  # unique placements/gvks/profiles before table rebuild
 E_ROUND = 1 << 18  # entry-buffer quantum (bounds trace churn)
@@ -189,6 +191,7 @@ def _fleet_solve(
     packed = (sites.reshape(-1) << 8) | counts.reshape(-1)
     write = jnp.where(valid_e & (offs < e_cap), offs, e_cap)
     buf = jnp.zeros((e_cap + 1,), jnp.int32).at[write].set(packed)
+    entries = buf[:e_cap]
 
     # one metadata word per row: n_placed | unsched<<8 | has_cand<<9
     meta = (
@@ -196,7 +199,26 @@ def _fleet_solve(
         | (unsched.astype(jnp.int32) << 8)
         | (has_cand.astype(jnp.int32) << 9)
     )
-    flat = jnp.concatenate([total[None], meta, buf[:e_cap]])
+    c_total = gvk_table.shape[1]
+    if c_total <= 0xFFFF:
+        # byte wire: transfer bytes are the pass's budget, and a packed
+        # entry fits 3 bytes when the site index fits 16 bits (counts are
+        # <= MAX_REPLICAS_FAST < 256, meta words < 2^10). Bytes are
+        # decomposed with shifts, not bitcasts, so the layout is
+        # endianness-independent.
+        total_u8 = jnp.stack(
+            [(total >> s) & 0xFF for s in (0, 8, 16, 24)]
+        ).astype(jnp.uint8)
+        meta_u8 = jnp.stack(
+            [meta & 0xFF, (meta >> 8) & 0xFF], axis=-1
+        ).astype(jnp.uint8).reshape(-1)
+        e_u8 = jnp.stack(
+            [entries & 0xFF, (entries >> 8) & 0xFF, (entries >> 16) & 0xFF],
+            axis=-1,
+        ).astype(jnp.uint8).reshape(-1)
+        flat = jnp.concatenate([total_u8, meta_u8, e_u8])
+    else:
+        flat = jnp.concatenate([total[None], meta, entries])
     if need_bits:
         return flat, outs[5].reshape(-1, outs[5].shape[-1])
     return flat, None
@@ -343,6 +365,8 @@ class FleetTable:
         # worst-case sum(replicas) bound (mean placed clusters per binding is
         # far under max replicas); overflow falls back to the safe bound
         self._last_total = 0
+        self._e_cap_cur: Optional[int] = None
+        self._shrink_votes = 0
 
     # -- rows --------------------------------------------------------------
 
@@ -596,8 +620,18 @@ class FleetTable:
         )
         self._sync_device()
         n = len(rows_np)
-        n_pad = max(self.chunk, -(-n // self.chunk) * self.chunk)
-        n_chunks = n_pad // self.chunk
+        # adaptive chunk: a straggler batch of a few hundred rows should
+        # not execute a full 4096-row chunk (pow2 snapping keeps the trace
+        # count logarithmic)
+        eff_chunk = min(self.chunk, _pow2(max(n, 256)))
+        n_pad = max(eff_chunk, -(-n // eff_chunk) * eff_chunk)
+        n_chunks = n_pad // eff_chunk
+        # pipeline: large passes run as two equal slices — the host fetches
+        # slice 0's buffer over the tunnel while the device executes slice 1
+        # (transfer and compute are the two halves of the pass wall time)
+        n_slices = 2 if n_chunks % 2 == 0 and n >= 4 * eff_chunk else 1
+        if n_slices == 2:
+            n_chunks //= 2
         st = self._st
         # all-rows storm mode: the row-index upload is cached on device
         is_all = n == self.n_rows and np.array_equal(
@@ -643,43 +677,89 @@ class FleetTable:
 
         # fetched bytes scale with e_cap, so tune it to ~1.25x the last
         # observed total; the safe bound can never overflow and is the
-        # first-pass / fallback trace
-        e_cap = cap_round(safe)
+        # first-pass / fallback trace. Hysteresis: grow immediately, shrink
+        # only after two consecutive lower demands — every distinct e_cap is
+        # a fresh XLA trace, and a demand oscillating across a quantum
+        # boundary was recompiling the solve once per storm wave
+        # _last_total tracks the max per-slice entry total
+        needed = cap_round(safe)
         if 0 < self._last_total and self._last_total * 5 // 4 < safe:
-            e_cap = min(e_cap, cap_round(self._last_total * 5 // 4))
+            needed = min(needed, cap_round(self._last_total * 5 // 4))
+        prev_cap = self._e_cap_cur
+        if prev_cap is None or needed >= prev_cap:
+            e_cap = needed
+            self._shrink_votes = 0
+        else:
+            self._shrink_votes += 1
+            e_cap = needed if self._shrink_votes >= 2 else prev_cap
+            if e_cap == needed:
+                self._shrink_votes = 0
+        self._e_cap_cur = e_cap
 
-        for attempt in range(2):
-            flat, bits = _fleet_solve(
+        def solve(rows_slice, cap):
+            return _fleet_solve(
                 *self._dev_tables,
-                rows_dev,
+                rows_slice,
                 *self._dev_state,
-                chunk=self.chunk,
+                chunk=eff_chunk,
                 n_chunks=n_chunks,
                 k_out=k_out,
-                e_cap=e_cap,
+                e_cap=cap,
                 wide=wide,
                 fast=fast,
                 has_aggregated=has_agg,
                 need_bits=need_bits,
             )
-            arr = np.asarray(flat)  # the ONE device->host fetch
-            total = int(arr[0])
-            if total <= e_cap:
-                break
-            e_cap = cap_round(safe)  # overflow: rerun with the safe bound
-        assert total <= e_cap, (total, e_cap)  # safe bound guarantees this
-        self._last_total = total
-        meta = arr[1 : 1 + n_pad]
-        entries = arr[1 + n_pad :]
+
+        slice_rows = n_pad // n_slices
+        slices = [
+            rows_dev[s * slice_rows : (s + 1) * slice_rows]
+            for s in range(n_slices)
+        ]
+        # dispatch every slice before fetching any: the device computes
+        # slice s+1 while the host drains slice s's buffer
+        byte_wire = c <= 0xFFFF
+
+        def decode(arr):
+            """(total, meta int32[slice_rows], entries int32[*])"""
+            if byte_wire:
+                a = arr.astype(np.int32)
+                total = int(a[0] | (a[1] << 8) | (a[2] << 16) | (a[3] << 24))
+                m = a[4 : 4 + 2 * slice_rows]
+                meta = m[0::2] | (m[1::2] << 8)
+                e = a[4 + 2 * slice_rows :]
+                entries = e[0::3] | (e[1::3] << 8) | (e[2::3] << 16)
+                return total, meta, entries
+            return int(arr[0]), arr[1 : 1 + slice_rows], arr[1 + slice_rows :]
+
+        pending = [solve(rs, e_cap) for rs in slices]
+        metas, entry_bufs, bit_bufs, totals = [], [], [], []
+        for s, (flat, bits) in enumerate(pending):
+            total, m, e = decode(np.asarray(flat))
+            if total > e_cap:  # overflow: rerun this slice at the safe bound
+                flat, bits = solve(slices[s], cap_round(safe))
+                total, m, e = decode(np.asarray(flat))
+            assert total <= len(e), (total, e_cap)
+            totals.append(total)
+            metas.append(m)
+            entry_bufs.append(e)
+            bit_bufs.append(bits)
+        self._last_total = max(totals)
+        meta = np.concatenate(metas) if n_slices > 1 else metas[0]
         n_placed = (meta & 0xFF).astype(np.int64)
-        starts = np.zeros(n_pad, np.int64)
-        np.cumsum(n_placed[:-1], out=starts[1:])
         unsched = (meta >> 8) & 1
         has_cand = (meta >> 9) & 1
+        # per-slice entry offsets (each slice's stream starts at 0)
+        starts = np.zeros(n_pad, np.int64)
+        for s in range(n_slices):
+            seg = n_placed[s * slice_rows : (s + 1) * slice_rows]
+            np.cumsum(seg[:-1], out=starts[s * slice_rows + 1 : (s + 1) * slice_rows])
 
-        batch = _FleetBatch(
-            self.engine.snapshot.names, entries, starts, bits
-        )
+        names = self.engine.snapshot.names
+        batches = [
+            _FleetBatch(names, entry_bufs[s], starts[s * slice_rows :], bit_bufs[s])
+            for s in range(n_slices)
+        ]
         out = []
         for i, p in enumerate(problems):
             term = self._terms[rows_np[i]]
@@ -696,7 +776,8 @@ class FleetTable:
             )
             out.append(
                 FleetResult(
-                    p.key, term, err, batch, i, int(n_placed[i]), dup,
+                    p.key, term, err, batches[i // slice_rows],
+                    i % slice_rows, int(n_placed[i]), dup,
                     p.replicas == 0,
                 )
             )

@@ -119,6 +119,28 @@ class TestDependenciesDistributor:
         assert cp.store.get("ResourceBinding", "default/c1-configmap") is not None
 
 
+class TestWorkBuildCache:
+    def test_template_label_only_edit_rebuilds_works(self):
+        """Metadata-only template edits bump neither generation nor any
+        binding field; the Work build cache must still rebuild (its token
+        hashes labels/annotations, not the generation)."""
+        cp = make_plane(2)
+        cp.store.apply(new_deployment("app", replicas=4))
+        cp.store.apply(nginx_policy(dynamic_weight_placement()))
+        cp.settle()
+        template = cp.store.get("Resource", "default/app")
+        template.meta.labels["team"] = "payments"  # no generation bump
+        cp.store.apply(template)
+        cp.settle()
+        works = [
+            w for w in cp.store.list("Work")
+            if w.meta.name.endswith("app-deployment")
+        ]
+        assert works
+        for w in works:
+            assert w.spec.workload[0].meta.labels.get("team") == "payments"
+
+
 class TestNamespaceSync:
     def test_namespace_propagates_to_all_members(self):
         cp = make_plane(2)

@@ -99,6 +99,33 @@ class TestBenchShardedStorm:
         result = json.loads(proc.stdout.strip().splitlines()[-1])
         assert result["unit"] == "s" and result["value"] > 0
 
+    def test_2d_sharding_placement_identity_at_10k_clusters(self):
+        """Placement identity under binding x cluster (2D) sharding at 10k
+        clusters (VERDICT r1 #6): the c-axis sort collectives must not
+        change a single placement."""
+        import os
+        import json
+        import subprocess
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        proc = subprocess.run(
+            [
+                sys.executable, "/root/repo/bench.py", "--cpu",
+                "--shard", "4x2",
+                "--bindings", "256", "--clusters", "10000", "--repeats", "1",
+            ],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd="/root/repo",
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert result["identical"] is True
+        assert "# identity under 4x2 sharding: True" in proc.stderr
+
     def test_engine_bench_verifies_on_cpu(self):
         """bench.py config 5 engine path at toy scale: every verification
         tier (numpy full-set, oracle sample, mixed strategies) must be
