@@ -22,13 +22,25 @@ class ScaleTargetRef:
 
 @dataclass
 class MetricSpec:
-    """Resource-utilization metric (the dominant HPA flavor).
-    type Resource with either target_average_utilization (percent of request)
-    or target_average_value (canonical units per pod)."""
+    """HPA metric source (autoscaling/v2 MetricSpec).
 
+    - type "Resource": resource utilization vs request, merged by the
+      metrics adapter's resource flavor (target_average_utilization in
+      percent, or target_average_value in canonical units per pod);
+    - type "Pods": a custom per-pod metric (custom.metrics.k8s.io) named by
+      metric_name, optionally filtered by metric_selector, compared against
+      target_average_value per pod;
+    - type "External": an external series (external.metrics.k8s.io) named
+      by metric_name + metric_selector, compared against target_value
+      (total) or target_average_value (per pod)."""
+
+    type: str = "Resource"  # Resource | Pods | External
     resource_name: str = "cpu"
     target_average_utilization: Optional[int] = None
-    target_average_value: Optional[int] = None
+    target_average_value: Optional[float] = None
+    metric_name: str = ""
+    metric_selector: Optional[dict] = None  # label selector (match_labels)
+    target_value: Optional[float] = None
 
 
 @dataclass

@@ -43,6 +43,15 @@ class FederatedHPAController:
         self.worker = runtime.new_worker("federated-hpa", self._reconcile)
         store.watch("FederatedHPA", lambda e: self.worker.enqueue(e.key))
         runtime.add_ticker(self._sweep)
+        self._metrics_adapter = None
+
+    def _adapter(self):
+        """Lazy metrics-adapter facade (custom/external metric flavors)."""
+        if self._metrics_adapter is None:
+            from ..metricsadapter import MetricsAdapter
+
+            self._metrics_adapter = MetricsAdapter(self.members)
+        return self._metrics_adapter
 
     def _sweep(self) -> None:
         for hpa in self.store.list("FederatedHPA"):
@@ -104,21 +113,63 @@ class FederatedHPAController:
         if last is not None and now - last < self.sync_period_seconds:
             return DONE
         metrics = self._collect(hpa, clusters)
-        if metrics is None or current == 0:
+        if current == 0:
             self._update_status(hpa, current, current)
             return DONE
-        self._last_eval[key] = now
-        avg_util, ready, total = metrics
 
         # desired = max over metrics of ceil(current * currentMetric /
         # targetMetric), calibrated by ready ratio (replica_calculator.go);
         # no computable metric keeps the current size
         proposals = []
         for metric in hpa.spec.metrics or []:
-            if metric.target_average_utilization:
+            mtype = getattr(metric, "type", "Resource") or "Resource"
+            if mtype == "Resource" and metric.target_average_utilization:
+                if metrics is None:
+                    continue
+                avg_util, ready, total = metrics
                 calibration = ready / total if total else 1.0
                 raw = current * (avg_util / metric.target_average_utilization)
                 proposals.append(math.ceil(raw * calibration))
+            elif mtype == "Pods" and metric.target_average_value:
+                # custom per-pod metric (custom.metrics.k8s.io): usage
+                # ratio = sum(values) / (target * currentReplicas)
+                # (replica_calculator.go GetMetricReplicas semantics)
+                samples = [
+                    s
+                    for s in self._adapter().custom.get_metric_by_selector(
+                        "pods",
+                        hpa.meta.namespace,
+                        metric.metric_name,
+                        metric_selector=metric.metric_selector,
+                    )
+                    if s.cluster in clusters
+                ]
+                if not samples:
+                    continue
+                usage = sum(s.value for s in samples)
+                proposals.append(
+                    math.ceil(usage / metric.target_average_value)
+                )
+            elif mtype == "External":
+                samples = self._adapter().external.get_external_metric(
+                    hpa.meta.namespace,
+                    metric.metric_name,
+                    selector=metric.metric_selector,
+                )
+                if not samples:
+                    continue
+                usage = sum(s.value for s in samples)
+                if metric.target_value:
+                    proposals.append(math.ceil(usage / metric.target_value))
+                elif metric.target_average_value:
+                    # GetExternalPerPodMetricReplicas: per-pod average
+                    proposals.append(
+                        math.ceil(usage / metric.target_average_value)
+                    )
+        if not proposals and metrics is None:
+            self._update_status(hpa, current, current)
+            return DONE
+        self._last_eval[key] = now
         desired = max(proposals) if proposals else current
         desired = min(max(desired, hpa.spec.min_replicas), hpa.spec.max_replicas)
 

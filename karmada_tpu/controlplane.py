@@ -173,6 +173,9 @@ class ControlPlane:
         self.search = SearchController(self.store, self.runtime, self.members)
         self.proxy = Proxy(self.store, self.members, self.search.cache)
         self.metrics_adapter = MetricsAdapter(self.members)
+        # the HPA controller consumes the SAME adapter facade (one cache/
+        # state surface), not a private duplicate over the registry
+        self.federated_hpa._metrics_adapter = self.metrics_adapter
         from .controllers.hpa_sync import (
             DeploymentReplicasSyncer,
             HpaScaleTargetMarker,

@@ -1,10 +1,27 @@
-"""Metrics providers fanning out to member clusters.
+"""Metrics providers fanning out to member clusters — the three metrics API
+flavors of the reference adapter.
+
+Ref: pkg/metricsadapter/provider/
+- resourcemetrics.go (metrics.k8s.io): PodMetrics/NodeMetrics queried by
+  name or by label selector from every member in parallel, returned as one
+  combined list with the owning cluster attached
+  (queryPodMetricsByName:167, queryPodMetricsBySelector:205,
+  queryNodeMetricsByName:260, queryNodeMetricsBySelector:297).
+- custommetrics.go (custom.metrics.k8s.io): GetMetricByName:64 /
+  GetMetricBySelector:113 fan out per cluster with BOTH an object label
+  selector and a metric label selector, uniting the per-cluster
+  MetricValueLists; ListAllMetrics:280 unions each member's discovered
+  (group-resource, metric, namespaced) infos.
+- externalmetrics.go: the reference STUBS this flavor ("karmada-
+  metrics-adapter still not implement it", externalmetrics.go:38); this
+  build implements it — namespaced external series filtered by a label
+  selector, summed per the external-metrics contract.
 
 The member-side sources are the MemberCluster metric surfaces
-(pod_metrics for resource metrics, custom_metrics for custom/external);
-a real deployment swaps those for metrics.k8s.io clients — the merge
-semantics here mirror provider/resourcemetrics.go (sum/weighted-average
-across clusters) and provider/custommetrics.go (per-cluster series united).
+(pod_metrics_detail / node_metrics / custom_metric_series /
+external_metric_series — the stand-ins for the per-cluster metrics API
+servers); a real deployment swaps those for API clients, the merge
+semantics are here.
 """
 
 from __future__ import annotations
@@ -12,21 +29,285 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..api.policy import LabelSelector
 from ..utils.member import MemberClientRegistry
 
 
 @dataclass
 class MetricValue:
+    """One sample, cluster-attributed (the reference annotates the owning
+    cluster onto each returned item)."""
+
     cluster: str
     value: float
     labels: dict[str, str] = field(default_factory=dict)
+    object_name: str = ""
+    namespace: str = ""
+    metric: str = ""
 
 
-class MetricsAdapter:
+@dataclass
+class CustomMetricInfo:
+    group_resource: str
+    metric: str
+    namespaced: bool = True
+
+    def __hash__(self):
+        return hash((self.group_resource, self.metric, self.namespaced))
+
+
+def _selector_matches(selector, labels: dict) -> bool:
+    if selector is None:
+        return True
+    if isinstance(selector, dict):
+        selector = LabelSelector(match_labels=selector)
+    return selector.matches(labels or {})
+
+
+class ResourceMetricsProvider:
+    """metrics.k8s.io flavor: pods/nodes by name or selector, all members."""
+
     def __init__(self, members: MemberClientRegistry) -> None:
         self.members = members
 
-    # -- resource metrics (metrics.k8s.io flavor) --------------------------
+    def _fan_out(self):
+        for name in self.members.names():
+            member = self.members.get(name)
+            if member is not None and member.reachable:
+                yield name, member
+
+    def pod_metrics_by_name(self, namespace: str, name: str) -> list[MetricValue]:
+        key = f"{namespace}/{name}" if namespace else name
+        out = []
+        for cluster, member in self._fan_out():
+            sample = member.pod_metrics_detail.get(key)
+            if sample:
+                out.append(
+                    MetricValue(
+                        cluster=cluster,
+                        value=float(sample.get("cpu", 0.0)),
+                        labels=dict(sample.get("labels") or {}),
+                        object_name=name,
+                        namespace=namespace,
+                        metric="cpu",
+                    )
+                )
+        return out
+
+    def pod_metrics_by_selector(
+        self, namespace: str, selector=None
+    ) -> list[MetricValue]:
+        out = []
+        prefix = f"{namespace}/" if namespace else ""
+        for cluster, member in self._fan_out():
+            for key, sample in member.pod_metrics_detail.items():
+                if namespace and not key.startswith(prefix):
+                    continue
+                if not _selector_matches(selector, sample.get("labels")):
+                    continue
+                out.append(
+                    MetricValue(
+                        cluster=cluster,
+                        value=float(sample.get("cpu", 0.0)),
+                        labels=dict(sample.get("labels") or {}),
+                        object_name=key.rpartition("/")[2],
+                        namespace=namespace,
+                        metric="cpu",
+                    )
+                )
+        return out
+
+    def node_metrics_by_name(self, name: str) -> list[MetricValue]:
+        out = []
+        for cluster, member in self._fan_out():
+            sample = member.node_metrics.get(name)
+            if sample:
+                out.append(
+                    MetricValue(
+                        cluster=cluster,
+                        value=float(sample.get("cpu", 0.0)),
+                        labels=dict(sample.get("labels") or {}),
+                        object_name=name,
+                        metric="cpu",
+                    )
+                )
+        return out
+
+    def node_metrics_by_selector(self, selector=None) -> list[MetricValue]:
+        out = []
+        for cluster, member in self._fan_out():
+            for name, sample in member.node_metrics.items():
+                if not _selector_matches(selector, sample.get("labels")):
+                    continue
+                out.append(
+                    MetricValue(
+                        cluster=cluster,
+                        value=float(sample.get("cpu", 0.0)),
+                        labels=dict(sample.get("labels") or {}),
+                        object_name=name,
+                        metric="cpu",
+                    )
+                )
+        return out
+
+
+class CustomMetricsProvider:
+    """custom.metrics.k8s.io flavor: object + metric label selectors,
+    namespaced and root-scoped, per-cluster lists united."""
+
+    def __init__(self, members: MemberClientRegistry) -> None:
+        self.members = members
+
+    def _series(self):
+        for name in self.members.names():
+            member = self.members.get(name)
+            if member is None or not member.reachable:
+                continue
+            for s in member.custom_metric_series:
+                yield name, s
+
+    @staticmethod
+    def _ns_match(s: dict, namespace: str) -> bool:
+        if not namespace:
+            return not s.get("namespaced", True)
+        return s.get("namespaced", True) and s.get("namespace", "") == namespace
+
+    def get_metric_by_name(
+        self,
+        resource: str,
+        namespace: str,
+        name: str,
+        metric: str,
+        metric_selector=None,
+    ) -> list[MetricValue]:
+        out = []
+        for cluster, s in self._series():
+            if (
+                s.get("resource") != resource
+                or s.get("metric") != metric
+                or s.get("object") != name
+                or not self._ns_match(s, namespace)
+                or not _selector_matches(metric_selector, s.get("labels"))
+            ):
+                continue
+            out.append(
+                MetricValue(
+                    cluster=cluster,
+                    value=float(s.get("value", 0.0)),
+                    labels=dict(s.get("labels") or {}),
+                    object_name=name,
+                    namespace=namespace,
+                    metric=metric,
+                )
+            )
+        return out
+
+    def get_metric_by_selector(
+        self,
+        resource: str,
+        namespace: str,
+        metric: str,
+        object_selector=None,
+        metric_selector=None,
+    ) -> list[MetricValue]:
+        out = []
+        for cluster, s in self._series():
+            if (
+                s.get("resource") != resource
+                or s.get("metric") != metric
+                or not self._ns_match(s, namespace)
+                or not _selector_matches(object_selector, s.get("object_labels"))
+                or not _selector_matches(metric_selector, s.get("labels"))
+            ):
+                continue
+            out.append(
+                MetricValue(
+                    cluster=cluster,
+                    value=float(s.get("value", 0.0)),
+                    labels=dict(s.get("labels") or {}),
+                    object_name=s.get("object", ""),
+                    namespace=namespace,
+                    metric=metric,
+                )
+            )
+        return out
+
+    def list_all_metrics(self) -> set[CustomMetricInfo]:
+        infos = set()
+        for _, s in self._series():
+            infos.add(
+                CustomMetricInfo(
+                    group_resource=s.get("resource", "pods"),
+                    metric=s.get("metric", ""),
+                    namespaced=bool(s.get("namespaced", True)),
+                )
+            )
+        return infos
+
+
+class ExternalMetricsProvider:
+    """external.metrics.k8s.io flavor. The reference stubs this whole
+    provider (externalmetrics.go:38); implemented here: namespaced series
+    filtered by a label selector, one value per matching series."""
+
+    def __init__(self, members: MemberClientRegistry) -> None:
+        self.members = members
+
+    def get_external_metric(
+        self, namespace: str, metric: str, selector=None
+    ) -> list[MetricValue]:
+        out = []
+        for name in self.members.names():
+            member = self.members.get(name)
+            if member is None or not member.reachable:
+                continue
+            for s in member.external_metric_series:
+                if s.get("metric") != metric:
+                    continue
+                if namespace and s.get("namespace", "") != namespace:
+                    continue
+                if not _selector_matches(selector, s.get("labels")):
+                    continue
+                out.append(
+                    MetricValue(
+                        cluster=name,
+                        value=float(s.get("value", 0.0)),
+                        labels=dict(s.get("labels") or {}),
+                        namespace=namespace,
+                        metric=metric,
+                    )
+                )
+        return out
+
+    def external_metric_sum(
+        self, namespace: str, metric: str, selector=None
+    ) -> Optional[float]:
+        samples = self.get_external_metric(namespace, metric, selector)
+        if not samples:
+            return None
+        return sum(s.value for s in samples)
+
+    def list_all_external_metrics(self) -> set[tuple[str, str]]:
+        infos = set()
+        for name in self.members.names():
+            member = self.members.get(name)
+            if member is None or not member.reachable:
+                continue
+            for s in member.external_metric_series:
+                infos.add((s.get("namespace", ""), s.get("metric", "")))
+        return infos
+
+
+class MetricsAdapter:
+    """Facade bundling the three providers (the adapter process)."""
+
+    def __init__(self, members: MemberClientRegistry) -> None:
+        self.members = members
+        self.resources = ResourceMetricsProvider(members)
+        self.custom = CustomMetricsProvider(members)
+        self.external = ExternalMetricsProvider(members)
+
+    # -- legacy workload-summary helpers (replica_calculator merge) --------
 
     def resource_metrics(self, workload_key: str) -> list[MetricValue]:
         """Per-cluster cpu utilization samples for a workload."""
@@ -56,21 +337,21 @@ class MetricsAdapter:
             sum(s.value * int(s.labels.get("pods", 0)) for s in samples) / total_pods
         )
 
-    # -- custom / external metrics -----------------------------------------
-
     def custom_metric(self, metric_name: str) -> list[MetricValue]:
-        out = []
-        for name in self.members.names():
-            member = self.members.get(name)
-            if member is None or not member.reachable:
-                continue
-            value = getattr(member, "custom_metrics", {}).get(metric_name)
-            if value is not None:
-                out.append(MetricValue(cluster=name, value=float(value)))
-        return out
+        """United per-cluster series for one metric (all scopes)."""
+        return [
+            MetricValue(cluster=c, value=float(s.get("value", 0.0)),
+                        labels=dict(s.get("labels") or {}),
+                        object_name=s.get("object", ""),
+                        metric=metric_name)
+            for c, s in self.custom._series()
+            if s.get("metric") == metric_name
+        ]
 
     def external_metric_sum(self, metric_name: str) -> Optional[float]:
-        samples = self.custom_metric(metric_name)
+        samples = [
+            s for s in self.custom_metric(metric_name)
+        ] + self.external.get_external_metric("", metric_name)
         if not samples:
             return None
         return sum(s.value for s in samples)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import copy
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
 
@@ -73,9 +74,24 @@ class MemberCluster:
         # workload-key -> metric sample {"pods", "ready_pods",
         # "cpu_utilization"} (metrics.k8s.io stand-in for the metrics adapter)
         self.pod_metrics: dict[str, dict] = {}
+        # metrics.k8s.io per-object surfaces (metricsadapter ResourceMetrics):
+        # "namespace/pod" -> {"cpu": milli, "memory": bytes, "labels": {...}}
+        self.pod_metrics_detail: dict[str, dict] = {}
+        # node name -> {"cpu": milli, "memory": bytes, "labels": {...}}
+        self.node_metrics: dict[str, dict] = {}
+        # custom.metrics.k8s.io series (metricsadapter CustomMetrics): each
+        # {"resource": "pods", "namespaced": bool, "namespace": str,
+        #  "object": str, "metric": str, "value": float, "labels": {...}}
+        self.custom_metric_series: list[dict] = []
+        # external.metrics.k8s.io series: each {"namespace": str,
+        #  "metric": str, "value": float, "labels": {...}}
+        self.external_metric_series: list[dict] = []
         # pod runtime seam: log buffers + pluggable exec handler
         self._pod_logs: dict[tuple[str, str], list[str]] = {}
+        self._log_arrived = threading.Condition(self._lock)
         self.exec_handler: Optional[Callable[[Resource, list], dict]] = None
+        # proxy-passthrough audit: (path, impersonated user/groups) records
+        self.proxy_audit: list[dict] = []
 
     # -- client surface ----------------------------------------------------
 
@@ -205,6 +221,35 @@ class MemberCluster:
         self._check()
         with self._lock:
             self._pod_logs.setdefault((namespace, name), []).append(line)
+            self._log_arrived.notify_all()
+
+    def wait_pod_logs(
+        self, namespace: str, name: str, after: int, timeout: float = 1.0
+    ) -> list[str]:
+        """Block up to ``timeout`` for log lines beyond index ``after``
+        (the log-follow seam the proxy passthrough streams from)."""
+        self._check()
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                lines = self._pod_logs.get((namespace, name), [])
+                if len(lines) > after:
+                    return list(lines[after:])
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._log_arrived.wait(remaining)
+
+    def record_proxy_request(self, path: str, headers: dict) -> None:
+        """Audit seam: the unified-auth tests assert the member saw the
+        impersonated identity, not the plane's own credentials."""
+        self.proxy_audit.append(
+            {
+                "path": path,
+                "user": headers.get("Impersonate-User", ""),
+                "groups": list(headers.get("Impersonate-Group", []) or []),
+            }
+        )
 
     def pod_logs(
         self, namespace: str, name: str, tail: Optional[int] = None

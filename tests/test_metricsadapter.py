@@ -1,0 +1,233 @@
+"""Metrics adapter: the three metrics API flavors + FederatedHPA via
+selector-filtered custom metrics across 3 members (VERDICT r1 #7).
+
+Ref: pkg/metricsadapter/provider/{resourcemetrics,custommetrics,
+externalmetrics}.go — by-name and by-selector queries with object AND
+metric label selectors, namespaced/root scoping, per-cluster list union,
+ListAllMetrics discovery union. The external flavor is stubbed in the
+reference (externalmetrics.go:38) and implemented here."""
+
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.autoscaling import (
+    FederatedHPA,
+    FederatedHPASpec,
+    MetricSpec,
+    ScaleTargetRef,
+)
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.api.policy import LabelSelector, LabelSelectorRequirement
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.metricsadapter import MetricsAdapter
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    new_cluster,
+    new_deployment,
+)
+
+
+def three_member_plane():
+    cp = ControlPlane()
+    for i in (1, 2, 3):
+        cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+    return cp
+
+
+class TestResourceMetrics:
+    def test_pod_metrics_by_name_and_selector(self):
+        cp = three_member_plane()
+        cp.members.get("member1").pod_metrics_detail["default/web-1"] = {
+            "cpu": 250, "memory": 1 << 28, "labels": {"app": "web"},
+        }
+        cp.members.get("member2").pod_metrics_detail["default/web-1"] = {
+            "cpu": 400, "labels": {"app": "web"},
+        }
+        cp.members.get("member2").pod_metrics_detail["default/db-1"] = {
+            "cpu": 900, "labels": {"app": "db"},
+        }
+        adapter = MetricsAdapter(cp.members)
+        by_name = adapter.resources.pod_metrics_by_name("default", "web-1")
+        assert {(s.cluster, s.value) for s in by_name} == {
+            ("member1", 250.0), ("member2", 400.0),
+        }
+        by_sel = adapter.resources.pod_metrics_by_selector(
+            "default", {"app": "web"}
+        )
+        assert len(by_sel) == 2
+        assert all(s.labels["app"] == "web" for s in by_sel)
+
+    def test_node_metrics_by_selector(self):
+        cp = three_member_plane()
+        cp.members.get("member1").node_metrics["n1"] = {
+            "cpu": 4000, "labels": {"pool": "gpu"},
+        }
+        cp.members.get("member3").node_metrics["n9"] = {
+            "cpu": 1000, "labels": {"pool": "cpu"},
+        }
+        adapter = MetricsAdapter(cp.members)
+        got = adapter.resources.node_metrics_by_selector({"pool": "gpu"})
+        assert [(s.cluster, s.object_name) for s in got] == [("member1", "n1")]
+        assert len(adapter.resources.node_metrics_by_name("n9")) == 1
+
+
+class TestCustomMetrics:
+    def _seed(self, cp):
+        cp.members.get("member1").custom_metric_series.extend([
+            {"resource": "pods", "namespaced": True, "namespace": "default",
+             "object": "web-1", "metric": "http_requests",
+             "value": 30.0, "labels": {"verb": "GET"},
+             "object_labels": {"app": "web"}},
+            {"resource": "pods", "namespaced": True, "namespace": "default",
+             "object": "web-1", "metric": "http_requests",
+             "value": 5.0, "labels": {"verb": "POST"},
+             "object_labels": {"app": "web"}},
+            {"resource": "namespaces", "namespaced": False, "namespace": "",
+             "object": "default", "metric": "ns_cost", "value": 12.0},
+        ])
+        cp.members.get("member2").custom_metric_series.append(
+            {"resource": "pods", "namespaced": True, "namespace": "default",
+             "object": "web-2", "metric": "http_requests",
+             "value": 50.0, "labels": {"verb": "GET"},
+             "object_labels": {"app": "web"}},
+        )
+        cp.members.get("member3").custom_metric_series.append(
+            {"resource": "pods", "namespaced": True, "namespace": "other",
+             "object": "web-9", "metric": "http_requests",
+             "value": 999.0, "labels": {"verb": "GET"},
+             "object_labels": {"app": "web"}},
+        )
+
+    def test_by_name_with_metric_selector(self):
+        cp = three_member_plane()
+        self._seed(cp)
+        adapter = MetricsAdapter(cp.members)
+        got = adapter.custom.get_metric_by_name(
+            "pods", "default", "web-1", "http_requests",
+            metric_selector={"verb": "GET"},
+        )
+        assert [(s.cluster, s.value) for s in got] == [("member1", 30.0)]
+
+    def test_by_selector_unions_clusters_and_respects_namespace(self):
+        cp = three_member_plane()
+        self._seed(cp)
+        adapter = MetricsAdapter(cp.members)
+        got = adapter.custom.get_metric_by_selector(
+            "pods", "default", "http_requests",
+            object_selector={"app": "web"},
+            metric_selector={"verb": "GET"},
+        )
+        # member3's series lives in another namespace and must not leak
+        assert {(s.cluster, s.object_name, s.value) for s in got} == {
+            ("member1", "web-1", 30.0), ("member2", "web-2", 50.0),
+        }
+        # match-expression selectors work too
+        sel = LabelSelector(match_expressions=[
+            LabelSelectorRequirement(key="verb", operator="In",
+                                     values=["GET", "PUT"])
+        ])
+        got2 = adapter.custom.get_metric_by_selector(
+            "pods", "default", "http_requests", metric_selector=sel
+        )
+        assert len(got2) == 2
+
+    def test_root_scoped_and_list_all(self):
+        cp = three_member_plane()
+        self._seed(cp)
+        adapter = MetricsAdapter(cp.members)
+        root = adapter.custom.get_metric_by_name(
+            "namespaces", "", "default", "ns_cost"
+        )
+        assert [s.value for s in root] == [12.0]
+        infos = adapter.custom.list_all_metrics()
+        assert {(i.group_resource, i.metric, i.namespaced) for i in infos} == {
+            ("pods", "http_requests", True), ("namespaces", "ns_cost", False),
+        }
+
+
+class TestExternalMetrics:
+    def test_namespaced_external_with_selector(self):
+        cp = three_member_plane()
+        cp.members.get("member1").external_metric_series.extend([
+            {"namespace": "default", "metric": "queue_depth", "value": 5,
+             "labels": {"queue": "orders"}},
+            {"namespace": "default", "metric": "queue_depth", "value": 100,
+             "labels": {"queue": "audit"}},
+        ])
+        cp.members.get("member2").external_metric_series.append(
+            {"namespace": "default", "metric": "queue_depth", "value": 7,
+             "labels": {"queue": "orders"}},
+        )
+        cp.members.get("member3").external_metric_series.append(
+            {"namespace": "other", "metric": "queue_depth", "value": 999,
+             "labels": {"queue": "orders"}},
+        )
+        adapter = MetricsAdapter(cp.members)
+        assert adapter.external.external_metric_sum(
+            "default", "queue_depth", {"queue": "orders"}
+        ) == 12
+        assert ("default", "queue_depth") in (
+            adapter.external.list_all_external_metrics()
+        )
+
+
+class TestFederatedHPACustomMetrics:
+    def test_hpa_scales_on_selector_filtered_custom_metric(self):
+        """FederatedHPA e2e driven by a selector-filtered custom metric
+        across 3 members (VERDICT r1 #7 done-criterion)."""
+        clock = [0.0]
+        cp = ControlPlane(clock=lambda: clock[0])
+        for i in (1, 2, 3):
+            cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+        cp.store.apply(new_deployment("web", replicas=3))
+        cp.store.apply(
+            PropagationPolicy(
+                meta=ObjectMeta(name="p", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="apps/v1", kind="Deployment")
+                    ],
+                    placement=dynamic_weight_placement(),
+                ),
+            )
+        )
+        cp.settle()
+        # per-pod http_requests across the three members; the "audit"
+        # series must be excluded by the metric selector
+        for i, (member, val) in enumerate(
+            [("member1", 120.0), ("member2", 80.0), ("member3", 100.0)]
+        ):
+            cp.members.get(member).custom_metric_series.extend([
+                {"resource": "pods", "namespaced": True,
+                 "namespace": "default", "object": f"web-{i}",
+                 "metric": "http_requests", "value": val,
+                 "labels": {"path": "api"}},
+                {"resource": "pods", "namespaced": True,
+                 "namespace": "default", "object": f"web-{i}",
+                 "metric": "http_requests", "value": 10_000.0,
+                 "labels": {"path": "healthz"}},
+            ])
+        cp.store.apply(
+            FederatedHPA(
+                meta=ObjectMeta(name="web-hpa", namespace="default"),
+                spec=FederatedHPASpec(
+                    scale_target_ref=ScaleTargetRef(kind="Deployment", name="web"),
+                    min_replicas=1,
+                    max_replicas=10,
+                    metrics=[
+                        MetricSpec(
+                            type="Pods",
+                            metric_name="http_requests",
+                            metric_selector={"path": "api"},
+                            target_average_value=50.0,
+                        )
+                    ],
+                    stabilization_window_seconds=0,
+                ),
+            )
+        )
+        clock[0] += 30
+        cp.settle()
+        template = cp.store.get("Resource", "default/web")
+        # sum(api series) = 300; target 50/pod -> 6 replicas
+        assert template.spec["replicas"] == 6
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        assert sum(tc.replicas for tc in rb.spec.clusters) == 6

@@ -19,7 +19,7 @@ from karmada_tpu.operator import (
     Task,
     WorkflowError,
 )
-from karmada_tpu.operator.karmada_operator import KarmadaComponents
+from karmada_tpu.operator.karmada_operator import ComponentSpec, KarmadaComponents
 from karmada_tpu.utils.metrics import Registry
 from karmada_tpu.utils.quantity import parse_resource_list
 
@@ -66,7 +66,7 @@ class TestKarmadaOperator:
         karmada = Karmada(
             meta=ObjectMeta(name="prod"),
             spec=KarmadaSpec(
-                components=KarmadaComponents(descheduler=True),
+                components=KarmadaComponents(descheduler=ComponentSpec(enabled=True)),
                 member_clusters=["m1", "m2"],
             ),
         )
@@ -155,3 +155,87 @@ class TestMetrics:
             ]
             >= 1
         )
+
+
+class TestOperatorLifecycle:
+    """install -> reconfigure (upgrade reconcile) -> failure path -> deinit
+    (VERDICT r1 #10 done-criterion)."""
+
+    def _cr(self):
+        return Karmada(
+            meta=ObjectMeta(name="plane", generation=1),
+            spec=KarmadaSpec(member_clusters=["m1", "m2"]),
+        )
+
+    def test_install_reconfigure_deinit(self):
+        from karmada_tpu.utils.builders import new_deployment
+        from karmada_tpu.api import (
+            PropagationPolicy, PropagationSpec, ResourceSelector,
+        )
+        from karmada_tpu.utils.builders import duplicated_placement
+
+        op = KarmadaOperator()
+        karmada = self._cr()
+        cp = op.reconcile(karmada)
+        assert karmada.status.observed_generation == 1
+        assert karmada.status.installed_version == karmada.spec.version
+        assert cp.descheduler is None
+
+        # the installed plane actually propagates
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="p", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[ResourceSelector(
+                    api_version="apps/v1", kind="Deployment")],
+                placement=duplicated_placement())))
+        cp.store.apply(new_deployment("web", replicas=2))
+        cp.settle()
+        assert cp.store.get("ResourceBinding", "default/web-deployment") is not None
+
+        # reconfigure: enable descheduler, add a member, flip a gate
+        karmada.meta.generation = 2
+        karmada.spec.components.descheduler.enabled = True
+        karmada.spec.member_clusters.append("m3")
+        karmada.spec.feature_gates["Failover"] = True
+        cp2 = op.reconcile(karmada)
+        assert cp2 is cp  # upgrade reconcile, not reinstall
+        assert cp.descheduler is not None
+        assert {c.name for c in cp.store.list("Cluster")} == {"m1", "m2", "m3"}
+        assert karmada.status.observed_generation == 2
+        from karmada_tpu.utils.features import FAILOVER, feature_gate
+        assert feature_gate.enabled(FAILOVER)
+
+        # member removal drains on the next reconcile
+        karmada.meta.generation = 3
+        karmada.spec.member_clusters.remove("m2")
+        op.reconcile(karmada)
+        assert {c.name for c in cp.store.list("Cluster")} == {"m1", "m3"}
+
+        op.deinit(karmada)
+        assert "plane" not in op.instances
+        assert not any(
+            c.type == "Ready" and c.status for c in karmada.status.conditions
+        )
+
+    def test_version_upgrade_rolls_unpinned_components(self):
+        op = KarmadaOperator()
+        karmada = self._cr()
+        op.reconcile(karmada)
+        karmada.meta.generation = 2
+        karmada.spec.version = "1.12.0"
+        op.reconcile(karmada)
+        assert karmada.status.installed_version == "1.12.0"
+        assert karmada.spec.components.scheduler.version == "1.12.0"
+
+    def test_version_skew_rejected_with_failure_condition(self):
+        from karmada_tpu.operator.karmada_operator import ComponentSpec as CS
+
+        op = KarmadaOperator()
+        karmada = self._cr()
+        karmada.spec.version = "1.13.0"
+        karmada.spec.components.scheduler = CS(version="1.11.0")  # 2 minors
+        with pytest.raises(WorkflowError):
+            op.reconcile(karmada)
+        assert karmada.status.failed_task == "validate"
+        cond = [c for c in karmada.status.conditions if c.type == "Ready"][0]
+        assert not cond.status and cond.reason == "TaskFailed"

@@ -158,8 +158,12 @@ class TestMetricsAdapter:
 
     def test_external_metric_sum(self):
         cp = make_plane()
-        cp.members.get("member1").custom_metrics = {"queue_depth": 5}
-        cp.members.get("member2").custom_metrics = {"queue_depth": 7}
+        cp.members.get("member1").external_metric_series.append(
+            {"namespace": "", "metric": "queue_depth", "value": 5}
+        )
+        cp.members.get("member2").external_metric_series.append(
+            {"namespace": "", "metric": "queue_depth", "value": 7}
+        )
         assert cp.metrics_adapter.external_metric_sum("queue_depth") == 12
 
 
