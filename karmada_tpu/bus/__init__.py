@@ -1,0 +1,9 @@
+"""Networked store watch bus (gRPC watch/apply surface + agent replica)."""
+
+from .service import (  # noqa: F401
+    StoreBusServer,
+    StoreReplica,
+    decode_object,
+    encode_object,
+    kind_registry,
+)

@@ -1,0 +1,3 @@
+"""Generated protobuf messages for the store watch bus."""
+
+from . import storebus_pb2  # noqa: F401

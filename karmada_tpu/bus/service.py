@@ -1,0 +1,345 @@
+"""Networked store watch bus: the plane's watch/apply surface over gRPC.
+
+Ref: the reference's control plane is nine binaries around a shared
+API server whose informer/watch channel carries all state
+(pkg/util/fedinformer; the agent consumes it over DCN). This runtime's
+Store is in-proc; the bus exports the same two primitives over the wire —
+a server-streamed Watch (replay + live events, the informer list-then-
+watch contract) and Apply/Delete write-through — so agents and
+out-of-process controllers can run a `StoreReplica`: a local Store mirror
+fed by the stream whose writes round-trip to the primary.
+
+Objects travel as canonical JSON of the API dataclasses (utils/codec);
+decode resolves classes from the kind registry below. Unknown kinds
+degrade to generic Resource manifests rather than failing the stream
+(forward compatibility across component versions).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..api.core import Resource
+from ..utils import Store
+from ..utils.codec import from_jsonable, to_jsonable
+from ..utils.store import Event as StoreEvent
+from .proto import storebus_pb2 as pb
+
+SERVICE_NAME = "karmada_tpu.bus.StoreBus"
+
+
+def _kind_registry() -> dict[str, type]:
+    """kind string -> dataclass, collected from every API surface that
+    stores objects (the scheme registry analogue)."""
+    registry: dict[str, type] = {}
+
+    def scan(module) -> None:
+        import dataclasses
+
+        for name in dir(module):
+            cls = getattr(module, name)
+            if (
+                isinstance(cls, type)
+                and dataclasses.is_dataclass(cls)
+                and isinstance(getattr(cls, "KIND", None), str)
+            ):
+                registry[cls.KIND] = cls
+
+    from ..api import autoscaling, cluster, core, networking, policy, work
+    from ..controllers import extras
+    from ..interpreter import declarative
+    from ..search import registry as search_registry
+
+    for mod in (
+        core, cluster, policy, work, autoscaling, networking, extras,
+        declarative, search_registry,
+    ):
+        scan(mod)
+    registry["Resource"] = Resource
+    return registry
+
+
+_REGISTRY: Optional[dict[str, type]] = None
+
+
+def kind_registry() -> dict[str, type]:
+    global _REGISTRY
+    if _REGISTRY is None:
+        _REGISTRY = _kind_registry()
+    return _REGISTRY
+
+
+def encode_object(obj) -> str:
+    return json.dumps(to_jsonable(obj), sort_keys=True, separators=(",", ":"))
+
+
+def decode_object(kind: str, object_json: str):
+    cls = kind_registry().get(kind, Resource)
+    return from_jsonable(cls, json.loads(object_json))
+
+
+class StoreBusServer:
+    """Serves one Store's watch/apply surface (mTLS contract identical to
+    the estimator/solver servers)."""
+
+    def __init__(
+        self,
+        store: Store,
+        address: str = "127.0.0.1:0",
+        *,
+        server_cert: Optional[bytes] = None,
+        server_key: Optional[bytes] = None,
+        client_ca: Optional[bytes] = None,
+        max_workers: int = 8,
+    ):
+        self.store = store
+        self._subscribers: list[tuple[queue.Queue, frozenset]] = []
+        self._lock = threading.Lock()
+        store.watch_all(self._fan_out)
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.so_reuseport", 0)],
+        )
+
+        def watch(request: pb.WatchRequest, context):
+            kinds = frozenset(request.kinds)
+            q: queue.Queue = queue.Queue(maxsize=100_000)
+            if request.replay:
+                # list-then-watch: replay current state as Added BEFORE
+                # registering for live events would race new writes; the
+                # store lock inside list() snapshots each kind, and any
+                # write between replay and registration re-delivers via the
+                # subscriber registration below happening first
+                with self._lock:
+                    self._subscribers.append((q, kinds))
+                for kind in sorted(self.store.kinds()):
+                    if kinds and kind not in kinds:
+                        continue
+                    for obj in self.store.list(kind):
+                        yield pb.Event(
+                            type="Added",
+                            kind=kind,
+                            key=obj.meta.namespaced_name,
+                            resource_version=obj.meta.resource_version,
+                            object_json=encode_object(obj),
+                        )
+            else:
+                with self._lock:
+                    self._subscribers.append((q, kinds))
+            try:
+                while context.is_active():
+                    try:
+                        ev = q.get(timeout=0.5)
+                    except queue.Empty:
+                        continue
+                    yield ev
+            finally:
+                with self._lock:
+                    self._subscribers = [
+                        (sq, sk) for sq, sk in self._subscribers if sq is not q
+                    ]
+
+        def apply(request: pb.ApplyRequest, context):
+            try:
+                obj = decode_object(request.kind, request.object_json)
+                applied = self.store.apply(obj)
+                return pb.ApplyResponse(
+                    resource_version=applied.meta.resource_version
+                )
+            except Exception as e:  # noqa: BLE001 — wire surface
+                return pb.ApplyResponse(error=str(e))
+
+        def delete(request: pb.DeleteRequest, context):
+            try:
+                gone = self.store.delete(
+                    request.kind, request.key, force=request.force
+                )
+                return pb.DeleteResponse(deleted=gone is not None)
+            except Exception as e:  # noqa: BLE001
+                return pb.DeleteResponse(error=str(e))
+
+        handlers = {
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                watch,
+                request_deserializer=pb.WatchRequest.FromString,
+                response_serializer=pb.Event.SerializeToString,
+            ),
+            "Apply": grpc.unary_unary_rpc_method_handler(
+                apply,
+                request_deserializer=pb.ApplyRequest.FromString,
+                response_serializer=pb.ApplyResponse.SerializeToString,
+            ),
+            "Delete": grpc.unary_unary_rpc_method_handler(
+                delete,
+                request_deserializer=pb.DeleteRequest.FromString,
+                response_serializer=pb.DeleteResponse.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        )
+        if bool(server_cert) != bool(server_key) or (
+            client_ca and not (server_cert and server_key)
+        ):
+            raise ValueError(
+                "incomplete server TLS config: server_cert and server_key are "
+                "both required (and client_ca implies them)"
+            )
+        if server_cert and server_key:
+            creds = grpc.ssl_server_credentials(
+                [(server_key, server_cert)],
+                root_certificates=client_ca,
+                require_client_auth=client_ca is not None,
+            )
+            self.port = self._server.add_secure_port(address, creds)
+        else:
+            self.port = self._server.add_insecure_port(address)
+        if self.port == 0:
+            raise RuntimeError(f"store bus failed to bind {address}")
+
+    def _fan_out(self, event: StoreEvent) -> None:
+        msg = pb.Event(
+            type=event.type,
+            kind=event.kind,
+            key=event.key,
+            resource_version=getattr(event.obj.meta, "resource_version", 0),
+            object_json=encode_object(event.obj),
+        )
+        with self._lock:
+            subs = list(self._subscribers)
+        for q, kinds in subs:
+            if kinds and event.kind not in kinds:
+                continue
+            try:
+                q.put_nowait(msg)
+            except queue.Full:
+                pass  # slow subscriber: it re-lists on reconnect
+
+    def start(self) -> int:
+        self._server.start()
+        return self.port
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class StoreReplica:
+    """Agent-side mirror: a local Store kept consistent by the bus stream;
+    writes round-trip through the primary (never applied locally first —
+    the echo from the stream is the commit signal, so the replica can never
+    diverge from the primary's admission decisions)."""
+
+    def __init__(
+        self,
+        target: str,
+        *,
+        kinds: tuple[str, ...] = (),
+        root_ca: Optional[bytes] = None,
+        client_cert: Optional[bytes] = None,
+        client_key: Optional[bytes] = None,
+    ):
+        if (client_cert or client_key) and not (root_ca and client_cert and client_key):
+            raise ValueError(
+                "incomplete client TLS config: client_cert/client_key require "
+                "each other and root_ca"
+            )
+        if root_ca is not None:
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=root_ca,
+                private_key=client_key,
+                certificate_chain=client_cert,
+            )
+            self._channel = grpc.secure_channel(target, creds)
+        else:
+            self._channel = grpc.insecure_channel(target)
+        self.store = Store()
+        self.kinds = kinds
+        self._watch = self._channel.unary_stream(
+            f"/{SERVICE_NAME}/Watch",
+            request_serializer=pb.WatchRequest.SerializeToString,
+            response_deserializer=pb.Event.FromString,
+        )
+        self._apply = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Apply",
+            request_serializer=pb.ApplyRequest.SerializeToString,
+            response_deserializer=pb.ApplyResponse.FromString,
+        )
+        self._delete = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/Delete",
+            request_serializer=pb.DeleteRequest.SerializeToString,
+            response_deserializer=pb.DeleteResponse.FromString,
+        )
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- replication -------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                stream = self._watch(
+                    pb.WatchRequest(kinds=list(self.kinds), replay=True)
+                )
+                self._synced.set()
+                for ev in stream:
+                    if self._stop.is_set():
+                        return
+                    self._apply_event(ev)
+            except grpc.RpcError:
+                if self._stop.is_set():
+                    return
+                self._synced.clear()
+                self._stop.wait(0.2)  # reconnect backoff, then re-list
+
+    def _apply_event(self, ev: pb.Event) -> None:
+        if ev.type == "Deleted":
+            self.store.delete(ev.kind, ev.key, force=True)
+            return
+        obj = decode_object(ev.kind, ev.object_json)
+        current = self.store.get(ev.kind, ev.key)
+        if (
+            current is not None
+            and current.meta.resource_version >= ev.resource_version
+        ):
+            return  # replay duplicate after reconnect
+        self.store.apply(obj)
+        # the replica mirrors the PRIMARY's resource versions so controllers
+        # comparing rvs across restarts agree with the source of truth
+        obj.meta.resource_version = ev.resource_version
+
+    def wait_synced(self, timeout: float = 5.0) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- write-through -----------------------------------------------------
+
+    def apply(self, obj) -> int:
+        kind = type(obj).KIND if hasattr(type(obj), "KIND") else "Resource"
+        resp = self._apply(
+            pb.ApplyRequest(kind=kind, object_json=encode_object(obj))
+        )
+        if resp.error:
+            raise RuntimeError(resp.error)
+        return resp.resource_version
+
+    def delete(self, kind: str, key: str, force: bool = False) -> bool:
+        resp = self._delete(pb.DeleteRequest(kind=kind, key=key, force=force))
+        if resp.error:
+            raise RuntimeError(resp.error)
+        return resp.deleted
+
+    def close(self) -> None:
+        self._stop.set()
+        self._channel.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -39,6 +39,9 @@ class ProxyResponse:
     error: str = ""
     # subresource result (log lines, exec output)
     data: Any = None
+    # list paging (multi-cluster continue + resource-version encodings)
+    continue_token: str = ""
+    resource_version: str = ""
 
 
 class CachePlugin:
@@ -54,6 +57,19 @@ class CachePlugin:
             hit = self.cache.get(req.gvk, req.namespace, req.name, req.cluster)
             if hit is not None:
                 return ProxyResponse(served_by=self.name, obj=hit[1])
+            return None
+        limit = int(req.options.get("limit", 0) or 0)
+        cont = str(req.options.get("continue", "") or "")
+        if limit or cont:
+            items, next_token, rv = self.cache.list_paged(
+                req.gvk, req.namespace or None, req.labels or None,
+                limit=limit, continue_token=cont, cluster=req.cluster,
+            )
+            if items or cont:
+                return ProxyResponse(
+                    served_by=self.name, items=items,
+                    continue_token=next_token, resource_version=rv,
+                )
             return None
         items = self.cache.list(req.gvk, req.namespace or None, req.labels or None)
         if req.cluster is not None:

@@ -10,10 +10,28 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+import base64
+import json
+
 from ..api.core import ObjectMeta, Resource
 from ..api.policy import ClusterAffinity
 from ..utils import DONE, Runtime, Store
 from ..utils.member import MemberClientRegistry, UnreachableError
+
+
+def encode_token(payload: dict) -> str:
+    """Opaque list token (the reference base64-encodes JSON the same way —
+    multiClusterResourceVersion.String / multiClusterContinue.String)."""
+    return base64.urlsafe_b64encode(
+        json.dumps(payload, sort_keys=True).encode()
+    ).decode()
+
+
+def decode_token(token: str) -> dict:
+    try:
+        return json.loads(base64.urlsafe_b64decode(token.encode()))
+    except Exception:
+        return {}
 
 
 @dataclass
@@ -79,6 +97,52 @@ class MultiClusterCache:
                 continue
             out.append((c, obj))
         return sorted(out, key=lambda t: (t[0], t[1].meta.namespaced_name))
+
+    def list_paged(
+        self,
+        gvk: str,
+        namespace: Optional[str] = None,
+        labels: Optional[dict[str, str]] = None,
+        limit: int = 0,
+        continue_token: str = "",
+        cluster: Optional[str] = None,
+    ) -> tuple[list[tuple[str, Resource]], str, str]:
+        """Paged multi-cluster list (ref: pkg/search/proxy/store/
+        multi_cluster_cache.go:187-265): items stream cluster by cluster in
+        name order; the continue token records (cluster, last item) so the
+        next page resumes mid-cluster and then moves on; the returned
+        resource version is the per-cluster rv map (the reference's
+        multiClusterResourceVersion encoding). Returns
+        (items, next_continue, resource_version)."""
+        everything = self.list(gvk, namespace, labels)
+        if cluster is not None:
+            # cluster scoping must precede the page window, or the limit
+            # counts items the caller never sees
+            everything = [(c, o) for c, o in everything if c == cluster]
+        start_cluster, after = "", ""
+        if continue_token:
+            tok = decode_token(continue_token)
+            start_cluster = tok.get("cluster", "")
+            after = tok.get("after", "")
+        # multi-cluster rv covers EVERY cluster contributing to the full
+        # list, independent of the page window
+        rv_map: dict[str, int] = {}
+        for c, obj in everything:
+            rv_map[c] = max(rv_map.get(c, 0), obj.meta.resource_version)
+        items: list[tuple[str, Resource]] = []
+        next_token = ""
+        for c, obj in everything:
+            key = obj.meta.namespaced_name
+            if c < start_cluster or (c == start_cluster and after and key <= after):
+                continue
+            if limit and len(items) >= limit:
+                last_c, last_obj = items[-1]
+                next_token = encode_token(
+                    {"cluster": last_c, "after": last_obj.meta.namespaced_name}
+                )
+                break
+            items.append((c, obj))
+        return items, next_token, encode_token(rv_map)
 
 
 class SearchController:
