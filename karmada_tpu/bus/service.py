@@ -110,14 +110,13 @@ class StoreBusServer:
         def watch(request: pb.WatchRequest, context):
             kinds = frozenset(request.kinds)
             q: queue.Queue = queue.Queue(maxsize=100_000)
+            dead = [False]  # set when the subscriber overflows (too slow)
+            # register BEFORE replay so writes landing mid-replay re-deliver
+            # (clients dedup on resource_version); the store lock inside
+            # list() snapshots each kind
+            with self._lock:
+                self._subscribers.append((q, kinds, dead))
             if request.replay:
-                # list-then-watch: replay current state as Added BEFORE
-                # registering for live events would race new writes; the
-                # store lock inside list() snapshots each kind, and any
-                # write between replay and registration re-delivers via the
-                # subscriber registration below happening first
-                with self._lock:
-                    self._subscribers.append((q, kinds))
                 for kind in sorted(self.store.kinds()):
                     if kinds and kind not in kinds:
                         continue
@@ -129,20 +128,22 @@ class StoreBusServer:
                             resource_version=obj.meta.resource_version,
                             object_json=encode_object(obj),
                         )
-            else:
-                with self._lock:
-                    self._subscribers.append((q, kinds))
+            # the Bookmark marks the replay boundary: clients report synced
+            # only after it (the list-then-watch initial-sync contract)
+            yield pb.Event(type="Bookmark")
             try:
-                while context.is_active():
+                while context.is_active() and not dead[0]:
                     try:
                         ev = q.get(timeout=0.5)
                     except queue.Empty:
                         continue
                     yield ev
+                # dead: fall through — closing the stream forces the client
+                # to reconnect and re-list, healing the dropped-event gap
             finally:
                 with self._lock:
                     self._subscribers = [
-                        (sq, sk) for sq, sk in self._subscribers if sq is not q
+                        s for s in self._subscribers if s[0] is not q
                     ]
 
         def apply(request: pb.ApplyRequest, context):
@@ -204,6 +205,13 @@ class StoreBusServer:
             raise RuntimeError(f"store bus failed to bind {address}")
 
     def _fan_out(self, event: StoreEvent) -> None:
+        with self._lock:
+            subs = [
+                s for s in self._subscribers
+                if not s[1] or event.kind in s[1]
+            ]
+        if not subs:
+            return  # no interested subscriber: stay off the write path
         msg = pb.Event(
             type=event.type,
             kind=event.kind,
@@ -211,21 +219,20 @@ class StoreBusServer:
             resource_version=getattr(event.obj.meta, "resource_version", 0),
             object_json=encode_object(event.obj),
         )
-        with self._lock:
-            subs = list(self._subscribers)
-        for q, kinds in subs:
-            if kinds and event.kind not in kinds:
-                continue
+        for q, _, dead in subs:
             try:
                 q.put_nowait(msg)
             except queue.Full:
-                pass  # slow subscriber: it re-lists on reconnect
+                # slow subscriber: close its stream so it reconnects and
+                # re-lists — silently dropping would leave it stale forever
+                dead[0] = True
 
     def start(self) -> int:
         self._server.start()
         return self.port
 
     def stop(self, grace: Optional[float] = 0.5) -> None:
+        self.store.unwatch_all(self._fan_out)
         self._server.stop(grace)
 
 
@@ -291,10 +298,13 @@ class StoreReplica:
                 stream = self._watch(
                     pb.WatchRequest(kinds=list(self.kinds), replay=True)
                 )
-                self._synced.set()
                 for ev in stream:
                     if self._stop.is_set():
                         return
+                    if ev.type == "Bookmark":
+                        # replay fully consumed: NOW the mirror is synced
+                        self._synced.set()
+                        continue
                     self._apply_event(ev)
             except grpc.RpcError:
                 if self._stop.is_set():
@@ -313,9 +323,14 @@ class StoreReplica:
             and current.meta.resource_version >= ev.resource_version
         ):
             return  # replay duplicate after reconnect
-        self.store.apply(obj)
         # the replica mirrors the PRIMARY's resource versions so controllers
-        # comparing rvs across restarts agree with the source of truth
+        # comparing rvs across restarts agree with the source of truth. The
+        # local counter is aligned BEFORE apply so the watch event this
+        # apply delivers already carries the primary rv (the stream thread
+        # is the store's only writer)
+        with self.store._lock:
+            self.store._rv = max(self.store._rv, ev.resource_version - 1)
+        self.store.apply(obj)
         obj.meta.resource_version = ev.resource_version
 
     def wait_synced(self, timeout: float = 5.0) -> bool:

@@ -52,7 +52,11 @@ def kernel_variant(
     the (weight, lastReplicas, index) key fits 31 bits with a small
     remainder rank. The bit split snaps to tiers so the static tuple (and
     hence the jit trace) does not churn as data maxima drift."""
-    max_w = 2 * max(avail_max, static_max, prev_max, 1)
+    # exact weight bound by cohort: avail (<= avail_max), prev (<= prev_max),
+    # fresh = avail + credited prev (<= sum), static (<= static_max) — the
+    # bound decides both the int32 gate and the packed-key bit budget, so
+    # every saved bit widens the fast path's reach
+    max_w = max(avail_max + prev_max, static_max, 1)
     narrow = max_w * max(max_n, 1) < 2**31 and max_w * c < 2**31
     fast = None
     if narrow:
@@ -247,6 +251,10 @@ class TensorScheduler:
                     [problems[i] for i in fast_idx],
                     [compiled[i] for i in fast_idx],
                 )
+                if len(fast_idx) == len(problems):
+                    # all rows rode the fleet: hand back the lazy
+                    # column-oriented result list as-is
+                    return fast_res
                 results: list = [None] * len(problems)
                 for i, res in zip(fast_idx, fast_res):
                     results[i] = res

@@ -313,6 +313,73 @@ class FleetResult:
         return self._feasible
 
 
+class _FleetResultList:
+    """Column-oriented result container: the scheduling data lives in the
+    fetched numpy arrays; per-binding `FleetResult` views materialize on
+    access (and are cached for identity stability). Building 100k Python
+    objects eagerly would cost more host time than the whole device pass —
+    consumers that iterate pay the same total, but batch callers that
+    sample (bench verification, partial write-backs) don't pay for rows
+    they never touch."""
+
+    __slots__ = (
+        "_problems", "_terms", "_batches", "_slice_rows", "_n_placed",
+        "_unsched", "_has_cand", "_is_dup", "_cache",
+    )
+
+    def __init__(self, problems, terms, batches, slice_rows, n_placed,
+                 unsched, has_cand, is_dup):
+        self._problems = problems
+        self._terms = terms
+        self._batches = batches
+        self._slice_rows = slice_rows
+        self._n_placed = n_placed
+        self._unsched = unsched
+        self._has_cand = has_cand
+        self._is_dup = is_dup
+        self._cache: dict[int, FleetResult] = {}
+
+    def __len__(self) -> int:
+        return len(self._problems)
+
+    def _make(self, i: int) -> FleetResult:
+        res = self._cache.get(i)
+        if res is not None:
+            return res
+        p = self._problems[i]
+        if not self._has_cand[i]:
+            err = "no clusters fit the placement"
+        elif self._unsched[i]:
+            err = "clusters available replicas are not enough"
+        else:
+            err = ""
+        dup = (
+            p.replicas
+            if (self._is_dup[i] and p.replicas > 0 and not err)
+            else None
+        )
+        res = FleetResult(
+            p.key, self._terms[i], err,
+            self._batches[i // self._slice_rows], i % self._slice_rows,
+            int(self._n_placed[i]), dup, p.replicas == 0,
+        )
+        self._cache[i] = res
+        return res
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._make(j) for j in range(*i.indices(len(self)))]
+        if i < 0:
+            i += len(self)
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        return self._make(i)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self._make(i)
+
+
 # --------------------------------------------------------------------------
 # the table
 # --------------------------------------------------------------------------
@@ -760,25 +827,8 @@ class FleetTable:
             _FleetBatch(names, entry_bufs[s], starts[s * slice_rows :], bit_bufs[s])
             for s in range(n_slices)
         ]
-        out = []
-        for i, p in enumerate(problems):
-            term = self._terms[rows_np[i]]
-            if not has_cand[i]:
-                err = "no clusters fit the placement"
-            elif unsched[i]:
-                err = "clusters available replicas are not enough"
-            else:
-                err = ""
-            dup = (
-                p.replicas
-                if (is_dup[i] and p.replicas > 0 and not err)
-                else None
-            )
-            out.append(
-                FleetResult(
-                    p.key, term, err, batches[i // slice_rows],
-                    i % slice_rows, int(n_placed[i]), dup,
-                    p.replicas == 0,
-                )
-            )
-        return out
+        terms = [self._terms[r] for r in rows_np]
+        return _FleetResultList(
+            problems, terms, batches, slice_rows, n_placed, unsched,
+            has_cand, is_dup,
+        )

@@ -131,9 +131,22 @@ class ClusterProxyServer:
         try:
             self._dispatch(handler, member, sub_path, query, impersonation)
         except UnreachableError as e:
-            self._error(handler, 503, str(e))
-        except KeyError as e:
-            self._error(handler, 404, str(e))
+            self._fail(handler, 503, str(e))
+        except (KeyError, ValueError) as e:
+            self._fail(handler, 404 if isinstance(e, KeyError) else 400, str(e))
+
+    def _fail(self, handler, code: int, message: str) -> None:
+        """Error path that respects an already-started chunked stream: once
+        headers are out, a second status line would corrupt the response —
+        terminate the stream instead."""
+        if getattr(handler, "_streamed", False):
+            try:
+                handler.wfile.write(b"0\r\n\r\n")
+                handler.wfile.flush()
+            except OSError:
+                pass
+            return
+        self._error(handler, code, message)
 
     def _dispatch(self, handler, member, path, query, impersonation) -> None:
         member.record_proxy_request(path, impersonation)
@@ -166,7 +179,12 @@ class ClusterProxyServer:
 
     def _serve_logs(self, handler, member, m, query) -> None:
         ns, name = m.group("ns"), m.group("name")
-        tail = int(query["tailLines"]) if "tailLines" in query else None
+        tail = None
+        if "tailLines" in query:
+            try:
+                tail = int(query["tailLines"])
+            except ValueError:
+                raise ValueError(f"invalid tailLines {query['tailLines']!r}")
         follow = query.get("follow", "") in ("true", "1")
         # ONE snapshot read: computing `seen` from a second read would skip
         # lines appended between the two reads
@@ -179,6 +197,7 @@ class ClusterProxyServer:
         handler.send_header("Content-Type", "text/plain")
         handler.send_header("Transfer-Encoding", "chunked")
         handler.end_headers()
+        handler._streamed = True  # headers sent: errors must not re-respond
 
         def chunk(data: bytes) -> None:
             handler.wfile.write(f"{len(data):X}\r\n".encode())

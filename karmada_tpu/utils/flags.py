@@ -1,0 +1,235 @@
+"""Per-binary flag surfaces with the reference's flag names.
+
+Ref: cmd/*/app/options/options.go — each reference process exposes its
+configuration as pflag surfaces (--plugins, --feature-gates,
+--enable-scheduler-estimator, --descheduling-interval, ...). The in-proc
+runtime collapses nine binaries into constructor kwargs; these parsers keep
+the FLAG CONTRACT: an operator's existing launch args parse here and map
+onto the corresponding in-proc configuration, so deployment manifests carry
+over. Each ``parse_*`` returns the kwargs dict its component constructor
+accepts (plus a ``settings`` section for flags that configure live
+behavior such as feature gates, applied by ``apply_common``).
+
+Semantics preserved from the reference:
+- ``--plugins`` (scheduler, options.go:163): '*' enables all in-tree
+  plugins; '*,-Foo' disables Foo; an explicit list enables only those.
+- ``--controllers`` (controller-manager, options.go:165): same grammar
+  over controller names.
+- ``--feature-gates``: key=bool pairs applied to the feature registry.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+#: the in-tree scheduler plugin set (framework/plugins/registry.go:30-39)
+IN_TREE_PLUGINS = (
+    "APIEnablement",
+    "ClusterAffinity",
+    "ClusterEviction",
+    "ClusterLocality",
+    "SpreadConstraint",
+    "TaintToleration",
+)
+
+#: controllers the manager can toggle (controller-manager options.go:165)
+CONTROLLERS = (
+    "binding", "cluster", "clusterStatus", "execution", "workStatus",
+    "namespace", "gracefulEviction", "applicationFailover", "remedy",
+    "workloadRebalancer", "federatedResourceQuota", "unifiedAuth",
+    "serviceExport", "multiclusterservice", "federatedHorizontalPodAutoscaler",
+    "cronFederatedHorizontalPodAutoscaler", "dependenciesDistributor",
+)
+
+
+def parse_star_list(values: Sequence[str], universe: Sequence[str], what: str):
+    """'*' / '*,-Foo' / explicit-list grammar shared by --plugins and
+    --controllers. Returns (enabled set, disabled set)."""
+    items = [v.strip() for v in values for v in v.split(",") if v.strip()]
+    if not items:
+        return set(universe), set()
+    has_star = "*" in items
+    disabled = {v[1:] for v in items if v.startswith("-")}
+    explicit = {v for v in items if v != "*" and not v.startswith("-")}
+    unknown = (disabled | explicit) - set(universe)
+    if unknown:
+        raise ValueError(f"unknown {what}: {sorted(unknown)}")
+    if has_star:
+        return set(universe) - disabled, disabled
+    if disabled and not explicit:
+        return set(universe) - disabled, disabled
+    return explicit, set(universe) - explicit
+
+
+def _feature_gates(value: str) -> dict[str, bool]:
+    out: dict[str, bool] = {}
+    for pair in value.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        key, _, raw = pair.partition("=")
+        if raw.lower() not in ("true", "false"):
+            raise ValueError(f"feature gate {pair!r} must be key=true|false")
+        out[key] = raw.lower() == "true"
+    return out
+
+
+def _common(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--kubeconfig", default="")
+    parser.add_argument("--master", default="")
+    parser.add_argument("--metrics-bind-address", default=":8080")
+    parser.add_argument("--health-probe-bind-address", default=":10351")
+    parser.add_argument("--feature-gates", type=_feature_gates, default={})
+    parser.add_argument("--leader-elect", default="true")
+
+
+def apply_common(ns: argparse.Namespace) -> None:
+    """Apply process-wide settings (feature gates) from parsed flags."""
+    from .features import feature_gate
+
+    for gate, value in (ns.feature_gates or {}).items():
+        feature_gate.set(gate, value)
+
+
+# -- karmada-scheduler (cmd/scheduler/app/options/options.go) ---------------
+
+
+def scheduler_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="karmada-scheduler", add_help=False)
+    _common(p)
+    p.add_argument("--scheduler-name", default="default-scheduler")
+    p.add_argument("--plugins", action="append", default=[])
+    p.add_argument("--enable-scheduler-estimator", default="false")
+    p.add_argument("--disable-scheduler-estimator-in-pull-mode", default="false")
+    p.add_argument("--scheduler-estimator-timeout", default="3s")
+    p.add_argument("--scheduler-estimator-port", type=int, default=10352)
+    p.add_argument("--enable-empty-workload-propagation", default="false")
+    return p
+
+
+def parse_scheduler_flags(argv: Sequence[str]) -> dict:
+    ns = scheduler_parser().parse_args(argv)
+    apply_common(ns)
+    enabled, disabled = parse_star_list(
+        ns.plugins or ["*"], IN_TREE_PLUGINS, "plugins"
+    )
+    return {
+        "scheduler_name": ns.scheduler_name,
+        "disabled_plugins": tuple(sorted(disabled)),
+        "enable_scheduler_estimator": ns.enable_scheduler_estimator == "true",
+        "scheduler_estimator_timeout_seconds": _duration(
+            ns.scheduler_estimator_timeout
+        ),
+        "scheduler_estimator_port": ns.scheduler_estimator_port,
+    }
+
+
+# -- karmada-controller-manager ---------------------------------------------
+
+
+def controller_manager_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="karmada-controller-manager", add_help=False
+    )
+    _common(p)
+    p.add_argument("--controllers", action="append", default=[])
+    p.add_argument("--cluster-monitor-period", default="5m")
+    p.add_argument("--cluster-monitor-grace-period", default="40s")
+    p.add_argument("--failover-eviction-timeout", default="5m")
+    p.add_argument("--graceful-eviction-timeout", default="10m")
+    p.add_argument("--concurrent-work-syncs", type=int, default=5)
+    return p
+
+
+def parse_controller_manager_flags(argv: Sequence[str]) -> dict:
+    ns = controller_manager_parser().parse_args(argv)
+    apply_common(ns)
+    enabled, disabled = parse_star_list(
+        ns.controllers or ["*"], CONTROLLERS, "controllers"
+    )
+    return {
+        "enabled_controllers": tuple(sorted(enabled)),
+        "disabled_controllers": tuple(sorted(disabled)),
+        "eviction_timeout": _duration(ns.failover_eviction_timeout),
+        "cluster_monitor_grace_period": _duration(
+            ns.cluster_monitor_grace_period
+        ),
+    }
+
+
+# -- karmada-descheduler -----------------------------------------------------
+
+
+def descheduler_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="karmada-descheduler", add_help=False)
+    _common(p)
+    p.add_argument("--descheduling-interval", default="2m")
+    p.add_argument("--unschedulable-threshold", default="5m")
+    return p
+
+
+def parse_descheduler_flags(argv: Sequence[str]) -> dict:
+    ns = descheduler_parser().parse_args(argv)
+    apply_common(ns)
+    return {
+        "descheduling_interval": _duration(ns.descheduling_interval),
+        "unschedulable_threshold": _duration(ns.unschedulable_threshold),
+    }
+
+
+# -- karmada-agent (cmd/agent/app/options/options.go) ------------------------
+
+
+def agent_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="karmada-agent", add_help=False)
+    _common(p)
+    p.add_argument("--cluster-name", required=True)
+    p.add_argument("--cluster-namespace", default="karmada-cluster")
+    p.add_argument("--cluster-status-update-frequency", default="10s")
+    p.add_argument("--report-secrets", action="append",
+                   default=["KubeCredentials", "KubeImpersonator"])
+    return p
+
+
+def parse_agent_flags(argv: Sequence[str]) -> dict:
+    ns = agent_parser().parse_args(argv)
+    apply_common(ns)
+    return {
+        "cluster_name": ns.cluster_name,
+        "cluster_namespace": ns.cluster_namespace,
+        "status_update_frequency": _duration(
+            ns.cluster_status_update_frequency
+        ),
+    }
+
+
+# -- helpers -----------------------------------------------------------------
+
+
+_UNITS = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def _duration(value: str) -> float:
+    """Go duration strings ('3s', '5m', '1h30m', '500ms') -> seconds."""
+    value = value.strip()
+    total = 0.0
+    num = ""
+    i = 0
+    while i < len(value):
+        ch = value[i]
+        if ch.isdigit() or ch == ".":
+            num += ch
+            i += 1
+            continue
+        unit = ch
+        if value[i:i + 2] == "ms":
+            unit = "ms"
+        if unit not in _UNITS or not num:
+            raise ValueError(f"unparseable duration {value!r}")
+        total += float(num) * _UNITS[unit]
+        num = ""
+        i += len(unit)
+    if num:  # bare number = seconds
+        total += float(num)
+    return total

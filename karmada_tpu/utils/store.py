@@ -209,6 +209,12 @@ class Store:
         with self._lock:
             self._all_watchers.append(handler)
 
+    def unwatch_all(self, handler: WatchHandler) -> None:
+        """Unregister a watch_all handler (long-lived stores outlive bus
+        servers; a dead server's handler must not stay on the write path)."""
+        with self._lock:
+            self._all_watchers = [h for h in self._all_watchers if h is not handler]
+
     def _deliver(self, event: Event) -> None:
         for handler in list(self._watchers.get(event.kind, [])):
             handler(event)

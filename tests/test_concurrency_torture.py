@@ -129,8 +129,10 @@ class TestStoreTorture:
                 bucket = self._buckets.setdefault(kind, {})
                 existing = bucket.get(key)
                 rv = self._rv
-                for _ in range(3):  # widen the unlocked window
-                    rv = rv + 0
+                if rv % 7 == 0:
+                    import time as _t
+
+                    _t.sleep(0)  # yield: forces interleaving in the window
                 self._rv = rv + 1  # classic lost update
                 obj.meta.resource_version = self._rv
                 if not obj.meta.uid:
@@ -145,7 +147,7 @@ class TestStoreTorture:
                 return obj
 
         detected = False
-        for _ in range(3):  # adversarial scheduling is probabilistic
+        for _ in range(5):  # adversarial scheduling is probabilistic
             if _run_torture(RacyStore()):
                 detected = True
                 break

@@ -1,0 +1,118 @@
+"""Per-binary flag surfaces (cmd/*/app/options contract)."""
+
+import pytest
+
+from karmada_tpu.utils.features import FAILOVER, feature_gate
+from karmada_tpu.utils.flags import (
+    IN_TREE_PLUGINS,
+    parse_agent_flags,
+    parse_controller_manager_flags,
+    parse_descheduler_flags,
+    parse_scheduler_flags,
+    parse_star_list,
+    _duration,
+)
+
+
+class TestStarList:
+    def test_star_enables_all(self):
+        enabled, disabled = parse_star_list(["*"], IN_TREE_PLUGINS, "plugins")
+        assert enabled == set(IN_TREE_PLUGINS) and not disabled
+
+    def test_star_minus_disables_named(self):
+        enabled, disabled = parse_star_list(
+            ["*,-TaintToleration"], IN_TREE_PLUGINS, "plugins"
+        )
+        assert disabled == {"TaintToleration"}
+        assert "ClusterAffinity" in enabled
+
+    def test_explicit_list_enables_only_those(self):
+        enabled, disabled = parse_star_list(
+            ["ClusterAffinity,APIEnablement"], IN_TREE_PLUGINS, "plugins"
+        )
+        assert enabled == {"ClusterAffinity", "APIEnablement"}
+        assert "TaintToleration" in disabled
+
+    def test_unknown_name_rejected(self):
+        with pytest.raises(ValueError, match="unknown plugins"):
+            parse_star_list(["Bogus"], IN_TREE_PLUGINS, "plugins")
+
+
+class TestSchedulerFlags:
+    def test_reference_launch_args_parse(self):
+        kwargs = parse_scheduler_flags([
+            "--scheduler-name=my-scheduler",
+            "--plugins=*,-ClusterLocality",
+            "--enable-scheduler-estimator=true",
+            "--scheduler-estimator-timeout=5s",
+            "--leader-elect=false",
+        ])
+        assert kwargs["scheduler_name"] == "my-scheduler"
+        assert kwargs["disabled_plugins"] == ("ClusterLocality",)
+        assert kwargs["enable_scheduler_estimator"] is True
+        assert kwargs["scheduler_estimator_timeout_seconds"] == 5.0
+
+    def test_feature_gates_apply(self):
+        before = feature_gate.enabled(FAILOVER)
+        try:
+            parse_scheduler_flags([f"--feature-gates={FAILOVER}=true"])
+            assert feature_gate.enabled(FAILOVER)
+        finally:
+            feature_gate.set(FAILOVER, before)
+
+    def test_flags_drive_engine_plugin_gate(self):
+        """The parsed disable list reaches the engine exactly like the
+        reference's --plugins wiring (scheduler.go:243-247)."""
+        from karmada_tpu.scheduler import (
+            BindingProblem, ClusterSnapshot, TensorScheduler,
+        )
+        from karmada_tpu.utils.builders import new_cluster, duplicated_placement
+        from karmada_tpu.api.cluster import Taint
+
+        kwargs = parse_scheduler_flags(["--plugins=*,-TaintToleration"])
+        clusters = [
+            new_cluster("m1"),
+            new_cluster(
+                "m2",
+                taints=[Taint(key="k", value="v", effect="NoSchedule")],
+            ),
+        ]
+        eng = TensorScheduler(
+            ClusterSnapshot(clusters),
+            disabled_plugins=kwargs["disabled_plugins"],
+        )
+        res = eng.schedule([
+            BindingProblem(key="b", placement=duplicated_placement(),
+                           replicas=1, requests={},
+                           gvk="apps/v1/Deployment")
+        ])[0]
+        # with TaintToleration disabled the tainted cluster is feasible
+        assert set(res.clusters) == {"m1", "m2"}
+
+
+class TestOtherBinaries:
+    def test_controller_manager_controllers_grammar(self):
+        kwargs = parse_controller_manager_flags(
+            ["--controllers=*,-remedy", "--failover-eviction-timeout=3m"]
+        )
+        assert "remedy" in kwargs["disabled_controllers"]
+        assert kwargs["eviction_timeout"] == 180.0
+
+    def test_descheduler_and_agent(self):
+        d = parse_descheduler_flags(["--unschedulable-threshold=90s"])
+        assert d["unschedulable_threshold"] == 90.0
+        a = parse_agent_flags([
+            "--cluster-name=member1",
+            "--cluster-status-update-frequency=15s",
+        ])
+        assert a["cluster_name"] == "member1"
+        assert a["status_update_frequency"] == 15.0
+
+
+class TestDurations:
+    def test_go_duration_grammar(self):
+        assert _duration("500ms") == 0.5
+        assert _duration("1h30m") == 5400.0
+        assert _duration("3s") == 3.0
+        with pytest.raises(ValueError):
+            _duration("3parsecs")
