@@ -407,13 +407,19 @@ def run_engine_north_star(args) -> dict:
     )
     times = []
     results = None
+    breakdown = {}
     with trace_ctx:
         for rep in range(args.repeats):
             t0 = time.perf_counter()
             results = engine.schedule(problems)
             t1 = time.perf_counter()
             times.append(t1 - t0)
-            print(f"# pass {rep}: {t1 - t0:.3f}s", file=sys.stderr)
+            breakdown = dict(getattr(engine, "last_breakdown", {}))
+            parts = " ".join(
+                f"{k}={v * 1e3:.0f}ms" if k != "fetch_mb" else f"{k}={v:.1f}"
+                for k, v in breakdown.items()
+            )
+            print(f"# pass {rep}: {t1 - t0:.3f}s  [{parts}]", file=sys.stderr)
     p50 = float(np.median(times))
     n_sched = sum(1 for r in results if r.success)
     print(

@@ -99,7 +99,9 @@ class StoreBusServer:
         max_workers: int = 8,
     ):
         self.store = store
-        self._subscribers: list[tuple[queue.Queue, frozenset]] = []
+        # (queue, kind filter, dead flag) per subscriber; dead[0] is set when
+        # the queue overflows and forces the stream closed
+        self._subscribers: list[tuple[queue.Queue, frozenset, list]] = []
         self._lock = threading.Lock()
         store.watch_all(self._fan_out)
         self._server = grpc.server(
@@ -328,8 +330,7 @@ class StoreReplica:
         # local counter is aligned BEFORE apply so the watch event this
         # apply delivers already carries the primary rv (the stream thread
         # is the store's only writer)
-        with self.store._lock:
-            self.store._rv = max(self.store._rv, ev.resource_version - 1)
+        self.store.advance_rv(ev.resource_version)
         self.store.apply(obj)
         obj.meta.resource_version = ev.resource_version
 

@@ -142,10 +142,12 @@ class BindingController:
         self.interpreter = interpreter
         self.work_index = work_index or WorkIndex(store)
         self.overrides = OverrideManager(store)
-        # binding ref -> (global fingerprint, {cluster: replicas}) of the
-        # last ensureWork pass: an incremental storm (scale +1) changes one
-        # target's count, so only that Work is rebuilt instead of revising/
-        # overriding/cloning the template once per target per reconcile.
+        # binding ref -> (global fingerprint, {cluster: (replicas,
+        # cluster_token)}) of the last ensureWork pass: an incremental storm
+        # (scale +1) changes one target's count, so only that Work is
+        # rebuilt instead of revising/overriding/cloning the template once
+        # per target per reconcile. cluster_token covers the live cluster
+        # fields override rules match on (labels/provider/region/zone).
         # Keyed on template (uid, generation) — the plane's spec-change
         # discipline (the scheduler gate relies on generation the same way).
         self._built: dict[str, tuple] = {}
@@ -172,6 +174,58 @@ class BindingController:
             replay=False,
         )
         store.watch("Work", self._on_work_event, replay=False)
+        # override rules match live cluster state: a label / topology edit
+        # must requeue the bindings whose Works were built against the old
+        # state (status heartbeats leave the token unchanged and are cheap)
+        store.watch("Cluster", self._on_cluster_event, replay=False)
+        self._cluster_tokens: dict[str, tuple] = {}
+
+    @staticmethod
+    def _cluster_token(cluster) -> Optional[tuple]:
+        """The live cluster fields override rules can match on
+        (ClusterAffinity: name/labels, FieldSelector: provider/region/zone).
+        Both the build cache and the Cluster watch compare THIS tuple — keep
+        them in lockstep via this single constructor."""
+        if cluster is None:
+            return None
+        return (
+            tuple(sorted(cluster.meta.labels.items())),
+            cluster.spec.provider,
+            cluster.spec.region,
+            cluster.spec.zone,
+        )
+
+    _UNSEEDED = object()
+
+    def _lookup_cluster_token(self, name: str) -> Optional[tuple]:
+        """Cached token for cache-hit targets: the Cluster watch keeps the
+        map current (synchronous delivery on the applying thread), so
+        steady-storm reconciles pay one dict get per target instead of a
+        store fetch + label sort. Lazily seeded from the store for clusters
+        that have produced no event since startup."""
+        tok = self._cluster_tokens.get(name, self._UNSEEDED)
+        if tok is self._UNSEEDED:
+            tok = self._cluster_token(self.store.get("Cluster", name))
+            self._cluster_tokens[name] = tok
+        return tok
+
+    def _on_cluster_event(self, event) -> None:
+        name = event.key
+        if event.type == "Deleted":
+            # tombstone (not pop): the post-build race check must see the
+            # deletion, and a later re-join overwrites it
+            self._cluster_tokens[name] = None
+            token = None
+        else:
+            token = self._cluster_token(event.obj)
+            if self._cluster_tokens.get(name) == token:
+                return  # status-only change: override matching unaffected
+            self._cluster_tokens[name] = token
+        for ref, (_fp, built_targets) in list(self._built.items()):
+            entry = built_targets.get(name)
+            if entry is not None and entry[1] != token:
+                kind, _, key = ref.partition(":")
+                self.worker.enqueue((kind, key))
 
     def _on_work_event(self, event) -> None:
         # an externally deleted Work must be rebuilt even though the build
@@ -236,8 +290,19 @@ class BindingController:
         )
         prev_global, prev_targets = self._built.get(ref, (None, None))
         unchanged = prev_global == fp_global and prev_targets is not None
+        built_targets: dict[str, tuple] = {}
         for cluster_name, replicas in targets.items():
-            if unchanged and prev_targets.get(cluster_name, -1) == replicas:
+            # apply_overrides matches rules against LIVE cluster state
+            # (name / labels / provider / region / zone), so the per-target
+            # cache entry carries a token over those fields: a cluster label
+            # edit that flips an override rule's match rebuilds exactly the
+            # Works on that cluster instead of going stale forever
+            cluster_token = self._lookup_cluster_token(cluster_name)
+            if unchanged and prev_targets.get(cluster_name) == (
+                replicas,
+                cluster_token,
+            ):
+                built_targets[cluster_name] = (replicas, cluster_token)
                 continue  # this target's Work is already up to date
             # every transform below (revise_replica, apply_overrides)
             # returns a fresh object, so the template is cloned lazily:
@@ -254,14 +319,28 @@ class BindingController:
                     workload.spec["completions"] = math.ceil(
                         total * replicas / max(rb.spec.replicas, 1)
                     )
+            # rebuild path: fetch the live object and stamp the token of the
+            # state the Work is ACTUALLY built against
             cluster_obj = self.store.get("Cluster", cluster_name)
+            built_targets[cluster_name] = (
+                replicas, self._cluster_token(cluster_obj),
+            )
             if cluster_obj is not None:
                 workload = self.overrides.apply_overrides(workload, cluster_obj)
             if workload is template:
                 workload = clone_resource(template)
             self._create_or_update_work(rb, kind, cluster_name, workload)
         self._cleanup_works(ref, keep_clusters=set(targets) | evicting)
-        self._built[ref] = (fp_global, dict(targets))
+        self._built[ref] = (fp_global, built_targets)
+        # close the build/event race: a Cluster event landing mid-build found
+        # no _built entry to requeue against, and this reconcile may have
+        # built against the pre-event object — re-check the freshly written
+        # tokens against the watch-maintained map and requeue on divergence
+        for name, (_reps, tok) in built_targets.items():
+            cur = self._cluster_tokens.get(name, self._UNSEEDED)
+            if cur is not self._UNSEEDED and cur != tok:
+                self.worker.enqueue((kind, key))
+                break
         return DONE
 
     # replica fields the per-target ReviseReplica pass overwrites; a

@@ -37,6 +37,11 @@ from typing import Any, Callable, Optional
 
 MAX_FUEL = 500_000  # AST-step budget per invocation
 MAX_ITERATIONS = 100_000  # per-loop bound
+# Single-value size ceiling (chars / elements). Fuel meters AST steps, not
+# the cost of one step: every op that can grow a value at C speed (seq
+# concat, repetition, extend/replace/join) is pre-checked against this cap
+# BEFORE allocating, so `s = s + s` doubling cannot outrun the fuel meter.
+MAX_VALUE_SIZE = 10**7
 
 _ALLOWED_NODES = (
     ast.Module, ast.FunctionDef, ast.arguments, ast.arg, ast.Return,
@@ -155,11 +160,26 @@ def _kube_get_pod_dependencies(template: Any, namespace: Any = "") -> list:
     ]
 
 
+def _bounded_sum(iterable, start=0):
+    # sum() with a sequence start concatenates at C speed in one AST step;
+    # numeric sums over bounded iterables are fine, sequence accumulation
+    # must respect the value-size cap
+    if isinstance(start, (str, list, tuple)):
+        items = list(iterable)
+        total = len(start) + sum(
+            len(x) for x in items if isinstance(x, (str, list, tuple))
+        )
+        if total > MAX_VALUE_SIZE:
+            raise ScriptError("sum result too large")
+        return sum(items, start)
+    return sum(iterable, start)
+
+
 _SAFE_BUILTINS: dict[str, Any] = {
     "len": lambda x: 0 if _is_nil(x) else len(x),
     "min": min,
     "max": max,
-    "sum": sum,
+    "sum": _bounded_sum,
     "abs": abs,
     "round": round,
     "int": lambda x=0: 0 if _is_nil(x) else int(x),
@@ -423,14 +443,42 @@ class ExprVM:
             if left.bit_length() + right.bit_length() > 1 << 16:
                 raise ScriptError("integer operands too large")
         elif isinstance(left, (str, list, tuple)) and isinstance(right, int):
-            if len(left) * max(right, 1) > 10**7:
+            if len(left) * max(right, 1) > MAX_VALUE_SIZE:
                 raise ScriptError("sequence repetition too large")
         elif isinstance(right, (str, list, tuple)) and isinstance(left, int):
-            if len(right) * max(left, 1) > 10**7:
+            if len(right) * max(left, 1) > MAX_VALUE_SIZE:
                 raise ScriptError("sequence repetition too large")
+
+    @staticmethod
+    def _format_guard(fmt: str, args: Any) -> None:
+        """'%999999999d' % 1 allocates ~1GB in one AST step. Bound the
+        printf path: cap explicit width/precision digit runs in the format
+        string, and cap the magnitude of int args when '*' (dynamic
+        width/precision) appears."""
+        import re
+
+        if len(fmt) > MAX_VALUE_SIZE:
+            raise ScriptError("format string too large")
+        for width, precision in re.findall(
+            r"%(?:\([^)]*\))?[-+ #0]*(\d*)(?:\.(\d*))?", fmt
+        ):
+            if (width and int(width) > 10**6) or (
+                precision and int(precision) > 10**6
+            ):
+                raise ScriptError("format width too large")
+        if "*" in fmt:
+            seq = args if isinstance(args, tuple) else (args,)
+            for a in seq:
+                if isinstance(a, int) and abs(a) > 10**6:
+                    raise ScriptError("dynamic format width too large")
 
     def _apply_binop(self, op: ast.operator, left: Any, right: Any) -> Any:
         if isinstance(op, ast.Add):
+            if isinstance(left, (str, list, tuple)) and isinstance(
+                right, (str, list, tuple)
+            ):
+                if len(left) + len(right) > MAX_VALUE_SIZE:
+                    raise ScriptError("concatenation result too large")
             return left + right
         if isinstance(op, ast.Sub):
             return left - right
@@ -442,6 +490,8 @@ class ExprVM:
         if isinstance(op, ast.FloorDiv):
             return left // right
         if isinstance(op, ast.Mod):
+            if isinstance(left, str):
+                self._format_guard(left, right)
             return left % right
         if isinstance(op, ast.Pow):
             if abs(_num(right)) > 64:
@@ -614,10 +664,54 @@ class ExprVM:
         tp = type(obj)
         allowed = _METHOD_WHITELIST.get(tp)
         if allowed is not None and attr in allowed:
+            bounded = _BOUNDED_METHODS.get((tp, attr))
+            if bounded is not None:
+                return lambda *args: bounded(obj, *args)
             return getattr(obj, attr)
         raise ScriptError(
             f"attribute {attr!r} is not allowed on {tp.__name__}"
         )
+
+
+def _bounded_extend(obj: list, iterable: Any) -> None:
+    items = list(iterable)
+    if len(obj) + len(items) > MAX_VALUE_SIZE:
+        raise ScriptError("extend result too large")
+    obj.extend(items)
+
+
+def _bounded_replace(obj: str, old: str, new: str, count: int = -1) -> str:
+    # pre-check the worst-case result length before the C-speed allocation:
+    # s.replace(a, s) multiplies len(s) by the occurrence count in one step
+    old = str(old)
+    new = str(new)
+    if not old:
+        occurrences = len(obj) + 1
+    else:
+        occurrences = obj.count(old)
+    if count >= 0:
+        occurrences = min(occurrences, count)
+    grown = len(obj) + occurrences * max(len(new) - len(old), 0)
+    if grown > MAX_VALUE_SIZE:
+        raise ScriptError("replace result too large")
+    return obj.replace(old, new, count)
+
+
+def _bounded_join(obj: str, iterable: Any) -> str:
+    parts = [str(p) for p in iterable]
+    total = sum(len(p) for p in parts) + len(obj) * max(len(parts) - 1, 0)
+    if total > MAX_VALUE_SIZE:
+        raise ScriptError("join result too large")
+    return obj.join(parts)
+
+
+# growth-capable whitelisted methods routed through pre-checked wrappers;
+# everything else on the whitelist is size-bounded by its receiver already
+_BOUNDED_METHODS: dict[tuple[type, str], Callable] = {
+    (list, "extend"): _bounded_extend,
+    (str, "replace"): _bounded_replace,
+    (str, "join"): _bounded_join,
+}
 
 
 _METHOD_WHITELIST: dict[type, frozenset] = {

@@ -349,9 +349,7 @@ class MetricsAdapter:
         ]
 
     def external_metric_sum(self, metric_name: str) -> Optional[float]:
-        samples = [
-            s for s in self.custom_metric(metric_name)
-        ] + self.external.get_external_metric("", metric_name)
-        if not samples:
-            return None
-        return sum(s.value for s in samples)
+        # external surface only, root scope: folding custom-metric series in
+        # here double-counted a name present on both surfaces (and counted
+        # per-object custom series into one scalar)
+        return self.external.external_metric_sum("", metric_name)

@@ -231,17 +231,23 @@ class TensorScheduler:
         )
 
     def schedule(self, problems: Sequence[BindingProblem]) -> list[ScheduleResult]:
+        import time as _time
+
+        t0 = _time.perf_counter()
         compiled = [self._compiled(p.placement) for p in problems]
+        self.last_breakdown = {"compile": _time.perf_counter() - t0}
         # engine-level features that the device-resident path does not
         # model force the general host path for the whole batch
         if not (
             self.custom_filters or self.extra_estimators or self.disabled_plugins
         ):
+            t0 = _time.perf_counter()
             fast_idx = [
                 i
                 for i, (p, cp) in enumerate(zip(problems, compiled))
                 if self._fleet_eligible(p, cp)
             ]
+            self.last_breakdown["eligible"] = _time.perf_counter() - t0
             if len(fast_idx) >= self.fleet_threshold:
                 from .fleet import FleetTable
 
@@ -251,6 +257,7 @@ class TensorScheduler:
                     [problems[i] for i in fast_idx],
                     [compiled[i] for i in fast_idx],
                 )
+                self.last_breakdown.update(self._fleet.last_breakdown)
                 if len(fast_idx) == len(problems):
                     # all rows rode the fleet: hand back the lazy
                     # column-oriented result list as-is

@@ -243,7 +243,12 @@ class _FleetBatch:
 
     def feasible_names(self, pos: int) -> tuple:
         if self._bits_np is None:
-            self._bits_np = np.ascontiguousarray(np.asarray(self._bits_dev))
+            # force little-endian word layout before the byte view so the
+            # bit positions are host-endianness-independent (the entry
+            # stream is decoded with shifts for the same reason)
+            self._bits_np = np.ascontiguousarray(
+                np.asarray(self._bits_dev).astype("<u4", copy=False)
+            )
         row = self._bits_np[pos]
         idx = np.nonzero(
             np.unpackbits(row.view(np.uint8), bitorder="little")
@@ -434,6 +439,8 @@ class FleetTable:
         self._last_total = 0
         self._e_cap_cur: Optional[int] = None
         self._shrink_votes = 0
+        # per-phase wall times of the last pass (bench breakdown surface)
+        self.last_breakdown: dict[str, float] = {}
 
     # -- rows --------------------------------------------------------------
 
@@ -670,6 +677,10 @@ class FleetTable:
     # -- scheduling --------------------------------------------------------
 
     def schedule(self, problems: Sequence, compiled: Sequence) -> list:
+        import time as _time
+
+        tmr: dict[str, float] = {}
+        t0 = _time.perf_counter()
         self._pass += 1
         # reclaim rows of deleted/idle bindings before the table would grow
         # (compaction reindexes rows, so it must run before any upsert of
@@ -685,7 +696,11 @@ class FleetTable:
             np.int32,
             len(problems),
         )
+        tmr["upsert"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
         self._sync_device()
+        tmr["sync"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
         n = len(rows_np)
         # adaptive chunk: a straggler batch of a few hundred rows should
         # not execute a full 4096-row chunk (pow2 snapping keeps the trace
@@ -799,18 +814,30 @@ class FleetTable:
                 return total, meta, entries
             return int(arr[0]), arr[1 : 1 + slice_rows], arr[1 + slice_rows :]
 
+        tmr["prep"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
         pending = [solve(rs, e_cap) for rs in slices]
+        tmr["dispatch"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
         metas, entry_bufs, bit_bufs, totals = [], [], [], []
+        fetched_bytes = 0
         for s, (flat, bits) in enumerate(pending):
-            total, m, e = decode(np.asarray(flat))
+            raw = np.asarray(flat)
+            fetched_bytes += raw.nbytes
+            total, m, e = decode(raw)
             if total > e_cap:  # overflow: rerun this slice at the safe bound
                 flat, bits = solve(slices[s], cap_round(safe))
-                total, m, e = decode(np.asarray(flat))
+                raw = np.asarray(flat)
+                fetched_bytes += raw.nbytes
+                total, m, e = decode(raw)
             assert total <= len(e), (total, e_cap)
             totals.append(total)
             metas.append(m)
             entry_bufs.append(e)
             bit_bufs.append(bits)
+        tmr["fetch"] = _time.perf_counter() - t0
+        tmr["fetch_mb"] = fetched_bytes / 1e6
+        t0 = _time.perf_counter()
         self._last_total = max(totals)
         meta = np.concatenate(metas) if n_slices > 1 else metas[0]
         n_placed = (meta & 0xFF).astype(np.int64)
@@ -828,6 +855,8 @@ class FleetTable:
             for s in range(n_slices)
         ]
         terms = [self._terms[r] for r in rows_np]
+        tmr["post"] = _time.perf_counter() - t0
+        self.last_breakdown = tmr
         return _FleetResultList(
             problems, terms, batches, slice_rows, n_placed, unsched,
             has_cand, is_dup,

@@ -68,6 +68,15 @@ class Store:
 
     # -- mutation ----------------------------------------------------------
 
+    def advance_rv(self, rv: int) -> None:
+        """Advance the resource-version counter to at least ``rv - 1`` so the
+        NEXT apply stamps ``rv``. Public seam for replicas mirroring a
+        primary's version stream (bus StoreReplica): the replica aligns the
+        counter before each replayed apply so its objects carry the
+        primary's rvs without reaching into Store internals."""
+        with self._lock:
+            self._rv = max(self._rv, rv - 1)
+
     def apply(self, obj: Any) -> Any:
         """Create-or-update. Bumps resource_version; bumps generation when a
         spec is present and changed is not detectable (callers that mutate

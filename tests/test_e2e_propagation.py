@@ -167,6 +167,70 @@ class TestOverrides:
         assert img1 == "docker.io/nginx:1.25"
         assert img2 == "registry.eu.example.com/nginx:1.25"
 
+    def test_cluster_label_edit_rebuilds_overridden_work(self):
+        """Override rules match LIVE cluster labels: editing a cluster's
+        labels after propagation must rebuild that cluster's Works (the
+        build cache carries a cluster-state token; round-2 advisor
+        finding)."""
+        from karmada_tpu.api.policy import LabelSelector
+
+        cp = make_plane(2)
+        cp.store.apply(new_deployment("app", replicas=1, image="docker.io/nginx:1.25"))
+        cp.store.apply(nginx_policy(duplicated_placement()))
+        cp.store.apply(
+            OverridePolicy(
+                meta=ObjectMeta(name="edge-override", namespace="default"),
+                spec=OverrideSpec(
+                    resource_selectors=[
+                        ResourceSelector(api_version="apps/v1", kind="Deployment")
+                    ],
+                    override_rules=[
+                        RuleWithCluster(
+                            target_cluster=ClusterAffinity(
+                                label_selector=LabelSelector(
+                                    match_labels={"tier": "edge"}
+                                )
+                            ),
+                            overriders=Overriders(
+                                image_overrider=[
+                                    ImageOverrider(
+                                        component="Registry",
+                                        operator="replace",
+                                        value="edge.example.com",
+                                    )
+                                ]
+                            ),
+                        )
+                    ],
+                ),
+            )
+        )
+        cp.settle()
+        img = (
+            cp.members.get("member1")
+            .get("apps/v1/Deployment", "default", "app")
+            .spec["template"]["spec"]["containers"][0]["image"]
+        )
+        assert img == "docker.io/nginx:1.25"  # no label yet: rule inert
+        # flip the cluster label so the override rule starts matching
+        cluster = cp.store.get("Cluster", "member1")
+        cluster.meta.labels["tier"] = "edge"
+        cp.store.apply(cluster)
+        cp.settle()
+        img = (
+            cp.members.get("member1")
+            .get("apps/v1/Deployment", "default", "app")
+            .spec["template"]["spec"]["containers"][0]["image"]
+        )
+        assert img == "edge.example.com/nginx:1.25"
+        # and member2 (unlabelled) is untouched
+        img2 = (
+            cp.members.get("member2")
+            .get("apps/v1/Deployment", "default", "app")
+            .spec["template"]["spec"]["containers"][0]["image"]
+        )
+        assert img2 == "docker.io/nginx:1.25"
+
 
 class TestFailover:
     def test_cluster_failover_evicts_and_reschedules(self):

@@ -43,6 +43,57 @@ class TestSandbox:
         with pytest.raises(ScriptError, match="budget|bound"):
             vm.call("f")
 
+    def test_exponential_growth_via_add_is_bounded(self):
+        # `s = s + s` doubles per fuel unit at C speed — the fuel meter alone
+        # cannot stop it before the allocation outruns memory
+        for src in (
+            "def f():\n    s = 'x' * 1000\n    for i in range(40):\n        s = s + s\n",
+            "def f():\n    l = [1] * 1000\n    for i in range(40):\n        l = l + l\n",
+            "def f():\n    s = 'x' * 1000\n    for i in range(40):\n        s += s\n",
+        ):
+            with pytest.raises(ScriptError, match="too large"):
+                ExprVM(src).call("f")
+
+    def test_growth_methods_are_bounded(self):
+        for src in (
+            # list.extend(l) doubles per call
+            "def f():\n    l = [1] * 1000\n    for i in range(40):\n        l.extend(l)\n",
+            # str.replace(a, s) squares in one call
+            "def f():\n    s = 'a' * 100000\n    return s.replace('a', s)\n",
+            # str.join multiplies in one call
+            "def f():\n    s = 'a' * 100000\n    return s.join([s] * 1000)\n",
+        ):
+            with pytest.raises(ScriptError, match="too large"):
+                ExprVM(src).call("f")
+
+    def test_builtin_growth_bypasses_are_bounded(self):
+        # sum() with a sequence start concatenates at C speed in one step
+        with pytest.raises(ScriptError, match="too large"):
+            ExprVM(
+                "def f():\n    l = [1] * 100000\n    return sum([l] * 200, [])\n"
+            ).call("f")
+        # printf width allocates the result in one step
+        with pytest.raises(ScriptError, match="width too large"):
+            ExprVM("def f():\n    return '%999999999d' % 1\n").call("f")
+        with pytest.raises(ScriptError, match="width too large"):
+            ExprVM("def f():\n    return '%*d' % (1000000000, 1)\n").call("f")
+        # normal uses unaffected
+        assert ExprVM("def f():\n    return sum([1, 2, 3])\n").call("f") == 6
+        assert (
+            ExprVM("def f():\n    return 'id-%05d of 100000' % 7\n").call("f")
+            == "id-00007 of 100000"
+        )
+
+    def test_bounded_methods_still_work_for_normal_sizes(self):
+        vm = ExprVM(
+            "def f():\n"
+            "    l = [1, 2]\n"
+            "    l.extend([3, 4])\n"
+            "    s = 'a-b-c'.replace('-', '.')\n"
+            "    return ','.join(['x', 'y']) + s + str(l[3])\n"
+        )
+        assert vm.call("f") == "x,ya.b.c4"
+
     def test_nil_semantics_match_lua_field_access(self):
         vm = ExprVM(
             "def f(obj):\n"
