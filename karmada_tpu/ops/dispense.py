@@ -147,11 +147,35 @@ def take_by_weight_fast(
     sites = None
     if with_idx:
         key = (weights << (l_bits + i_bits)) | (last << i_bits) | (c - 1 - idx)
-        top_vals = lax.top_k(key, k_top)[0]
-        pos = jnp.clip(remain - 1, 0, k_top - 1)
-        thr = top_vals[pos]
-        bonus = ((key >= thr) & (remain > 0)).astype(jnp.int32)
-        if return_sites:
+        if not return_sites:
+            # the bonus set is exactly {key >= (remain-th largest key)}, and
+            # because the packed key is a strict total order that threshold
+            # is found EXACTLY by a 31-step binary search over the key space
+            # (count of keys >= mid is monotone) — measured ~5x cheaper than
+            # lax.top_k on the v5e at C=5k, and bit-for-bit identical
+            hi_bits = w_bits + l_bits + i_bits
+
+            def srch(_, lohi):
+                lo, hi = lohi
+                # upper mid via hi - (hi-lo)//2: lo + (hi-lo+1) overflows
+                # int32 when the key space spans the full 31 bits
+                mid = hi - (hi - lo) // 2
+                cnt = jnp.sum((key >= mid).astype(jnp.int32))
+                ge = cnt >= jnp.maximum(remain, 1)
+                return jnp.where(ge, mid, lo), jnp.where(ge, hi, mid - 1)
+
+            thr, _ = lax.fori_loop(
+                0,
+                hi_bits + 1,
+                srch,
+                (jnp.int32(0), jnp.int32((1 << hi_bits) - 1)),
+            )
+            bonus = ((key >= thr) & (remain > 0)).astype(jnp.int32)
+        else:
+            top_vals = lax.top_k(key, k_top)[0]
+            pos = jnp.clip(remain - 1, 0, k_top - 1)
+            thr = top_vals[pos]
+            bonus = ((key >= thr) & (remain > 0)).astype(jnp.int32)
             sites = (c - 1) - (top_vals & ((1 << i_bits) - 1))
     else:
         key = (weights << l_bits) | last

@@ -278,6 +278,10 @@ class CompiledPlacement:
     strategy: int = S_DUPLICATED
     static_weights: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
     spread_constraints: list[SpreadConstraint] = field(default_factory=list)
+    # single-affinity-term + no effective spread constraints: the
+    # placement-level half of the fleet fast-path gate, precomputed by
+    # TensorScheduler._compiled (the per-problem check is a hot loop)
+    fleet_single_term: bool = False
 
 
 def compile_placement(placement: Optional[Placement], snap: ClusterSnapshot) -> CompiledPlacement:
