@@ -52,6 +52,10 @@ class ControlPlane:
         enable_member_hpa_sync: bool = False,
         eviction_timeout: float = 600.0,
         clock=None,
+        # Pull-cluster lease staleness threshold (ClusterLeaseDuration
+        # analogue); process-level harnesses shorten it so agent-death
+        # failover is observable in wall-clock test time
+        lease_grace_seconds: float = None,
         # --plugins enable/disable list + out-of-tree filter plugins
         # (cmd/scheduler/app/options/options.go:130-165 analogue)
         disabled_scheduler_plugins=(),
@@ -96,8 +100,14 @@ class ControlPlane:
             self.store, self.runtime, self.detector,
             work_index=self.work_index,
         )
+        status_kw = (
+            {"lease_grace_seconds": lease_grace_seconds}
+            if lease_grace_seconds is not None
+            else {}
+        )
         self.cluster_status_controller = ClusterStatusController(
-            self.store, self.runtime, self.members, clock=self.clock
+            self.store, self.runtime, self.members, clock=self.clock,
+            **status_kw,
         )
         self.cluster_controller = ClusterController(self.store, self.runtime)
         self.taint_manager = TaintManager(self.store, self.runtime, clock=self.clock)
@@ -214,13 +224,23 @@ class ControlPlane:
 
     # -- cluster lifecycle (karmadactl join/unjoin analogue) ---------------
 
-    def join_cluster(self, cluster: Cluster, member: Optional[MemberCluster] = None):
+    def join_cluster(
+        self,
+        cluster: Cluster,
+        member: Optional[MemberCluster] = None,
+        *,
+        remote_agent: bool = False,
+    ):
         """Register a member. Push mode: the control plane owns the client
         (karmadactl join); Pull mode: a KarmadaAgent runs "inside" the member
-        and drives the work application itself (karmadactl register)."""
+        and drives the work application itself (karmadactl register).
+        ``remote_agent`` marks a Pull member whose agent runs OUT of process
+        (python -m karmada_tpu.bus.agent over the store bus) — the plane
+        registers only the inventory shell and never constructs a local
+        agent; the real member state lives in the agent's process."""
         member = member or MemberCluster(cluster.name)
         self.members.register(member)
-        if cluster.spec.sync_mode == "Pull":
+        if cluster.spec.sync_mode == "Pull" and not remote_agent:
             from .controllers.remedy import KarmadaAgent
 
             self.agents = getattr(self, "agents", {})

@@ -1,0 +1,58 @@
+"""Estimator server process: ``python -m karmada_tpu.estimator``.
+
+Ref: cmd/scheduler-estimator — one estimator deployment per member cluster,
+serving MaxAvailableReplicas / GetUnschedulableReplicas over gRPC from the
+member's node/pod state. In this simulated world the member's nodes are
+synthesized in-process (the node-informer stand-in); the wire contract and
+the scheduler-side fan-out are the real thing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .accurate import AccurateEstimator, NodeSnapshot, NodeState
+from .grpc_transport import EstimatorGrpcServer
+from .service import EstimatorService
+
+DIMS = ["cpu", "memory", "pods", "ephemeral-storage"]
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="karmada-tpu estimator server")
+    p.add_argument("--cluster", required=True)
+    p.add_argument("--address", default="127.0.0.1:0")
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument("--cpu", type=int, default=16000, help="milli-cpu per node")
+    p.add_argument("--memory", type=int, default=64 << 30)
+    p.add_argument("--pods", type=int, default=110)
+    args = p.parse_args(argv)
+
+    nodes = [
+        NodeState(
+            name=f"{args.cluster}-node-{i}",
+            allocatable={
+                "cpu": args.cpu,
+                "memory": args.memory,
+                "pods": args.pods,
+                "ephemeral-storage": 100 << 30,
+            },
+        )
+        for i in range(args.nodes)
+    ]
+    est = AccurateEstimator(args.cluster, NodeSnapshot(nodes, DIMS))
+    server = EstimatorGrpcServer(EstimatorService(est), args.address)
+    port = server.start()
+    # the parent process scrapes this line to learn the bound port
+    print(f"estimator {args.cluster} listening on port {port}", flush=True)
+    try:
+        server._server.wait_for_termination()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -238,3 +238,69 @@ def conventional_target(prefix: str, cluster: str, port: int, host: str = "") ->
     so DNS or /etc/hosts resolves it, tests pass an explicit host)."""
     name = f"{prefix}-{cluster}"
     return f"{host or name}:{port}"
+
+
+class RemoteAccurateEstimator:
+    """EstimatorRegistry-compatible adapter over a gRPC connection: the
+    scheduler-side face of an estimator SERVER running in another process
+    (per-member deployment; ref client/accurate.go SchedulerEstimator).
+
+    ``max_available_replicas`` interns the request batch to its unique
+    profiles and issues one MaxAvailableReplicas RPC per profile — the
+    reference queries per binding; batching per profile is the same answer
+    at orders fewer round-trips. Unreachable estimators answer -1
+    (UnauthenticReplica, client/interface.go:30) so the min-merge ignores
+    them instead of blocking scheduling."""
+
+    def __init__(self, cluster_name: str, conn, dims_provider):
+        import numpy as _np
+
+        self.cluster_name = cluster_name
+        self.conn = conn
+        self.dims_provider = dims_provider  # () -> list[str] snapshot dims
+        self.unschedulable: dict[str, int] = {}
+        self._np = _np
+
+    def max_available_replicas(self, requirements, requests_batch=None):
+        np_ = self._np
+        if requests_batch is None:
+            req = dict(requirements.resource_request) if requirements else {}
+            try:
+                resp = self.conn.call(
+                    "MaxAvailableReplicas",
+                    MaxAvailableReplicasRequest(
+                        cluster=self.cluster_name, resource_request=req
+                    ),
+                )
+                return np_.asarray([resp.max_replicas], np_.int32)
+            except Exception:  # noqa: BLE001 — wire failure = no answer
+                return np_.asarray([-1], np_.int32)
+        dims = list(self.dims_provider())
+        batch = np_.asarray(requests_batch, np_.int64)
+        uniq, inv = np_.unique(batch, axis=0, return_inverse=True)
+        per_prof = np_.empty(len(uniq), np_.int32)
+        for u, row in enumerate(uniq):
+            req = {d: int(q) for d, q in zip(dims, row) if q > 0}
+            try:
+                resp = self.conn.call(
+                    "MaxAvailableReplicas",
+                    MaxAvailableReplicasRequest(
+                        cluster=self.cluster_name, resource_request=req
+                    ),
+                )
+                per_prof[u] = resp.max_replicas
+            except Exception:  # noqa: BLE001
+                per_prof[u] = -1
+        return per_prof[inv]
+
+    def get_unschedulable_replicas(self, namespace: str, name: str) -> int:
+        try:
+            resp = self.conn.call(
+                "GetUnschedulableReplicas",
+                UnschedulableReplicasRequest(
+                    cluster=self.cluster_name, namespace=namespace, name=name
+                ),
+            )
+            return resp.unschedulable_replicas
+        except Exception:  # noqa: BLE001
+            return 0

@@ -1,0 +1,313 @@
+"""Multi-process local-up: the hack/local-up-karmada.sh analogue.
+
+Ref: hack/local-up-karmada.sh:33-46 boots a full multi-process Karmada
+(apiserver + controller-manager + scheduler + webhook + agent in kind
+clusters); hack/run-e2e.sh:44-56 then drives 36 e2e suites against it.
+
+This module composes the TPU-native plane the same way, as REAL OS
+processes wired only by network surfaces:
+
+- the PLANE process (``python -m karmada_tpu.localup serve``) runs the
+  store + controller fleet + scheduler and serves three network surfaces:
+  the store bus (gRPC watch/apply), the cluster proxy (HTTP), and
+  /metrics (Prometheus text);
+- a SOLVER sidecar process (``python -m karmada_tpu.solver``) owns the
+  Score/Assign engine; the plane routes scheduling over gRPC with
+  snapshot-version fencing;
+- an ESTIMATOR server process (``python -m karmada_tpu.estimator``) per
+  designated member answers MaxAvailableReplicas over gRPC;
+- a pull-mode AGENT process (``python -m karmada_tpu.bus.agent``) mirrors
+  the plane over the bus and drives its member cluster.
+
+``LocalUp`` is the orchestrator: it spawns the children, scrapes their
+ports, and exposes the endpoints — used by the CLI (``local-up
+--processes``) and by tests/test_localup_processes.py, which drives the
+quickstart through the network surfaces only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+
+def _scrape_port(proc: subprocess.Popen, pattern: str, timeout: float = 30.0) -> int:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"child exited rc={proc.returncode} before printing a port"
+                )
+            continue
+        m = re.search(pattern, line)
+        if m:
+            return int(m.group(1))
+    raise RuntimeError(f"no port line matching {pattern!r} within {timeout}s")
+
+
+# --------------------------------------------------------------------------
+# the plane process
+# --------------------------------------------------------------------------
+
+
+def serve_plane(args) -> None:
+    """Run the control plane + its network surfaces until SIGTERM."""
+    from .bus.service import StoreBusServer
+    from .cli import cmd_init, cmd_join
+    from .controlplane import ControlPlane  # noqa: F401 (docs)
+    from .search.proxyserver import ClusterProxyServer
+    from .utils.builders import new_cluster
+    from .utils.metrics import MetricsServer
+
+    if args.feature_gates:
+        from .utils.features import feature_gate
+
+        for spec in args.feature_gates.split(","):
+            name, _, val = spec.partition("=")
+            feature_gate.set(name.strip(), val.strip().lower() in ("1", "true", ""))
+
+    solver = None
+    if args.solver:
+        from .solver.client import RemoteSolver
+
+        solver = RemoteSolver(args.solver)
+    cp = cmd_init(solver=solver, enable_descheduler=args.descheduler,
+                  lease_grace_seconds=args.lease_grace or None)
+    for i in range(1, args.members + 1):
+        cmd_join(cp, f"member{i}", cpu="100", memory="200Gi")
+    for name in args.pull:
+        cluster = new_cluster(name, cpu="100", memory="200Gi")
+        cluster.spec.sync_mode = "Pull"
+        cp.join_cluster(cluster, remote_agent=True)
+
+    # remote estimator registrations: NAME=HOST:PORT
+    if args.estimator:
+        from .estimator.grpc_transport import (
+            GrpcEstimatorConnection,
+            RemoteAccurateEstimator,
+        )
+
+        for spec in args.estimator:
+            name, _, target = spec.partition("=")
+            conn = GrpcEstimatorConnection(name, target)
+            cp.estimators.register(
+                RemoteAccurateEstimator(
+                    name, conn, lambda: cp.scheduler.snapshot.dims
+                )
+            )
+        names = sorted(cp.members.names())
+        cp.scheduler.extra_estimators = [
+            cp.estimators.make_batch_estimator(names)
+        ]
+
+    bus = StoreBusServer(cp.store, args.bus_address)
+    bus_port = bus.start()
+    proxy = ClusterProxyServer(cp.members, tokens={"admin-token": ("admin", ["system:masters"])})
+    proxy_port = proxy.start()
+    metrics = MetricsServer()
+    metrics_port = metrics.start()
+    cp.settle()
+    print(
+        json.dumps(
+            {
+                "bus": bus_port,
+                "proxy": proxy_port,
+                "metrics": metrics_port,
+                "clusters": sorted(c.name for c in cp.store.list("Cluster")),
+            }
+        ),
+        flush=True,
+    )
+
+    stop = [False]
+
+    def on_term(signum, frame):
+        stop[0] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop[0]:
+            cp.settle()
+            time.sleep(args.loop_interval)
+    finally:
+        metrics.stop()
+        proxy.stop()
+        bus.stop()
+
+
+# --------------------------------------------------------------------------
+# the orchestrator
+# --------------------------------------------------------------------------
+
+
+class LocalUp:
+    """Spawn the full multi-process deployment; context-manager teardown.
+
+    Children: solver sidecar, one estimator (member1), the plane (bus +
+    proxy + metrics), one pull agent. All wiring is host:port — nothing
+    shares memory with anything else."""
+
+    def __init__(
+        self,
+        members: int = 2,
+        pull: tuple[str, ...] = ("pull1",),
+        with_solver: bool = True,
+        with_estimator: bool = True,
+        descheduler: bool = False,
+        lease_grace: float = 0.0,
+        feature_gates: str = "Failover=true",
+    ):
+        self.lease_grace = lease_grace
+        self.feature_gates = feature_gates
+        self.members = members
+        self.pull = pull
+        self.with_solver = with_solver
+        self.with_estimator = with_estimator
+        self.descheduler = descheduler
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.endpoints: dict[str, int] = {}
+
+    def _spawn(self, name: str, cmd: list[str]) -> subprocess.Popen:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        # children must import this package regardless of the caller's cwd
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_parent
+        )
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        self.procs[name] = proc
+        return proc
+
+    def __enter__(self) -> "LocalUp":
+        py = sys.executable
+        try:
+            if self.with_solver:
+                p = self._spawn(
+                    "solver", [py, "-m", "karmada_tpu.solver", "--address", "127.0.0.1:0"]
+                )
+                self.endpoints["solver"] = _scrape_port(p, r"port (\d+)")
+            if self.with_estimator:
+                p = self._spawn(
+                    "estimator",
+                    [py, "-m", "karmada_tpu.estimator", "--cluster", "member1",
+                     "--address", "127.0.0.1:0"],
+                )
+                self.endpoints["estimator"] = _scrape_port(p, r"port (\d+)")
+
+            plane_cmd = [
+                py, "-m", "karmada_tpu.localup", "serve",
+                "--members", str(self.members),
+            ]
+            for name in self.pull:
+                plane_cmd += ["--pull", name]
+            if self.with_solver:
+                plane_cmd += ["--solver", f"127.0.0.1:{self.endpoints['solver']}"]
+            if self.with_estimator:
+                plane_cmd += [
+                    "--estimator", f"member1=127.0.0.1:{self.endpoints['estimator']}"
+                ]
+            if self.descheduler:
+                plane_cmd += ["--descheduler"]
+            if self.lease_grace:
+                plane_cmd += ["--lease-grace", str(self.lease_grace)]
+            if self.feature_gates:
+                plane_cmd += ["--feature-gates", self.feature_gates]
+            p = self._spawn("plane", plane_cmd)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                line = p.stdout.readline()
+                if line.startswith("{"):
+                    info = json.loads(line)
+                    self.endpoints.update(
+                        bus=info["bus"], proxy=info["proxy"], metrics=info["metrics"]
+                    )
+                    self.clusters = info["clusters"]
+                    break
+                if p.poll() is not None:
+                    raise RuntimeError(f"plane exited rc={p.returncode}")
+            else:
+                raise RuntimeError("plane never printed its endpoints")
+
+            for name in self.pull:
+                self._spawn(
+                    f"agent-{name}",
+                    [py, "-m", "karmada_tpu.bus.agent",
+                     "--target", f"127.0.0.1:{self.endpoints['bus']}",
+                     "--cluster", name],
+                )
+            return self
+        except Exception:
+            self.__exit__(None, None, None)
+            raise
+
+    def __exit__(self, *exc) -> None:
+        for proc in reversed(list(self.procs.values())):
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+
+    def kill(self, name: str) -> None:
+        """Fault injection: hard-kill one component process."""
+        proc = self.procs[name]
+        proc.kill()
+        proc.wait(timeout=5)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sv = sub.add_parser("serve", help="run the plane process (internal)")
+    sv.add_argument("--members", type=int, default=2)
+    sv.add_argument("--pull", action="append", default=[])
+    sv.add_argument("--solver", default="")
+    sv.add_argument("--estimator", action="append", default=[])
+    sv.add_argument("--bus-address", default="127.0.0.1:0")
+    sv.add_argument("--descheduler", action="store_true")
+    sv.add_argument("--loop-interval", type=float, default=0.05)
+    sv.add_argument("--lease-grace", type=float, default=0.0)
+    sv.add_argument("--feature-gates", default="",
+                    help="comma list NAME=true|false (pkg/features)")
+
+    up = sub.add_parser("up", help="spawn the full multi-process deployment")
+    up.add_argument("--members", type=int, default=2)
+    up.add_argument("--pull", action="append", default=["pull1"])
+
+    args = p.parse_args(argv)
+    if args.command == "serve":
+        serve_plane(args)
+    elif args.command == "up":
+        with LocalUp(members=args.members, pull=tuple(args.pull)) as lu:
+            print(json.dumps(lu.endpoints), flush=True)
+            try:
+                while all(p.poll() is None for p in lu.procs.values()):
+                    time.sleep(1)
+            except KeyboardInterrupt:
+                pass
+
+
+if __name__ == "__main__":
+    main()

@@ -141,3 +141,62 @@ queue_incoming_bindings = registry.counter(
     "karmada_scheduler_queue_incoming_bindings_total",
     "queue pressure by event",
 )
+
+
+class MetricsServer:
+    """Prometheus text exposition over HTTP: every reference binary serves
+    /metrics on --metrics-bind-address (cmd/scheduler/app/options/
+    options.go:148); this is that endpoint for the TPU-native processes.
+    Also answers /healthz (the readiness probe the reference wires via
+    healthz.InstallHandler)."""
+
+    def __init__(
+        self,
+        reg: Registry | None = None,
+        address: tuple[str, int] = ("127.0.0.1", 0),
+    ):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        import threading
+
+        self.registry = reg or registry
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = outer.registry.render().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/healthz":
+                    body = b"ok\n"
+                    ctype = "text/plain"
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(address, Handler)
+        self.port = self._httpd.server_address[1]
+        self._threading = threading
+        self._thread = None
+
+    def start(self) -> int:
+        self._thread = self._threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

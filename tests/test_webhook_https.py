@@ -1,0 +1,100 @@
+"""Admission chain as an out-of-process HTTP(S) webhook (VERDICT r3 #10).
+
+Mirrors the interpreter webhook's transport tier: the same AdmissionChain
+that hooks the Store in-proc is served behind TLS, and a Store wired with
+``RemoteAdmission`` round-trips every write through it — mutations come
+back over the wire, denials raise, unreachable webhooks fail closed (or
+open with failurePolicy=Ignore semantics).
+Ref: cmd/webhook/app/webhook.go:161-183.
+"""
+
+import subprocess
+
+import pytest
+
+from karmada_tpu.api.cluster import Cluster, ClusterSpec
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.api.policy import (
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+)
+from karmada_tpu.utils import Store
+from karmada_tpu.webhook.chain import PERMANENT_ID_ANNOTATION
+from karmada_tpu.webhook.server import (
+    AdmissionDenied,
+    AdmissionWebhookServer,
+    RemoteAdmission,
+)
+
+
+def make_policy(name="pp"):
+    return PropagationPolicy(
+        meta=ObjectMeta(name=name, namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ]
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def tls_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("admission-pki")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(d / "srv.key"), "-out", str(d / "srv.crt"),
+         "-days", "1", "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        check=True, capture_output=True,
+    )
+    return d
+
+
+@pytest.fixture()
+def https_store(tls_files):
+    server = AdmissionWebhookServer(
+        certfile=str(tls_files / "srv.crt"),
+        keyfile=str(tls_files / "srv.key"),
+    )
+    url = server.start()
+    remote = RemoteAdmission(
+        url, ca_bundle=(tls_files / "srv.crt").read_bytes()
+    )
+    store = Store(admission=remote.admit, delete_admission=remote.admit_delete)
+    yield store, server
+    server.stop()
+
+
+class TestAdmissionOverHttps:
+    def test_mutation_round_trips(self, https_store):
+        store, _ = https_store
+        policy = make_policy()
+        assert PERMANENT_ID_ANNOTATION not in policy.meta.annotations
+        store.apply(policy)
+        # the webhook PROCESS side ran the mutator; the annotation came back
+        # over the wire and was folded into the caller's object
+        assert PERMANENT_ID_ANNOTATION in policy.meta.annotations
+        stored = store.get("PropagationPolicy", "default/pp")
+        assert PERMANENT_ID_ANNOTATION in stored.meta.annotations
+
+    def test_validation_denial_raises(self, https_store):
+        store, _ = https_store
+        bad = Cluster(
+            meta=ObjectMeta(name="Bad_Name!"),
+            spec=ClusterSpec(sync_mode="Push"),
+        )
+        with pytest.raises(ValueError):
+            store.apply(bad)
+        assert store.get("Cluster", "Bad_Name!") is None
+
+    def test_unreachable_webhook_fails_closed_and_open(self, tls_files):
+        closed = RemoteAdmission("https://127.0.0.1:1/admit")
+        store = Store(admission=closed.admit)
+        with pytest.raises(AdmissionDenied):
+            store.apply(make_policy())
+        opened = RemoteAdmission("https://127.0.0.1:1/admit", fail_open=True)
+        store2 = Store(admission=opened.admit)
+        store2.apply(make_policy())  # failurePolicy=Ignore semantics
+        assert store2.get("PropagationPolicy", "default/pp") is not None
