@@ -1,0 +1,242 @@
+"""Baseline calibration: native C++ divider vs numpy divider vs engine.
+
+The north-star target names the in-tree Go divider; no Go toolchain exists
+in this image, so ``divider.cc`` (g++ -O2) re-executes the reference's
+per-binding division loop — same data flow as the Go scheduler: per
+binding, filter candidates, pick the cohort, sort the candidate list,
+largest-remainder dispense. This script generates the EXACT config-5
+workload (same RNG streams as bench.py), feeds it to the native binary,
+verifies placement identity against the numpy divider on every row, and
+prints the calibration ratios.
+
+Run: python baselines/calibrate.py [--bindings 100000 --clusters 5000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--bindings", type=int, default=100_000)
+    p.add_argument("--clusters", type=int, default=5_000)
+    p.add_argument("--skip-numpy", action="store_true")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from karmada_tpu.refimpl.divider_np import assign_batch_np
+    from karmada_tpu.scheduler import ClusterSnapshot, TensorScheduler
+    from karmada_tpu.utils.builders import synthetic_fleet
+    from karmada_tpu.utils.quantity import parse_resource_list
+
+    b_total, c = args.bindings, args.clusters
+    # ---- same workload as bench.py config 5 -------------------------------
+    clusters = synthetic_fleet(c, seed=7, taint_fraction=0.08)
+    snap = ClusterSnapshot(clusters)
+    profiles_req = [
+        parse_resource_list(
+            {"cpu": f"{250 * (q + 1)}m", "memory": f"{512 * (q + 1)}Mi"}
+        )
+        for q in range(8)
+    ]
+    rng = np.random.default_rng(42)
+    replicas = rng.integers(1, 100, b_total).astype(np.uint8)
+    prof_idx = rng.integers(0, 8, b_total).astype(np.uint8)
+    tol_mask = (rng.random(b_total) < 0.30).astype(np.uint8)
+    has_prev = rng.random(b_total) < 0.7
+    prev_sites = rng.integers(0, c, (b_total, 8)).astype(np.uint16)
+    prev_counts = rng.integers(1, 30, (b_total, 8)).astype(np.uint8)
+    n_prev = rng.integers(1, 9, b_total).astype(np.uint8)
+    fresh = (rng.random(b_total) < 0.05).astype(np.uint8)
+    n_prev = np.where(has_prev, n_prev, 0).astype(np.uint8)
+
+    # per-(profile, cluster) availability: the engine's estimator table
+    # WITHOUT the per-binding replica clamp (the C++ side applies the
+    # reference's min-merge semantics per binding via weights; for dynamic
+    # weight the clamp only matters via the MAX_INT32 sentinel, absent here)
+    eng = TensorScheduler(snap)
+    dims = snap.dims
+    prof_rows = np.zeros((8, len(dims)), np.int64)
+    for q, req in enumerate(profiles_req):
+        for d, v in req.items():
+            j = dims.index(d) if d in dims else None
+            if j is not None:
+                prof_rows[q, j] = v
+        if "pods" in dims:
+            prof_rows[q, dims.index("pods")] = max(
+                prof_rows[q, dims.index("pods")], 1
+            )
+    table = np.asarray(eng._profile_table(prof_rows)).astype(np.int32)  # [8, C]
+    tainted = np.zeros(c, np.uint8)
+    for j, cl in enumerate(clusters):
+        tainted[j] = any(t.key == "fleet.io/dedicated" for t in cl.spec.taints)
+
+    # ---- write compact workload ------------------------------------------
+    tmp = tempfile.mkdtemp(prefix="divider-cal-")
+    inp, outp = os.path.join(tmp, "in.bin"), os.path.join(tmp, "out.bin")
+    rec = np.zeros(
+        b_total,
+        dtype=np.dtype(
+            [
+                ("profile", np.uint8), ("replicas", np.uint8),
+                ("tolerates", np.uint8), ("fresh", np.uint8),
+                ("n_prev", np.uint8),
+                ("prev_site", np.uint16, (8,)), ("prev_count", np.uint8, (8,)),
+            ],
+            align=False,
+        ),
+    )
+    rec["profile"] = prof_idx
+    rec["replicas"] = replicas
+    rec["tolerates"] = tol_mask
+    rec["fresh"] = fresh
+    rec["n_prev"] = n_prev
+    rec["prev_site"] = prev_sites
+    rec["prev_count"] = prev_counts
+    capacity = np.asarray(snap.available_cap, np.int64)  # [C, R] free cap
+    with open(inp, "wb") as f:
+        f.write(struct.pack("<IIII", b_total, c, 8, capacity.shape[1]))
+        f.write(table.astype("<i4").tobytes())
+        f.write(capacity.astype("<i8").tobytes())
+        f.write(prof_rows.astype("<i8").tobytes())
+        f.write(tainted.tobytes())
+        f.write(rec.tobytes())
+
+    # ---- run the native divider ------------------------------------------
+    binary = os.path.join(os.path.dirname(os.path.abspath(__file__)), "divider")
+    if not os.path.exists(binary):
+        subprocess.run(
+            ["g++", "-O2", "-o", binary, binary + ".cc"], check=True
+        )
+    out = subprocess.run(
+        [binary, inp, outp], capture_output=True, text=True, check=True
+    )
+    stats = json.loads(out.stdout)
+    t_cpp = stats["divider_cpp_seconds"]
+    print(
+        f"# C++ divider (faithful per-binding estimation): {t_cpp:.2f}s "
+        f"for {b_total} bindings", file=sys.stderr,
+    )
+    out_i = subprocess.run(
+        [binary, inp, outp + ".interned", "--interned"],
+        capture_output=True, text=True, check=True,
+    )
+    t_cpp_interned = json.loads(out_i.stdout)["divider_cpp_seconds"]
+    print(
+        f"# C++ divider (+engine's profile interning): {t_cpp_interned:.2f}s",
+        file=sys.stderr,
+    )
+
+    # ---- verify identity vs the numpy divider ----------------------------
+    with open(outp, "rb") as f:
+        total = struct.unpack("<I", f.read(4))[0]
+        counts = np.frombuffer(f.read(4 * b_total), np.int32)
+        entries = np.frombuffer(f.read(4 * total), np.int32)
+
+    t_np = 0.0
+    mismatches = 0
+    checked = 0
+    if not args.skip_numpy:
+        starts = np.zeros(b_total, np.int64)
+        np.cumsum(np.maximum(counts[:-1], 0), out=starts[1:])
+        chunk = 8192
+        for s in range(0, b_total, chunk):
+            e = min(s + chunk, b_total)
+            n = e - s
+            feasible = (~tainted.astype(bool))[None, :] | tol_mask[s:e, None].astype(bool)
+            prev = np.zeros((n, c), np.int32)
+            rows = np.arange(n)[:, None]
+            ks = np.arange(8)[None, :]
+            sel = ks < n_prev[s:e, None]
+            prev[rows.repeat(8, 1)[sel], prev_sites[s:e][sel].astype(np.int64)] = (
+                prev_counts[s:e][sel]
+            )
+            feasible |= prev > 0
+            avail = table[prof_idx[s:e]].astype(np.int32)
+            reps = replicas[s:e].astype(np.int32)
+            avail = np.minimum(
+                np.where(avail == 2**31 - 1, reps[:, None], avail), 2**31 - 1
+            ).astype(np.int32)
+            strategy = np.full(n, 2, np.int32)
+            static_w = np.zeros((n, c), np.int32)
+            t0 = time.perf_counter()
+            got, unsched = assign_batch_np(
+                strategy, reps, feasible, static_w, avail, prev,
+                fresh[s:e].astype(bool),
+            )
+            t_np += time.perf_counter() - t0
+            for k in range(n):
+                i = s + k
+                if counts[i] == -1:
+                    ok = bool(unsched[k]) or not feasible[k].any()
+                else:
+                    ent = entries[starts[i] : starts[i] + counts[i]]
+                    mine = {int(x) >> 8: int(x) & 0xFF for x in ent}
+                    ref = {
+                        int(j): int(got[k, j]) for j in np.flatnonzero(got[k])
+                    }
+                    ok = mine == ref and not unsched[k]
+                mismatches += not ok
+                checked += 1
+        print(
+            f"# identity vs numpy divider: {checked - mismatches}/{checked}",
+            file=sys.stderr,
+        )
+        print(
+            f"# numpy divider wall: {t_np:.2f}s -> numpy/C++ ratio "
+            f"{t_np / max(t_cpp, 1e-9):.2f}x",
+            file=sys.stderr,
+        )
+    # persist the calibration so bench.py can report an estimated
+    # vs-native multiple alongside vs_numpy
+    cal_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "CALIBRATION.json"
+    )
+    with open(cal_path, "w") as f:
+        json.dump(
+            {
+                "bindings": b_total,
+                "clusters": c,
+                "cpp_seconds": round(t_cpp, 4),
+                "cpp_interned_seconds": round(t_cpp_interned, 4),
+                "numpy_seconds": round(t_np, 4),
+                "numpy_over_cpp": round(t_np / max(t_cpp, 1e-9), 3),
+                "verified_rows": checked,
+                "verified_mismatches": mismatches,
+            },
+            f,
+            indent=1,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "divider_cpp_baseline",
+                "value": round(t_cpp, 4),
+                "unit": "s",
+                "cpp_interned_seconds": round(t_cpp_interned, 4),
+                "numpy_seconds": round(t_np, 4),
+                "numpy_over_cpp": round(t_np / max(t_cpp, 1e-9), 2),
+                "verified_rows": checked,
+                "verified_mismatches": mismatches,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""Pure-Python spread-constraint selection oracle.
+
+Independent re-execution of the reference's SelectClusters stage
+(pkg/scheduler/core/spreadconstraint/) for verification: given one
+binding's feasible clusters with scores and credited availability, return
+the selected cluster indices exactly as the reference would — so the
+engine's config-4 placements (SpreadConstraint region+cluster over
+synthetic fleets) can be checked end to end, not just for conservation
+(VERDICT r3 item 8).
+
+Implemented per the reference semantics:
+- group score (group_clusters.go:138-330): Duplicated counts clusters
+  covering the full replica count at 1000x weight; Divided walks the
+  score-ordered members until cluster-min-groups and
+  ceil(replicas/region-min-groups) are both covered.
+- selectGroups DFS (select_groups.go:102-224): region combinations whose
+  total cluster count reaches the cluster min-groups, path length in
+  [minGroups, maxGroups]; paths ranked weight desc / value desc /
+  discovery order; a shorter path that is a prefix of the winner is
+  preferred.
+- region assembly (select_clusters_by_region.go:28-70): the best cluster
+  of every chosen region, remainder filled by (score desc, avail desc)
+  up to the cluster max-groups.
+- cluster-only constraint (select_clusters_by_cluster.go:26-99): top
+  max-groups by order with availability swap-repair from the remainder.
+
+This module deliberately shares NO code with karmada_tpu.scheduler.spread /
+groups (the engine path): plain dicts and lists, per-binding.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+WEIGHT_UNIT = 1000
+INVALID_REPLICAS = -1
+
+
+def cluster_order(
+    candidates: Sequence[int],
+    score: dict[int, int],
+    credited: dict[int, int],
+) -> list[int]:
+    """(score desc, credited desc, index asc) — spreadconstraint/util.go."""
+    return sorted(
+        candidates, key=lambda j: (-score.get(j, 0), -credited.get(j, 0), j)
+    )
+
+
+def group_score(
+    members: Sequence[int],
+    score: dict[int, int],
+    credited: dict[int, int],
+    duplicated: bool,
+    replicas: int,
+    region_min_groups: int,
+    cluster_min_groups: int,
+) -> int:
+    if duplicated:
+        valid = [j for j in members if credited.get(j, 0) >= replicas]
+        if not valid:
+            return 0
+        return len(valid) * WEIGHT_UNIT + sum(
+            score.get(j, 0) for j in valid
+        ) // len(valid)
+    target = math.ceil(replicas / max(region_min_groups, 1))
+    min_count = max(cluster_min_groups, region_min_groups)
+    s_avail = s_score = taken = 0
+    for j in members:
+        s_avail += credited.get(j, 0)
+        s_score += score.get(j, 0)
+        taken += 1
+        if taken >= min_count and s_avail >= target:
+            break
+    if s_avail < target:
+        return s_avail * WEIGHT_UNIT + s_score // max(len(members), 1)
+    return target * WEIGHT_UNIT + s_score // max(taken, 1)
+
+
+def select_region_groups(
+    groups: list[tuple[str, int, int]],  # (name, n_clusters, weight)
+    min_groups: int,
+    max_groups: int,
+    cluster_min: int,
+) -> list[str]:
+    """DFS + prioritization; returns chosen region names ([] = FitError)."""
+    if not groups:
+        return []
+    if max_groups <= 0:
+        max_groups = len(groups)
+    # DFS enumeration order: clusters asc, weight desc, name asc
+    ordered = sorted(groups, key=lambda g: (g[1], -g[2], g[0]))
+    paths: list[tuple[list[tuple[str, int, int]], int, int, int]] = []
+    stack: list[tuple[str, int, int]] = []
+    seq = [0]
+
+    def dfs(total: int, begin: int) -> None:
+        if total >= cluster_min and min_groups <= len(stack) <= max_groups:
+            seq[0] += 1
+            chosen = sorted(stack, key=lambda g: (-g[2], g[0]))
+            paths.append(
+                (
+                    list(chosen),
+                    sum(g[2] for g in chosen),
+                    sum(g[1] for g in chosen),
+                    seq[0],
+                )
+            )
+            return
+        if len(stack) >= max_groups:
+            return
+        for i in range(begin, len(ordered)):
+            stack.append(ordered[i])
+            dfs(total + ordered[i][1], i + 1)
+            if len(ordered) == min_groups:
+                return  # select_groups.go:180-182 early-out
+            stack.pop()
+
+    dfs(0, 0)
+    if not paths:
+        return []
+    paths.sort(key=lambda p: (-p[1], -p[2], p[3]))
+    best = paths[0]
+    for cand in paths[1:]:
+        if len(cand[0]) < len(best[0]) and all(
+            best[0][i][0] == g[0] for i, g in enumerate(cand[0])
+        ):
+            best = cand
+    return [g[0] for g in best[0]]
+
+
+def select_spread_clusters(
+    candidates: Sequence[int],  # feasible cluster indices
+    region_of: dict[int, str],  # cluster index -> region name ("" = none)
+    score: dict[int, int],
+    credited: dict[int, int],
+    constraints: dict[str, tuple[int, int]],  # field -> (min, max)
+    replicas: int,
+    duplicated: bool,
+) -> Optional[list[int]]:
+    """Returns the selected cluster indices or None (FitError)."""
+    need = INVALID_REPLICAS if duplicated else replicas
+    order = cluster_order(candidates, score, credited)
+
+    if "region" in constraints:
+        r_min, r_max = constraints["region"]
+        c_min, c_max = constraints.get("cluster", (0, 0))
+        regions: dict[str, list[int]] = {}
+        for j in order:
+            name = region_of.get(j, "")
+            if name:
+                regions.setdefault(name, []).append(j)
+        if len(regions) < max(r_min, 1):
+            return None
+        groups = [
+            (
+                name,
+                len(members),
+                group_score(
+                    members, score, credited, duplicated, replicas,
+                    r_min, c_min,
+                ),
+            )
+            for name, members in regions.items()
+        ]
+        chosen = select_region_groups(groups, r_min, r_max, c_min)
+        if not chosen:
+            return None
+        selected = [regions[name][0] for name in chosen]
+        rest = [j for name in chosen for j in regions[name][1:]]
+        want = len(selected) + len(rest)
+        if want > c_max:
+            want = c_max
+        extra = want - len(selected)
+        if extra > 0:
+            rest.sort(
+                key=lambda j: (-score.get(j, 0), -credited.get(j, 0), j)
+            )
+            selected.extend(rest[:extra])
+        return selected
+
+    if "cluster" in constraints:
+        c_min, c_max = constraints["cluster"]
+        total = len(order)
+        if total < max(c_min, 1):
+            return None
+        cap = c_max if c_max > 0 else total
+        keep = list(order[: min(cap, total)])
+        rest = list(order[min(cap, total):])
+        if need == INVALID_REPLICAS:
+            return keep
+        idx = len(keep) - 1
+        while sum(credited.get(j, 0) for j in keep) < need and idx >= 0:
+            if rest:
+                b = max(range(len(rest)), key=lambda k: credited.get(rest[k], 0))
+                if credited.get(rest[b], 0) > credited.get(keep[idx], 0):
+                    keep[idx], rest[b] = rest[b], keep[idx]
+                    idx -= 1
+                    continue
+            idx -= 1
+        if sum(credited.get(j, 0) for j in keep) < need:
+            return None
+        return keep
+
+    # zone/provider-only: unsupported upstream (select_clusters.go:58)
+    return None

@@ -150,7 +150,8 @@ class SearchController:
     (pkg/search/controller.go)."""
 
     def __init__(
-        self, store: Store, runtime: Runtime, members: MemberClientRegistry
+        self, store: Store, runtime: Runtime, members: MemberClientRegistry,
+        indexer=None,
     ) -> None:
         from .backend import InvertedIndexBackend
 
@@ -158,8 +159,10 @@ class SearchController:
         self.members = members
         self.cache = MultiClusterCache()
         # registries with spec.backend == "opensearch" additionally index
-        # into the document backend (backendstore/opensearch.go analogue)
-        self.indexer = InvertedIndexBackend()
+        # into the document backend (backendstore/opensearch.go analogue).
+        # Inject an HttpIndexerBackend (search/indexer.py) to ship the
+        # documents to an EXTERNAL indexer process over the wire instead.
+        self.indexer = indexer if indexer is not None else InvertedIndexBackend()
         # registry key -> doc keys it indexed last pass; the diff drives
         # deletions so member-side removals and backend switches don't
         # leave stale documents
@@ -174,6 +177,11 @@ class SearchController:
             return
         for rr in self.store.list("ResourceRegistry"):
             self.worker.enqueue(rr.meta.namespaced_name)
+        # networked backends buffer bulk batches; the periodic sweep drains
+        # them so documents don't sit unshipped between watch bursts
+        flush = getattr(self.indexer, "flush", None)
+        if flush is not None:
+            flush()
 
     def resync(self) -> None:
         """Re-enqueue every registry (addon enable / manual refresh)."""
@@ -187,6 +195,11 @@ class SearchController:
         for rr in list(self._indexed):
             for doc in self._indexed.pop(rr, set()):
                 self.indexer.delete(*doc)
+        # networked backends buffer deletions: ship them now — the sweep
+        # no longer runs once disabled
+        flush = getattr(self.indexer, "flush", None)
+        if flush is not None:
+            flush()
         self.cache.clear()
 
     def _reconcile(self, key: str) -> Optional[str]:

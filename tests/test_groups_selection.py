@@ -156,3 +156,91 @@ class TestRegionAssembly:
             need=1, duplicated=False, replicas=1,
         )
         assert sel is None
+
+
+class TestSpreadOracleDifferential:
+    """The engine's spread selection (scheduler/spread + groups, array-based
+    with memoization) vs the pure-Python verification oracle
+    (refimpl/spread, per-binding dicts): randomized fleets must select
+    IDENTICAL cluster sets for region+cluster constraint mixes — the
+    config-4 identity claim rests on these two paths being independent yet
+    equal."""
+
+    def test_randomized_selection_identity(self):
+        from karmada_tpu.refimpl.spread import select_spread_clusters
+        from karmada_tpu.scheduler.spread import cluster_order
+        from karmada_tpu.scheduler.groups import select_by_topology_groups
+        from karmada_tpu.scheduler.spread import select_by_cluster_constraint
+
+        rng = np.random.default_rng(11)
+        for trial in range(200):
+            c = int(rng.integers(4, 40))
+            regions = [f"r{k}" for k in range(int(rng.integers(1, 6)))]
+            clusters = []
+            for j in range(c):
+                cl = new_cluster(f"m{j:02d}", cpu="50", memory="100Gi")
+                cl.spec.region = (
+                    str(rng.choice(regions)) if rng.random() < 0.9 else ""
+                )
+                clusters.append(cl)
+            snap = ClusterSnapshot(clusters)
+            feasible = rng.random(c) < 0.85
+            if not feasible.any():
+                continue
+            score = np.where(rng.random(c) < 0.3, 100, 0)
+            credited = rng.integers(0, 30, c).astype(np.int64)
+            replicas = int(rng.integers(1, 60))
+            duplicated = bool(rng.random() < 0.3)
+            need = -1 if duplicated else replicas
+            r_min = int(rng.integers(1, 4))
+            r_max = int(rng.integers(r_min, 6))
+            c_min = int(rng.integers(1, 5))
+            c_max = int(rng.integers(c_min, 12))
+            use_region = bool(rng.random() < 0.7)
+
+            order = cluster_order(score, credited, feasible)
+            if use_region:
+                sc = {
+                    "region": SpreadConstraint(
+                        spread_by_field="region",
+                        min_groups=r_min, max_groups=r_max,
+                    ),
+                    "cluster": SpreadConstraint(
+                        spread_by_field="cluster",
+                        min_groups=c_min, max_groups=c_max,
+                    ),
+                }
+                got = select_by_topology_groups(
+                    snap, sc, order, score, credited, need,
+                    duplicated=duplicated, replicas=replicas,
+                )
+                constraints = {
+                    "region": (r_min, r_max), "cluster": (c_min, c_max)
+                }
+            else:
+                sc_c = SpreadConstraint(
+                    spread_by_field="cluster",
+                    min_groups=c_min, max_groups=c_max,
+                )
+                got = select_by_cluster_constraint(
+                    sc_c, order, credited, need
+                )
+                constraints = {"cluster": (c_min, c_max)}
+
+            cand = [int(j) for j in np.flatnonzero(feasible)]
+            want = select_spread_clusters(
+                cand,
+                {j: clusters[j].spec.region for j in range(c)},
+                {j: int(score[j]) for j in cand},
+                {j: int(credited[j]) for j in cand},
+                constraints,
+                replicas,
+                duplicated=duplicated,
+            )
+            got_set = sorted(int(j) for j in got) if got is not None else None
+            want_set = sorted(want) if want is not None else None
+            assert got_set == want_set, (
+                f"trial {trial}: engine={got_set} oracle={want_set} "
+                f"(region={use_region}, dup={duplicated}, reps={replicas}, "
+                f"rmin/max={r_min}/{r_max}, cmin/max={c_min}/{c_max})"
+            )
