@@ -86,6 +86,15 @@ def build_parser():
     )
     p.add_argument("--dims", type=int, default=4)
     p.add_argument(
+        "--hetero", type=int, default=0,
+        help="config-5 variant: N UNIQUE placements (distinct label "
+        "selectors / tolerations / static weights) spread across the "
+        "bindings — stresses placement compilation, mask interning, and "
+        "the fleet table's MAX_SLOTS rebuild behavior (SURVEY section 7 "
+        "label-selector cost warning). 0 = the homogeneous headline "
+        "workload",
+    )
+    p.add_argument(
         "--config",
         type=int,
         default=5,
@@ -407,6 +416,68 @@ def run_engine_north_star(args) -> dict:
         for p in range(8)
     ]
 
+    def make_hetero_placements(n: int) -> list:
+        # n unique placements: distinct matchExpressions over the fleet's
+        # tier/env label vocabulary, toleration variants, and (a slice)
+        # distinct static weight lists — every one is a separate
+        # compile_placement + fleet cp-slot
+        from karmada_tpu.api.policy import (
+            ClusterAffinity as CA, LabelSelector as LS,
+            LabelSelectorRequirement as LSR,
+        )
+
+        out: list = []
+        rng_h = np.random.default_rng(5)
+        tiers = [f"t{k}" for k in range(16)]
+        envs = ["prod", "staging", "dev"]
+        for u in range(n):
+            n_t = int(rng_h.integers(2, 9))
+            tier_vals = sorted(
+                str(t) for t in rng_h.choice(tiers, n_t, replace=False)
+            )
+            env_vals = sorted(
+                str(e)
+                for e in rng_h.choice(envs, int(rng_h.integers(1, 3)), replace=False)
+            )
+            aff = CA(
+                label_selector=LS(
+                    match_expressions=[
+                        LSR(key="tier", operator="In", values=tier_vals),
+                        LSR(key="env", operator="In", values=env_vals),
+                    ]
+                )
+            )
+            tols = [tol] if u % 3 == 0 else []
+            mode = u % 10
+            if mode < 8:
+                pl = dynamic_weight_placement(
+                    cluster_affinity=aff, cluster_tolerations=tols
+                )
+            elif mode == 8:
+                pl = duplicated_placement()
+                pl.cluster_affinity = aff
+                pl.cluster_tolerations = tols
+            else:
+                picks = rng_h.choice(c, 24, replace=False)
+                pl = static_weight_placement(
+                    {
+                        names[int(j)]: int(w)
+                        for j, w in zip(picks, rng_h.integers(1, 6, 24))
+                    }
+                )
+                pl.cluster_affinity = aff
+                pl.cluster_tolerations = tols
+            out.append(pl)
+        print(
+            f"# heterogeneous tier: {len(out)} unique placements "
+            f"(MAX_SLOTS check: {'EXCEEDS' if len(out) > 4096 else 'fits'} "
+            "the 4096-slot fleet table)",
+            file=sys.stderr,
+        )
+        return out
+
+    hetero_pls: list = make_hetero_placements(args.hetero) if args.hetero else []
+
     t0 = time.perf_counter()
     rng = np.random.default_rng(42)
     replicas = rng.integers(1, 100, b_total)
@@ -417,10 +488,15 @@ def run_engine_north_star(args) -> dict:
     prev_counts = rng.integers(1, 30, (b_total, 8))
     n_prev = rng.integers(1, 9, b_total)
     fresh = rng.random(b_total) < 0.05
+    def pick_placement(i: int):
+        if hetero_pls:
+            return hetero_pls[i % len(hetero_pls)]
+        return pl_tol if tol_mask[i] else pl_plain
+
     problems = [
         BindingProblem(
             key=f"b{i}",
-            placement=pl_tol if tol_mask[i] else pl_plain,
+            placement=pick_placement(i),
             replicas=int(replicas[i]),
             requests=profiles[prof_idx[i]],
             gvk="apps/v1/Deployment",
@@ -513,6 +589,45 @@ def run_engine_north_star(args) -> dict:
         show(f"churn pass {rep}", t1 - t0)
     churn_p50 = float(np.median(churn_times))
     print(f"# churn p50 (full availability drift): {churn_p50:.3f}s", file=sys.stderr)
+    # ---- heterogeneous-placement sub-tier (default run only) --------------
+    # 3.5k UNIQUE placements across the same bindings: stresses selector
+    # compilation, mask interning, and the fleet cp-table at scale (SURVEY
+    # section 7 label-selector warning). A dedicated full run is available
+    # via --hetero N.
+    hetero_p50 = 0.0
+    if not args.hetero and not args.no_verify:
+        h_pls = make_hetero_placements(3500)
+        h_problems = [
+            BindingProblem(
+                key=p.key, placement=h_pls[i % len(h_pls)],
+                replicas=p.replicas, requests=p.requests, gvk=p.gvk,
+                prev=p.prev, fresh=p.fresh,
+            )
+            for i, p in enumerate(problems)
+        ]
+        h_engine = TensorScheduler(snap, chunk_size=args.chunk)
+        t0 = time.perf_counter()
+        h_engine.schedule(h_problems)
+        print(f"# hetero warm pass: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+        h_times = []
+        for rep in range(3):
+            t0 = time.perf_counter()
+            h_res = h_engine.schedule(h_problems)
+            h_times.append(time.perf_counter() - t0)
+        hetero_p50 = float(np.median(h_times))
+        n_h = sum(1 for r_ in h_res if r_.success)
+        # spot-verify placements against the pure-Python oracle
+        h_idx = list(range(0, b_total, max(1, b_total // 256)))[:256]
+        h_ok, h_bad = _verify_rows(snap, h_problems, h_res, h_engine, h_idx)
+        print(
+            f"# hetero tier (3500 unique placements): p50 "
+            f"{hetero_p50:.3f}s, {n_h}/{b_total} scheduled, oracle "
+            f"{h_ok}/{len(h_idx)} identical",
+            file=sys.stderr,
+        )
+        if h_bad:
+            print(f"# WARNING: hetero mismatches: {h_bad}", file=sys.stderr)
+
     # restore the measured-snapshot results for verification below
     swapped = engine.update_snapshot(snap)
     assert swapped
@@ -523,12 +638,20 @@ def run_engine_north_star(args) -> dict:
         file=sys.stderr,
     )
 
+    metric = f"p50_engine_schedule_{b_total // 1000}kx{c}_dynamic_weight"
+    if args.hetero:
+        metric = (
+            f"p50_engine_hetero{args.hetero}_"
+            f"{b_total // 1000}kx{c}"
+        )
     out = {
-        "metric": f"p50_engine_schedule_{b_total // 1000}kx{c}_dynamic_weight",
+        "metric": metric,
         "value": round(p50, 4),
         "unit": "s",
         "churn_p50": round(churn_p50, 4),
     }
+    if hetero_p50:
+        out["hetero3500_p50"] = round(hetero_p50, 4)
     if args.no_verify:
         out["vs_baseline"] = 0.0
         return out

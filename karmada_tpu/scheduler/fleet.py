@@ -662,6 +662,7 @@ class FleetTable:
     def _rebuild_tables(self) -> None:
         snap = self.engine.snapshot
         gen = getattr(self.engine, "_snapshot_gen", 0)
+        slots_changed = self._tables_dirty
         if gen != self._snapshot_gen:
             # snapshot swapped in place (same cluster set): recompile each
             # slot's placement against the new snapshot, order-preserving so
@@ -677,33 +678,51 @@ class FleetTable:
                     self._static_max, int(cp.static_weights.max(initial=0))
                 )
         c = snap.num_clusters
-        aff = np.stack(
-            [
-                (cp.terms[0][1] & cp.spread_field_ok).astype(np.int32)
-                for _, cp in self._cp_pl
-            ]
+        # the mask tables are functions of the snapshot's FILTER fields only
+        # (labels/taints/enablements/topology — snapshot.mask_token) and the
+        # interned slot lists. An availability-only swap (churn) leaves both
+        # unchanged, so the resident device tables stay valid — re-uploading
+        # the [U, 3C] cp_table costs seconds per pass over the tunnel at
+        # heterogeneous U (hundreds of MB)
+        token = snap.mask_token
+        need_masks = (
+            self._dev_tables is None
+            or slots_changed
+            or token != getattr(self, "_mask_token", None)
         )
-        taint = np.stack(
-            [cp.taint_ok.astype(np.int32) for _, cp in self._cp_pl]
-        )
-        static = np.stack(
-            [cp.static_weights.astype(np.int32) for _, cp in self._cp_pl]
-        )
-        cp_table = np.concatenate([aff, taint, static], axis=1)  # [U, 3C]
-        gvk_rows = []
-        for g in self._gvk_list:
-            gid = snap.gvk_vocab.get(g) if g else None
-            if gid is None:
-                mask = (
-                    np.zeros(c, bool)
-                    if g and len(snap.gvk_vocab) > 0
-                    else np.ones(c, bool)
-                )
-            else:
-                word, bit = gid // 32, gid % 32
-                mask = (snap.gvk_bits[:, word] >> np.uint32(bit)) & 1 != 0
-            gvk_rows.append(mask.astype(np.int32))
-        gvk_table = np.stack(gvk_rows)
+        if need_masks:
+            aff = np.stack(
+                [
+                    (cp.terms[0][1] & cp.spread_field_ok).astype(np.int32)
+                    for _, cp in self._cp_pl
+                ]
+            )
+            taint = np.stack(
+                [cp.taint_ok.astype(np.int32) for _, cp in self._cp_pl]
+            )
+            static = np.stack(
+                [cp.static_weights.astype(np.int32) for _, cp in self._cp_pl]
+            )
+            cp_table = np.concatenate([aff, taint, static], axis=1)  # [U, 3C]
+            gvk_rows = []
+            for g in self._gvk_list:
+                gid = snap.gvk_vocab.get(g) if g else None
+                if gid is None:
+                    mask = (
+                        np.zeros(c, bool)
+                        if g and len(snap.gvk_vocab) > 0
+                        else np.ones(c, bool)
+                    )
+                else:
+                    word, bit = gid // 32, gid % 32
+                    mask = (snap.gvk_bits[:, word] >> np.uint32(bit)) & 1 != 0
+                gvk_rows.append(mask.astype(np.int32))
+            gvk_table = np.stack(gvk_rows)
+            cp_dev = jnp.asarray(cp_table)
+            gvk_dev = jnp.asarray(gvk_table)
+            inc_dev = jnp.asarray(~snap.complete_enablements)
+        else:
+            cp_dev, gvk_dev, _, inc_dev = self._dev_tables
         prof_table = self.engine._profile_table(np.stack(self._profiles))
         self._avail_max = int(
             jnp.max(
@@ -714,12 +733,8 @@ class FleetTable:
                 )
             )
         )
-        self._dev_tables = (
-            jnp.asarray(cp_table),
-            jnp.asarray(gvk_table),
-            prof_table,
-            jnp.asarray(~snap.complete_enablements),
-        )
+        self._dev_tables = (cp_dev, gvk_dev, prof_table, inc_dev)
+        self._mask_token = token
         self._tables_dirty = False
 
     def _sync_device(self) -> None:

@@ -163,6 +163,44 @@ class ClusterSnapshot:
     def num_clusters(self) -> int:
         return len(self.clusters)
 
+    @property
+    def mask_token(self) -> int:
+        """Digest of every field the FILTER masks are a function of (names,
+        labels, taints, API enablements, topology ids) — capacities and
+        resource models excluded. Snapshots with equal tokens compile every
+        placement to identical masks, so mask tables built against one are
+        valid against the other: the fleet table uses this to skip the
+        ~hundreds-of-MB mask-table re-upload on availability-only swaps
+        (update_snapshot churn), which costs seconds over a tunneled
+        device link."""
+        tok = getattr(self, "_mask_token", None)
+        if tok is None:
+            import hashlib
+
+            h = hashlib.blake2b(digest_size=16)
+            h.update("\x00".join(self.names).encode())
+            # every bitset/id array AND its vocab string table: equal bit
+            # patterns under a renamed vocabulary (env=prod -> env=blue
+            # interned at the same id) are DIFFERENT mask inputs
+            h.update(self.label_bits.tobytes())
+            h.update("\x00".join(self.label_vocab._ids).encode())
+            h.update(self.key_bits.tobytes())
+            h.update("\x00".join(self.key_vocab._ids).encode())
+            h.update(self.taint_bits.tobytes())
+            h.update("\x00".join(self.taint_vocab._ids).encode())
+            h.update(self.gvk_bits.tobytes())
+            h.update("\x00".join(self.gvk_vocab._ids).encode())
+            h.update(self.complete_enablements.tobytes())
+            h.update(self.provider_ids.tobytes())
+            h.update("\x00".join(self.provider_vocab._ids).encode())
+            h.update(self.region_ids.tobytes())
+            h.update("\x00".join(self.region_vocab._ids).encode())
+            h.update(self.zone_ids.tobytes())
+            h.update("\x00".join(self.zone_vocab._ids).encode())
+            tok = int.from_bytes(h.digest(), "little")
+            self._mask_token = tok
+        return tok
+
     def dim_index(self, name: str) -> Optional[int]:
         try:
             return self.dims.index(name)

@@ -440,3 +440,48 @@ class TestPlacementCacheLifetime:
             assert res.clusters == {want: 1}, i
             del pl
             gc.collect()
+
+
+class TestMaskTokenSwapSafety:
+    """update_snapshot keeps compiled masks only when the FILTER fields are
+    truly unchanged: a renamed label value that lands on the same interned
+    bit id must still invalidate (review finding: vocab string tables are
+    part of the token, not just the bit patterns)."""
+
+    def _snap(self, env):
+        from karmada_tpu.utils.builders import new_cluster
+
+        clusters = [new_cluster(f"m{i}", cpu="50", memory="100Gi") for i in range(3)]
+        for cl in clusters:
+            cl.meta.labels = {"env": env}
+        return ClusterSnapshot(clusters)
+
+    def test_label_rename_invalidates_compiled_masks(self):
+        from karmada_tpu.api.policy import LabelSelector
+
+        s1 = self._snap("prod")
+        engine = TensorScheduler(s1)
+        pl = dynamic_weight_placement(
+            cluster_affinity=ClusterAffinity(
+                label_selector=LabelSelector(match_labels={"env": "prod"})
+            )
+        )
+        p = BindingProblem(key="b", placement=pl, replicas=3,
+                           requests={"cpu": 100}, gvk="apps/v1/Deployment")
+        res = engine.schedule([p])[0]
+        assert res.success and sum(res.clusters.values()) == 3
+        # relabel every cluster env=blue: same interned bit layout,
+        # different vocabulary -> the selector must stop matching
+        s2 = self._snap("blue")
+        assert s1.mask_token != s2.mask_token
+        assert engine.update_snapshot(s2)
+        res2 = engine.schedule([p])[0]
+        assert not res2.success, "stale compiled mask survived the relabel"
+
+    def test_availability_only_swap_keeps_token(self):
+        s1 = self._snap("prod")
+        s2 = self._snap("prod")
+        for cl in s2.clusters:
+            cl.status.resource_summary.allocated["cpu"] = 1000
+        s2b = ClusterSnapshot(s2.clusters)
+        assert s1.mask_token == s2b.mask_token
