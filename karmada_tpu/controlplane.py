@@ -63,6 +63,11 @@ class ControlPlane:
         # out-of-process solver sidecar (karmada_tpu.solver.RemoteSolver):
         # routes Score/Assign over gRPC instead of the in-proc engine
         solver=None,
+        # external admission (webhook.server.RemoteAdmission hooks): every
+        # store write round-trips a TLS webhook process instead of the
+        # in-proc chain (cmd/webhook deployment shape)
+        admission_override=None,
+        delete_admission_override=None,
     ) -> None:
         import time as _time
 
@@ -71,8 +76,10 @@ class ControlPlane:
 
         self.admission = default_admission_chain()
         self.store = Store(
-            admission=self.admission.admit,
-            delete_admission=self.admission.admit_delete,
+            admission=admission_override or self.admission.admit,
+            delete_admission=(
+                delete_admission_override or self.admission.admit_delete
+            ),
         )
         self.runtime = Runtime()
         self.members = MemberClientRegistry()

@@ -38,20 +38,60 @@ import time
 from typing import Optional
 
 
-def _scrape_port(proc: subprocess.Popen, pattern: str, timeout: float = 30.0) -> int:
+def spawn_child(cmd: list[str]) -> subprocess.Popen:
+    """Spawn a component child process: CPU jax, package importable
+    regardless of the caller's cwd. Shared by LocalUp and the process
+    operator — one copy of the env construction."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = (
+        pkg_parent + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH")
+        else pkg_parent
+    )
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env,
+    )
+
+
+def scrape_line(proc: subprocess.Popen, pattern: str, timeout: float = 60.0) -> str:
+    """First regex group of the first stdout line matching ``pattern``.
+
+    select()-gated so a child that hangs BEFORE printing (import stall,
+    bind wait) raises after ``timeout`` instead of blocking readline
+    forever; a child that dies mid-startup raises immediately."""
+    import select
+
     deadline = time.time() + timeout
-    while time.time() < deadline:
+    while True:
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            raise RuntimeError(
+                f"no line matching {pattern!r} within {timeout}s"
+            )
+        ready, _, _ = select.select([proc.stdout], [], [], min(remaining, 0.5))
+        if not ready:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"child exited rc={proc.returncode} during startup"
+                )
+            continue
         line = proc.stdout.readline()
         if not line:
             if proc.poll() is not None:
                 raise RuntimeError(
-                    f"child exited rc={proc.returncode} before printing a port"
+                    f"child exited rc={proc.returncode} during startup"
                 )
+            time.sleep(0.05)  # stdout closed but child alive: avoid spin
             continue
         m = re.search(pattern, line)
         if m:
-            return int(m.group(1))
-    raise RuntimeError(f"no port line matching {pattern!r} within {timeout}s")
+            return m.group(1)
+
+
+def _scrape_port(proc: subprocess.Popen, pattern: str, timeout: float = 30.0) -> int:
+    return int(scrape_line(proc, pattern, timeout))
 
 
 # --------------------------------------------------------------------------
@@ -75,13 +115,34 @@ def serve_plane(args) -> None:
             name, _, val = spec.partition("=")
             feature_gate.set(name.strip(), val.strip().lower() in ("1", "true", ""))
 
+    admission_kw = {}
+    if args.admission:
+        # out-of-process TLS admission: every store write round-trips the
+        # webhook process (cmd/webhook deployment shape)
+        from .webhook.server import RemoteAdmission
+
+        ca = open(args.admission_ca, "rb").read() if args.admission_ca else None
+        remote = RemoteAdmission(args.admission, ca_bundle=ca)
+        admission_kw = {
+            "admission_override": remote.admit,
+            "delete_admission_override": remote.admit_delete,
+        }
+
     solver = None
     if args.solver:
         from .solver.client import RemoteSolver
 
         solver = RemoteSolver(args.solver)
     cp = cmd_init(solver=solver, enable_descheduler=args.descheduler,
-                  lease_grace_seconds=args.lease_grace or None)
+                  lease_grace_seconds=args.lease_grace or None,
+                  **admission_kw)
+    if args.state_file and os.path.exists(args.state_file):
+        # etcd-persistence analogue: a restarted plane restores the store
+        # snapshot its predecessor checkpointed on shutdown, so operator
+        # upgrades don't wipe control-plane state
+        restored = cp.store.restore(args.state_file)
+        print(f"# restored {restored} objects from {args.state_file}",
+              file=sys.stderr)
     for i in range(1, args.members + 1):
         cmd_join(cp, f"member{i}", cpu="100", memory="200Gi")
     for name in args.pull:
@@ -140,6 +201,10 @@ def serve_plane(args) -> None:
             cp.settle()
             time.sleep(args.loop_interval)
     finally:
+        if args.state_file:
+            saved = cp.store.checkpoint(args.state_file)
+            print(f"# checkpointed {saved} objects to {args.state_file}",
+                  file=sys.stderr)
         metrics.stop()
         proxy.stop()
         bus.stop()
@@ -178,21 +243,7 @@ class LocalUp:
         self.endpoints: dict[str, int] = {}
 
     def _spawn(self, name: str, cmd: list[str]) -> subprocess.Popen:
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        # children must import this package regardless of the caller's cwd
-        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env["PYTHONPATH"] = (
-            pkg_parent + os.pathsep + env["PYTHONPATH"]
-            if env.get("PYTHONPATH")
-            else pkg_parent
-        )
-        proc = subprocess.Popen(
-            cmd,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
-        )
+        proc = spawn_child(cmd)
         self.procs[name] = proc
         return proc
 
@@ -291,6 +342,13 @@ def main(argv=None) -> None:
     sv.add_argument("--lease-grace", type=float, default=0.0)
     sv.add_argument("--feature-gates", default="",
                     help="comma list NAME=true|false (pkg/features)")
+    sv.add_argument("--admission", default="",
+                    help="external admission webhook URL (https://.../admit)")
+    sv.add_argument("--admission-ca", default="",
+                    help="PEM CA bundle for the admission webhook")
+    sv.add_argument("--state-file", default="",
+                    help="checkpoint/restore path for the store (the etcd "
+                    "persistence analogue across plane restarts)")
 
     up = sub.add_parser("up", help="spawn the full multi-process deployment")
     up.add_argument("--members", type=int, default=2)

@@ -62,6 +62,9 @@ class KarmadaSpec:
     version: str = OPERATOR_VERSION  # control-plane version (upgrade axis)
     components: KarmadaComponents = field(default_factory=KarmadaComponents)
     member_clusters: list[str] = field(default_factory=list)
+    # pull-mode members whose agents run OUT of process (the process
+    # operator spawns one karmada_tpu.bus.agent per name)
+    pull_members: list[str] = field(default_factory=list)
     feature_gates: dict[str, bool] = field(default_factory=dict)
 
 
@@ -381,5 +384,6 @@ def _spec_copy(spec: KarmadaSpec) -> KarmadaSpec:
         version=spec.version,
         components=comps,
         member_clusters=list(spec.member_clusters),
+        pull_members=list(spec.pull_members),
         feature_gates=dict(spec.feature_gates),
     )

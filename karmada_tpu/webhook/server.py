@@ -170,3 +170,30 @@ class RemoteAdmission:
 
     def admit_delete(self, kind: str, obj) -> None:
         self._post(kind, obj, "DELETE")
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--address", default="127.0.0.1:0")
+    p.add_argument("--certfile", default="")
+    p.add_argument("--keyfile", default="")
+    args = p.parse_args(argv)
+    host, _, port = args.address.partition(":")
+    server = AdmissionWebhookServer(
+        address=(host, int(port or 0)),
+        certfile=args.certfile or None,
+        keyfile=args.keyfile or None,
+    )
+    url = server.start()
+    # the parent process scrapes this line to learn the bound endpoint
+    print(f"admission webhook listening on port {server.port} ({url})", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
