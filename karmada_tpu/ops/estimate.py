@@ -44,12 +44,17 @@ def gather_profile_rows(
 ) -> jnp.ndarray:
     """int32[B, C] = table[idx], expressed as a one-hot matmul.
 
-    A direct row gather with a [B]-sized index vector hangs XLA compilation
-    inside lax.scan on the tunneled TPU backend, and a gather is a bad fit
-    for the hardware anyway; one_hot(idx) @ table rides the MXU instead.
-    The 16-bit split below keeps the selection exact for EVERY int32 value
-    (sentinels included) — each half fits f32's mantissa and a one-hot row
-    selects a single entry, so there is no accumulation error."""
+    HISTORY: on the round-1/2 tunneled-backend toolchain a direct row
+    gather with a [B]-sized index vector hung XLA compilation inside
+    lax.scan; this MXU formulation was the workaround. Round-3 re-probes
+    (inside lax.scan, chunk=4096, U=2..3500) show plain gathers now
+    compile cleanly and run ~2.4x faster at large U, so the fleet solve
+    (scheduler/fleet.py) uses plain gathers. This helper is retained for
+    callers that want the matmul form (and as the fallback should a future
+    toolchain regress); the 16-bit split keeps the selection exact for
+    EVERY int32 value (sentinels included) — each half fits f32's mantissa
+    and a one-hot row selects a single entry, so there is no accumulation
+    error."""
     u = table.shape[0]
     onehot = jax.nn.one_hot(idx, u, dtype=jnp.float32)  # [B, U]
     # 16-bit split keeps every int32 exact in f32 (each half < 2^16 and the

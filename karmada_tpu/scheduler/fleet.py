@@ -20,10 +20,12 @@ Tunnel-aware design (measured on the v5e tunnel: ~25-30 MB/s transfers,
 - all per-row state is gathered ON DEVICE from resident arrays (`rows` is
   the only per-pass index upload, and the all-rows storm case keeps even
   that cached on device);
-- placement/taint/static-weight masks are interned per unique placement and
-  gathered per chunk via the one-hot-matmul row gather
-  (ops.estimate.gather_profile_rows) — plain [B]-index gathers inside
-  lax.scan hang XLA compilation on the tunneled backend;
+- placement/taint/static-weight masks are interned per unique placement
+  and gathered per chunk with plain [B]-index row gathers (re-probed on
+  the current backend across U=2..3500: compiles cleanly and runs at
+  bandwidth; the historical one-hot-matmul workaround for a scan-gather
+  compile hang remains in ops.estimate.gather_profile_rows for other
+  callers);
 - DELTA FETCH: the device keeps every row's previous (site << 8 | count)
   entry vector resident; a pass ships home only the rows whose vector
   CHANGED (plus one meta word per row), against a host-side mirror of the
@@ -60,7 +62,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.divide import AGGREGATED, DUPLICATED as S_DUPLICATED, _divide_batch
-from ..ops.estimate import MAX_INT32, gather_profile_rows, merge_estimates
+from ..ops.estimate import MAX_INT32, merge_estimates
 
 K_PREV = 32  # max previous-assignment sites on the fast path (small fleets
 # legitimately spread one binding over dozens of clusters; rows beyond this
@@ -157,12 +159,17 @@ def _fleet_solve(
             "b", c_ax,
         )
         prev_mask = prev > 0
-        cp_rows = gather_profile_rows(cp_table, cpc)  # [chunk, 3C]
+        # plain [B]-index row gathers: re-probed on the current backend at
+        # U in {2..3500} x W in {5k, 15k} — compiles fine and runs at
+        # bandwidth (~0.12s/pass) vs 0.29s+ for the one-hot matmul at
+        # heterogeneous U (the matmul workaround predates this backend;
+        # ops.estimate.gather_profile_rows keeps it for other callers)
+        cp_rows = cp_table[cpc]  # [chunk, 3C]
         aff_m = cp_rows[:, :c] != 0
         taint_m = cp_rows[:, c : 2 * c] != 0
         static_w = cp_rows[:, 2 * c :]
-        gvk_m = gather_profile_rows(gvk_table, gvc) != 0
-        general = gather_profile_rows(prof_table, pfc)
+        gvk_m = gvk_table[gvc] != 0
+        general = prof_table[pfc]
         # mask composition — same algebra as TensorScheduler._pack_chunk
         feasible = shard(
             aff_m
