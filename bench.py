@@ -468,10 +468,12 @@ def run_engine_north_star(args) -> dict:
                 pl.cluster_affinity = aff
                 pl.cluster_tolerations = tols
             out.append(pl)
+        from karmada_tpu.scheduler.fleet import MAX_SLOTS
+
         print(
             f"# heterogeneous tier: {len(out)} unique placements "
-            f"(MAX_SLOTS check: {'EXCEEDS' if len(out) > 4096 else 'fits'} "
-            "the 4096-slot fleet table)",
+            f"(MAX_SLOTS check: {'EXCEEDS' if len(out) > MAX_SLOTS else 'fits'} "
+            f"the {MAX_SLOTS}-slot fleet table)",
             file=sys.stderr,
         )
         return out

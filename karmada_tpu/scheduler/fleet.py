@@ -68,7 +68,11 @@ K_PREV = 32  # max previous-assignment sites on the fast path (small fleets
 # legitimately spread one binding over dozens of clusters; rows beyond this
 # take the general host path)
 MAX_REPLICAS_FAST = 128  # divided-strategy replica cap (bounds the entry vector)
-MAX_SLOTS = 4096  # unique placements/gvks/profiles before table rebuild
+MAX_SLOTS = 8192  # unique placements/gvks/profiles before table rebuild.
+# Sizing: the cp table is [U, 3C] int32 = 8192 x 15000 x 4B ~ 0.5 GB at
+# C=5000 — comfortable in 16 GB HBM, uploaded once per mask change; plain
+# row gathers make the per-pass cost independent of U. Fleets beyond this
+# many unique placements fall back to a table rebuild per schedule call.
 E_ROUND = 1 << 18  # entry-buffer quantum (bounds trace churn)
 
 
