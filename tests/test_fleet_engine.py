@@ -240,3 +240,73 @@ def test_fleet_lazy_results_expose_schedule_result_surface():
     assert res[0].success and sum(res[0].clusters.values()) == 6
     assert res[1].success and res[1].clusters == {}
     assert len(res[1].feasible) > 0
+
+
+def test_delta_fetch_sequence_fuzz():
+    """Multi-pass mutation fuzz for the delta-fetch machinery: random
+    per-pass mutations (replica bumps, prev rewrites, fresh flips, NEW
+    bindings, availability-only snapshot swaps, partial batches) must keep
+    the fleet path identical to a fresh host-path run on EVERY pass — the
+    resident entry base / host mirror / changed-bit protocol can never
+    serve a stale placement."""
+    rng = np.random.default_rng(123)
+    clusters = synthetic_fleet(40, seed=21)
+    snap = ClusterSnapshot(clusters)
+    problems = _mixed_problems(clusters, 240, 11)
+    eng = TensorScheduler(snap, chunk_size=64)
+    eng.fleet_threshold = 1
+    next_key = len(problems)
+    for pass_no in range(8):
+        op = pass_no % 4
+        if op == 1:  # mutate ~10% of rows
+            for i in rng.choice(len(problems), 24, replace=False):
+                p = problems[i]
+                problems[i] = BindingProblem(
+                    key=p.key, placement=p.placement,
+                    replicas=int(rng.integers(0, 40)), requests=p.requests,
+                    gvk=p.gvk,
+                    prev={
+                        clusters[int(j)].name: int(rng.integers(1, 9))
+                        for j in rng.choice(len(clusters), 2, replace=False)
+                    } if rng.random() < 0.5 else {},
+                    fresh=bool(rng.random() < 0.3),
+                )
+        elif op == 2:  # availability-only snapshot swap (token unchanged)
+            for cl in clusters:
+                rs = cl.status.resource_summary
+                for dim, q in list(rs.allocated.items()):
+                    cap = rs.allocatable.get(dim, 0)
+                    rs.allocated[dim] = int(
+                        min(max(0, q + int(rng.integers(-2, 3)) * max(1, cap // 100)), cap)
+                    )
+            snap = ClusterSnapshot(clusters)
+            assert eng.update_snapshot(snap)
+        elif op == 3:  # grow the fleet with new bindings
+            for _ in range(16):
+                problems.append(
+                    BindingProblem(
+                        key=f"b{next_key}",
+                        placement=problems[int(rng.integers(0, 4))].placement,
+                        replicas=int(rng.integers(0, 40)), requests=REQ,
+                        gvk="apps/v1/Deployment",
+                    )
+                )
+                next_key += 1
+        # alternate full batches with partial ones (delta rows subset)
+        if pass_no % 2 == 0:
+            batch = problems
+        else:
+            idx = sorted(
+                int(j) for j in rng.choice(len(problems), 96, replace=False)
+            )
+            batch = [problems[j] for j in idx]
+        got = eng.schedule(batch)
+        assert eng._fleet is not None, "fleet path did not engage"
+        host = TensorScheduler(snap)
+        want = host._schedule_host(
+            batch, [host._compiled(p.placement) for p in batch]
+        )
+        try:
+            _assert_same(want, got)
+        except AssertionError as e:
+            raise AssertionError(f"pass {pass_no}: {e}") from e
