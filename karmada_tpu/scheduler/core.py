@@ -175,6 +175,14 @@ class TensorScheduler:
         # table detect in-place snapshot swaps (update_snapshot)
         self._fleet = None
         self._snapshot_gen = 0
+        # (id(base compiled), selection bytes) -> (derived cp, pinned base)
+        self._selection_cache: dict = {}
+        # binding key -> (row fingerprint, derived cp | None): skips the
+        # packing+selection stage for unchanged spread rows in steady storms
+        self._derived_rows: dict = {}
+        # request-profile bytes -> availability row [C] (per snapshot gen)
+        self._sel_profile_rows: dict = {}
+        self._sel_profile_gen = -1
 
     PLACEMENT_CACHE_CAP = 8192
     #: minimum eligible-batch size before the device-resident path engages
@@ -229,6 +237,8 @@ class TensorScheduler:
         # recompiling thousands of selectors (~0.5s/pass at 3.5k placements)
         if snapshot.mask_token != self.snapshot.mask_token:
             self._placement_cache.clear()
+            self._selection_cache.clear()
+        self._derived_rows.clear()  # selections depend on capacities
         self.snapshot = snapshot
         self._snapshot_gen += 1
         return True
@@ -248,6 +258,15 @@ class TensorScheduler:
             from ..ops.divide import DUPLICATED as _DUP
             from .fleet import K_PREV as _KP, MAX_REPLICAS_FAST as _MRF
 
+            # spread-constraint rows ride the fleet too: their host-side
+            # group selection collapses to a per-row candidate mask, which
+            # is interned as a DERIVED placement (terms = the selection)
+            # so the device-resident path divides over exactly the selected
+            # set — SelectClusters becomes part of placement compilation
+            compiled = self._derive_spread_selections(problems, compiled)
+            self.last_breakdown["select"] = _time.perf_counter() - t0
+
+            t0 = _time.perf_counter()
             # THE fleet-eligibility predicate (single source of truth):
             # placement half precomputed as cp.fleet_single_term; the
             # per-problem half stays a plain inline expression because this
@@ -289,6 +308,153 @@ class TensorScheduler:
                         results[i] = res
                 return results
         return self._schedule_host(problems, compiled)
+
+    #: cap on interned selection variants; selection outcomes are memoized
+    #: by row content so real fleets produce few — the cap only bounds
+    #: adversarial churn
+    SELECTION_CACHE_CAP = 8192
+
+    def _derive_spread_selections(
+        self,
+        problems: Sequence[BindingProblem],
+        compiled: list[CompiledPlacement],
+    ) -> list[CompiledPlacement]:
+        """Replace each single-term spread-constraint row's compiled
+        placement with a DERIVED one whose affinity term IS the selected
+        candidate set (select_clusters.go's SelectClusters stage folded
+        into placement compilation). Selection runs on host exactly as the
+        general path's Select stage does (same code, same memoization);
+        the interned result makes the row fleet-eligible, so spread
+        workloads get the device-resident delta-fetch path. Rows the
+        selection REJECTS (FitError) keep their original placement and
+        fall through to the host path, which reports the failure.
+
+        Steady-state cost: selections are pure in (snapshot generation,
+        placement, replicas/requests/prev), so a per-binding-key cache
+        skips the whole packing+selection stage for unchanged rows, and
+        availability rows come from a per-profile cache (one device fetch
+        per NEW profile per snapshot generation)."""
+        from .spread import select_clusters_batch
+
+        # cheap predicate: fleet_single_term is precomputed per compiled
+        # placement; a single-term cp that is NOT fleet-eligible is exactly
+        # a spread-constrained one (the ignore rule is folded in)
+        spread_idx = [
+            i
+            for i, cp in enumerate(compiled)
+            if len(cp.terms) == 1 and not cp.fleet_single_term
+        ]
+        if not spread_idx:
+            return compiled
+        compiled = list(compiled)
+        snap = self.snapshot
+        gen = self._snapshot_gen
+        cache = self._selection_cache
+        row_cache = self._derived_rows
+        pending: list[int] = []
+        for i in spread_idx:
+            p = problems[i]
+            fp = (
+                gen, id(p.placement), p.replicas,
+                tuple(p.requests.items()), tuple(p.prev.items()),
+            )
+            hit = row_cache.get(p.key)
+            if hit is not None and hit[0] == fp:
+                if hit[1] is not None:
+                    compiled[i] = hit[1]
+                continue  # None = cached FitError: stay on the host path
+            pending.append(i)
+        if not pending:
+            return compiled
+
+        for start in range(0, len(pending), self.chunk_size):
+            idx = pending[start : start + self.chunk_size]
+            sub_p = [problems[i] for i in idx]
+            sub_c = [compiled[i] for i in idx]
+            feasible, _strat, replicas, _sw, requests, prev, _fr = (
+                self._pack_chunk(sub_p, sub_c, 0)
+            )
+            avail = self._selection_availability(requests, replicas, gen)
+            candidates = select_clusters_batch(
+                snap, sub_p, sub_c, 0, feasible, avail, prev
+            )
+            for k, i in enumerate(idx):
+                p = problems[i]
+                fp = (
+                    gen, id(p.placement), p.replicas,
+                    tuple(p.requests.items()), tuple(p.prev.items()),
+                )
+                sel = candidates[k]
+                if not sel.any():
+                    row_cache[p.key] = (fp, None)  # FitError: host reports
+                    continue
+                base = compiled[i]
+                key = (id(base), sel.tobytes())
+                entry = cache.get(key)
+                if entry is None:
+                    c = snap.num_clusters
+                    derived = CompiledPlacement(
+                        placement=base.placement,
+                        terms=[(base.terms[0][0], sel.copy())],
+                        # selection already ran on the post-filter set;
+                        # all-true here keeps the fleet's leniency
+                        # re-composition idempotent
+                        taint_ok=np.ones(c, bool),
+                        spread_field_ok=np.ones(c, bool),
+                        strategy=base.strategy,
+                        static_weights=base.static_weights,
+                        spread_constraints=[],
+                        fleet_single_term=True,
+                    )
+                    derived.derived = True  # fleet keys rows on id(derived)
+                    if len(cache) >= self.SELECTION_CACHE_CAP:
+                        cache.clear()
+                    # pin base: the key embeds id(base) — a GC'd base whose
+                    # address is recycled must not alias a cache entry
+                    cache[key] = (derived, base)
+                else:
+                    derived = entry[0]
+                compiled[i] = derived
+                row_cache[p.key] = (fp, derived)
+        if len(row_cache) > 4 * max(len(problems), 1) + 65536:
+            row_cache.clear()  # key-churn bound; repopulates next pass
+        return compiled
+
+    def _selection_availability(
+        self, requests: np.ndarray, replicas: np.ndarray, gen: int
+    ) -> np.ndarray:
+        """Per-row availability for the Select stage from a per-profile
+        cache: one device fetch per NEW request profile per snapshot
+        generation (requests repeat fleet-wide), mirroring merge_estimates
+        exactly — min over estimates with -1 ignored, MAX_INT32 sentinel
+        clamped to spec.Replicas, zero-replica short-circuit."""
+        from ..ops.estimate import MAX_INT32 as _MI
+
+        if self._sel_profile_gen != gen:
+            self._sel_profile_gen = gen
+            self._sel_profile_rows.clear()
+        uniq, inv = np.unique(requests, axis=0, return_inverse=True)
+        missing = [
+            u for u in range(len(uniq))
+            if uniq[u].tobytes() not in self._sel_profile_rows
+        ]
+        if missing:
+            table = np.asarray(
+                self._profile_table(uniq[np.asarray(missing)])
+            ).astype(np.int64)
+            for row, u in enumerate(missing):
+                self._sel_profile_rows[uniq[u].tobytes()] = table[row]
+        dense = np.stack(
+            [self._sel_profile_rows[uniq[u].tobytes()] for u in range(len(uniq))]
+        )[inv]
+        reps_col = replicas.astype(np.int64)[:, None]
+        avail = np.where(
+            dense == int(_MI), reps_col, np.where(dense < 0, reps_col, dense)
+        )
+        # zero-replica rows short-circuit to the sentinel path exactly
+        # like merge_estimates (avail == replicas == 0 everywhere)
+        avail = np.where(reps_col == 0, 0, avail)
+        return np.minimum(avail, int(_MI)).astype(np.int32)
 
     def _schedule_host(
         self,

@@ -573,9 +573,17 @@ class FleetTable:
         self._dev_state = None  # full re-upload
 
     @staticmethod
-    def _fingerprint(p) -> tuple:
+    def _fingerprint(p, compiled) -> tuple:
+        # DERIVED placements (spread selections interned by core.schedule)
+        # carry their candidate set in the compiled object, so the row must
+        # re-pack whenever the derived object changes — its identity IS the
+        # selection content (interned per (base, mask)). Plain placements
+        # key on the Placement object: their compiled masks recompile IN
+        # PLACE at the same slot on snapshot swaps.
         return (
-            id(p.placement), p.replicas, p.gvk, p.fresh,
+            id(p.placement),
+            id(compiled) if getattr(compiled, "derived", False) else None,
+            p.replicas, p.gvk, p.fresh,
             tuple(p.requests.items()), tuple(p.prev.items()),
         )
 
@@ -583,9 +591,14 @@ class FleetTable:
         row = self._key_row.get(problem.key)
         if row is not None:
             self._row_last_used[row] = self._pass
-            if self._problems[row] is problem:
+            # O(1) fast path: same problem object AND same compiled
+            # identity class (the stored fingerprint's derived-id element
+            # pins derived selections; None for plain placements)
+            if self._problems[row] is problem and self._fps[row][1] == (
+                id(compiled) if getattr(compiled, "derived", False) else None
+            ):
                 return row
-            fp = self._fingerprint(problem)
+            fp = self._fingerprint(problem, compiled)
             if fp == self._fps[row]:
                 self._problems[row] = problem
                 return row
@@ -656,12 +669,46 @@ class FleetTable:
                 k += 1
         st["prev_sites"][row] = sites
         st["prev_counts"][row] = cnts
-        self._fps[row] = self._fingerprint(problem)
+        self._fps[row] = self._fingerprint(problem, compiled)
         self._terms[row] = compiled.terms[0][0]
         self._dirty.add(row)
 
+    def _compact_slots(self) -> None:
+        """Drop DERIVED placement slots no live row references: selection
+        drift interns new variants every availability change, and without
+        eviction a long-lived engine would cross MAX_SLOTS and discard the
+        whole table (losing the delta base for every row). Plain placement
+        slots are never dropped — they are stable and few. Triggers a full
+        table rebuild + state re-upload, so it runs only under pressure."""
+        used = set(
+            int(s) for s in np.unique(self._st["cp_idx"][: self.n_rows])
+        )
+        keep = [
+            i
+            for i, (pl, cp) in enumerate(self._cp_pl)
+            if i in used or not getattr(cp, "derived", False)
+        ]
+        if len(keep) == len(self._cp_pl):
+            return
+        remap = np.full(len(self._cp_pl), -1, np.int32)
+        for new_i, old_i in enumerate(keep):
+            remap[old_i] = new_i
+        self._cp_pl = [self._cp_pl[i] for i in keep]
+        self._cp_slot = {id(cp): i for i, (pl, cp) in enumerate(self._cp_pl)}
+        self._static_max = max(
+            (int(cp.static_weights.max(initial=0)) for _, cp in self._cp_pl),
+            default=0,
+        )
+        self._st["cp_idx"][: self.n_rows] = remap[
+            self._st["cp_idx"][: self.n_rows]
+        ]
+        self._tables_dirty = True
+        self._dev_state = None  # cp_idx remapped: full re-upload
+
     @property
     def slots_exhausted(self) -> bool:
+        if len(self._cp_pl) > MAX_SLOTS * 3 // 4:
+            self._compact_slots()
         return (
             len(self._cp_pl) > MAX_SLOTS
             or len(self._gvk_list) > MAX_SLOTS
@@ -677,12 +724,20 @@ class FleetTable:
         if gen != self._snapshot_gen:
             # snapshot swapped in place (same cluster set): recompile each
             # slot's placement against the new snapshot, order-preserving so
-            # row cp_idx values stay valid
+            # row cp_idx values stay valid. DERIVED slots (interned spread
+            # selections) are NOT recompiled — their mask IS the selection
+            # content owned by core's selection cache; re-derivation happens
+            # upstream per pass, landing changed selections in NEW slots via
+            # the id(derived)-keyed row fingerprints. Recompiling them here
+            # would overwrite the selection with the base affinity mask.
             self._snapshot_gen = gen
             self._cp_slot.clear()
             self._static_max = 0
-            for i, (pl, _) in enumerate(self._cp_pl):
-                cp = self.engine._compiled(pl)
+            for i, (pl, cp_old) in enumerate(self._cp_pl):
+                if getattr(cp_old, "derived", False):
+                    cp = cp_old
+                else:
+                    cp = self.engine._compiled(pl)
                 self._cp_pl[i] = (pl, cp)
                 self._cp_slot[id(cp)] = i
                 self._static_max = max(

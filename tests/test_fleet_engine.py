@@ -310,3 +310,113 @@ def test_delta_fetch_sequence_fuzz():
             _assert_same(want, got)
         except AssertionError as e:
             raise AssertionError(f"pass {pass_no}: {e}") from e
+
+
+def test_spread_rows_ride_the_fleet_and_match_host_path():
+    """Spread-constraint selections intern as DERIVED placements so those
+    rows ride the device-resident path; placements must equal the host
+    path exactly, and capacity drift that changes the selection must
+    re-pack the affected rows (derived identity = selection content)."""
+    from karmada_tpu.api.policy import (
+        ClusterAffinity, LabelSelector, SpreadConstraint,
+    )
+
+    rng = np.random.default_rng(77)
+    clusters = synthetic_fleet(60, seed=13)
+    snap = ClusterSnapshot(clusters)
+    pls = []
+    for _ in range(4):
+        pls.append(
+            dynamic_weight_placement(
+                cluster_affinity=ClusterAffinity(
+                    label_selector=LabelSelector(
+                        match_labels={"env": str(rng.choice(["prod", "staging", "dev"]))}
+                    )
+                ),
+                spread_constraints=[
+                    SpreadConstraint(
+                        spread_by_field="region",
+                        min_groups=int(rng.integers(1, 3)),
+                        max_groups=int(rng.integers(3, 6)),
+                    ),
+                    SpreadConstraint(
+                        spread_by_field="cluster",
+                        min_groups=2,
+                        max_groups=int(rng.integers(4, 12)),
+                    ),
+                ],
+            )
+        )
+    problems = [
+        BindingProblem(
+            key=f"s{i}", placement=pls[i % 4],
+            replicas=int(rng.integers(1, 30)), requests=REQ,
+            gvk="apps/v1/Deployment",
+            prev={
+                clusters[int(j)].name: int(rng.integers(1, 6))
+                for j in rng.choice(len(clusters), 2, replace=False)
+            } if rng.random() < 0.4 else {},
+        )
+        for i in range(300)
+    ]
+    eng = TensorScheduler(snap, chunk_size=128)
+    eng.fleet_threshold = 1
+    got = eng.schedule(problems)
+    assert eng._fleet is not None, "spread rows must engage the fleet"
+    # the fleet table actually carries them (derived placements interned)
+    assert eng._fleet.n_rows >= 250
+    host = TensorScheduler(snap)
+    want = host._schedule_host(
+        problems, [host._compiled(p.placement) for p in problems]
+    )
+    _assert_same(want, got)
+
+    # capacity drift changes selections: the derived identities change and
+    # the fleet re-packs — still identical to a fresh host run
+    for cl in clusters:
+        rs = cl.status.resource_summary
+        rs.allocated["cpu"] = int(rs.allocatable.get("cpu", 0) * float(rng.uniform(0.1, 0.9)))
+    snap2 = ClusterSnapshot(clusters)
+    assert eng.update_snapshot(snap2)
+    got2 = eng.schedule(problems)
+    host2 = TensorScheduler(snap2)
+    want2 = host2._schedule_host(
+        problems, [host2._compiled(p.placement) for p in problems]
+    )
+    _assert_same(want2, got2)
+
+
+def test_zero_replica_spread_rows_match_host_path():
+    """Zero-replica (non-workload) spread rows must expose the same
+    feasible/selected set on the fleet path as on the host path — the
+    selection availability mirrors merge_estimates' zero-replica
+    short-circuit exactly."""
+    from karmada_tpu.api.policy import (
+        ClusterAffinity, LabelSelector, SpreadConstraint,
+    )
+
+    clusters = synthetic_fleet(30, seed=4)
+    snap = ClusterSnapshot(clusters)
+    pl = dynamic_weight_placement(
+        cluster_affinity=ClusterAffinity(
+            label_selector=LabelSelector(match_labels={"env": "prod"})
+        ),
+        spread_constraints=[
+            SpreadConstraint(spread_by_field="region", min_groups=1, max_groups=3),
+            SpreadConstraint(spread_by_field="cluster", min_groups=1, max_groups=5),
+        ],
+    )
+    problems = [
+        BindingProblem(key=f"z{i}", placement=pl, replicas=(0 if i % 3 == 0 else 5),
+                       requests=REQ, gvk="apps/v1/Deployment")
+        for i in range(120)
+    ]
+    eng = TensorScheduler(snap, chunk_size=64)
+    eng.fleet_threshold = 1
+    got = eng.schedule(problems)
+    assert eng._fleet is not None
+    host = TensorScheduler(snap)
+    want = host._schedule_host(
+        problems, [host._compiled(p.placement) for p in problems]
+    )
+    _assert_same(want, got)
