@@ -920,3 +920,45 @@ class TestFieldOverriderNoOps:
             FieldOverrider(field_path="/spec/data/cfg.json")]))
         # no ops -> the embedded JSON must NOT be re-serialized as YAML
         assert obj.spec["data"]["cfg.json"] == '{"a": 1}'
+
+
+class TestSpreadConstraintPolicy:
+    """Plane-level spread constraints: a PropagationPolicy carrying
+    region+cluster SpreadConstraints schedules through the engine's
+    derived-selection fleet path and honors the constraint bounds."""
+
+    def test_spread_policy_bounds_regions_and_clusters(self):
+        from karmada_tpu.api.policy import SpreadConstraint
+
+        cp = ControlPlane()
+        for i in range(1, 9):
+            cluster = new_cluster(f"m{i}", cpu="100", memory="200Gi")
+            cluster.spec.region = f"r{(i - 1) // 2}"  # 4 regions x 2
+            cp.join_cluster(cluster)
+        cp.settle()
+        placement = dynamic_weight_placement(
+            spread_constraints=[
+                SpreadConstraint(spread_by_field="region",
+                                 min_groups=2, max_groups=3),
+                SpreadConstraint(spread_by_field="cluster",
+                                 min_groups=2, max_groups=4),
+            ]
+        )
+        cp.store.apply(new_deployment("spread-app", replicas=8))
+        cp.store.apply(nginx_policy(placement))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/spread-app-deployment")
+        assert rb is not None and rb.spec.clusters, "not scheduled"
+        placed = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        assert sum(placed.values()) == 8
+        regions = {
+            cp.store.get("Cluster", n).spec.region for n in placed
+        }
+        assert 2 <= len(regions) <= 3, regions
+        assert 2 <= len(placed) <= 4, placed
+        # members actually hold the divided workload
+        for name, reps in placed.items():
+            obj = cp.members.get(name).get(
+                "apps/v1/Deployment", "default", "spread-app"
+            )
+            assert obj is not None and obj.spec["replicas"] == reps
