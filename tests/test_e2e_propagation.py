@@ -26,6 +26,7 @@ from karmada_tpu.api.policy import (
     Overriders,
     RuleWithCluster,
     ClusterAffinity,
+    SpreadConstraint,
 )
 from karmada_tpu.controllers import execution_namespace
 from karmada_tpu.controlplane import ControlPlane
@@ -928,13 +929,12 @@ class TestSpreadConstraintPolicy:
     derived-selection fleet path and honors the constraint bounds."""
 
     def test_spread_policy_bounds_regions_and_clusters(self):
-        from karmada_tpu.api.policy import SpreadConstraint
-
         cp = ControlPlane()
         for i in range(1, 9):
-            cluster = new_cluster(f"m{i}", cpu="100", memory="200Gi")
-            cluster.spec.region = f"r{(i - 1) // 2}"  # 4 regions x 2
-            cp.join_cluster(cluster)
+            cp.join_cluster(
+                new_cluster(f"m{i}", cpu="100", memory="200Gi",
+                            region=f"r{(i - 1) // 2}")  # 4 regions x 2
+            )
         cp.settle()
         placement = dynamic_weight_placement(
             spread_constraints=[
