@@ -611,6 +611,7 @@ def run_engine_north_star(args) -> dict:
         t0 = time.perf_counter()
         h_engine.schedule(h_problems)
         print(f"# hetero warm pass: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+        h_engine.schedule(h_problems)  # stabilize (entry-cap settles)
         h_times = []
         for rep in range(3):
             t0 = time.perf_counter()
