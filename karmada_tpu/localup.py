@@ -172,9 +172,17 @@ def serve_plane(args) -> None:
 
     bus = StoreBusServer(cp.store, args.bus_address)
     bus_port = bus.start()
-    proxy = ClusterProxyServer(cp.members, tokens={"admin-token": ("admin", ["system:masters"])})
+
+    def addr(spec: str) -> tuple[str, int]:
+        host, _, port = spec.partition(":")
+        return (host or "127.0.0.1", int(port or 0))
+
+    proxy = ClusterProxyServer(
+        cp.members, addr(args.proxy_address),
+        tokens={"admin-token": ("admin", ["system:masters"])},
+    )
     proxy_port = proxy.start()
-    metrics = MetricsServer()
+    metrics = MetricsServer(address=addr(args.metrics_address))
     metrics_port = metrics.start()
     cp.settle()
     print(
@@ -196,9 +204,19 @@ def serve_plane(args) -> None:
 
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)
+    last_ckpt = time.time()
     try:
         while not stop[0]:
             cp.settle()
+            if (
+                args.state_file
+                and args.checkpoint_interval > 0
+                and time.time() - last_ckpt >= args.checkpoint_interval
+            ):
+                # periodic durability: a SIGKILLed plane restarts from the
+                # last interval snapshot, not from empty (etcd analogue)
+                cp.store.checkpoint(args.state_file)
+                last_ckpt = time.time()
             time.sleep(args.loop_interval)
     finally:
         if args.state_file:
@@ -349,6 +367,13 @@ def main(argv=None) -> None:
     sv.add_argument("--state-file", default="",
                     help="checkpoint/restore path for the store (the etcd "
                     "persistence analogue across plane restarts)")
+    sv.add_argument("--checkpoint-interval", type=float, default=15.0,
+                    help="periodic store checkpoint seconds (0 = only on "
+                    "shutdown); bounds data loss on a hard kill")
+    sv.add_argument("--proxy-address", default="127.0.0.1:0",
+                    help="pin the cluster-proxy bind address")
+    sv.add_argument("--metrics-address", default="127.0.0.1:0",
+                    help="pin the /metrics bind address")
 
     up = sub.add_parser("up", help="spawn the full multi-process deployment")
     up.add_argument("--members", type=int, default=2)

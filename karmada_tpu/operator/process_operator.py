@@ -72,9 +72,10 @@ def _stop(proc: Optional[subprocess.Popen], grace: float = 5.0) -> None:
 class ProcessKarmadaOperator:
     """Reconciles Karmada CRs into multi-process deployments."""
 
-    def __init__(self) -> None:
+    def __init__(self, checkpoint_interval: float = 15.0) -> None:
         self.instances: dict[str, ProcessInstance] = {}
         self._applied_specs: dict[str, KarmadaSpec] = {}
+        self.checkpoint_interval = checkpoint_interval
 
     # -- public ------------------------------------------------------------
 
@@ -109,6 +110,38 @@ class ProcessKarmadaOperator:
         finally:
             karmada.status.completed_tasks = list(job.completed)
         return self.instances[name]
+
+    def supervise(self, karmada: Karmada) -> list[str]:
+        """One supervision sweep (the Deployment-controller analogue the
+        reference gets from Kubernetes itself): restart any dead component
+        of an installed instance at its PINNED endpoint. The plane restarts
+        from its latest periodic checkpoint; gRPC clients (RemoteSolver,
+        estimator connections, StoreReplica agents) reconnect to the pinned
+        ports on their own — the solver's snapshot-version fencing re-syncs
+        cluster state on the first post-restart schedule. Returns the
+        component names restarted."""
+        inst = self.instances.get(karmada.meta.name)
+        if inst is None:
+            return []
+        data = {"karmada": karmada}
+        restarted: list[str] = []
+        starters = {
+            "webhook": self._start_webhook,
+            "solver": self._start_solver,
+            "estimator": self._start_estimator,
+            "plane": self._start_plane,
+        }
+        for comp, proc in list(inst.procs.items()):
+            if proc.poll() is None:
+                continue
+            if comp.startswith("agent-"):
+                self._spawn_agent(inst, comp[len("agent-"):])
+            else:
+                starters[comp](data)
+            restarted.append(comp)
+        if restarted:
+            self._wait_ready(data)
+        return restarted
 
     def deinit(self, karmada: Karmada) -> None:
         inst = self.instances.pop(karmada.meta.name, None)
@@ -180,8 +213,13 @@ class ProcessKarmadaOperator:
 
     def _start_webhook(self, data: dict) -> None:
         inst = self._instance(data)
+        # pinned on restart: the live plane's RemoteAdmission keeps dialing
+        # the URL it was constructed with
+        prev = str(inst.endpoints.get("webhook", ""))
+        port = prev.rsplit(":", 1)[-1].split("/")[0] if prev else "0"
         proc = _spawn(
             [sys.executable, "-m", "karmada_tpu.webhook.server",
+             "--address", f"127.0.0.1:{port}",
              "--certfile", os.path.join(inst.pki_dir, "webhook.crt"),
              "--keyfile", os.path.join(inst.pki_dir, "webhook.key")]
         )
@@ -191,18 +229,20 @@ class ProcessKarmadaOperator:
 
     def _start_solver(self, data: dict) -> None:
         inst = self._instance(data)
+        port = inst.endpoints.get("solver", 0)  # pinned on restart
         proc = _spawn(
             [sys.executable, "-m", "karmada_tpu.solver",
-             "--address", "127.0.0.1:0"]
+             "--address", f"127.0.0.1:{port}"]
         )
         inst.procs["solver"] = proc
         inst.endpoints["solver"] = int(_scrape(proc, r"port (\d+)"))
 
     def _start_estimator(self, data: dict) -> None:
         inst = self._instance(data)
+        port = inst.endpoints.get("estimator", 0)  # pinned on restart
         proc = _spawn(
             [sys.executable, "-m", "karmada_tpu.estimator",
-             "--cluster", "member1", "--address", "127.0.0.1:0"]
+             "--cluster", "member1", "--address", f"127.0.0.1:{port}"]
         )
         inst.procs["estimator"] = proc
         inst.endpoints["estimator"] = int(_scrape(proc, r"port (\d+)"))
@@ -215,7 +255,16 @@ class ProcessKarmadaOperator:
             sys.executable, "-m", "karmada_tpu.localup", "serve",
             "--members", str(max(1, len(spec.member_clusters) or 2)),
             "--state-file", os.path.join(inst.pki_dir, "store.ckpt"),
+            "--checkpoint-interval", str(self.checkpoint_interval),
         ]
+        # pinned surfaces on restart: agents / CLIs / supervision probes
+        # keep their targets across plane replacements
+        if "bus" in inst.endpoints:
+            cmd += ["--bus-address", f"127.0.0.1:{inst.endpoints['bus']}"]
+        if "proxy" in inst.endpoints:
+            cmd += ["--proxy-address", f"127.0.0.1:{inst.endpoints['proxy']}"]
+        if "metrics" in inst.endpoints:
+            cmd += ["--metrics-address", f"127.0.0.1:{inst.endpoints['metrics']}"]
         for name in spec.pull_members:
             cmd += ["--pull", name]
         if "solver" in inst.endpoints:
@@ -250,16 +299,18 @@ class ProcessKarmadaOperator:
             clusters=info["clusters"],
         )
 
+    def _spawn_agent(self, inst: ProcessInstance, name: str) -> None:
+        inst.procs[f"agent-{name}"] = _spawn(
+            [sys.executable, "-m", "karmada_tpu.bus.agent",
+             "--target", f"127.0.0.1:{inst.endpoints['bus']}",
+             "--cluster", name]
+        )
+
     def _start_agents(self, data: dict) -> None:
         inst = self._instance(data)
         karmada = data["karmada"]
         for name in karmada.spec.pull_members:
-            proc = _spawn(
-                [sys.executable, "-m", "karmada_tpu.bus.agent",
-                 "--target", f"127.0.0.1:{inst.endpoints['bus']}",
-                 "--cluster", name]
-            )
-            inst.procs[f"agent-{name}"] = proc
+            self._spawn_agent(inst, name)
 
     def _wait_ready(self, data: dict) -> None:
         inst = self._instance(data)
@@ -342,9 +393,4 @@ class ProcessKarmadaOperator:
         for comp in [c for c in inst.procs if c.startswith("agent-")]:
             _stop(inst.procs.pop(comp))
         for name in want:
-            proc = _spawn(
-                [sys.executable, "-m", "karmada_tpu.bus.agent",
-                 "--target", f"127.0.0.1:{inst.endpoints['bus']}",
-                 "--cluster", name]
-            )
-            inst.procs[f"agent-{name}"] = proc
+            self._spawn_agent(inst, name)

@@ -163,18 +163,26 @@ class Store:
         the store is the single source of truth, controllers and the solver
         are stateless, so a snapshot + replay IS resume). Returns the number
         of objects written."""
+        import os
         import pickle
 
-        # Serialize while holding the lock: the bucket copies are shallow, so
-        # pickling after release could tear the snapshot if a concurrent
-        # writer mutates an object mid-dump.
+        # Hold the lock only for the (cheap, shallow) bucket copies: the
+        # store lock never guarded in-place OBJECT mutation anyway, so
+        # pickling outside it is no less consistent and a live plane's
+        # periodic checkpoints stop stalling every concurrent read/write
+        # for the full serialization time.
         with self._lock:
             payload = {
                 kind: dict(bucket) for kind, bucket in self._buckets.items()
             }
-            blob = pickle.dumps({"rv": self._rv, "buckets": payload})
-        with open(path, "wb") as f:
+            rv = self._rv
+        blob = pickle.dumps({"rv": rv, "buckets": payload})
+        # atomic replace: a crash (or SIGKILL) mid-write must never leave a
+        # truncated snapshot that bricks the next restore
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
             f.write(blob)
+        os.replace(tmp, path)
         return sum(len(b) for b in payload.values())
 
     def restore(self, path: str) -> int:

@@ -178,3 +178,108 @@ class TestProcessOperator:
                 r.close()
         finally:
             op.deinit(cr)
+
+    def test_supervision_restarts_dead_components_at_pinned_ports(self):
+        """The operator's supervision sweep (Deployment-controller
+        analogue): SIGKILLed components restart at their PINNED endpoints;
+        the plane returns from its periodic checkpoint; connected clients
+        (bus replicas, the plane's solver channel) recover on their own."""
+        op = ProcessKarmadaOperator(checkpoint_interval=0.5)
+        cr = Karmada(meta=ObjectMeta(name="heal", generation=1))
+        inst = op.reconcile(cr)
+        bus = f"127.0.0.1:{inst.endpoints['bus']}"
+        r = StoreReplica(bus)
+        r.start()
+        assert r.wait_synced(10)
+        try:
+            r.apply(new_deployment("pre-crash", replicas=1))
+            assert wait_for(
+                lambda: r.store.get("Resource", "default/pre-crash") is not None
+            )
+            time.sleep(1.2)  # let a periodic checkpoint cover the object
+
+            # solver dies -> restarted at the same port; scheduling resumes
+            solver_port = inst.endpoints["solver"]
+            inst.procs["solver"].kill()
+            inst.procs["solver"].wait(timeout=5)
+            restarted = op.supervise(cr)
+            assert "solver" in restarted
+            assert inst.endpoints["solver"] == solver_port
+            assert inst.alive("solver")
+
+            # plane dies HARD (no shutdown checkpoint) -> restarted at the
+            # same bus port from the periodic snapshot
+            inst.procs["plane"].kill()
+            inst.procs["plane"].wait(timeout=5)
+            restarted = op.supervise(cr)
+            assert "plane" in restarted
+            assert f"127.0.0.1:{inst.endpoints['bus']}" == bus  # pinned
+
+            def recovered():
+                return r.store.get("Resource", "default/pre-crash") is not None
+
+            assert wait_for(recovered, timeout=20.0), (
+                "pre-crash state lost after hard plane kill"
+            )
+
+            # end-to-end health: a NEW workload schedules through the
+            # restarted plane + solver
+            from karmada_tpu.api import (
+                PropagationPolicy, PropagationSpec, ResourceSelector,
+            )
+            from karmada_tpu.utils.builders import duplicated_placement
+
+            def apply_ok():
+                try:
+                    r.apply(new_deployment("post-heal", replicas=1))
+                    r.apply(
+                        PropagationPolicy(
+                            meta=ObjectMeta(name="heal-pp", namespace="default"),
+                            spec=PropagationSpec(
+                                resource_selectors=[
+                                    ResourceSelector(
+                                        api_version="apps/v1", kind="Deployment"
+                                    )
+                                ],
+                                placement=duplicated_placement(),
+                            ),
+                        )
+                    )
+                    return True
+                except Exception:
+                    return False
+
+            assert wait_for(apply_ok, timeout=15.0)
+
+            def scheduled():
+                rb = r.store.get(
+                    "ResourceBinding", "default/post-heal-deployment"
+                )
+                return rb is not None and len(rb.spec.clusters) >= 1
+
+            assert wait_for(scheduled, timeout=60.0), (
+                "scheduling never resumed after supervision restarts"
+            )
+
+            # webhook dies -> restarted at the SAME URL, so the live
+            # plane's RemoteAdmission keeps working without a restart
+            url = inst.endpoints["webhook"]
+            inst.procs["webhook"].kill()
+            inst.procs["webhook"].wait(timeout=5)
+            restarted = op.supervise(cr)
+            assert "webhook" in restarted
+            assert inst.endpoints["webhook"] == url
+
+            def admitted_write():
+                try:
+                    r.apply(new_deployment("post-webhook-heal", replicas=1))
+                    return True
+                except Exception:
+                    return False
+
+            assert wait_for(admitted_write, timeout=15.0), (
+                "writes never recovered after webhook restart"
+            )
+        finally:
+            r.close()
+            op.deinit(cr)
