@@ -173,9 +173,7 @@ def serve_plane(args) -> None:
     bus = StoreBusServer(cp.store, args.bus_address)
     bus_port = bus.start()
 
-    def addr(spec: str) -> tuple[str, int]:
-        host, _, port = spec.partition(":")
-        return (host or "127.0.0.1", int(port or 0))
+    from .utils.net import parse_hostport as addr
 
     proxy = ClusterProxyServer(
         cp.members, addr(args.proxy_address),
@@ -205,6 +203,7 @@ def serve_plane(args) -> None:
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)
     last_ckpt = time.time()
+    last_ckpt_rv = -1
     try:
         while not stop[0]:
             cp.settle()
@@ -214,8 +213,13 @@ def serve_plane(args) -> None:
                 and time.time() - last_ckpt >= args.checkpoint_interval
             ):
                 # periodic durability: a SIGKILLed plane restarts from the
-                # last interval snapshot, not from empty (etcd analogue)
-                cp.store.checkpoint(args.state_file)
+                # last interval snapshot, not from empty (etcd analogue).
+                # Skipped while the store rv is unchanged — an idle plane
+                # must not re-serialize its whole store every interval.
+                rv = cp.store.rv
+                if rv != last_ckpt_rv:
+                    cp.store.checkpoint(args.state_file)
+                    last_ckpt_rv = rv
                 last_ckpt = time.time()
             time.sleep(args.loop_interval)
     finally:

@@ -252,8 +252,9 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--address", default="127.0.0.1:0")
     args = p.parse_args(argv)
-    host, _, port = args.address.partition(":")
-    server = IndexerServer((host, int(port or 0)))
+    from ..utils.net import parse_hostport
+
+    server = IndexerServer(parse_hostport(args.address))
     bound = server.start()
     print(f"indexer listening on port {bound}", flush=True)
     try:

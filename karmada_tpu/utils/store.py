@@ -68,6 +68,13 @@ class Store:
 
     # -- mutation ----------------------------------------------------------
 
+    @property
+    def rv(self) -> int:
+        """Current resource-version counter (public read for change-gated
+        periodic checkpoints and diagnostics)."""
+        with self._lock:
+            return self._rv
+
     def advance_rv(self, rv: int) -> None:
         """Advance the resource-version counter to at least ``rv - 1`` so the
         NEXT apply stamps ``rv``. Public seam for replicas mirroring a
@@ -166,17 +173,17 @@ class Store:
         import os
         import pickle
 
-        # Hold the lock only for the (cheap, shallow) bucket copies: the
-        # store lock never guarded in-place OBJECT mutation anyway, so
-        # pickling outside it is no less consistent and a live plane's
-        # periodic checkpoints stop stalling every concurrent read/write
-        # for the full serialization time.
+        # Serialize while holding the lock: the bucket copies are shallow
+        # and delete()/finalize mutate stored objects' meta IN PLACE under
+        # the lock (store.py delete path), including from bus gRPC worker
+        # threads — pickling after release could tear the snapshot
+        # (tests/test_concurrency_torture.py pins this). The stall is
+        # bounded by callers checkpointing only when the rv moved.
         with self._lock:
             payload = {
                 kind: dict(bucket) for kind, bucket in self._buckets.items()
             }
-            rv = self._rv
-        blob = pickle.dumps({"rv": rv, "buckets": payload})
+            blob = pickle.dumps({"rv": self._rv, "buckets": payload})
         # atomic replace: a crash (or SIGKILL) mid-write must never leave a
         # truncated snapshot that bricks the next restore
         tmp = f"{path}.tmp"

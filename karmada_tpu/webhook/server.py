@@ -180,9 +180,10 @@ def main(argv=None) -> None:
     p.add_argument("--certfile", default="")
     p.add_argument("--keyfile", default="")
     args = p.parse_args(argv)
-    host, _, port = args.address.partition(":")
+    from ..utils.net import parse_hostport
+
     server = AdmissionWebhookServer(
-        address=(host, int(port or 0)),
+        address=parse_hostport(args.address),
         certfile=args.certfile or None,
         keyfile=args.keyfile or None,
     )

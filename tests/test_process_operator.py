@@ -257,7 +257,9 @@ class TestProcessOperator:
                 )
                 return rb is not None and len(rb.spec.clusters) >= 1
 
-            assert wait_for(scheduled, timeout=60.0), (
+            # generous timeout: the restarted solver may recompile its
+            # traces from a cold cache under CPU contention
+            assert wait_for(scheduled, timeout=150.0), (
                 "scheduling never resumed after supervision restarts"
             )
 
