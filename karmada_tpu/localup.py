@@ -55,7 +55,7 @@ def spawn_child(cmd: list[str]) -> subprocess.Popen:
     )
 
 
-def scrape_line(proc: subprocess.Popen, pattern: str, timeout: float = 60.0) -> str:
+def scrape_line(proc: subprocess.Popen, pattern: str, timeout: float = 240.0) -> str:
     """First regex group of the first stdout line matching ``pattern``.
 
     select()-gated so a child that hangs BEFORE printing (import stall,
@@ -90,7 +90,7 @@ def scrape_line(proc: subprocess.Popen, pattern: str, timeout: float = 60.0) -> 
             return m.group(1)
 
 
-def _scrape_port(proc: subprocess.Popen, pattern: str, timeout: float = 30.0) -> int:
+def _scrape_port(proc: subprocess.Popen, pattern: str, timeout: float = 240.0) -> int:
     return int(scrape_line(proc, pattern, timeout))
 
 
@@ -304,7 +304,7 @@ class LocalUp:
             if self.feature_gates:
                 plane_cmd += ["--feature-gates", self.feature_gates]
             p = self._spawn("plane", plane_cmd)
-            deadline = time.time() + 60
+            deadline = time.time() + 240
             while time.time() < deadline:
                 line = p.stdout.readline()
                 if line.startswith("{"):

@@ -621,7 +621,7 @@ class TensorScheduler:
         req = jnp.asarray(profiles_np)
         general = general_estimate(jnp.asarray(snap.available_cap), req)
         mp = snap.model_pack
-        if feature_gate.enabled(CUSTOMIZED_CLUSTER_RESOURCE_MODELING) and mp.has_models.any():
+        if self._models_active():
             # model path replaces the summary path where applicable, still
             # capped by allowed pods (general.go:63-94,118-135)
             from ..models import estimate_by_models
@@ -651,6 +651,43 @@ class TensorScheduler:
         return jnp.where(
             jnp.asarray(snap.has_summary)[None, :], general, jnp.int32(-1)
         )
+
+    def _models_active(self) -> bool:
+        """Whether the resource-model estimator path would answer — THE
+        predicate _profile_table activates the model estimation with; the
+        tiny-batch host fast path must gate on exactly the same condition
+        or small batches would silently diverge from the device path."""
+        return bool(
+            feature_gate.enabled(CUSTOMIZED_CLUSTER_RESOURCE_MODELING)
+            and self.snapshot.model_pack.has_models.any()
+        )
+
+    def _availability_np(
+        self, requests: np.ndarray, replicas: np.ndarray
+    ) -> np.ndarray:
+        """Host mirror of ``_availability`` for the tiny-batch fast path
+        (general estimator only — callers gate off models and out-of-tree
+        estimators): per-unique-profile floor division with merge_estimates'
+        exact sentinel semantics (no-summary -> no answer -> clamp to
+        spec.Replicas; zero-replica short-circuit)."""
+        from ..ops.estimate import MAX_INT32 as _MI
+
+        cap = np.maximum(np.asarray(self.snapshot.available_cap), 0)
+        uniq, inv = np.unique(requests, axis=0, return_inverse=True)
+        u, r = uniq.shape
+        table = np.full((u, cap.shape[0]), int(_MI), np.int64)
+        for d in range(r):
+            req = uniq[:, d]
+            ratio = cap[None, :, d] // np.maximum(req[:, None], 1)
+            table = np.where((req > 0)[:, None], np.minimum(table, ratio), table)
+        table = np.where(
+            np.asarray(self.snapshot.has_summary)[None, :], table, int(_MI)
+        )
+        dense = table[inv]
+        reps_col = replicas.astype(np.int64)[:, None]
+        avail = np.where(reps_col == 0, int(_MI), dense)
+        avail = np.where(avail == int(_MI), reps_col, avail)
+        return np.minimum(avail, int(_MI)).astype(np.int32)
 
     def _availability(self, requests: np.ndarray, replicas: np.ndarray) -> jnp.ndarray:
         """calAvailableReplicas (core/util.go:54-104): min-merge over
@@ -700,8 +737,24 @@ class TensorScheduler:
                 requests = np.pad(requests, ((0, pad), (0, 0)))
                 prev = np.pad(prev, ((0, pad), (0, 0)))
                 fresh = np.pad(fresh, (0, pad))
+        # tiny-batch host fast path: a handful of bindings pays more in
+        # device round-trips (~0.1s fixed each over a tunnel) than the
+        # whole problem costs in numpy. The vectorized-numpy divider is the
+        # oracle-verified identity referent (tests/test_divider_np.py +
+        # every bench run), so placements are bit-identical. Gated off
+        # whenever the resource-model estimator path or out-of-tree
+        # estimators could answer differently.
+        host_small = (
+            padded * snap.num_clusters <= 1 << 16
+            and not self.extra_estimators
+            and not self._models_active()
+        )
         with algo_timer.time(schedule_step="Score"):
-            avail = self._availability(requests, replicas)
+            avail = (
+                self._availability_np(requests, replicas)
+                if host_small
+                else self._availability(requests, replicas)
+            )
 
         # Select: spread-constraint group selection narrows the candidate set
         from .spread import select_clusters_batch  # local import (cycle-free)
@@ -714,11 +767,36 @@ class TensorScheduler:
                 snap, problems, compiled, term_round, feasible, avail, prev,
             )
 
+        if host_small:
+            # the numpy dispense packs (weight, last, index) into ONE int64
+            # key; inputs beyond that bound (near-MAX availability with
+            # large previous counts) must take the device kernels, which
+            # have no such packing
+            avail_np = np.asarray(avail)
+            wmax = int(
+                max(
+                    int(avail_np.max(initial=0)) + int(prev.max(initial=0)),
+                    int(static_w.max(initial=0)),
+                    0,
+                )
+            )
+            lmax = int(prev.max(initial=0)) + 1
+            host_small = (wmax + 1) * lmax * snap.num_clusters < 2**63
         with algo_timer.time(schedule_step="AssignReplicas"):
-            res = self._assign(strategy, replicas, candidates, static_w, avail,
-                               prev, fresh)
-        assignment = np.asarray(res.assignment)
-        unschedulable = np.asarray(res.unschedulable)
+            if host_small:
+                from ..refimpl.divider_np import assign_batch_np
+
+                assignment, unschedulable = assign_batch_np(
+                    strategy, replicas, candidates, static_w,
+                    avail_np, prev, fresh,
+                )
+            else:
+                res = self._assign(
+                    strategy, replicas, candidates, static_w, avail,
+                    prev, fresh,
+                )
+                assignment = np.asarray(res.assignment)
+                unschedulable = np.asarray(res.unschedulable)
         return self._unpack(problems, compiled, term_round, candidates,
                             assignment, unschedulable)
 

@@ -254,7 +254,7 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
     from ..utils.net import parse_hostport
 
-    server = IndexerServer(parse_hostport(args.address))
+    server = IndexerServer(parse_hostport(args.address, default_host=""))
     bound = server.start()
     print(f"indexer listening on port {bound}", flush=True)
     try:

@@ -183,7 +183,7 @@ def main(argv=None) -> None:
     from ..utils.net import parse_hostport
 
     server = AdmissionWebhookServer(
-        address=parse_hostport(args.address),
+        address=parse_hostport(args.address, default_host=""),
         certfile=args.certfile or None,
         keyfile=args.keyfile or None,
     )

@@ -485,3 +485,59 @@ class TestMaskTokenSwapSafety:
             cl.status.resource_summary.allocated["cpu"] = 1000
         s2b = ClusterSnapshot(s2.clusters)
         assert s1.mask_token == s2b.mask_token
+
+
+class TestTinyBatchHostFastPath:
+    """Small batches (configs 1-2 scale) divide on host numpy instead of
+    paying device round-trips; placements must be identical to the device
+    path (forced here via a no-answer extra estimator, which disables the
+    fast path without changing merge results)."""
+
+    def test_small_batch_identity_device_vs_host(self):
+        rng = np.random.default_rng(3)
+        clusters = synthetic_fleet(40, seed=6)
+        snap = ClusterSnapshot(clusters)
+        pls = [
+            dynamic_weight_placement(),
+            duplicated_placement(),
+            static_weight_placement(
+                {c.name: (i % 3) + 1 for i, c in enumerate(clusters[:8])}
+            ),
+            aggregated_placement(),
+        ]
+        req = parse_resource_list({"cpu": "250m", "memory": "512Mi"})
+        for trial in range(10):
+            problems = [
+                BindingProblem(
+                    key=f"t{trial}b{i}", placement=pls[int(rng.integers(0, 4))],
+                    replicas=int(rng.integers(0, 40)), requests=req,
+                    gvk="apps/v1/Deployment",
+                    prev={
+                        clusters[int(j)].name: int(rng.integers(1, 9))
+                        for j in rng.choice(40, int(rng.integers(0, 4)), replace=False)
+                    },
+                    fresh=bool(rng.random() < 0.2),
+                )
+                for i in range(int(rng.integers(1, 24)))
+            ]
+            host_eng = TensorScheduler(snap)
+            got = host_eng._schedule_host(
+                problems, [host_eng._compiled(p.placement) for p in problems]
+            )
+            # no-answer extra estimator: merge-identical, but disables the
+            # host_small gate so the device kernels run
+            dev_eng = TensorScheduler(
+                snap,
+                extra_estimators=[
+                    lambda reqs, reps: np.full(
+                        (len(reqs), len(clusters)), -1, np.int32
+                    )
+                ],
+            )
+            want = dev_eng._schedule_host(
+                problems, [dev_eng._compiled(p.placement) for p in problems]
+            )
+            for w, g in zip(want, got):
+                assert w.success == g.success, (trial, w.key, w.error, g.error)
+                assert dict(w.clusters) == dict(g.clusters), (trial, w.key)
+                assert sorted(w.feasible) == sorted(g.feasible), (trial, w.key)
