@@ -381,9 +381,13 @@ def main(argv=None) -> None:
 
     up = sub.add_parser("up", help="spawn the full multi-process deployment")
     up.add_argument("--members", type=int, default=2)
-    up.add_argument("--pull", action="append", default=["pull1"])
+    # default applied after parsing: an append action with a non-empty
+    # default list would APPEND user values to it (no way to drop pull1)
+    up.add_argument("--pull", action="append", default=None)
 
     args = p.parse_args(argv)
+    if args.command == "up" and args.pull is None:
+        args.pull = ["pull1"]
     if args.command == "serve":
         serve_plane(args)
     elif args.command == "up":

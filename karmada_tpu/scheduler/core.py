@@ -359,9 +359,13 @@ class TensorScheduler:
                 tuple(p.requests.items()), tuple(p.prev.items()),
             )
             hit = row_cache.get(p.key)
-            if hit is not None and hit[0] == fp:
-                if hit[1] is not None:
-                    compiled[i] = hit[1]
+            # hit[1] pins the Placement whose id() the fingerprint embeds:
+            # without it a GC'd placement re-allocated at the same address
+            # would alias a stale derived selection (same hazard the
+            # _selection_cache pins its base against)
+            if hit is not None and hit[0] == fp and hit[1] is p.placement:
+                if hit[2] is not None:
+                    compiled[i] = hit[2]
                 continue  # None = cached FitError: stay on the host path
             pending.append(i)
         if not pending:
@@ -386,7 +390,8 @@ class TensorScheduler:
                 )
                 sel = candidates[k]
                 if not sel.any():
-                    row_cache[p.key] = (fp, None)  # FitError: host reports
+                    # FitError: host reports (placement pinned, see lookup)
+                    row_cache[p.key] = (fp, p.placement, None)
                     continue
                 base = compiled[i]
                 key = (id(base), sel.tobytes())
@@ -415,7 +420,7 @@ class TensorScheduler:
                 else:
                     derived = entry[0]
                 compiled[i] = derived
-                row_cache[p.key] = (fp, derived)
+                row_cache[p.key] = (fp, p.placement, derived)
         if len(row_cache) > 4 * max(len(problems), 1) + 65536:
             row_cache.clear()  # key-churn bound; repopulates next pass
         return compiled

@@ -301,19 +301,32 @@ class _FleetBatch:
     updated in place for CHANGED rows only — the delta-fetch base); the
     feasibility bitsets are a lazily-fetched device output. Views are valid
     until the next schedule() pass on the same engine — consumers patch
-    results synchronously (scheduler_controller), so the aliasing window is
-    never observed in the control plane."""
+    results synchronously (scheduler_controller). A generation counter
+    captured at construction ENFORCES that window: decoding a result after
+    a later pass (or a compaction) has rewritten the mirror raises instead
+    of silently yielding another pass's — or another binding's — entries."""
 
-    __slots__ = ("names", "host_entries", "rows", "_bits_dev", "_bits_np")
+    __slots__ = (
+        "names", "host_entries", "rows", "_bits_dev", "_bits_np",
+        "_table", "_gen",
+    )
 
-    def __init__(self, names, host_entries, rows, bits_dev):
+    def __init__(self, names, host_entries, rows, bits_dev, table, gen):
         self.names = names
         self.host_entries = host_entries  # int32[cap, k_out] (site<<8|count)
         self.rows = rows  # int32[n] table row per result position
         self._bits_dev = bits_dev  # device uint32[n_pad, W] or None
         self._bits_np = None
+        self._table = table
+        self._gen = gen
 
     def entries_for(self, pos: int) -> np.ndarray:
+        if self._table is not None and self._table._result_gen != self._gen:
+            raise RuntimeError(
+                "stale FleetResult: a later schedule() pass (or table "
+                "compaction) has rewritten the entry mirror; decode "
+                "results before re-scheduling"
+            )
         return self.host_entries[self.rows[pos]]
 
     def feasible_names(self, pos: int) -> tuple:
@@ -519,6 +532,10 @@ class FleetTable:
         self._resident_entries = None
         self._host_entries: Optional[np.ndarray] = None
         self._k_res = 1  # running max entry width (grow-only)
+        # bumped whenever _host_entries is rewritten (each pass, and on
+        # compaction remaps); _FleetBatch captures it so stale result
+        # views fail loudly instead of decoding another pass's entries
+        self._result_gen = 0
         # per-phase wall times of the last pass (bench breakdown surface)
         self.last_breakdown: dict[str, float] = {}
 
@@ -550,8 +567,10 @@ class FleetTable:
         self._dirty.clear()
         self._dev_state = None  # full re-upload with the compacted layout
         self._all_rows_n = -1
-        # row ids were remapped: the delta base is meaningless now
+        # row ids were remapped: the delta base is meaningless now, and so
+        # is any result view still pointing at the old row layout
         self._resident_entries = None
+        self._result_gen += 1
         return True
 
     def _grow(self, need: int) -> None:
@@ -1027,9 +1046,15 @@ class FleetTable:
             cols = np.arange(int(counts.sum())) - np.repeat(starts_c, counts)
             self._host_entries[flat_rows, cols] = stream[: int(counts.sum())]
         tmr["changed_rows"] = float(len(ch_pos))
+        self._result_gen += 1
 
         names = self.engine.snapshot.names
-        batches = [_FleetBatch(names, self._host_entries, rows_np, bits)]
+        batches = [
+            _FleetBatch(
+                names, self._host_entries, rows_np, bits,
+                self, self._result_gen,
+            )
+        ]
         terms = [self._terms[r] for r in rows_np]
         tmr["post"] = _time.perf_counter() - t0
         self.last_breakdown = tmr

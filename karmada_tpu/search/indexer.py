@@ -65,28 +65,68 @@ class IndexerServer:
                     self._reply(404, {"error": "not found"})
                     return
                 length = int(self.headers.get("Content-Length", 0))
+                # two-phase: validate + decode the WHOLE batch into thunks
+                # first, then apply. A malformed op must not leave an
+                # applied prefix behind (the old sequential form both
+                # persisted the prefix and made the client count the whole
+                # batch as poison); on rejection nothing is applied and the
+                # failing index is reported so the client drops only it.
                 try:
                     ops = json.loads(self.rfile.read(length) or b"[]")
-                    applied = 0
-                    for op in ops:
+                    if not isinstance(ops, list):
+                        raise ValueError("bulk body must be a JSON array")
+                except Exception as exc:  # noqa: BLE001 — wire surface
+                    self._reply(400, {"error": str(exc), "failed_index": -1})
+                    return
+                thunks = []
+                for i, op in enumerate(ops):
+                    try:
                         kind = op.get("op")
                         if kind == "upsert":
-                            outer.index.upsert(
-                                op["cluster"], _doc_to_obj(op["object"])
+                            cluster, obj = op["cluster"], _doc_to_obj(
+                                op["object"]
+                            )
+                            thunks.append(
+                                lambda c=cluster, o=obj: outer.index.upsert(
+                                    c, o
+                                )
                             )
                         elif kind == "delete":
-                            outer.index.delete(
+                            a = (
                                 op["cluster"], op["gvk"],
                                 op["namespace"], op["name"],
                             )
+                            thunks.append(
+                                lambda a=a: outer.index.delete(*a)
+                            )
                         elif kind == "drop_cluster":
-                            outer.index.drop_cluster(op["cluster"])
+                            cluster = op["cluster"]
+                            thunks.append(
+                                lambda c=cluster: outer.index.drop_cluster(c)
+                            )
                         else:
                             raise ValueError(f"unknown op {kind!r}")
+                    except Exception as exc:  # noqa: BLE001 — wire surface
+                        self._reply(
+                            400, {"error": str(exc), "failed_index": i}
+                        )
+                        return
+                applied = 0
+                try:
+                    for t in thunks:
+                        t()
                         applied += 1
-                    self._reply(200, {"applied": applied})
-                except Exception as exc:  # noqa: BLE001 — wire surface
-                    self._reply(400, {"error": str(exc)})
+                except Exception as exc:  # noqa: BLE001 — an apply-phase
+                    # failure must still produce an HTTP response: a dropped
+                    # connection reads as TRANSIENT to the client, which
+                    # would requeue (and partially re-apply) the same batch
+                    # forever. 500 + no failed_index → the client drops the
+                    # batch and counts it, making progress.
+                    self._reply(
+                        500, {"error": str(exc), "applied": applied}
+                    )
+                    return
+                self._reply(200, {"applied": applied})
 
             def do_GET(self):
                 parsed = urlparse(self.path)
@@ -189,30 +229,45 @@ class HttpIndexerBackend:
     def flush(self) -> bool:
         """Ship the buffered batch. Transient failures (connection/timeout)
         requeue the batch for the next flush, in order (BulkIndexer retry
-        semantics); an HTTP rejection is a POISON batch — the server will
-        never accept it, so it is dropped (counted in ``dropped``) instead
-        of head-of-line-blocking every later document. Returns success."""
+        semantics). An HTTP rejection is atomic server-side (nothing was
+        applied): the reported ``failed_index`` op is POISON — dropped and
+        counted — and the rest of the batch retries, so one malformed op
+        neither persists a prefix nor discards its batchmates. Returns
+        success."""
         with self._send_lock:
             with self._lock:
                 if not self._buffer:
                     return True
                 batch, self._buffer = self._buffer, []
-            req = urllib.request.Request(
-                f"http://{self.target}/bulk",
-                data=json.dumps(batch).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                    json.loads(resp.read())
-                return True
-            except urllib.error.HTTPError:
-                self.dropped += len(batch)  # permanent server rejection
-                return False
-            except (urllib.error.URLError, OSError):
-                with self._lock:
-                    self._buffer = batch + self._buffer  # retry later, in order
-                return False
+            while batch:
+                req = urllib.request.Request(
+                    f"http://{self.target}/bulk",
+                    data=json.dumps(batch).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(
+                        req, timeout=self.timeout
+                    ) as resp:
+                        json.loads(resp.read())
+                    return True
+                except urllib.error.HTTPError as exc:
+                    try:
+                        bad = json.loads(exc.read()).get("failed_index", -1)
+                    except Exception:  # noqa: BLE001 — wire surface
+                        bad = -1
+                    if 0 <= bad < len(batch):
+                        self.dropped += 1
+                        batch = batch[:bad] + batch[bad + 1 :]
+                        continue  # retry the rest without the poison op
+                    self.dropped += len(batch)  # unidentifiable rejection
+                    return False
+                except (urllib.error.URLError, OSError):
+                    with self._lock:
+                        # retry later, in order
+                        self._buffer = batch + self._buffer
+                    return False
+            return True
 
     # -- queries ------------------------------------------------------------
 

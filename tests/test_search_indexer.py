@@ -74,15 +74,18 @@ class TestHttpIndexerBackend:
             server.stop()
 
     def test_poison_batch_is_dropped_not_requeued(self, indexer_proc):
-        """A batch the server REJECTS (HTTP 4xx) must not head-of-line
-        block later documents: it is dropped and counted."""
+        """A rejected op must not head-of-line block its batchmates: the
+        server rejects atomically with the failing index, the client drops
+        ONLY that op (counted) and delivers the rest of the batch."""
         be = HttpIndexerBackend(indexer_proc, batch_size=100)
         be._enqueue({"op": "bogus-op"})
-        assert not be.flush()
+        be.upsert("m1", new_deployment("batchmate", replicas=1))
+        assert be.flush()  # poison dropped, batchmate delivered
         assert be.dropped == 1 and not be._buffer
+        assert be.count() == 1  # batchmate survived, nothing else applied
         be.upsert("m1", new_deployment("after-poison", replicas=1))
         assert be.flush()
-        assert be.count() == 1
+        assert be.count() == 2
 
     def test_search_controller_ships_documents_over_the_wire(self, indexer_proc):
         """The controller's opensearch-backend registries land documents in
