@@ -521,9 +521,10 @@ def run_engine_north_star(args) -> dict:
     t0 = time.perf_counter()
     engine.schedule(problems)
     print(f"# warm/compile pass: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
-    # two more passes let the entry-buffer cap settle (shrink takes two
-    # consecutive votes) so every timed pass runs the tuned trace
-    for tag in ("tune", "stabilize"):
+    # three more passes let the entry/meta buffer caps settle (shrink takes
+    # two consecutive votes, observed one pass later) so every timed pass
+    # runs the tuned trace
+    for tag in ("tune", "stabilize", "settle"):
         t0 = time.perf_counter()
         engine.schedule(problems)
         print(f"# {tag} pass: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
@@ -566,7 +567,7 @@ def run_engine_north_star(args) -> dict:
     # estimator tables rebuild, and every row's result re-ships.
     drift_snaps = []
     rng_c = np.random.default_rng(99)
-    for _ in range(max(2, args.repeats) + 1):
+    for _ in range(max(2, args.repeats) + 2):
         for cl in clusters:
             rs = cl.status.resource_summary
             for dim, q in list(rs.allocated.items()):
@@ -576,12 +577,15 @@ def run_engine_north_star(args) -> dict:
                 )
         drift_snaps.append(ClusterSnapshot(clusters))
     # warm the churn-tier traces (entry caps re-tier under load; each
-    # distinct cap is one XLA trace, persistently cached across runs)
-    swapped = engine.update_snapshot(drift_snaps[0])
-    assert swapped
-    engine.schedule(problems)
+    # distinct cap is one XLA trace, persistently cached across runs).
+    # TWO warm passes: the first re-tiers the caps via the exact phase-B
+    # path, the second compiles the speculative phase-B trace that engages
+    # once a churn pass has been observed.
+    for warm_snap in drift_snaps[:2]:
+        assert engine.update_snapshot(warm_snap)
+        engine.schedule(problems)
     churn_times = []
-    for rep, snap_r in enumerate(drift_snaps[1:]):
+    for rep, snap_r in enumerate(drift_snaps[2:]):
         t0 = time.perf_counter()
         swapped = engine.update_snapshot(snap_r)
         assert swapped

@@ -80,6 +80,13 @@ def _pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def _cap_round(v: int) -> int:
+    """Entry-buffer quantization: E_ROUND multiples above the quantum,
+    powers of two (floor 1024) below — bounds the XLA trace count."""
+    v = max(v, 1)
+    return -(-v // E_ROUND) * E_ROUND if v > E_ROUND else _pow2(max(v, 1024))
+
+
 # --------------------------------------------------------------------------
 # fused solve
 # --------------------------------------------------------------------------
@@ -290,6 +297,269 @@ def _fleet_solve(
 
 
 # --------------------------------------------------------------------------
+# two-phase solve: pass kernel (A) + changed-rows entry kernel (B)
+# --------------------------------------------------------------------------
+#
+# The single-dispatch _fleet_solve above compacts EVERY row's entry vector
+# with a full-width [chunk, C] sort each pass — measured ~0.29s of the
+# ~0.41s kernel at 100k x 5k, paid even when a steady pass changes nothing.
+# The two-phase form keeps the DENSE assignment resident (uint8[cap, C])
+# and splits the pass:
+#
+#   A: solve + diff against the dense resident + update it; wire home is
+#      4B changed-count + a changed-row BITMASK (n/8 bytes) + the changed
+#      rows' meta words (tuned cap). No sort, no entry stream: a steady
+#      100k pass ships ~13 KB and runs no compaction at all.
+#   B: only when rows changed — gather exactly the changed rows from the
+#      dense resident and sort-compact THEM into the entry stream. The
+#      entry cap is sized EXACTLY from the changed metas the host already
+#      holds (sum of n_placed), so the overflow->rerun double dispatch of
+#      the tuned single-phase path is structurally impossible here.
+#
+# The legacy single-dispatch path remains for tables whose dense mirror
+# would not fit the HBM budget (cap x C bytes), e.g. the 1M-binding tier.
+
+#: dense-resident budget: above this, FleetTable uses the legacy
+#: entry-resident single-dispatch path (uint8[cap, C] would not pay for
+#: its HBM at multi-million-row tables)
+DENSE_RESIDENT_MAX_BYTES = 2 << 30
+M_ROUND = 1 << 15  # changed-meta buffer quantum (bounds trace churn)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "chunk", "n_chunks", "wide", "fast", "has_aggregated",
+        "need_bits", "all_rows", "m_cap", "mesh", "shard_c",
+    ),
+    donate_argnames=("res_dense", "res_meta"),
+)
+def _fleet_pass(
+    cp_table,  # int32[U, 3C]: [aff&spread_field | taint | static_w]
+    gvk_table,  # int32[G, C]
+    prof_table,  # int32[P, C] general availability (-1 = no answer)
+    incomplete_en,  # bool[C] — ~CompleteAPIEnablements
+    rows,  # int32[n_pad] table rows (-1 = padding)
+    cp_idx, gvk_idx, prof_idx,  # int32[cap]
+    replicas, strategy,  # int32[cap]
+    fresh,  # bool[cap]
+    prev_sites, prev_counts,  # int32[cap, K_PREV]
+    res_dense,  # uint8[cap, C] last pass's dense assignment (donated)
+    res_meta,  # int32[cap] last pass's meta words (donated)
+    *,
+    chunk: int,
+    n_chunks: int,
+    wide: bool,
+    fast: Optional[tuple],
+    has_aggregated: bool,
+    need_bits: bool,
+    all_rows: bool,
+    m_cap: int,
+    mesh=None,
+    shard_c: bool = False,
+):
+    """Phase A: divide every row, diff against the dense resident, ship the
+    changed bitmask + changed metas. Returns (flat_wire_u8, bits|None,
+    new_res_dense, new_res_meta)."""
+    c = gvk_table.shape[1]
+    cap = res_dense.shape[0]
+    c_ax = "c" if (mesh is not None and shard_c) else None
+
+    def shard(a, *axes):
+        if mesh is None:
+            return a
+        return lax.with_sharding_constraint(a, NamedSharding(mesh, P(*axes)))
+
+    valid = rows >= 0
+    r = jnp.maximum(rows, 0)
+    cp = cp_idx[r]
+    gv = gvk_idx[r]
+    pf = prof_idx[r]
+    reps = jnp.where(valid, replicas[r], 0)
+    st = strategy[r]
+    fr = fresh[r] & valid
+    ps = prev_sites[r]
+    pc = jnp.where(valid[:, None], prev_counts[r], 0)
+
+    def body(carry, i):
+        rd, rm = carry
+        sl = lambda a: lax.dynamic_slice_in_dim(a, i * chunk, chunk, axis=0)
+        cpc, gvc, pfc = sl(cp), sl(gv), sl(pf)
+        repsc, stc, frc, vc = sl(reps), sl(st), sl(fr), sl(valid)
+        psc, pcc = sl(ps), sl(pc)
+        rc = sl(r)
+        repsc, stc, frc, vc = (
+            shard(repsc, "b"), shard(stc, "b"), shard(frc, "b"),
+            shard(vc, "b"),
+        )
+        cpc, gvc, pfc = shard(cpc, "b"), shard(gvc, "b"), shard(pfc, "b")
+        psc, pcc = shard(psc, "b", None), shard(pcc, "b", None)
+        prev = shard(
+            jnp.zeros((chunk, c), jnp.int32)
+            .at[jnp.arange(chunk)[:, None], psc]
+            .add(pcc),
+            "b", c_ax,
+        )
+        prev_mask = prev > 0
+        cp_rows = cp_table[cpc]  # [chunk, 3C]
+        aff_m = cp_rows[:, :c] != 0
+        taint_m = cp_rows[:, c : 2 * c] != 0
+        static_w = cp_rows[:, 2 * c :]
+        gvk_m = gvk_table[gvc] != 0
+        general = prof_table[pfc]
+        feasible = shard(
+            aff_m
+            & (gvk_m | (prev_mask & incomplete_en[None, :]))
+            & (taint_m | prev_mask)
+            & vc[:, None],
+            "b", c_ax,
+        )
+        avail = shard(merge_estimates(repsc, (general,)), "b", c_ax)
+        assignment, unsched = _divide_batch(
+            stc, repsc, feasible, static_w, avail, prev, frc,
+            has_aggregated, wide, fast,
+        )
+        # Duplicated rows ride the feasibility bitset; their dense rows are
+        # zero so the resident diff ignores them (meta carries their state)
+        assignment = shard(
+            jnp.where((stc == S_DUPLICATED)[:, None], 0, assignment),
+            "b", c_ax,
+        )
+        dense8 = assignment.astype(jnp.uint8)  # counts <= MAX_REPLICAS_FAST
+        n_placed = (assignment > 0).sum(axis=1).astype(jnp.int32)
+        has_cand = feasible.any(axis=1)
+        meta = (
+            n_placed
+            | (unsched.astype(jnp.int32) << 8)
+            | (has_cand.astype(jnp.int32) << 9)
+        )
+        # diff + in-place resident update. all_rows reads/writes contiguous
+        # slices; partial batches use row gather/scatter (few rows: the
+        # per-row scatter overhead is what made this form wrong for the
+        # 100k storm, which is exactly the all_rows case)
+        if all_rows:
+            old_d = lax.dynamic_slice(rd, (i * chunk, 0), (chunk, c))
+            old_m = lax.dynamic_slice_in_dim(rm, i * chunk, chunk, 0)
+            rd = lax.dynamic_update_slice(rd, dense8, (i * chunk, 0))
+            rm = lax.dynamic_update_slice_in_dim(rm, meta, i * chunk, 0)
+        else:
+            old_d = rd[rc]
+            old_m = rm[rc]
+            safe_r = jnp.where(vc, rc, cap)
+            rd = rd.at[safe_r].set(dense8, mode="drop")
+            rm = rm.at[safe_r].set(meta, mode="drop")
+        changed = (
+            ((dense8 != old_d).any(axis=1) | (meta != old_m)) & vc
+        )
+        outs = (changed, meta)
+        if need_bits:
+            pad = (-c) % 32
+            f = jnp.pad(feasible, ((0, 0), (0, pad)))
+            w32 = f.reshape(chunk, -1, 32).astype(jnp.uint32)
+            shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+            outs = outs + ((w32 << shifts).sum(axis=-1, dtype=jnp.uint32),)
+        return (rd, rm), outs
+
+    (res_dense, res_meta), outs = lax.scan(
+        body, (res_dense, res_meta), jnp.arange(n_chunks)
+    )
+    changed = outs[0].reshape(-1)  # bool[n_pad]
+    meta = outs[1].reshape(-1)
+
+    # wire: [4B total][bitmask n_pad/8 B][m_cap x 2B changed metas in row
+    # order]. n_pad is a multiple of 256, so the bitmask packs evenly.
+    cnt = jnp.cumsum(changed.astype(jnp.int32)) - changed
+    total = cnt[-1] + changed[-1].astype(jnp.int32)
+    write = jnp.where(changed & (cnt < m_cap), cnt, m_cap)
+    mbuf = jnp.zeros((m_cap + 1,), jnp.int32).at[write].set(meta)
+    mstream = mbuf[:m_cap]
+    # changed TABLE rows, compacted in the same bitmask order — stays on
+    # device so a speculative phase B can consume it without waiting for
+    # the host to decode the bitmask (saves one tunnel round-trip per
+    # churn pass)
+    rowbuf = (
+        jnp.full((m_cap + 1,), -1, jnp.int32).at[write].set(r)[:m_cap]
+    )
+    w32 = changed.reshape(-1, 32).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, :]
+    words = (w32 << shifts).sum(axis=-1, dtype=jnp.uint32)
+    mask_u8 = jnp.stack(
+        [(words >> s) & 0xFF for s in (0, 8, 16, 24)], axis=-1
+    ).astype(jnp.uint8).reshape(-1)
+    total_u8 = jnp.stack(
+        [(total >> s) & 0xFF for s in (0, 8, 16, 24)]
+    ).astype(jnp.uint8)
+    meta_u8 = jnp.stack(
+        [mstream & 0xFF, (mstream >> 8) & 0xFF], axis=-1
+    ).astype(jnp.uint8).reshape(-1)
+    flat = jnp.concatenate([total_u8, mask_u8, meta_u8])
+    bits = outs[2].reshape(-1, outs[2].shape[-1]) if need_bits else None
+    return flat, bits, rowbuf, res_dense, res_meta
+
+
+@partial(
+    jax.jit,
+    static_argnames=("chunk", "n_chunks", "k_out", "e_cap", "byte_wire"),
+)
+def _fleet_entries(
+    res_dense,  # uint8[cap, C] — the dense resident phase A just updated
+    rows,  # int32[m_pad] changed table rows (-1 = padding)
+    *,
+    chunk: int,
+    n_chunks: int,
+    k_out: int,
+    e_cap: int,  # exact-or-larger (host sums changed n_placed): no overflow
+    byte_wire: bool,
+):
+    """Phase B: sort-compact ONLY the changed rows' dense vectors into the
+    row-major (site << 8 | count) entry stream. Runs at the changed-row
+    count, not the table size."""
+    cap, c = res_dense.shape
+    idxs = jnp.arange(c, dtype=jnp.int32)[None, :]
+
+    def body(carry, i):
+        rc = lax.dynamic_slice_in_dim(rows, i * chunk, chunk, 0)
+        vc = rc >= 0
+        dense = res_dense[jnp.maximum(rc, 0)].astype(jnp.int32)
+        dense = jnp.where(vc[:, None], dense, 0)
+        packed_full = jnp.where(
+            dense > 0, (idxs << 8) | dense, jnp.int32(2**31 - 1)
+        )
+        srt = lax.sort(packed_full, is_stable=False)[:, :k_out]
+        return carry, jnp.where(srt == 2**31 - 1, 0, srt)
+
+    _, ents = lax.scan(body, 0, jnp.arange(n_chunks))
+    entries = ents.reshape(-1, k_out)  # [m_pad, k_out]
+    valid_e = (entries > 0).reshape(-1)
+    offs = jnp.cumsum(valid_e.astype(jnp.int32)) - valid_e
+    total = offs[-1] + valid_e[-1].astype(jnp.int32)
+    packed = entries.reshape(-1)
+    write = jnp.where(valid_e & (offs < e_cap), offs, e_cap)
+    buf = jnp.zeros((e_cap + 1,), jnp.int32).at[write].set(packed)
+    stream = buf[:e_cap]
+    if byte_wire:
+        total_u8 = jnp.stack(
+            [(total >> s) & 0xFF for s in (0, 8, 16, 24)]
+        ).astype(jnp.uint8)
+        e_u8 = jnp.stack(
+            [stream & 0xFF, (stream >> 8) & 0xFF, (stream >> 16) & 0xFF],
+            axis=-1,
+        ).astype(jnp.uint8).reshape(-1)
+        return jnp.concatenate([total_u8, e_u8])
+    return jnp.concatenate([total[None], stream])
+
+
+@jax.jit
+def _gather_meta(res_meta, rows):
+    """Changed-meta fallback when phase A's tuned meta buffer overflows:
+    one cheap gather instead of a full-solve rerun."""
+    m = jnp.where(rows >= 0, res_meta[jnp.maximum(rows, 0)], 0)
+    return jnp.stack(
+        [m & 0xFF, (m >> 8) & 0xFF], axis=-1
+    ).astype(jnp.uint8).reshape(-1)
+
+
+# --------------------------------------------------------------------------
 # results
 # --------------------------------------------------------------------------
 
@@ -492,7 +762,11 @@ class FleetTable:
 
     def __init__(self, engine):
         self.engine = engine
-        self.chunk = engine.chunk_size
+        # floor to a power of two (>= 256): the dense wire packs the
+        # changed bitmask in 32-bit words and phase B divides the meta
+        # buffer by the chunk, so n_pad must stay pow2-aligned — the
+        # engine's chunk_size is a perf knob, not a semantic one
+        self.chunk = 1 << max(engine.chunk_size, 256).bit_length() - 1
         self.cap = 0
         self.n_rows = 0
         self._key_row: dict[str, int] = {}
@@ -532,6 +806,23 @@ class FleetTable:
         self._resident_entries = None
         self._host_entries: Optional[np.ndarray] = None
         self._k_res = 1  # running max entry width (grow-only)
+        # two-phase dense path (see _fleet_pass/_fleet_entries): the dense
+        # assignment + meta words live on device; _host_meta mirrors the
+        # meta resident so results decode without a full per-pass fetch
+        self._res_dense = None  # uint8[cap, C] device
+        self._res_meta = None  # int32[cap] device
+        self._host_meta: Optional[np.ndarray] = None
+        self._m_cap_cur: Optional[int] = None
+        self._m_shrink = 0
+        self._last_changed: Optional[int] = None
+        # O(1) batch reuse: (problems_list, compiled_list, rows) of the
+        # last scheduled batch — the engine's batch-identity fast path
+        # re-passes the SAME list objects, so identity means the row
+        # mapping is already current (cleared on growth/compaction).
+        # _reuse_pass stands in for the per-row last-used bumps the
+        # skipped upserts would have done (consumed by _compact).
+        self._reuse: Optional[tuple] = None
+        self._reuse_pass = 0
         # bumped whenever _host_entries is rewritten (each pass, and on
         # compaction remaps); _FleetBatch captures it so stale result
         # views fail loudly instead of decoding another pass's entries
@@ -549,16 +840,17 @@ class FleetTable:
         churn workload grows the table and its pinned problems without
         bound). Returns True if at least half the rows were reclaimed."""
         cutoff = self._pass - self.COMPACT_IDLE_PASSES
-        keep = [
-            row
-            for row in range(self.n_rows)
-            if self._row_last_used[row] >= cutoff
-        ]
+        lu = np.fromiter(self._row_last_used, np.int64, self.n_rows)
+        if self._reuse is not None:
+            # the batch-reuse fast path skips upsert (and with it the
+            # per-row last-used bump): its rows were live at _reuse_pass
+            lu[self._reuse[2]] = getattr(self, "_reuse_pass", self._pass)
+        keep = np.flatnonzero(lu >= cutoff).tolist()
         if len(keep) * 2 > self.n_rows:
             return False
         for k in ("_problems", "_fps", "_terms"):
             setattr(self, k, [getattr(self, k)[r] for r in keep])
-        self._row_last_used = [self._row_last_used[r] for r in keep]
+        self._row_last_used = lu[keep].tolist()  # reuse bump persists
         idx = np.asarray(keep, np.int64)
         for name, arr in self._st.items():
             arr[: len(keep)] = arr[idx]
@@ -570,8 +862,20 @@ class FleetTable:
         # row ids were remapped: the delta base is meaningless now, and so
         # is any result view still pointing at the old row layout
         self._resident_entries = None
+        self._reset_dense()
+        self._reuse = None  # row ids remapped
         self._result_gen += 1
         return True
+
+    def _reset_dense(self) -> None:
+        """Invalidate the dense-path residents (row remap / growth / path
+        switch). The next dense pass reallocates zeroed residents and a
+        zeroed host meta mirror — a consistent pair, so every row whose
+        current result is nonzero re-reports as changed and refills the
+        mirrors."""
+        self._res_dense = None
+        self._res_meta = None
+        self._host_meta = None
 
     def _grow(self, need: int) -> None:
         new_cap = max(self.chunk, _pow2(need))
@@ -590,6 +894,8 @@ class FleetTable:
         self._st = st
         self.cap = new_cap
         self._dev_state = None  # full re-upload
+        self._reset_dense()  # cap changed: residents reallocate zeroed
+        self._reuse = None
 
     @staticmethod
     def _fingerprint(p, compiled) -> tuple:
@@ -737,6 +1043,18 @@ class FleetTable:
     # -- device sync -------------------------------------------------------
 
     def _rebuild_tables(self) -> None:
+        import os as _os
+        import time as _t
+        _dbg = _os.environ.get("KARMADA_SYNC_DEBUG") == "1"
+        _t0 = _t.perf_counter()
+
+        def _mark(tag):
+            nonlocal _t0
+            if _dbg:
+                now = _t.perf_counter()
+                print(f"# rebuild {tag}: {(now - _t0) * 1e3:.1f}ms", flush=True)
+                _t0 = now
+
         snap = self.engine.snapshot
         gen = getattr(self.engine, "_snapshot_gen", 0)
         slots_changed = self._tables_dirty
@@ -762,6 +1080,7 @@ class FleetTable:
                 self._static_max = max(
                     self._static_max, int(cp.static_weights.max(initial=0))
                 )
+        _mark("recompile")
         c = snap.num_clusters
         # the mask tables are functions of the snapshot's FILTER fields only
         # (labels/taints/enablements/topology — snapshot.mask_token) and the
@@ -808,19 +1127,42 @@ class FleetTable:
             inc_dev = jnp.asarray(~snap.complete_enablements)
         else:
             cp_dev, gvk_dev, _, inc_dev = self._dev_tables
-        prof_table = self.engine._profile_table(np.stack(self._profiles))
-        self._avail_max = int(
-            jnp.max(
-                jnp.where(
-                    (prof_table == MAX_INT32) | (prof_table == -1),
-                    0,
-                    prof_table,
+        _mark("masks")
+        profs = np.stack(self._profiles)
+        prof_table = self.engine._profile_table(profs)
+        _mark("prof_table")
+        if self.engine._models_active():
+            self._avail_max = int(
+                jnp.max(
+                    jnp.where(
+                        (prof_table == MAX_INT32) | (prof_table == -1),
+                        0,
+                        prof_table,
+                    )
                 )
             )
-        )
+        else:
+            # host mirror of the general-estimator max: the device form is
+            # a blocking scalar fetch (~0.1s tunnel round-trip) and this
+            # rebuild runs EVERY churn pass (snapshot gen bumps each drift)
+            self._avail_max = self._host_avail_max(profs)
+        _mark("avail_max")
         self._dev_tables = (cp_dev, gvk_dev, prof_table, inc_dev)
         self._mask_token = token
         self._tables_dirty = False
+
+    def _host_avail_max(self, profs: np.ndarray) -> int:
+        """Sentinel-excluded max over the shared host mirror of the
+        general-estimator profile table (core.host_profile_table). The
+        device form is a blocking scalar fetch (~0.1s tunnel round-trip)
+        and runs every churn pass; the model path keeps the device fetch
+        (no host mirror of the model estimator yet)."""
+        from .core import host_profile_table
+
+        mi = 2**31 - 1
+        table = host_profile_table(self.engine.snapshot, profs)
+        valid = table != mi
+        return int(table[valid].max()) if valid.any() else 0
 
     def _sync_device(self) -> None:
         if self._tables_dirty or (
@@ -853,20 +1195,33 @@ class FleetTable:
         tmr: dict[str, float] = {}
         t0 = _time.perf_counter()
         self._pass += 1
-        # reclaim rows of deleted/idle bindings before the table would grow
-        # (compaction reindexes rows, so it must run before any upsert of
-        # this pass hands out indices). Gated on ACTUAL new keys so the
-        # steady all-rows storm pays one dict sweep at capacity pressure,
-        # not an O(n_rows) compaction scan per pass.
-        if self.n_rows + len(problems) > self.cap:
-            new_keys = sum(1 for p in problems if p.key not in self._key_row)
-            if self.n_rows + new_keys > self.cap:
-                self._compact()
-        rows_np = np.fromiter(
-            (self.upsert(p, cp) for p, cp in zip(problems, compiled)),
-            np.int32,
-            len(problems),
-        )
+        ru = self._reuse
+        if ru is not None and ru[0] is problems and ru[1] is compiled:
+            # same batch objects as last pass: rows are current (upsert
+            # would O(1)-skip every row anyway — this skips the loop).
+            # _reuse_pass stands in for the per-row _row_last_used bump
+            # the skipped upserts would have done; _compact honors it.
+            rows_np = ru[2]
+            self._reuse_pass = self._pass
+        else:
+            # reclaim rows of deleted/idle bindings before the table would
+            # grow (compaction reindexes rows, so it must run before any
+            # upsert of this pass hands out indices). Gated on ACTUAL new
+            # keys so the steady all-rows storm pays one dict sweep at
+            # capacity pressure, not an O(n_rows) compaction scan per pass.
+            if self.n_rows + len(problems) > self.cap:
+                new_keys = sum(
+                    1 for p in problems if p.key not in self._key_row
+                )
+                if self.n_rows + new_keys > self.cap:
+                    self._compact()
+            rows_np = np.fromiter(
+                (self.upsert(p, cp) for p, cp in zip(problems, compiled)),
+                np.int32,
+                len(problems),
+            )
+            self._reuse = (problems, compiled, rows_np)
+            self._reuse_pass = self._pass
         tmr["upsert"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
         self._sync_device()
@@ -915,51 +1270,6 @@ class FleetTable:
         safe = int(
             np.minimum(np.where(is_dup, 0, reps_sel), k_out).sum()
         )
-        # delta base: device-resident per-row entry vectors + the matching
-        # host mirror, k_res wide (grow-only running max of k_out so a
-        # straggler batch with smaller replicas doesn't wipe the base).
-        # Table growth or a k_res increase resets both — the next pass
-        # reports every row changed and refills them.
-        k_res = max(self._k_res, k_out)
-        if (
-            self._resident_entries is None
-            or self._resident_entries.shape != (self.cap, k_res)
-        ):
-            self._resident_entries = jnp.zeros((self.cap, k_res), jnp.int32)
-            self._host_entries = np.zeros((self.cap, k_res), np.int32)
-        self._k_res = k_res
-
-        def cap_round(v: int) -> int:
-            v = max(v, 1)
-            return (
-                -(-v // E_ROUND) * E_ROUND if v > E_ROUND else _pow2(max(v, 1024))
-            )
-
-        # fetched bytes scale with e_cap, so tune it to ~1.25x the last
-        # observed total; the safe bound can never overflow and is the
-        # first-pass / fallback trace. Hysteresis: grow immediately, shrink
-        # only after two consecutive lower demands — every distinct e_cap is
-        # a fresh XLA trace, and a demand oscillating across a quantum
-        # boundary was recompiling the solve once per storm wave
-        # _last_total tracks the last pass's CHANGED-entry total — under
-        # delta fetch a steady storm's demand is ~zero, so the tuned cap
-        # (and with it the fetched buffer) collapses to the floor quantum;
-        # a churn burst overflows once, reruns at the safe bound, and the
-        # cap follows it back up
-        needed = cap_round(safe)
-        if self._last_total is not None and self._last_total * 5 // 4 < safe:
-            needed = min(needed, cap_round(self._last_total * 5 // 4))
-        prev_cap = self._e_cap_cur
-        if prev_cap is None or needed >= prev_cap:
-            e_cap = needed
-            self._shrink_votes = 0
-        else:
-            self._shrink_votes += 1
-            e_cap = needed if self._shrink_votes >= 2 else prev_cap
-            if e_cap == needed:
-                self._shrink_votes = 0
-        self._e_cap_cur = e_cap
-
         # engine-level mesh: shard the row axis (and optionally the cluster
         # axis) when the chunk/cluster extents divide the mesh evenly;
         # uneven extents fall back to single-device semantics
@@ -976,6 +1286,63 @@ class FleetTable:
                     and c_sz > 1
                     and c % c_sz == 0
                 )
+        shared = dict(
+            problems=problems, rows_np=rows_np, rows_dev=rows_dev, tmr=tmr,
+            n=n, n_pad=n_pad, eff_chunk=eff_chunk, n_chunks=n_chunks,
+            is_all=is_all, c=c, k_out=k_out, wide=wide, fast=fast,
+            has_agg=has_agg, need_bits=need_bits, is_dup=is_dup, safe=safe,
+            mesh=mesh, shard_c=shard_c, byte_wire=c <= 0xFFFF, t0=t0,
+        )
+        if self.cap * c <= DENSE_RESIDENT_MAX_BYTES:
+            return self._solve_dense(**shared)
+        return self._solve_legacy(**shared)
+
+    def _solve_legacy(
+        self, *, problems, rows_np, rows_dev, tmr, n, n_pad, eff_chunk,
+        n_chunks, is_all, c, k_out, wide, fast, has_agg, need_bits, is_dup,
+        safe, mesh, shard_c, byte_wire, t0,
+    ) -> "_FleetResultList":
+        """Single-dispatch entry-resident solve — the path for tables whose
+        dense mirror would exceed the HBM budget (multi-million-row
+        fleets). Everything ships per pass: full meta + tuned entry
+        stream."""
+        import time as _time
+
+        cap_round = _cap_round
+        # delta base: device-resident per-row entry vectors + the matching
+        # host mirror, k_res wide (grow-only running max of k_out so a
+        # straggler batch with smaller replicas doesn't wipe the base).
+        # Table growth or a k_res increase resets both — the next pass
+        # reports every row changed and refills them.
+        k_res = max(self._k_res, k_out)
+        if (
+            self._resident_entries is None
+            or self._resident_entries.shape != (self.cap, k_res)
+        ):
+            self._resident_entries = jnp.zeros((self.cap, k_res), jnp.int32)
+            self._host_entries = np.zeros((self.cap, k_res), np.int32)
+        self._k_res = k_res
+
+        # fetched bytes scale with e_cap, so tune it to ~1.25x the last
+        # observed total; the safe bound can never overflow and is the
+        # first-pass / fallback trace. Hysteresis: grow immediately, shrink
+        # only after two consecutive lower demands — every distinct e_cap is
+        # a fresh XLA trace, and a demand oscillating across a quantum
+        # boundary was recompiling the solve once per storm wave
+        # _last_total tracks the last pass's CHANGED-entry total — under
+        # delta fetch a steady storm's demand is ~zero, so the tuned cap
+        # (and with it the fetched buffer) collapses to the floor quantum;
+        # a churn burst overflows once, reruns at the safe bound, and the
+        # cap follows it back up
+        from .core import tune_cap
+
+        needed = cap_round(safe)
+        if self._last_total is not None and self._last_total * 5 // 4 < safe:
+            needed = min(needed, cap_round(self._last_total * 5 // 4))
+        e_cap, self._shrink_votes = tune_cap(
+            needed, self._e_cap_cur, self._shrink_votes
+        )
+        self._e_cap_cur = e_cap
 
         def solve(rows_slice, cap):
             return _fleet_solve(
@@ -996,8 +1363,6 @@ class FleetTable:
                 mesh=mesh,
                 shard_c=shard_c,
             )
-
-        byte_wire = c <= 0xFFFF
 
         def decode(arr):
             """(total, meta int32[n_pad], stream int32[*])"""
@@ -1048,6 +1413,208 @@ class FleetTable:
         tmr["changed_rows"] = float(len(ch_pos))
         self._result_gen += 1
 
+        names = self.engine.snapshot.names
+        batches = [
+            _FleetBatch(
+                names, self._host_entries, rows_np, bits,
+                self, self._result_gen,
+            )
+        ]
+        terms = [self._terms[r] for r in rows_np]
+        tmr["post"] = _time.perf_counter() - t0
+        self.last_breakdown = tmr
+        return _FleetResultList(
+            problems, terms, batches, n_pad, n_placed, unsched,
+            has_cand, is_dup,
+        )
+
+    def _solve_dense(
+        self, *, problems, rows_np, rows_dev, tmr, n, n_pad, eff_chunk,
+        n_chunks, is_all, c, k_out, wide, fast, has_agg, need_bits, is_dup,
+        safe, mesh, shard_c, byte_wire, t0,
+    ) -> "_FleetResultList":
+        """Two-phase solve: _fleet_pass (divide + dense diff, ~13 KB wire
+        on a steady pass) and, only when rows changed, _fleet_entries over
+        exactly those rows with an exactly-sized entry buffer (no
+        overflow rerun by construction)."""
+        import time as _time
+
+        if self._res_dense is None or self._res_dense.shape != (
+            self.cap, c
+        ):
+            self._res_dense = jnp.zeros((self.cap, c), jnp.uint8)
+            self._res_meta = jnp.zeros((self.cap,), jnp.int32)
+            self._host_meta = np.zeros(self.cap, np.int32)
+        # host entry mirror: width grows in place (no resident to reset —
+        # the dense base is width-independent)
+        k_res = max(self._k_res, k_out)
+        if self._host_entries is None or self._host_entries.shape[0] != (
+            self.cap
+        ):
+            self._host_entries = np.zeros((self.cap, k_res), np.int32)
+        elif self._host_entries.shape[1] < k_res:
+            self._host_entries = np.pad(
+                self._host_entries,
+                ((0, 0), (0, k_res - self._host_entries.shape[1])),
+            )
+        self._k_res = k_res
+
+        # changed-meta buffer: tuned like the legacy e_cap but overflow
+        # costs one cheap _gather_meta round-trip, not a solve rerun
+        def m_round(v: int) -> int:
+            v = max(v, 1)
+            q = -(-v // M_ROUND) * M_ROUND if v > 4096 else 4096
+            return min(q, n_pad)
+
+        from .core import tune_cap
+
+        needed = m_round(n)
+        if self._last_changed is not None and (
+            self._last_changed * 5 // 4 < n
+        ):
+            needed = min(needed, m_round(self._last_changed * 5 // 4))
+        m_cap, self._m_shrink = tune_cap(
+            needed, self._m_cap_cur, self._m_shrink, ceil=n_pad
+        )
+        self._m_cap_cur = m_cap
+
+        cap_round = _cap_round
+        tmr["prep"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        flat, bits, rowbuf, rd, rm = _fleet_pass(
+            *self._dev_tables,
+            rows_dev,
+            *self._dev_state,
+            self._res_dense,
+            self._res_meta,
+            chunk=eff_chunk,
+            n_chunks=n_chunks,
+            wide=wide,
+            fast=fast,
+            has_aggregated=has_agg,
+            need_bits=need_bits,
+            all_rows=is_all,
+            m_cap=m_cap,
+            mesh=mesh,
+            shard_c=shard_c,
+        )
+        self._res_dense, self._res_meta = rd, rm
+        # speculative phase B: when the last pass saw churn, dispatch the
+        # entry compaction over A's device-resident changed-row buffer
+        # BEFORE fetching A — B executes back-to-back with A on device and
+        # its wire overlaps A's decode, removing a round-trip from the
+        # churn critical path. Steady passes (last_changed == 0) skip it.
+        spec_flat = None
+        spec_cap = 0
+        if self._last_changed and self._last_total:
+            spec_cap = cap_round(self._last_total * 9 // 8)
+            b_chunk = min(eff_chunk, m_cap)
+            spec_flat = _fleet_entries(
+                self._res_dense,
+                rowbuf,
+                chunk=b_chunk,
+                n_chunks=m_cap // b_chunk,
+                k_out=k_out,
+                e_cap=spec_cap,
+                byte_wire=byte_wire,
+            )
+        tmr["dispatch"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        raw = np.asarray(flat)
+        tmr["fetch_a"] = _time.perf_counter() - t0
+        fetched_bytes = raw.nbytes
+        a = raw.astype(np.int32)
+        total = int(a[0] | (a[1] << 8) | (a[2] << 16) | (a[3] << 24))
+        nb = n_pad // 8
+        changed_bits = np.unpackbits(
+            raw[4 : 4 + nb], bitorder="little"
+        )[:n_pad].astype(bool)
+        ch_pos = np.flatnonzero(changed_bits)
+        assert len(ch_pos) == total, (len(ch_pos), total)
+        ch_rows = rows_np[ch_pos] if total else np.empty(0, np.int64)
+        if total <= m_cap:
+            mb = a[4 + nb : 4 + nb + 2 * m_cap]
+            metas = (mb[0::2] | (mb[1::2] << 8))[:total]
+        else:
+            # tuned buffer overflow (churn onset): one gather round-trip
+            m_pad_f = max(4096, _pow2(total))
+            rows_f = np.full(m_pad_f, -1, np.int32)
+            rows_f[:total] = ch_rows
+            mraw = np.asarray(
+                _gather_meta(self._res_meta, jnp.asarray(rows_f))
+            ).astype(np.int32)
+            fetched_bytes += mraw.nbytes
+            metas = (mraw[0::2] | (mraw[1::2] << 8))[:total]
+        self._last_changed = total
+
+        # phase B: entries for exactly the changed rows
+        if total:
+            self._host_meta[ch_rows] = metas
+            counts = (metas & 0xFF).astype(np.int64)
+            e_total = int(counts.sum())
+            self._host_entries[ch_rows] = 0
+            self._last_total = e_total
+            if e_total:
+                raw2 = None
+                if (
+                    spec_flat is not None
+                    and total <= m_cap
+                    and e_total <= spec_cap
+                ):
+                    # the speculative B covers exactly the changed rows
+                    t_b = _time.perf_counter()
+                    raw2 = np.asarray(spec_flat)
+                    tmr["fetch_b"] = _time.perf_counter() - t_b
+                else:
+                    # exact fallback: churn onset (no speculation) or the
+                    # speculative caps were too small
+                    m_pad_b = max(2048, _pow2(total))
+                    b_chunk = min(eff_chunk, m_pad_b)
+                    rows_b = np.full(m_pad_b, -1, np.int32)
+                    rows_b[:total] = ch_rows
+                    e_cap = cap_round(e_total)
+                    t_b = _time.perf_counter()
+                    flat2 = _fleet_entries(
+                        self._res_dense,
+                        jnp.asarray(rows_b),
+                        chunk=b_chunk,
+                        n_chunks=m_pad_b // b_chunk,
+                        k_out=k_out,
+                        e_cap=e_cap,
+                        byte_wire=byte_wire,
+                    )
+                    tmr["dispatch_b"] = _time.perf_counter() - t_b
+                    t_b = _time.perf_counter()
+                    raw2 = np.asarray(flat2)
+                    tmr["fetch_b"] = _time.perf_counter() - t_b
+                fetched_bytes += raw2.nbytes
+                if byte_wire:
+                    a2 = raw2.astype(np.int32)
+                    total2 = int(
+                        a2[0] | (a2[1] << 8) | (a2[2] << 16) | (a2[3] << 24)
+                    )
+                    e = a2[4:]
+                    stream = e[0::3] | (e[1::3] << 8) | (e[2::3] << 16)
+                else:
+                    total2 = int(raw2[0])
+                    stream = raw2[1:]
+                assert total2 == e_total, (total2, e_total)
+                flat_rows = np.repeat(ch_rows, counts)
+                starts_c = np.cumsum(counts) - counts
+                cols = np.arange(e_total) - np.repeat(starts_c, counts)
+                self._host_entries[flat_rows, cols] = stream[:e_total]
+        else:
+            self._last_total = 0
+        tmr["fetch"] = _time.perf_counter() - t0
+        tmr["fetch_mb"] = fetched_bytes / 1e6
+        tmr["changed_rows"] = float(total)
+        t0 = _time.perf_counter()
+
+        meta_sel = self._host_meta[rows_np]
+        n_placed = (meta_sel & 0xFF).astype(np.int64)
+        unsched = (meta_sel >> 8) & 1
+        has_cand = (meta_sel >> 9) & 1
+        self._result_gen += 1
         names = self.engine.snapshot.names
         batches = [
             _FleetBatch(

@@ -172,6 +172,23 @@ def test_entry_buffer_overflow_falls_back_to_safe_bound(monkeypatch):
         assert sorted(feas) == sorted(f.feasible), key
 
 
+def test_batch_reuse_survives_compaction():
+    """The batch-identity fast path skips upsert (and its last-used bump);
+    a compaction sweep must still see those rows as live, not idle."""
+    clusters = synthetic_fleet(30, seed=8)
+    snap = ClusterSnapshot(clusters)
+    problems = _mixed_problems(clusters, 600, 3)
+    eng = TensorScheduler(snap)
+    eng.fleet_threshold = 1
+    for _ in range(8):  # advance _pass well past COMPACT_IDLE_PASSES
+        eng.schedule(problems)
+    ft = eng._fleet
+    assert ft._reuse is not None  # the fast path engaged
+    keys_before = set(ft._key_row)
+    assert not ft._compact()  # live batch: nothing to reclaim
+    assert set(ft._key_row) == keys_before
+
+
 def test_dispense_no_idx_mode_matches_sort_dispense():
     """Tie-heavy fuzz of with_idx=False (two-stage top_k) vs the exact
     3-key sort, including placed-site coverage of the returned top-k."""
