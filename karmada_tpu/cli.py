@@ -516,7 +516,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     commands operate on a REMOTE plane over the wire — state through the
     store bus, member access through the cluster proxy; without it,
     ``local-up`` bootstraps a demo plane in-process (``--processes`` spawns
-    the full multi-process deployment instead)."""
+    the full multi-process deployment instead). Applies the parent's jax
+    platform policy first — a CLI child of localup/the operator must not
+    dial the single-client accelerator tunnel."""
     parser = argparse.ArgumentParser(prog="karmadactl-tpu")
     parser.add_argument("--bus", default="", help="remote plane bus host:port")
     parser.add_argument("--proxy", default="", help="cluster proxy host:port")

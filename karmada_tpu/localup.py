@@ -38,11 +38,23 @@ import time
 from typing import Optional
 
 
-def spawn_child(cmd: list[str]) -> subprocess.Popen:
-    """Spawn a component child process: CPU jax, package importable
-    regardless of the caller's cwd. Shared by LocalUp and the process
-    operator — one copy of the env construction."""
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+def spawn_child(cmd: list[str], platform: str = "cpu") -> subprocess.Popen:
+    """Spawn a component child process: ``platform`` selects its jax
+    backend (default CPU — control-plane components must never dial the
+    accelerator), package importable regardless of the caller's cwd.
+    Shared by LocalUp and the process operator — one copy of the env
+    construction.
+
+    The accelerator is SINGLE-CLIENT: exactly one component per machine
+    may run with a non-cpu platform (deployment-wise that is the solver
+    sidecar — the "dedicate a chip to scheduling" shape in
+    docs/OPERATIONS.md). KARMADA_TPU_PLATFORM is the authoritative
+    channel: the tunnel sitecustomize overrides JAX_PLATFORMS
+    programmatically, so each child entrypoint re-asserts the policy via
+    utils.platform.apply_child_platform()."""
+    env = dict(
+        os.environ, JAX_PLATFORMS=platform, KARMADA_TPU_PLATFORM=platform
+    )
     pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = (
         pkg_parent + os.pathsep + env["PYTHONPATH"]
@@ -60,31 +72,44 @@ def scrape_line(proc: subprocess.Popen, pattern: str, timeout: float = 240.0) ->
 
     select()-gated so a child that hangs BEFORE printing (import stall,
     bind wait) raises after ``timeout`` instead of blocking readline
-    forever; a child that dies mid-startup raises immediately."""
+    forever; a child that dies mid-startup raises immediately — with its
+    recent output in the error, so startup failures are diagnosable from
+    the orchestrator's traceback alone."""
+    import collections
     import select
+
+    tail: collections.deque = collections.deque(maxlen=15)
+
+    def die(reason: str) -> None:
+        if proc.poll() is not None:
+            try:
+                rest = proc.stdout.read() or ""
+                tail.extend(rest.splitlines()[-10:])
+            except Exception:  # noqa: BLE001 — best-effort diagnostics
+                pass
+        out = "\n".join(f"    | {ln.rstrip()}" for ln in tail)
+        raise RuntimeError(
+            f"{reason} (cmd: {' '.join(proc.args[:6])}...)\n"
+            f"  recent child output:\n{out or '    | <none>'}"
+        )
 
     deadline = time.time() + timeout
     while True:
         remaining = deadline - time.time()
         if remaining <= 0:
-            raise RuntimeError(
-                f"no line matching {pattern!r} within {timeout}s"
-            )
+            die(f"no line matching {pattern!r} within {timeout}s")
         ready, _, _ = select.select([proc.stdout], [], [], min(remaining, 0.5))
         if not ready:
             if proc.poll() is not None:
-                raise RuntimeError(
-                    f"child exited rc={proc.returncode} during startup"
-                )
+                die(f"child exited rc={proc.returncode} during startup")
             continue
         line = proc.stdout.readline()
         if not line:
             if proc.poll() is not None:
-                raise RuntimeError(
-                    f"child exited rc={proc.returncode} during startup"
-                )
+                die(f"child exited rc={proc.returncode} during startup")
             time.sleep(0.05)  # stdout closed but child alive: avoid spin
             continue
+        tail.append(line)
         m = re.search(pattern, line)
         if m:
             return m.group(1)
@@ -253,6 +278,7 @@ class LocalUp:
         descheduler: bool = False,
         lease_grace: float = 0.0,
         feature_gates: str = "Failover=true",
+        solver_platform: str = "cpu",
     ):
         self.lease_grace = lease_grace
         self.feature_gates = feature_gates
@@ -261,11 +287,17 @@ class LocalUp:
         self.with_solver = with_solver
         self.with_estimator = with_estimator
         self.descheduler = descheduler
+        # per-component platform policy: only the solver sidecar may own
+        # the accelerator (single-client tunnel); everything else is CPU
+        self.solver_platform = solver_platform
+        self.solver_backend = ""  # scraped from the sidecar at startup
         self.procs: dict[str, subprocess.Popen] = {}
         self.endpoints: dict[str, int] = {}
 
-    def _spawn(self, name: str, cmd: list[str]) -> subprocess.Popen:
-        proc = spawn_child(cmd)
+    def _spawn(
+        self, name: str, cmd: list[str], platform: str = "cpu"
+    ) -> subprocess.Popen:
+        proc = spawn_child(cmd, platform=platform)
         self.procs[name] = proc
         return proc
 
@@ -274,9 +306,20 @@ class LocalUp:
         try:
             if self.with_solver:
                 p = self._spawn(
-                    "solver", [py, "-m", "karmada_tpu.solver", "--address", "127.0.0.1:0"]
+                    "solver",
+                    [py, "-m", "karmada_tpu.solver", "--address",
+                     "127.0.0.1:0", "--report-backend"],
+                    platform=self.solver_platform,
                 )
                 self.endpoints["solver"] = _scrape_port(p, r"port (\d+)")
+                # backend init can take minutes on an accelerator tunnel
+                # (single-client grant: a predecessor's unclean exit can
+                # hold the claim until the server-side session expires);
+                # the line is printed after the port so CPU deployments
+                # scrape both instantly
+                self.solver_backend = scrape_line(
+                    p, r"solver backend (\S+)", timeout=600.0
+                )
             if self.with_estimator:
                 p = self._spawn(
                     "estimator",

@@ -40,11 +40,20 @@ class ComponentSpec:
     replicas: int = 1
     feature_gates: dict[str, bool] = field(default_factory=dict)
     extra_args: dict[str, str] = field(default_factory=dict)
+    # jax backend the component's process runs on ("cpu" | "axon,cpu" |
+    # "tpu"...). Only the solver sidecar should ever be non-cpu: the
+    # accelerator is single-client per machine, and dedicating it to the
+    # Score/Assign engine is the deployment shape docs/OPERATIONS.md
+    # describes. Enforced by the process operator at spawn time.
+    platform: str = "cpu"
 
 
 @dataclass
 class KarmadaComponents:
     scheduler: ComponentSpec = field(default_factory=ComponentSpec)
+    # the solver sidecar (karmada_tpu.solver) — the component the
+    # accelerator platform policy applies to
+    solver: ComponentSpec = field(default_factory=ComponentSpec)
     controller_manager: ComponentSpec = field(default_factory=ComponentSpec)
     webhook: ComponentSpec = field(default_factory=ComponentSpec)
     descheduler: ComponentSpec = field(
@@ -75,6 +84,10 @@ class KarmadaStatus:
     failed_task: str = ""
     observed_generation: int = 0
     installed_version: str = ""
+    # per-component lifetime restart counts from the process supervisor
+    # (crash-loop visibility; the ComponentsHealthy condition carries the
+    # CrashLoopBackOff reason + backoff detail)
+    component_restarts: dict[str, int] = field(default_factory=dict)
 
 
 @dataclass

@@ -49,6 +49,7 @@ class ProcessInstance:
     pki_dir: str = ""
     procs: dict[str, subprocess.Popen] = field(default_factory=dict)
     endpoints: dict[str, object] = field(default_factory=dict)
+    solver_backend: str = ""  # scraped when the solver owns an accelerator
 
     def alive(self, component: str) -> bool:
         proc = self.procs.get(component)
@@ -56,6 +57,25 @@ class ProcessInstance:
 
 
 from ..localup import scrape_line as _scrape, spawn_child as _spawn
+
+
+@dataclass
+class ComponentHealth:
+    """Per-component supervision state (the CrashLoopBackOff analogue:
+    Kubernetes' kubelet applies exponential backoff to a container that
+    keeps dying; the reference operator inherits that for free from the
+    Deployments it renders — this build supplies it directly)."""
+
+    restarts: int = 0  # lifetime restart count (surfaced on the CR)
+    recent: list = field(default_factory=list)  # restart times in window
+    backoff: float = 0.0  # current backoff seconds (0 = none)
+    backoff_until: float = 0.0  # monotonic deadline; dead waits until then
+    last_restart: float = 0.0
+
+    def reset(self) -> None:
+        self.recent.clear()
+        self.backoff = 0.0
+        self.backoff_until = 0.0
 
 
 def _stop(proc: Optional[subprocess.Popen], grace: float = 5.0) -> None:
@@ -72,14 +92,38 @@ def _stop(proc: Optional[subprocess.Popen], grace: float = 5.0) -> None:
 class ProcessKarmadaOperator:
     """Reconciles Karmada CRs into multi-process deployments."""
 
-    def __init__(self, checkpoint_interval: float = 15.0) -> None:
+    def __init__(
+        self,
+        checkpoint_interval: float = 15.0,
+        backoff_initial: float = 1.0,
+        backoff_max: float = 30.0,
+        storm_window: float = 30.0,
+        storm_cap: int = 5,
+    ) -> None:
         self.instances: dict[str, ProcessInstance] = {}
         self._applied_specs: dict[str, KarmadaSpec] = {}
         self.checkpoint_interval = checkpoint_interval
+        # supervision policy: first death restarts immediately; repeat
+        # deaths wait an exponentially growing backoff (doubling to
+        # backoff_max); more than storm_cap restarts inside storm_window
+        # is a CRASH LOOP — restarts continue at max backoff and the CR
+        # reports ComponentsHealthy=False/CrashLoopBackOff
+        self.backoff_initial = backoff_initial
+        self.backoff_max = backoff_max
+        self.storm_window = storm_window
+        self.storm_cap = storm_cap
+        self._health: dict[tuple[str, str], ComponentHealth] = {}
+        import threading
+
+        self._lock = threading.RLock()  # reconcile vs watchdog sweeps
 
     # -- public ------------------------------------------------------------
 
     def reconcile(self, karmada: Karmada) -> ProcessInstance:
+        with self._lock:
+            return self._reconcile_locked(karmada)
+
+    def _reconcile_locked(self, karmada: Karmada) -> ProcessInstance:
         name = karmada.meta.name
         fresh = name not in self.instances
         job = (
@@ -112,17 +156,26 @@ class ProcessKarmadaOperator:
         return self.instances[name]
 
     def supervise(self, karmada: Karmada) -> list[str]:
-        """One supervision sweep (the Deployment-controller analogue the
-        reference gets from Kubernetes itself): restart any dead component
-        of an installed instance at its PINNED endpoint. The plane restarts
-        from its latest periodic checkpoint; gRPC clients (RemoteSolver,
-        estimator connections, StoreReplica agents) reconnect to the pinned
-        ports on their own — the solver's snapshot-version fencing re-syncs
-        cluster state on the first post-restart schedule. Returns the
-        component names restarted."""
-        inst = self.instances.get(karmada.meta.name)
+        """One supervision sweep: restart any dead component of an
+        installed instance at its PINNED endpoint, under the crash-loop
+        policy (exponential backoff per component, restart-storm cap
+        surfaced on the CR). The plane restarts from its latest periodic
+        checkpoint; gRPC clients (RemoteSolver, estimator connections,
+        StoreReplica agents) reconnect to the pinned ports on their own —
+        the solver's snapshot-version fencing re-syncs cluster state on
+        the first post-restart schedule. Returns the component names
+        restarted this sweep (a component inside its backoff window stays
+        down and is NOT in the list). ``Supervisor`` wraps this in a
+        watchdog thread."""
+        with self._lock:
+            return self._supervise_locked(karmada)
+
+    def _supervise_locked(self, karmada: Karmada) -> list[str]:
+        name = karmada.meta.name
+        inst = self.instances.get(name)
         if inst is None:
             return []
+        now = time.monotonic()
         data = {"karmada": karmada}
         restarted: list[str] = []
         starters = {
@@ -132,16 +185,109 @@ class ProcessKarmadaOperator:
             "plane": self._start_plane,
         }
         for comp, proc in list(inst.procs.items()):
+            h = self._health.setdefault((name, comp), ComponentHealth())
             if proc.poll() is None:
+                # alive past the storm window: forgive the history so a
+                # one-off crash next month starts from a fresh backoff
+                if h.backoff and now - h.last_restart > self.storm_window:
+                    h.reset()
                 continue
-            if comp.startswith("agent-"):
-                self._spawn_agent(inst, comp[len("agent-"):])
-            else:
-                starters[comp](data)
-            restarted.append(comp)
+            if now < h.backoff_until:
+                continue  # backing off: stays down this sweep
+            try:
+                if comp.startswith("agent-"):
+                    self._spawn_agent(inst, comp[len("agent-"):])
+                else:
+                    starters[comp](data)
+                started = True
+            except Exception:
+                # a FAILED restart attempt (child died during startup,
+                # scrape timeout) must still advance the backoff — or the
+                # watchdog would hot-loop respawns with no cap at all
+                started = False
+            # the backoff clock starts when the restart attempt COMPLETES:
+            # child startup (imports, port scrape) can take many seconds,
+            # and a deadline anchored at sweep start would be expired
+            t_done = time.monotonic()
+            h.restarts += 1
+            h.last_restart = t_done
+            h.recent = [
+                t for t in h.recent if t_done - t <= self.storm_window
+            ] + [t_done]
+            h.backoff = min(
+                self.backoff_max,
+                h.backoff * 2 if h.backoff else self.backoff_initial,
+            )
+            h.backoff_until = t_done + h.backoff
+            if started:
+                restarted.append(comp)
+        self._surface_health(karmada, now)
         if restarted:
             self._wait_ready(data)
         return restarted
+
+    def _surface_health(self, karmada: Karmada, now: float) -> None:
+        """Crash-loop status on the Karmada CR (the reference surfaces
+        component failures as Karmada CR conditions via its controller;
+        operator/pkg/controller/karmada condition plumbing)."""
+        name = karmada.meta.name
+        inst = self.instances.get(name)
+        karmada.status.component_restarts = {
+            comp: h.restarts
+            for (n, comp), h in self._health.items()
+            if n == name and h.restarts
+        }
+        # crash loop = storm_cap exceeded inside the window OR the backoff
+        # has been driven to its max (with doubling backoff the window can
+        # physically hold only ~storm_cap restarts, so max-backoff is the
+        # steady-state signature of a perpetually dying component)
+        looping = sorted(
+            comp
+            for (n, comp), h in self._health.items()
+            if n == name
+            and (
+                len([t for t in h.recent if now - t <= self.storm_window])
+                > self.storm_cap
+                or (h.backoff >= self.backoff_max and h.recent)
+            )
+        )
+        dead = sorted(
+            comp
+            for comp in (inst.procs if inst else {})
+            if not inst.alive(comp)
+        )
+        if looping:
+            msgs = []
+            for comp in looping:
+                h = self._health[(name, comp)]
+                msgs.append(
+                    f"{comp}: {h.restarts} restarts "
+                    f"({len(h.recent)} in {self.storm_window:.0f}s), "
+                    f"backoff {h.backoff:.1f}s"
+                )
+            set_condition(
+                karmada.status.conditions,
+                Condition(
+                    type="ComponentsHealthy", status=False,
+                    reason="CrashLoopBackOff", message="; ".join(msgs),
+                ),
+            )
+        elif dead:
+            # down but not yet looping: waiting out a backoff window
+            set_condition(
+                karmada.status.conditions,
+                Condition(
+                    type="ComponentsHealthy", status=False,
+                    reason="BackOff", message=", ".join(dead) + " down",
+                ),
+            )
+        else:
+            set_condition(
+                karmada.status.conditions,
+                Condition(
+                    type="ComponentsHealthy", status=True, reason="AllAlive"
+                ),
+            )
 
     def deinit(self, karmada: Karmada) -> None:
         inst = self.instances.pop(karmada.meta.name, None)
@@ -229,13 +375,24 @@ class ProcessKarmadaOperator:
 
     def _start_solver(self, data: dict) -> None:
         inst = self._instance(data)
+        karmada = data["karmada"]
+        platform = karmada.spec.components.solver.platform or "cpu"
         port = inst.endpoints.get("solver", 0)  # pinned on restart
-        proc = _spawn(
-            [sys.executable, "-m", "karmada_tpu.solver",
-             "--address", f"127.0.0.1:{port}"]
-        )
+        cmd = [sys.executable, "-m", "karmada_tpu.solver",
+               "--address", f"127.0.0.1:{port}"]
+        if platform != "cpu":
+            cmd.append("--report-backend")
+        proc = _spawn(cmd, platform=platform)
         inst.procs["solver"] = proc
         inst.endpoints["solver"] = int(_scrape(proc, r"port (\d+)"))
+        if platform != "cpu":
+            # confirm the sidecar actually owns the accelerator — a tunnel
+            # that fell back to CPU silently would fake the deployment
+            # shape. Long timeout: a predecessor's unclean exit can hold
+            # the single-client grant for minutes (see localup.py)
+            inst.solver_backend = _scrape(
+                proc, r"solver backend (\S+)", timeout=600.0
+            )
 
     def _start_estimator(self, data: dict) -> None:
         inst = self._instance(data)
@@ -394,3 +551,52 @@ class ProcessKarmadaOperator:
             _stop(inst.procs.pop(comp))
         for name in want:
             self._spawn_agent(inst, name)
+
+
+class Supervisor:
+    """Watchdog thread around ``ProcessKarmadaOperator.supervise``: the
+    always-on Deployment-controller loop the reference gets from
+    Kubernetes itself. Polls component liveness every ``interval``
+    seconds, restarts dead components under the operator's backoff /
+    crash-loop policy, and keeps the Karmada CR's ComponentsHealthy
+    condition current. One Supervisor per CR; sweeps and reconciles share
+    the operator's lock."""
+
+    def __init__(
+        self,
+        operator: ProcessKarmadaOperator,
+        karmada: Karmada,
+        interval: float = 0.5,
+    ) -> None:
+        import threading
+
+        self.operator = operator
+        self.karmada = karmada
+        self.interval = interval
+        self.restarted_total: list[str] = []  # log of restart events
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Supervisor":
+        import threading
+
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.restarted_total.extend(
+                    self.operator.supervise(self.karmada)
+                )
+            except Exception:  # noqa: BLE001 — the watchdog must survive
+                # a failed restart attempt (it retries next sweep; the
+                # component's backoff keeps growing)
+                pass
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -68,11 +68,16 @@ K_PREV = 32  # max previous-assignment sites on the fast path (small fleets
 # legitimately spread one binding over dozens of clusters; rows beyond this
 # take the general host path)
 MAX_REPLICAS_FAST = 128  # divided-strategy replica cap (bounds the entry vector)
-MAX_SLOTS = 8192  # unique placements/gvks/profiles before table rebuild.
-# Sizing: the cp table is [U, 3C] int32 = 8192 x 15000 x 4B ~ 0.5 GB at
-# C=5000 — comfortable in 16 GB HBM, uploaded once per mask change; plain
-# row gathers make the per-pass cost independent of U. Fleets beyond this
-# many unique placements fall back to a table rebuild per schedule call.
+MAX_SLOTS = 8192  # unique placements/gvks/profiles FLOOR before slot
+# eviction engages. Sizing: the cp table is [U, 3C] int32 = 8192 x 15000
+# x 4B ~ 0.5 GB at C=5000; plain row gathers make the per-pass cost
+# independent of U. The EFFECTIVE cap scales with the cluster count up to
+# CP_TABLE_MAX_BYTES (so a 5k-cluster fleet carries ~26k unique
+# placements before any fallback), and crossing 3/4 of it first evicts
+# slots no live row references — only a fleet whose LIVE rows reference
+# more uniques than the budget allows falls back to a rebuild per call.
+CP_TABLE_MAX_BYTES = 1536 << 20  # device cp-table budget (HBM)
+MAX_SLOTS_HARD = 65536  # interning-dict / host-staging sanity bound
 E_ROUND = 1 << 18  # entry-buffer quantum (bounds trace churn)
 
 
@@ -778,6 +783,8 @@ class FleetTable:
         # interning slots
         self._cp_slot: dict[int, int] = {}
         self._cp_pl: list = []  # slot -> (placement, compiled) pinned
+        self._cp_uploaded = 0  # slots currently valid on the device table
+        self._cp_remapped = False  # slot ids changed: full upload needed
         self._gvk_slot: dict[str, int] = {}
         self._gvk_list: list[str] = []
         self._prof_slot: dict[bytes, int] = {}
@@ -998,20 +1005,23 @@ class FleetTable:
         self._terms[row] = compiled.terms[0][0]
         self._dirty.add(row)
 
-    def _compact_slots(self) -> None:
-        """Drop DERIVED placement slots no live row references: selection
-        drift interns new variants every availability change, and without
-        eviction a long-lived engine would cross MAX_SLOTS and discard the
-        whole table (losing the delta base for every row). Plain placement
-        slots are never dropped — they are stable and few. Triggers a full
-        table rebuild + state re-upload, so it runs only under pressure."""
+    def _compact_slots(self, aggressive: bool = False) -> None:
+        """Drop placement slots no live row references. The cheap sweep
+        drops DERIVED slots only (selection drift interns new variants
+        every availability change); ``aggressive`` (under cap pressure)
+        also drops unreferenced PLAIN slots — create/delete churn over a
+        heterogeneous fleet retires placements whose rows compaction
+        already reclaimed, and re-interning a returning placement is one
+        cached compile + one slot append. Triggers a full table rebuild +
+        state re-upload, so it runs only under pressure."""
         used = set(
             int(s) for s in np.unique(self._st["cp_idx"][: self.n_rows])
         )
         keep = [
             i
             for i, (pl, cp) in enumerate(self._cp_pl)
-            if i in used or not getattr(cp, "derived", False)
+            if i in used
+            or (not aggressive and not getattr(cp, "derived", False))
         ]
         if len(keep) == len(self._cp_pl):
             return
@@ -1028,16 +1038,36 @@ class FleetTable:
             self._st["cp_idx"][: self.n_rows]
         ]
         self._tables_dirty = True
+        self._cp_remapped = True  # device cp rows are stale: full upload
         self._dev_state = None  # cp_idx remapped: full re-upload
+
+    def _max_slots(self) -> int:
+        """Effective unique-placement cap: MAX_SLOTS floor, scaled up to
+        the CP_TABLE_MAX_BYTES device budget (3C int32 words per slot).
+        Snapped DOWN to a power of two so the pow2 device capacity the
+        cap implies actually fits the budget (a raw quotient would let
+        the allocated table overshoot it by up to 2x)."""
+        c = max(1, self.engine.snapshot.num_clusters)
+        by_budget = max(1, CP_TABLE_MAX_BYTES // (12 * c))
+        pow2_floor = 1 << (by_budget.bit_length() - 1)
+        return min(MAX_SLOTS_HARD, max(MAX_SLOTS, pow2_floor))
 
     @property
     def slots_exhausted(self) -> bool:
-        if len(self._cp_pl) > MAX_SLOTS * 3 // 4:
+        mx = self._max_slots()
+        if len(self._cp_pl) > mx * 3 // 4:
             self._compact_slots()
+        if len(self._cp_pl) > mx:
+            # retired placements stay pinned by their AGED rows: reclaim
+            # idle rows first, then sweep every unreferenced slot — a
+            # generational churn workload (new unique placements per wave)
+            # keeps one table alive instead of rebuilding per call
+            self._compact()
+            self._compact_slots(aggressive=True)
         return (
-            len(self._cp_pl) > MAX_SLOTS
-            or len(self._gvk_list) > MAX_SLOTS
-            or len(self._profiles) > MAX_SLOTS
+            len(self._cp_pl) > mx
+            or len(self._gvk_list) > mx
+            or len(self._profiles) > mx
         )
 
     # -- device sync -------------------------------------------------------
@@ -1080,34 +1110,78 @@ class FleetTable:
                 self._static_max = max(
                     self._static_max, int(cp.static_weights.max(initial=0))
                 )
+            # NOTE: device cp rows stay valid here — masks are functions
+            # of the FILTER fields only, and a swap that changed those
+            # fields changed mask_token, which the `full` check below
+            # already catches (resetting _cp_uploaded on every gen bump
+            # would re-upload the whole [U, 3C] table each churn pass)
         _mark("recompile")
         c = snap.num_clusters
+
+        def cp_rows_np(slots) -> np.ndarray:
+            return np.concatenate(
+                [
+                    np.stack(
+                        [
+                            (cp.terms[0][1] & cp.spread_field_ok).astype(
+                                np.int32
+                            )
+                            for _, cp in slots
+                        ]
+                    ),
+                    np.stack(
+                        [cp.taint_ok.astype(np.int32) for _, cp in slots]
+                    ),
+                    np.stack(
+                        [cp.static_weights.astype(np.int32) for _, cp in slots]
+                    ),
+                ],
+                axis=1,
+            )  # [k, 3C]
+
         # the mask tables are functions of the snapshot's FILTER fields only
         # (labels/taints/enablements/topology — snapshot.mask_token) and the
         # interned slot lists. An availability-only swap (churn) leaves both
-        # unchanged, so the resident device tables stay valid — re-uploading
-        # the [U, 3C] cp_table costs seconds per pass over the tunnel at
-        # heterogeneous U (hundreds of MB)
+        # unchanged, so the resident device tables stay valid. New interned
+        # slots APPEND to a pow2-capacity device table (one small scatter —
+        # re-uploading the full [U, 3C] table costs seconds per new
+        # placement over the tunnel at heterogeneous U, and an exact-U
+        # shape retraced the whole solve per slot); mask-token changes and
+        # slot remaps rebuild in full.
         token = snap.mask_token
-        need_masks = (
+        n_slots = len(self._cp_pl)
+        full = (
             self._dev_tables is None
-            or slots_changed
             or token != getattr(self, "_mask_token", None)
+            or self._cp_remapped
+            or self._cp_uploaded == 0
         )
-        if need_masks:
-            aff = np.stack(
-                [
-                    (cp.terms[0][1] & cp.spread_field_ok).astype(np.int32)
-                    for _, cp in self._cp_pl
-                ]
+        if full:
+            # pow2 capacity allocated ON DEVICE (zeros are free there);
+            # only the live slot rows ship over the wire
+            cap_s = _pow2(max(n_slots, 16))
+            cp_dev = (
+                jnp.zeros((cap_s, 3 * c), jnp.int32)
+                .at[:n_slots]
+                .set(jnp.asarray(cp_rows_np(self._cp_pl)))
             )
-            taint = np.stack(
-                [cp.taint_ok.astype(np.int32) for _, cp in self._cp_pl]
-            )
-            static = np.stack(
-                [cp.static_weights.astype(np.int32) for _, cp in self._cp_pl]
-            )
-            cp_table = np.concatenate([aff, taint, static], axis=1)  # [U, 3C]
+            self._cp_uploaded = n_slots
+            self._cp_remapped = False
+        else:
+            cp_dev = self._dev_tables[0]
+            if n_slots > self._cp_uploaded:
+                if n_slots > cp_dev.shape[0]:  # grow device capacity
+                    grown = jnp.zeros(
+                        (_pow2(n_slots), 3 * c), jnp.int32
+                    )
+                    cp_dev = lax.dynamic_update_slice(
+                        grown, cp_dev, (0, 0)
+                    )
+                new = cp_rows_np(self._cp_pl[self._cp_uploaded :])
+                idx = jnp.arange(self._cp_uploaded, n_slots)
+                cp_dev = cp_dev.at[idx].set(jnp.asarray(new))
+                self._cp_uploaded = n_slots
+        if full or slots_changed:
             gvk_rows = []
             for g in self._gvk_list:
                 gid = snap.gvk_vocab.get(g) if g else None
@@ -1121,15 +1195,25 @@ class FleetTable:
                     word, bit = gid // 32, gid % 32
                     mask = (snap.gvk_bits[:, word] >> np.uint32(bit)) & 1 != 0
                 gvk_rows.append(mask.astype(np.int32))
-            gvk_table = np.stack(gvk_rows)
-            cp_dev = jnp.asarray(cp_table)
-            gvk_dev = jnp.asarray(gvk_table)
+            gvk_dev = (
+                jnp.zeros((_pow2(max(len(gvk_rows), 4)), c), jnp.int32)
+                .at[: len(gvk_rows)]
+                .set(jnp.asarray(np.stack(gvk_rows)))
+            )
             inc_dev = jnp.asarray(~snap.complete_enablements)
         else:
-            cp_dev, gvk_dev, _, inc_dev = self._dev_tables
+            _, gvk_dev, _, inc_dev = self._dev_tables
         _mark("masks")
         profs = np.stack(self._profiles)
-        prof_table = self.engine._profile_table(profs)
+        # pow2 row padding keeps the solve trace stable as profiles intern
+        # (zero-request pad rows estimate to the untouched sentinel and are
+        # never gathered — prof_idx stays below the live count)
+        pad_p = _pow2(max(len(profs), 4))
+        profs_dev = profs
+        if pad_p > len(profs):
+            profs_dev = np.zeros((pad_p, profs.shape[1]), profs.dtype)
+            profs_dev[: len(profs)] = profs
+        prof_table = self.engine._profile_table(profs_dev)
         _mark("prof_table")
         if self.engine._models_active():
             self._avail_max = int(

@@ -14,6 +14,13 @@ def main(argv=None) -> None:
     p.add_argument("--server-cert", default="", help="PEM file (TLS)")
     p.add_argument("--server-key", default="", help="PEM file (TLS)")
     p.add_argument("--client-ca", default="", help="PEM file (mTLS client auth)")
+    p.add_argument(
+        "--report-backend", action="store_true",
+        help="print the resolved jax backend platform after binding — the "
+        "orchestrator scrapes it to confirm which component owns the "
+        "accelerator (forces backend init, which can take tens of "
+        "seconds over a TPU tunnel)",
+    )
     args = p.parse_args(argv)
 
     def read(path):
@@ -29,6 +36,10 @@ def main(argv=None) -> None:
     port = server.start()
     # the parent process scrapes this line to learn the bound port
     print(f"solver listening on port {port}", flush=True)
+    if args.report_backend:
+        import jax
+
+        print(f"solver backend {jax.devices()[0].platform}", flush=True)
     try:
         server.wait()
     except KeyboardInterrupt:

@@ -1,0 +1,47 @@
+"""Child-process jax platform policy.
+
+The accelerator environment's sitecustomize registers the tunnel backend
+and overrides platform selection PROGRAMMATICALLY at interpreter start,
+so a parent setting ``JAX_PLATFORMS=cpu`` in a child's env is silently
+ignored — the child's first jax use would dial the (single-client)
+accelerator tunnel. ``spawn_child`` therefore passes the requested
+platform in ``KARMADA_TPU_PLATFORM`` and every child entrypoint calls
+``apply_child_platform()`` before its first jax use, re-asserting the
+policy through ``jax.config`` the same way the sitecustomize set it.
+
+Ref: the reference pins components to nodes/devices via pod scheduling
+(operator-rendered Deployments); here the analogue is per-process
+backend selection.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_child_platform() -> None:
+    """Apply the parent-requested jax platform (no-op when unset).
+
+    Must run before any jax backend initializes; safe to call multiple
+    times. Import of jax is deferred so non-jax children don't pay it.
+    """
+    plat = os.environ.get("KARMADA_TPU_PLATFORM")
+    if not plat:
+        return
+    os.environ["JAX_PLATFORMS"] = plat
+    import sys
+
+    if "jax" not in sys.modules:
+        # jax not imported yet: nothing has overridden the env var, and
+        # importing jax here just to re-assert it would make every
+        # non-jax child pay the import. (Under the tunnel sitecustomize
+        # jax IS already imported at this point — that is the case the
+        # config override below exists for.)
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception:
+        # backends already initialized: the env var was our best effort
+        pass

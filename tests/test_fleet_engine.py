@@ -172,6 +172,55 @@ def test_entry_buffer_overflow_falls_back_to_safe_bound(monkeypatch):
         assert sorted(feas) == sorted(f.feasible), key
 
 
+def test_slot_eviction_survives_generational_placement_churn(monkeypatch):
+    """Crossing the unique-placement cap with RETIRED placements must not
+    rebuild the table per call: idle rows are reclaimed, their slots
+    swept, and the SAME FleetTable keeps scheduling (delta base intact).
+    Placements exceeding the cap while all still live do rebuild — that
+    is the genuine capacity limit, not the cliff."""
+    from karmada_tpu.utils.builders import static_weight_placement
+
+    monkeypatch.setattr(fleet_mod, "MAX_SLOTS", 16)
+    monkeypatch.setattr(fleet_mod, "MAX_SLOTS_HARD", 16)
+    monkeypatch.setattr(fleet_mod, "CP_TABLE_MAX_BYTES", 0)
+    clusters = synthetic_fleet(20, seed=3)
+    snap = ClusterSnapshot(clusters)
+    names = [c.name for c in clusters]
+    eng = TensorScheduler(snap)
+    eng.fleet_threshold = 1
+
+    def gen_problems(gen: int):
+        pls = [
+            static_weight_placement({names[j]: j + k + 1 for j in range(5)})
+            for k in range(10)  # 10 unique placements per generation
+        ]
+        return [
+            BindingProblem(
+                key=f"g{gen}_{i}", placement=pls[i % 10], replicas=4 + i % 7,
+                requests={}, gvk="apps/v1/Deployment",
+            )
+            for i in range(40)
+        ]
+
+    tables = set()
+    for gen in range(4):  # 40 uniques over the table's life vs cap 16
+        probs = gen_problems(gen)
+        for _ in range(6):  # age the previous generation past the window
+            res = eng.schedule(probs)
+        tables.add(id(eng._fleet))
+        host = TensorScheduler(snap)
+        want = host._schedule_host(
+            probs, [host._compiled(p.placement) for p in probs]
+        )
+        _assert_same(want, res)
+    # generations retire cleanly: one table (first gen fills 10/16; later
+    # gens evict the retired ones instead of tripping the rebuild path).
+    # At most the live generation + its not-yet-swept predecessor remain
+    # (the sweep runs at the NEXT cap-pressure check).
+    assert len(tables) == 1, "table rebuilt despite retirable slots"
+    assert len(eng._fleet._cp_pl) <= 20, len(eng._fleet._cp_pl)
+
+
 def test_batch_reuse_survives_compaction():
     """The batch-identity fast path skips upsert (and its last-used bump);
     a compaction sweep must still see those rows as live, not idle."""

@@ -54,6 +54,10 @@ def deployment():
 class TestMultiProcessQuickstart:
     def test_quickstart_through_network_surfaces(self, deployment):
         lu, r = deployment
+        # platform policy: control-plane components run CPU jax; the
+        # scraped backend confirms the solver honored it (the TPU-owning
+        # variant is tests/test_tpu_solver_localup.py, opt-in)
+        assert lu.solver_backend == "cpu"
         # all three clusters visible over the bus
         assert wait_for(
             lambda: {c.name for c in r.store.list("Cluster")}

