@@ -37,11 +37,17 @@ headline workload (VERDICT.md "What's weak" #3).
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import sys
 import time
 
 import numpy as np
+
+
+# Tier hygiene: each sub-tier dels its engine/results then gc.collect()s
+# so its device residents free before the next tier allocates (three live
+# engines exceed HBM at C=5000).
 
 
 def build_parser():
@@ -538,8 +544,8 @@ def run_engine_north_star(args) -> dict:
     )
     times = []
     results = None
-    def show(tag, wall):
-        breakdown = dict(getattr(engine, "last_breakdown", {}))
+    def show(tag, wall, eng=None):
+        breakdown = dict(getattr(eng or engine, "last_breakdown", {}))
         parts = " ".join(
             f"{k}={v:.1f}" if k == "fetch_mb"
             else f"{k}={int(v)}" if k == "changed_rows"
@@ -582,7 +588,8 @@ def run_engine_north_star(args) -> dict:
     # path, the second compiles the speculative phase-B trace that engages
     # once a churn pass has been observed.
     for warm_snap in drift_snaps[:2]:
-        assert engine.update_snapshot(warm_snap)
+        swapped = engine.update_snapshot(warm_snap)
+        assert swapped
         engine.schedule(problems)
     churn_times = []
     for rep, snap_r in enumerate(drift_snaps[2:]):
@@ -634,6 +641,8 @@ def run_engine_north_star(args) -> dict:
         )
         if h_bad:
             print(f"# WARNING: hetero mismatches: {h_bad}", file=sys.stderr)
+        del h_engine, h_res, h_problems
+        gc.collect()
 
     # ---- >MAX_SLOTS-unique sub-tier (the old 8192-slot cliff) -------------
     # 9000 unique placements over 50k bindings: the slot cap now scales
@@ -660,7 +669,8 @@ def run_engine_north_star(args) -> dict:
         print(f"# hetero-9000 warm pass: {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
         table_obj = k_engine._fleet
-        k_engine.schedule(k_problems)  # stabilize
+        for _ in range(3):  # caps settle (shrink = 2 votes + 1 observe)
+            k_engine.schedule(k_problems)
         k_times = []
         for rep in range(2):
             t0 = time.perf_counter()
@@ -682,8 +692,92 @@ def run_engine_north_star(args) -> dict:
                 f"survived={survived}",
                 file=sys.stderr,
             )
+        del k_engine, k_res, k_problems
+        gc.collect()
 
-    # restore the measured-snapshot results for verification below
+    # ---- 1M x 5k scale tier (first-class, VERDICT r3 item 9) --------------
+    # Ten times the headline bindings through the same engine: steady +
+    # full-drift churn p50s with sampled oracle verification. The dense
+    # resident would exceed its HBM budget at this cap, so this tier also
+    # keeps the legacy entry-resident path honest.
+    m1_steady = m1_churn = 0.0
+    if not args.hetero and not args.no_verify and b_total == 100_000:
+        b_m = 1_000_000
+        rng_m = np.random.default_rng(1234)
+        reps_m = rng_m.integers(1, 100, b_m)
+        prof_m = rng_m.integers(0, 8, b_m)
+        tol_m = rng_m.random(b_m) < 0.30
+        t0 = time.perf_counter()
+        m_problems = [
+            BindingProblem(
+                key=f"m{i}",
+                placement=pl_tol if tol_m[i] else pl_plain,
+                replicas=int(reps_m[i]),
+                requests=profiles[prof_m[i]],
+                gvk="apps/v1/Deployment",
+            )
+            for i in range(b_m)
+        ]
+        print(f"# 1M problem build: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        m_engine = TensorScheduler(snap, chunk_size=args.chunk)
+        t0 = time.perf_counter()
+        m_engine.schedule(m_problems)
+        print(f"# 1M warm pass: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        for tag in ("tune", "stabilize", "settle"):
+            t0 = time.perf_counter()
+            m_engine.schedule(m_problems)
+            print(f"# 1M {tag} pass: {time.perf_counter() - t0:.1f}s",
+                  file=sys.stderr)
+        m_times = []
+        for rep in range(2):
+            t0 = time.perf_counter()
+            m_res = m_engine.schedule(m_problems)
+            m_times.append(time.perf_counter() - t0)
+            show(f"1M steady pass {rep}", m_times[-1], m_engine)
+        m1_steady = float(np.median(m_times))
+        # churn: two full-availability-drift warms (exact phase B, then the
+        # speculative trace) + timed passes
+        m_drifts = []
+        for _ in range(4):
+            for cl in clusters:
+                rs = cl.status.resource_summary
+                for dim, q in list(rs.allocated.items()):
+                    alloc = rs.allocatable.get(dim, 0)
+                    rs.allocated[dim] = int(min(max(
+                        0, q + int(rng_m.integers(-3, 4)) * max(1, alloc // 200)
+                    ), alloc))
+            m_drifts.append(ClusterSnapshot(clusters))
+        for warm_snap in m_drifts[:2]:
+            swapped = m_engine.update_snapshot(warm_snap)
+            assert swapped
+            m_engine.schedule(m_problems)
+        m_churn_times = []
+        for rep, snap_m in enumerate(m_drifts[2:]):
+            t0 = time.perf_counter()
+            swapped = m_engine.update_snapshot(snap_m)
+            assert swapped
+            m_res = m_engine.schedule(m_problems)
+            m_churn_times.append(time.perf_counter() - t0)
+            show(f"1M churn pass {rep}", m_churn_times[-1], m_engine)
+        m1_churn = float(np.median(m_churn_times))
+        m_idx = list(range(0, b_m, max(1, b_m // 128)))[:128]
+        m_ok, m_bad = _verify_rows(
+            ClusterSnapshot(clusters), m_problems, m_res, m_engine, m_idx
+        )
+        print(
+            f"# 1M x 5k tier: steady p50 {m1_steady:.3f}s, churn p50 "
+            f"{m1_churn:.3f}s, oracle {m_ok}/{len(m_idx)} identical",
+            file=sys.stderr,
+        )
+        if m_bad:
+            print(f"# WARNING: 1M mismatches: {m_bad}", file=sys.stderr)
+        del m_problems, m_engine, m_res
+        gc.collect()
+
+    # restore the measured-snapshot results for verification below (the
+    # original ``snap`` holds copies of the pre-drift capacities)
     swapped = engine.update_snapshot(snap)
     assert swapped
     results = engine.schedule(problems)
@@ -709,6 +803,9 @@ def run_engine_north_star(args) -> dict:
         out["hetero3500_p50"] = round(hetero_p50, 4)
     if hetero9k_p50:
         out["hetero9000_p50"] = round(hetero9k_p50, 4)
+    if m1_steady:
+        out["scale1m_steady_p50"] = round(m1_steady, 4)
+        out["scale1m_churn_p50"] = round(m1_churn, 4)
     if args.no_verify:
         out["vs_baseline"] = 0.0
         return out

@@ -511,6 +511,208 @@ def cmd_addons(cp: ControlPlane, enable: Sequence[str] = (), disable: Sequence[s
     return state
 
 
+# --------------------------------------------------------------------------
+# generic resource verbs (ref: pkg/karmadactl/karmadactl.go:98-178 — the
+# kubectl-style apply/delete/patch/label/annotate/api-resources surface;
+# subdirs pkg/karmadactl/{apply,patch,...}). Every verb runs over a
+# ControlPlane-SHAPED handle: in-proc cp or RemotePlane — remote writes
+# ride the store bus and the PLANE's admission chain validates them
+# server-side, exactly like kubectl hitting the aggregated apiserver.
+# --------------------------------------------------------------------------
+
+
+def _load_manifests(text: str) -> list[dict]:
+    """Parse manifests: a JSON object, a JSON array, a {kind: List,
+    items: [...]} envelope, or (when available) multi-document YAML."""
+    text = text.strip()
+    docs: list = []
+    if text.startswith(("{", "[")):
+        data = json.loads(text)
+        docs = data if isinstance(data, list) else [data]
+    else:
+        try:
+            import yaml  # type: ignore[import-not-found]
+        except ImportError as exc:  # JSON-only environment
+            raise ValueError(
+                "manifest is not JSON and no YAML parser is available"
+            ) from exc
+        docs = [d for d in yaml.safe_load_all(text) if d]
+    out: list[dict] = []
+    for d in docs:
+        if isinstance(d, dict) and d.get("kind") == "List":
+            out.extend(d.get("items") or [])
+        else:
+            out.append(d)
+    return out
+
+
+def _manifest_to_obj(manifest: dict):
+    """k8s-style manifest -> typed object. Kinds the bus codec knows
+    (karmada-native CRs) decode through the registry (metadata -> meta);
+    anything else becomes a template ``Resource`` — the store's workload
+    representation (what the detector matches policies against)."""
+    from .bus.service import kind_registry
+    from .utils.codec import from_jsonable
+
+    kind = manifest.get("kind", "")
+    reg = kind_registry()
+    if kind in reg and kind != "Resource":
+        d = {k: v for k, v in manifest.items() if k not in (
+            "apiVersion", "kind",
+        )}
+        if "metadata" in d and "meta" not in d:
+            d["meta"] = d.pop("metadata")
+        return from_jsonable(reg[kind], d)
+    from .interpreter.webhook import resource_from_dict
+
+    return resource_from_dict(manifest)
+
+
+def _resolve(cp, kind: str, namespace: str, name: str):
+    """(store_kind, key, obj) for a verb target. ``kind`` is a registry
+    kind ("PropagationPolicy"), or a gvk ("apps/v1/Deployment") / bare
+    workload kind ("Deployment") for template Resources."""
+    from .bus.service import kind_registry
+
+    key = f"{namespace}/{name}" if namespace else name
+    if "/" not in kind and kind in kind_registry() and kind != "Resource":
+        return kind, key, cp.store.get(kind, key)
+    obj = cp.store.get("Resource", key)
+    if obj is not None and "/" in kind:
+        if f"{obj.api_version}/{obj.kind}" != kind:
+            return "Resource", key, None
+    elif obj is not None and kind not in ("", "Resource", obj.kind):
+        return "Resource", key, None
+    return "Resource", key, obj
+
+
+def _merge_patch(doc, patch):
+    """RFC 7386 JSON merge patch (kubectl patch --type=merge)."""
+    if not isinstance(patch, dict):
+        return patch
+    out = dict(doc) if isinstance(doc, dict) else {}
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = _merge_patch(out.get(k), v)
+    return out
+
+
+def cmd_apply(cp, manifests: Sequence[dict]) -> list[str]:
+    """Create-or-update each manifest through the (possibly remote) store;
+    the plane's admission chain validates server-side."""
+    from .utils.store import obj_key, obj_kind
+
+    applied = []
+    for m in manifests:
+        obj = _manifest_to_obj(m)
+        cp.store.apply(obj)
+        applied.append(f"{obj_kind(obj)}/{obj_key(obj)}")
+    return applied
+
+
+def cmd_delete(
+    cp, kind: str, namespace: str, name: str, *, force: bool = False
+) -> bool:
+    store_kind, key, obj = _resolve(cp, kind, namespace, name)
+    if obj is None:
+        return False
+    return bool(cp.store.delete(store_kind, key, force=force))
+
+
+def cmd_patch(
+    cp, kind: str, namespace: str, name: str, patch, patch_type: str = "merge"
+):
+    """Patch an object: ``merge`` (RFC 7386) or ``json`` (RFC 6902 ops).
+    Spec changes bump the generation, mirroring the apiserver contract
+    controllers reconcile against."""
+    from .bus.service import decode_object
+    from .interpreter.webhook import apply_json_patch
+    from .utils.codec import to_jsonable
+
+    store_kind, key, obj = _resolve(cp, kind, namespace, name)
+    if obj is None:
+        raise KeyError(f"{kind} {key} not found")
+    doc = to_jsonable(obj)
+    if patch_type == "merge":
+        patched = _merge_patch(doc, patch)
+    elif patch_type == "json":
+        patched = apply_json_patch(doc, patch)
+    else:
+        raise ValueError(f"unknown patch type {patch_type!r}")
+    new = decode_object(store_kind, json.dumps(patched))
+    if to_jsonable(new).get("spec") != doc.get("spec"):
+        new.meta.generation = obj.meta.generation + 1
+    cp.store.apply(new)  # remote facades return the rv, not the object
+    return new
+
+
+def _mutate_meta_map(
+    cp, kind: str, namespace: str, name: str, changes: Sequence[str],
+    attr: str,
+):
+    from .bus.service import decode_object, encode_object
+
+    store_kind, key, obj = _resolve(cp, kind, namespace, name)
+    if obj is None:
+        raise KeyError(f"{kind} {key} not found")
+    # work on a codec round-trip COPY: store/mirror gets return the live
+    # object, and mutating it before apply would make a rejected write
+    # visible anyway (and defeat old-vs-new comparison in-proc)
+    obj = decode_object(store_kind, encode_object(obj))
+    mapping = dict(getattr(obj.meta, attr))
+    for ch in changes:
+        if ch.endswith("-") and "=" not in ch:
+            mapping.pop(ch[:-1], None)
+        else:
+            k, sep, v = ch.partition("=")
+            if not sep:
+                raise ValueError(f"expected KEY=VALUE or KEY-, got {ch!r}")
+            mapping[k] = v
+    setattr(obj.meta, attr, mapping)
+    cp.store.apply(obj)  # remote facades return the rv, not the object
+    return obj
+
+
+def cmd_label(cp, kind, namespace, name, changes):
+    """kubectl-style label mutation: KEY=VALUE adds/overwrites, KEY-
+    removes."""
+    return _mutate_meta_map(cp, kind, namespace, name, changes, "labels")
+
+
+def cmd_annotate(cp, kind, namespace, name, changes):
+    return _mutate_meta_map(cp, kind, namespace, name, changes, "annotations")
+
+
+#: kinds stored by bare name (no namespace segment in the store key) —
+#: discovery must say so or clients will address them as ns/name
+_CLUSTER_SCOPED = {
+    "Cluster", "ClusterPropagationPolicy", "ClusterOverridePolicy",
+    "ClusterResourceBinding", "ResourceRegistry", "Remedy",
+    "ClusterTaintPolicy", "Karmada", "ResourceInterpreterCustomization",
+    "ResourceInterpreterWebhookConfiguration", "WorkloadRebalancer",
+}
+
+
+def cmd_api_resources(cp) -> list[dict]:
+    """The discovery surface (karmadactl api-resources): registry kinds
+    plus the proxied workload plurals."""
+    from .bus.service import kind_registry
+    from .search.proxyserver import _PLURALS
+
+    out = [
+        {"kind": k, "namespaced": k not in _CLUSTER_SCOPED,
+         "source": "karmada"}
+        for k in sorted(kind_registry())
+    ]
+    out += [
+        {"kind": gvk, "plural": plural, "source": "cluster-proxy"}
+        for plural, gvk in sorted(_PLURALS.items())
+    ]
+    return out
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     """argparse front end. With ``--bus`` (and optionally ``--proxy``) the
     commands operate on a REMOTE plane over the wire — state through the
@@ -566,6 +768,36 @@ def main(argv: Optional[list[str]] = None) -> int:
     pm.add_argument("gvk")
     pm.add_argument("namespace")
     pm.add_argument("name")
+
+    ap = sub.add_parser("apply", help="apply manifests through the bus")
+    ap.add_argument("-f", "--filename", required=True,
+                    help="manifest file (JSON/YAML; '-' = stdin)")
+
+    dl = sub.add_parser("delete", help="delete a resource through the bus")
+    dl.add_argument("kind", help="registry kind or workload gvk")
+    dl.add_argument("namespace")
+    dl.add_argument("name")
+    dl.add_argument("--force", action="store_true",
+                    help="bypass finalizer gating")
+
+    pt = sub.add_parser("patch", help="patch a resource through the bus")
+    pt.add_argument("kind")
+    pt.add_argument("namespace")
+    pt.add_argument("name")
+    pt.add_argument("-p", "--patch", required=True,
+                    help="patch document (JSON)")
+    pt.add_argument("--type", dest="patch_type", default="merge",
+                    choices=("merge", "json"))
+
+    for nm in ("label", "annotate"):
+        mu = sub.add_parser(nm, help=f"{nm} a resource through the bus")
+        mu.add_argument("kind")
+        mu.add_argument("namespace")
+        mu.add_argument("name")
+        mu.add_argument("changes", nargs="+",
+                        help="KEY=VALUE to set, KEY- to remove")
+
+    sub.add_parser("api-resources", help="discovery: served kinds")
 
     args = parser.parse_args(argv)
 
@@ -635,6 +867,47 @@ def main(argv: Optional[list[str]] = None) -> int:
         elif args.command == "promote":
             cmd_promote(rp, args.cluster, args.gvk, args.namespace, args.name)
             print(f"{args.gvk} {args.namespace}/{args.name} promoted")
+        elif args.command == "apply":
+            try:
+                if args.filename == "-":
+                    text = sys.stdin.read()
+                else:
+                    with open(args.filename) as f:
+                        text = f.read()
+                applied = cmd_apply(rp, _load_manifests(text))
+            except Exception as exc:  # unreadable file, parse, admission
+                print(json.dumps({"error": str(exc)}))
+                return 1
+            for ref in applied:
+                print(f"{ref} applied")
+        elif args.command == "delete":
+            ok = cmd_delete(
+                rp, args.kind, args.namespace, args.name, force=args.force
+            )
+            if not ok:
+                print(json.dumps({"error": "not found"}))
+                return 1
+            print(f"{args.kind}/{args.namespace}/{args.name} deleted")
+        elif args.command == "patch":
+            try:
+                obj = cmd_patch(
+                    rp, args.kind, args.namespace, args.name,
+                    json.loads(args.patch), args.patch_type,
+                )
+            except Exception as exc:
+                print(json.dumps({"error": str(exc)}))
+                return 1
+            print(json.dumps(to_jsonable(obj)))
+        elif args.command in ("label", "annotate"):
+            fn = cmd_label if args.command == "label" else cmd_annotate
+            try:
+                obj = fn(rp, args.kind, args.namespace, args.name, args.changes)
+            except Exception as exc:
+                print(json.dumps({"error": str(exc)}))
+                return 1
+            print(json.dumps(to_jsonable(obj)))
+        elif args.command == "api-resources":
+            print(json.dumps(cmd_api_resources(rp)))
     return 0
 
 

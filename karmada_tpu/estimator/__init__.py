@@ -3,6 +3,7 @@
 from .accurate import (  # noqa: F401
     AccurateEstimator,
     EstimatorRegistry,
+    NodeCache,
     NodeSnapshot,
     NodeState,
 )

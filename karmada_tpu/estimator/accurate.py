@@ -64,6 +64,100 @@ class NodeSnapshot:
                 )
 
 
+class NodeCache:
+    """Incrementally-maintained node state for one member cluster.
+
+    Ref: pkg/util/lifted/scheduler/cache/cache.go (AddPod/RemovePod/
+    AddNode/RemoveNode/UpdateNode) + server/estimate.go:59-102, where the
+    estimator server keeps a kube-scheduler cache incrementally updated
+    and snapshots it per request. ``NodeSnapshot`` repacks the full
+    [N, R] array from scratch — fine at test scale, wrong shape for a
+    10k-node member where every pod event would cost O(N x R). This cache
+    mutates packed rows IN PLACE: O(R) per event, stable row ids (a
+    freed row is recycled), and the estimator reads the live arrays with
+    no copy. Duck-type compatible with ``NodeSnapshot`` (``nodes`` /
+    ``dims`` / ``available``), so ``AccurateEstimator`` takes either."""
+
+    def __init__(self, dims: Sequence[str], nodes: Sequence[NodeState] = ()):
+        self.dims = list(dims)
+        self._pods_dim = (
+            self.dims.index("pods") if "pods" in self.dims else None
+        )
+        self.nodes: list[Optional[NodeState]] = []
+        self.available = np.zeros((0, len(self.dims)), np.int64)
+        self._rows: dict[str, int] = {}
+        self._free: list[int] = []
+        self.generation = 0
+        for node in nodes:
+            self.upsert_node(node)
+
+    def _pack_row(self, i: int, node: NodeState) -> None:
+        for j, d in enumerate(self.dims):
+            self.available[i, j] = (
+                node.allocatable.get(d, 0) - node.requested.get(d, 0)
+            )
+        if self._pods_dim is not None:
+            self.available[i, self._pods_dim] = max(
+                node.allocatable.get("pods", 0) - node.num_pods, 0
+            )
+
+    def upsert_node(self, node: NodeState) -> None:
+        row = self._rows.get(node.name)
+        if row is None:
+            if self._free:
+                row = self._free.pop()
+            else:
+                row = len(self.nodes)
+                self.nodes.append(None)
+                if row >= self.available.shape[0]:
+                    grown = np.zeros(
+                        (max(16, 2 * self.available.shape[0]), len(self.dims)),
+                        np.int64,
+                    )
+                    grown[: self.available.shape[0]] = self.available
+                    self.available = grown
+            self._rows[node.name] = row
+        self.nodes[row] = node
+        self._pack_row(row, node)
+        self.generation += 1
+
+    def remove_node(self, name: str) -> None:
+        row = self._rows.pop(name, None)
+        if row is None:
+            return
+        self.nodes[row] = None
+        self.available[row] = 0  # zero rows contribute zero replicas
+        self._free.append(row)
+        self.generation += 1
+
+    def add_pod(self, node_name: str, requests: Mapping[str, int]) -> None:
+        """A pod scheduled onto the node: its requests reduce the node's
+        headroom and occupy one pod slot (cache.go AddPod)."""
+        row = self._rows.get(node_name)
+        if row is None:
+            return
+        node = self.nodes[row]
+        for d, q in requests.items():
+            node.requested[d] = node.requested.get(d, 0) + q
+        node.num_pods += 1
+        self._pack_row(row, node)
+        self.generation += 1
+
+    def remove_pod(self, node_name: str, requests: Mapping[str, int]) -> None:
+        row = self._rows.get(node_name)
+        if row is None:
+            return
+        node = self.nodes[row]
+        for d, q in requests.items():
+            node.requested[d] = node.requested.get(d, 0) - q
+        node.num_pods = max(0, node.num_pods - 1)
+        self._pack_row(row, node)
+        self.generation += 1
+
+    def live_nodes(self) -> list[NodeState]:
+        return [n for n in self.nodes if n is not None]
+
+
 @jax.jit
 def _node_sum_estimate(
     node_avail: jnp.ndarray,  # int64[N, R]
@@ -134,6 +228,9 @@ class AccurateEstimator:
             return ok
         claim = requirements.node_claim
         for i, node in enumerate(nodes):
+            if node is None:  # NodeCache hole (removed node)
+                ok[i] = False
+                continue
             if claim.node_selector:
                 if any(node.labels.get(k) != v for k, v in claim.node_selector.items()):
                     ok[i] = False
@@ -173,12 +270,14 @@ class AccurateEstimator:
                     req[0, j] = requirements.resource_request.get(d, 0)
         else:
             req = np.asarray(requests_batch, np.int64)
+        n = len(self.snapshot.nodes)
         node_ok = np.broadcast_to(
-            self._node_prefilter(requirements)[None, :], (len(req), len(self.snapshot.nodes))
+            self._node_prefilter(requirements)[None, :], (len(req), n)
         )
         out = np.asarray(
             _node_sum_estimate(
-                jnp.asarray(self.snapshot.available),
+                # trim to the row count: a NodeCache over-allocates
+                jnp.asarray(self.snapshot.available[:n]),
                 jnp.asarray(node_ok),
                 jnp.asarray(req),
             )

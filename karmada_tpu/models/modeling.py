@@ -124,3 +124,48 @@ def estimate_by_models(
         jnp.where(is_req[:, :, 0, :], covered[None, :, :], True), axis=-1
     )
     return total, applicable
+
+
+def estimate_by_models_np(
+    min_bounds: "np.ndarray",  # int64[C, G, R]
+    counts: "np.ndarray",  # int32[C, G]
+    covered: "np.ndarray",  # bool[C, R]
+    requests: "np.ndarray",  # int64[B, R]
+) -> tuple:
+    """numpy mirror of ``estimate_by_models`` — bit-identical (all exact
+    int64 arithmetic, same argmax/first-compliant-grade semantics). The
+    tiny-batch host fast path and the fleet's avail-max bound consume it
+    so model-bearing fleets stay off the device round-trip for small
+    work (BASELINE config 3); tests/test_estimators.py fuzzes the two
+    against each other."""
+    import numpy as np
+
+    c_n, g_n, r_n = min_bounds.shape
+    req = requests[:, None, None, :]  # [B,1,1,R]
+    is_req = req > 0
+    mb = min_bounds[None, :, :, :]  # [1,C,G,R]
+    compliant = (mb >= req) & (mb >= 0)  # [B,C,G,R]
+    first = np.where(
+        compliant.any(axis=2), np.argmax(compliant, axis=2), g_n
+    )  # [B,C,R]
+    idx = np.max(np.where(is_req[:, :, 0, :], first, 0), axis=-1)  # [B,C]
+    no_grade = idx >= g_n
+    safe_req = np.maximum(req, 1)
+    per_dim = np.where(mb >= 0, mb, 0) // safe_req
+    per_node = np.min(
+        np.where(is_req, per_dim, np.int64(2**62)), axis=-1
+    )  # [B,C,G]
+    per_node = np.where(per_node >= 2**62, 0, per_node)
+    per_node = np.maximum(per_node, 1)
+    grade_ids = np.arange(g_n)[None, None, :]
+    usable = grade_ids >= idx[:, :, None]
+    total = np.sum(
+        np.where(usable, counts[None, :, :].astype(np.int64) * per_node, 0),
+        axis=-1,
+    )
+    total = np.where(no_grade, 0, total)
+    total = np.minimum(total, np.int64(2**31 - 1)).astype(np.int32)
+    applicable = np.all(
+        np.where(is_req[:, :, 0, :], covered[None, :, :], True), axis=-1
+    )
+    return total, applicable

@@ -90,15 +90,21 @@ def kernel_variant(
     return (not narrow), fast
 
 
-def host_profile_table(snapshot, uniq: np.ndarray) -> np.ndarray:
-    """numpy mirror of ``general_estimate`` over unique request profiles:
-    int64[U, C], MAX_INT32 sentinel where nothing is requested or the
-    cluster gives no summary (ops/estimate.py:25-38). THE single host-side
-    mirror — the tiny-batch fast path and the fleet's avail-max bound both
-    consume it, so sentinel semantics cannot drift between them. Values
-    are clamped to the sentinel BEFORE comparison, exactly like the device
-    form's final min — an absurd-but-legal ratio above 2^31-1 must read as
-    "no answer -> clamp to spec.Replicas", not as a huge availability."""
+def host_profile_table(
+    snapshot, uniq: np.ndarray, models_active: bool = False
+) -> np.ndarray:
+    """numpy mirror of ``TensorScheduler._profile_table`` over unique
+    request profiles: int64[U, C], MAX_INT32 sentinel where nothing is
+    requested or the cluster gives no summary (ops/estimate.py:25-38),
+    with the resource-model estimator replacing the summary estimate
+    where applicable when ``models_active`` (general.go:63-94,118-135 —
+    pods cap applied separately, exactly like the device form). THE
+    single host-side mirror — the tiny-batch fast path and the fleet's
+    avail-max bound both consume it, so sentinel semantics cannot drift.
+    Values are clamped to the sentinel BEFORE comparison, exactly like
+    the device form's final min — an absurd-but-legal ratio above 2^31-1
+    must read as "no answer -> clamp to spec.Replicas", not as a huge
+    availability."""
     mi = 2**31 - 1  # plain int (ops.estimate.MAX_INT32 is a DEVICE scalar)
     cap = np.maximum(np.asarray(snapshot.available_cap), 0)
     table = np.full((uniq.shape[0], cap.shape[0]), mi, np.int64)
@@ -107,6 +113,25 @@ def host_profile_table(snapshot, uniq: np.ndarray) -> np.ndarray:
         ratio = cap[None, :, d] // np.maximum(req[:, None], 1)
         table = np.where((req > 0)[:, None], np.minimum(table, ratio), table)
     table = np.minimum(table, mi)
+    if models_active:
+        from ..models.modeling import estimate_by_models_np
+
+        mp = snapshot.model_pack
+        pods_dim = snapshot.dim_index("pods")
+        req_models = np.asarray(uniq)
+        if pods_dim is not None:
+            req_models = req_models.copy()
+            req_models[:, pods_dim] = 0
+        model_avail, applicable = estimate_by_models_np(
+            np.asarray(mp.min_bounds), np.asarray(mp.counts),
+            np.asarray(mp.covered), req_models,
+        )
+        model_avail = model_avail.astype(np.int64)
+        if pods_dim is not None:
+            allowed = np.minimum(np.maximum(cap[:, pods_dim], 0), mi)
+            model_avail = np.minimum(model_avail, allowed[None, :])
+        use_model = np.asarray(mp.has_models)[None, :] & applicable
+        table = np.where(use_model, model_avail, table)
     return np.where(np.asarray(snapshot.has_summary)[None, :], table, mi)
 
 
@@ -766,13 +791,15 @@ class TensorScheduler:
         self, requests: np.ndarray, replicas: np.ndarray
     ) -> np.ndarray:
         """Host mirror of ``_availability`` for the tiny-batch fast path
-        (general estimator only — callers gate off models and out-of-tree
-        estimators): the shared ``host_profile_table`` plus merge_estimates'
-        exact sentinel semantics (no-summary -> no answer -> clamp to
-        spec.Replicas; zero-replica short-circuit)."""
+        (general + resource-model estimators — callers gate off
+        out-of-tree estimators only): the shared ``host_profile_table``
+        plus merge_estimates' exact sentinel semantics (no-summary -> no
+        answer -> clamp to spec.Replicas; zero-replica short-circuit)."""
         mi = 2**31 - 1
         uniq, inv = np.unique(requests, axis=0, return_inverse=True)
-        dense = host_profile_table(self.snapshot, uniq)[inv]
+        dense = host_profile_table(
+            self.snapshot, uniq, models_active=self._models_active()
+        )[inv]
         reps_col = replicas.astype(np.int64)[:, None]
         avail = np.where(reps_col == 0, mi, dense)
         avail = np.where(avail == mi, reps_col, avail)
@@ -830,13 +857,13 @@ class TensorScheduler:
         # device round-trips (~0.1s fixed each over a tunnel) than the
         # whole problem costs in numpy. The vectorized-numpy divider is the
         # oracle-verified identity referent (tests/test_divider_np.py +
-        # every bench run), so placements are bit-identical. Gated off
-        # whenever the resource-model estimator path or out-of-tree
-        # estimators could answer differently.
+        # every bench run), so placements are bit-identical. The resource-
+        # model estimator has its own exact numpy mirror (host_profile
+        # _table models_active branch), so only out-of-tree estimators
+        # force the device path.
         host_small = (
             padded * snap.num_clusters <= 1 << 16
             and not self.extra_estimators
-            and not self._models_active()
         )
         with algo_timer.time(schedule_step="Score"):
             avail = (

@@ -86,10 +86,27 @@ def _pow2(n: int) -> int:
 
 
 def _cap_round(v: int) -> int:
-    """Entry-buffer quantization: E_ROUND multiples above the quantum,
-    powers of two (floor 1024) below — bounds the XLA trace count."""
+    """Entry-buffer quantization: powers of two (floor 1024) up to the
+    quantum, then QUARTER-OCTAVE buckets (5/8, 6/8, 7/8, 8/8 of the next
+    power of two). Fixed-size quanta broke at scale: a 32M-entry churn
+    demand drifting ±1% per pass landed in a different 256k-multiple each
+    time, recompiling the (minutes-long at 1M rows) solve every pass;
+    quarter-octaves bound the overshoot at 25% with 4 traces per octave."""
     v = max(v, 1)
-    return -(-v // E_ROUND) * E_ROUND if v > E_ROUND else _pow2(max(v, 1024))
+    if v <= E_ROUND:
+        return _pow2(max(v, 1024))
+    p = _pow2(v)  # v in (p/2, p]
+    for frac in (5, 6, 7):
+        if v * 8 <= p * frac:
+            return p * frac // 8
+    return p
+
+
+def _slot_cap(n: int) -> int:
+    """Device slot-table capacity: pow2 up to 8192, then multiples of 4096
+    — pow2 beyond that wastes up to half the (hundreds-of-MB) cp table,
+    while the coarse quantum keeps the solve's trace count bounded."""
+    return _pow2(max(n, 16)) if n <= 8192 else -(-n // 4096) * 4096
 
 
 # --------------------------------------------------------------------------
@@ -325,9 +342,28 @@ def _fleet_solve(
 # would not fit the HBM budget (cap x C bytes), e.g. the 1M-binding tier.
 
 #: dense-resident budget: above this, FleetTable uses the legacy
-#: entry-resident single-dispatch path (uint8[cap, C] would not pay for
-#: its HBM at multi-million-row tables)
-DENSE_RESIDENT_MAX_BYTES = 2 << 30
+#: entry-resident single-dispatch path (a 1M x 5k table's 5.2 GB mirror
+#: plus the solve working set over-commits a 16 GB part in practice —
+#: measured RESOURCE_EXHAUSTED on the v5e). Override via
+#: KARMADA_TPU_DENSE_BUDGET (bytes) on larger parts.
+def _dense_budget() -> int:
+    import os
+
+    raw = os.environ.get("KARMADA_TPU_DENSE_BUDGET", "")
+    try:
+        return int(raw) if raw else 2 << 30
+    except ValueError:
+        import sys
+
+        print(
+            f"# KARMADA_TPU_DENSE_BUDGET={raw!r} is not an integer byte "
+            "count; using the 2 GiB default",
+            file=sys.stderr,
+        )
+        return 2 << 30
+
+
+DENSE_RESIDENT_MAX_BYTES = _dense_budget()
 M_ROUND = 1 << 15  # changed-meta buffer quantum (bounds trace churn)
 
 
@@ -1088,7 +1124,15 @@ class FleetTable:
         snap = self.engine.snapshot
         gen = getattr(self.engine, "_snapshot_gen", 0)
         slots_changed = self._tables_dirty
-        if gen != self._snapshot_gen:
+        if gen != self._snapshot_gen and snap.mask_token == getattr(
+            self, "_mask_token", None
+        ):
+            # availability-only swap: masks are pure functions of the
+            # FILTER fields (mask_token), so every compiled slot is still
+            # valid — recompiling 9k heterogeneous selectors through the
+            # engine's LRU was ~6s per churn pass for identical results
+            self._snapshot_gen = gen
+        elif gen != self._snapshot_gen:
             # snapshot swapped in place (same cluster set): recompile each
             # slot's placement against the new snapshot, order-preserving so
             # row cp_idx values stay valid. DERIVED slots (interned spread
@@ -1157,25 +1201,32 @@ class FleetTable:
             or self._cp_uploaded == 0
         )
         if full:
-            # pow2 capacity allocated ON DEVICE (zeros are free there);
-            # only the live slot rows ship over the wire
-            cap_s = _pow2(max(n_slots, 16))
-            cp_dev = (
-                jnp.zeros((cap_s, 3 * c), jnp.int32)
-                .at[:n_slots]
-                .set(jnp.asarray(cp_rows_np(self._cp_pl)))
-            )
+            # quantized capacity, padded with on-device zeros via concat
+            # (a functional .at[:n].set on a zeros table would hold TWO
+            # full-size buffers transiently — at 10k slots x 5k clusters
+            # that is most of a GB each); only live rows ship the wire
+            cap_s = _slot_cap(n_slots)
+            live = jnp.asarray(cp_rows_np(self._cp_pl))
+            if cap_s > n_slots:
+                cp_dev = jnp.concatenate(
+                    [live, jnp.zeros((cap_s - n_slots, 3 * c), jnp.int32)]
+                )
+            else:
+                cp_dev = live
             self._cp_uploaded = n_slots
             self._cp_remapped = False
         else:
             cp_dev = self._dev_tables[0]
             if n_slots > self._cp_uploaded:
                 if n_slots > cp_dev.shape[0]:  # grow device capacity
-                    grown = jnp.zeros(
-                        (_pow2(n_slots), 3 * c), jnp.int32
-                    )
-                    cp_dev = lax.dynamic_update_slice(
-                        grown, cp_dev, (0, 0)
+                    cp_dev = jnp.concatenate(
+                        [
+                            cp_dev,
+                            jnp.zeros(
+                                (_slot_cap(n_slots) - cp_dev.shape[0], 3 * c),
+                                jnp.int32,
+                            ),
+                        ]
                     )
                 new = cp_rows_np(self._cp_pl[self._cp_uploaded :])
                 idx = jnp.arange(self._cp_uploaded, n_slots)
@@ -1215,21 +1266,10 @@ class FleetTable:
             profs_dev[: len(profs)] = profs
         prof_table = self.engine._profile_table(profs_dev)
         _mark("prof_table")
-        if self.engine._models_active():
-            self._avail_max = int(
-                jnp.max(
-                    jnp.where(
-                        (prof_table == MAX_INT32) | (prof_table == -1),
-                        0,
-                        prof_table,
-                    )
-                )
-            )
-        else:
-            # host mirror of the general-estimator max: the device form is
-            # a blocking scalar fetch (~0.1s tunnel round-trip) and this
-            # rebuild runs EVERY churn pass (snapshot gen bumps each drift)
-            self._avail_max = self._host_avail_max(profs)
+        # host mirror of the estimator max (general + models): the device
+        # form is a blocking scalar fetch (~0.1s tunnel round-trip) and
+        # this rebuild runs EVERY churn pass (snapshot gen bumps per drift)
+        self._avail_max = self._host_avail_max(profs)
         _mark("avail_max")
         self._dev_tables = (cp_dev, gvk_dev, prof_table, inc_dev)
         self._mask_token = token
@@ -1237,14 +1277,16 @@ class FleetTable:
 
     def _host_avail_max(self, profs: np.ndarray) -> int:
         """Sentinel-excluded max over the shared host mirror of the
-        general-estimator profile table (core.host_profile_table). The
-        device form is a blocking scalar fetch (~0.1s tunnel round-trip)
-        and runs every churn pass; the model path keeps the device fetch
-        (no host mirror of the model estimator yet)."""
+        estimator profile table (core.host_profile_table, general +
+        resource models). The device form was a blocking scalar fetch
+        (~0.1s tunnel round-trip) running every churn pass."""
         from .core import host_profile_table
 
         mi = 2**31 - 1
-        table = host_profile_table(self.engine.snapshot, profs)
+        table = host_profile_table(
+            self.engine.snapshot, profs,
+            models_active=self.engine._models_active(),
+        )
         valid = table != mi
         return int(table[valid].max()) if valid.any() else 0
 

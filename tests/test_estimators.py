@@ -190,3 +190,164 @@ class TestAccurateEstimator:
         )
         assert res.clusters.get("m1", 0) <= 3
         assert sum(res.clusters.values()) == 10
+
+
+class TestModelEstimatorHostMirror:
+    def _model_fleet(self, n=20, seed=3):
+        from karmada_tpu.api.cluster import (
+            AllocatableModeling, ResourceModel, ResourceModelRange,
+        )
+        from karmada_tpu.utils.builders import synthetic_fleet
+
+        clusters = synthetic_fleet(n, seed=seed)
+        rng = np.random.default_rng(seed)
+        for cl in clusters:
+            if rng.random() < 0.3:
+                continue  # some clusters stay model-less (summary path)
+            g_n = int(rng.integers(2, 4))
+            cl.spec.resource_models = [
+                ResourceModel(grade=g, ranges=[
+                    ResourceModelRange(
+                        name="cpu", min=500 * 2**g, max=500 * 2**(g + 1)
+                    ),
+                    ResourceModelRange(
+                        name="memory", min=(1 << 30) * 2**g,
+                        max=(1 << 30) * 2**(g + 1),
+                    ),
+                ])
+                for g in range(g_n)
+            ]
+            cl.status.resource_summary.allocatable_modelings = [
+                AllocatableModeling(grade=g, count=int(rng.integers(1, 30)))
+                for g in range(g_n)
+            ]
+        return clusters
+
+    def test_numpy_mirror_matches_device_kernel(self):
+        """estimate_by_models_np must be bit-identical to the jitted
+        kernel across randomized model packs and request profiles."""
+        from karmada_tpu.models.modeling import estimate_by_models_np
+
+        snap = ClusterSnapshot(self._model_fleet(24, seed=7))
+        mp = snap.model_pack
+        rng = np.random.default_rng(11)
+        reqs = np.stack([
+            np.array([int(rng.integers(0, 4000)),
+                      int(rng.integers(0, 8 << 30)),
+                      int(rng.integers(0, 3)),
+                      int(rng.integers(0, 2 << 30))][: len(snap.dims)],
+                     dtype=np.int64)
+            for _ in range(40)
+        ])
+        dev_total, dev_app = estimate_by_models(
+            jnp.asarray(mp.min_bounds), jnp.asarray(mp.counts),
+            jnp.asarray(mp.covered), jnp.asarray(reqs),
+        )
+        np_total, np_app = estimate_by_models_np(
+            np.asarray(mp.min_bounds), np.asarray(mp.counts),
+            np.asarray(mp.covered), reqs,
+        )
+        assert np.array_equal(np.asarray(dev_total), np_total)
+        assert np.array_equal(np.asarray(dev_app), np_app)
+
+    def test_model_batches_take_host_fast_path_identically(self):
+        """BASELINE config-3 shape (VERDICT r3 item 9): tiny model-bearing
+        batches divide on host numpy, bit-identical to the device path."""
+        clusters = self._model_fleet(20, seed=3)
+        snap = ClusterSnapshot(clusters)
+        req = parse_resource_list({"cpu": "250m", "memory": "512Mi"})
+        from karmada_tpu.utils.builders import aggregated_placement
+
+        pl = aggregated_placement()
+        problems = [
+            BindingProblem(key=f"b{i}", placement=pl, replicas=(i % 20) + 1,
+                           requests=req, gvk="apps/v1/Deployment")
+            for i in range(60)
+        ]
+        host_eng = TensorScheduler(snap)
+        assert host_eng._models_active()
+        got = host_eng._schedule_host(
+            problems, [host_eng._compiled(p.placement) for p in problems]
+        )
+        # force the device estimator/divider with an out-of-tree estimator
+        # that answers -1 (ignored by the merge): placements must match
+        dev_eng = TensorScheduler(
+            snap,
+            extra_estimators=[
+                lambda requests, reps: jnp.full(
+                    (requests.shape[0], snap.num_clusters), -1, jnp.int32
+                )
+            ],
+        )
+        want = dev_eng._schedule_host(
+            problems, [dev_eng._compiled(p.placement) for p in problems]
+        )
+        for w, g in zip(want, got):
+            assert w.success == g.success
+            assert dict(w.clusters) == dict(g.clusters), w.key
+
+
+class TestIncrementalNodeCache:
+    def test_event_stream_matches_full_repack(self):
+        """NodeCache (incremental AddPod/RemovePod/Upsert/Remove — the
+        kube-scheduler cache analogue) must answer identically to a fresh
+        NodeSnapshot repack of the surviving nodes after every event."""
+        from karmada_tpu.estimator import AccurateEstimator
+        from karmada_tpu.estimator.accurate import NodeCache, NodeSnapshot, NodeState
+
+        dims = ["cpu", "memory", "pods"]
+        rng = np.random.default_rng(4)
+
+        def mk_node(i):
+            return NodeState(
+                name=f"n{i}",
+                allocatable={"cpu": int(rng.integers(4_000, 64_000)),
+                             "memory": int(rng.integers(8, 256)) << 30,
+                             "pods": int(rng.integers(30, 110))},
+                num_pods=int(rng.integers(0, 10)),
+            )
+
+        cache = NodeCache(dims, [mk_node(i) for i in range(12)])
+        est_inc = AccurateEstimator("m1", cache)
+        live_names = [f"n{i}" for i in range(12)]
+        next_id = 12
+        reqs = np.stack([
+            np.array([int(rng.integers(100, 3000)),
+                      int(rng.integers(1, 8)) << 30, 1], np.int64)
+            for _ in range(6)
+        ])
+        for step in range(120):
+            ev = rng.random()
+            if ev < 0.45 and live_names:  # pod add
+                cache.add_pod(str(rng.choice(live_names)),
+                              {"cpu": 250, "memory": 512 << 20})
+            elif ev < 0.65 and live_names:  # pod remove
+                cache.remove_pod(str(rng.choice(live_names)),
+                                 {"cpu": 250, "memory": 512 << 20})
+            elif ev < 0.8:  # node joins
+                node = mk_node(next_id)
+                next_id += 1
+                cache.upsert_node(node)
+                live_names.append(node.name)
+            elif ev < 0.92 and len(live_names) > 2:  # node leaves
+                gone = live_names.pop(int(rng.integers(len(live_names))))
+                cache.remove_node(gone)
+            else:  # node capacity update
+                if live_names:
+                    name = str(rng.choice(live_names))
+                    node = cache.nodes[cache._rows[name]]
+                    node.allocatable["cpu"] = int(rng.integers(4_000, 64_000))
+                    cache.upsert_node(node)
+            if step % 10 != 9:
+                continue
+            # referent: full repack of the live nodes (copied so the
+            # referent cannot alias the cache's mutable NodeStates)
+            import copy
+
+            ref_snap = NodeSnapshot(
+                [copy.deepcopy(n) for n in cache.live_nodes()], dims
+            )
+            est_ref = AccurateEstimator("m1", ref_snap)
+            got = est_inc.max_available_replicas(None, reqs)
+            want = est_ref.max_available_replicas(None, reqs)
+            assert np.array_equal(got, want), f"step {step}: {got} != {want}"

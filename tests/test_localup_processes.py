@@ -156,6 +156,107 @@ class TestMultiProcessQuickstart:
             )
         )
 
+    def test_remote_cli_generic_verbs(self, deployment, tmp_path):
+        """VERDICT r3 item 8: the kubectl-style write surface over the bus
+        (pkg/karmadactl/karmadactl.go:98-178 — apply/patch/label/annotate/
+        delete/api-resources), with admission enforced SERVER-SIDE in the
+        plane process."""
+        lu, r = deployment
+        bus = f"127.0.0.1:{lu.endpoints['bus']}"
+
+        # apply: a Deployment template + a policy, one manifest file
+        manifest = tmp_path / "app.json"
+        manifest.write_text(json.dumps([
+            {
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": {"name": "verbs-app", "namespace": "default"},
+                "spec": {"replicas": 4},
+            },
+            {
+                "kind": "PropagationPolicy",
+                "metadata": {"name": "verbs-pp", "namespace": "default"},
+                "spec": {
+                    "resource_selectors": [
+                        {"api_version": "apps/v1", "kind": "Deployment",
+                         "name": "verbs-app"}
+                    ],
+                    "placement": {
+                        "replica_scheduling": {
+                            "replica_scheduling_type": "Divided",
+                            "replica_division_preference": "Weighted",
+                        }
+                    },
+                },
+            },
+        ]))
+        out = run_cli("--bus", bus, "apply", "-f", str(manifest))
+        assert "Resource/default/verbs-app" in out
+        assert "PropagationPolicy/default/verbs-pp" in out
+
+        def divided(total):
+            def check():
+                rb = r.store.get(
+                    "ResourceBinding", "default/verbs-app-deployment"
+                )
+                return rb is not None and sum(
+                    tc.replicas for tc in rb.spec.clusters
+                ) == total
+            return check
+
+        assert wait_for(divided(4)), "applied workload never scheduled"
+
+        # patch: bump replicas through the bus; the binding re-divides
+        out = run_cli(
+            "--bus", bus, "patch", "apps/v1/Deployment", "default",
+            "verbs-app", "-p", json.dumps({"spec": {"replicas": 9}}),
+        )
+        assert json.loads(out)["spec"]["replicas"] == 9
+        assert wait_for(divided(9)), "patched replica count never re-divided"
+
+        # label + annotate round-trip
+        out = run_cli(
+            "--bus", bus, "label", "apps/v1/Deployment", "default",
+            "verbs-app", "tier=web", "junk-",
+        )
+        assert json.loads(out)["meta"]["labels"]["tier"] == "web"
+        out = run_cli(
+            "--bus", bus, "annotate", "apps/v1/Deployment", "default",
+            "verbs-app", "owner=cli-e2e",
+        )
+        assert json.loads(out)["meta"]["annotations"]["owner"] == "cli-e2e"
+
+        # admission observed: an invalid policy is REJECTED by the plane's
+        # chain, server-side, through the same wire path
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({
+            "kind": "PropagationPolicy",
+            "metadata": {"name": "bad-pp", "namespace": "default"},
+            "spec": {"resource_selectors": []},
+        }))
+        proc = subprocess.run(
+            [sys.executable, "-m", "karmada_tpu.cli", "--bus", bus,
+             "apply", "-f", str(bad)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 1
+        assert "resourceSelectors" in proc.stdout
+        assert r.store.get("PropagationPolicy", "default/bad-pp") is None
+
+        # api-resources discovery
+        out = run_cli("--bus", bus, "api-resources")
+        kinds = {e["kind"] for e in json.loads(out)}
+        assert {"PropagationPolicy", "Cluster", "apps/v1/Deployment"} <= kinds
+
+        # delete: template gone; binding cleaned up by the detector
+        out = run_cli(
+            "--bus", bus, "delete", "apps/v1/Deployment", "default",
+            "verbs-app",
+        )
+        assert "deleted" in out
+        assert wait_for(
+            lambda: r.store.get("Resource", "default/verbs-app") is None
+        )
+
     def test_cluster_proxy_passthrough_serves_member_state(self, deployment):
         lu, r = deployment
         # the deployment propagated to member1 inside the plane process; the
