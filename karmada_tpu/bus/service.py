@@ -81,7 +81,15 @@ def encode_object(obj) -> str:
 
 def decode_object(kind: str, object_json: str):
     cls = kind_registry().get(kind, Resource)
-    return from_jsonable(cls, json.loads(object_json))
+    doc = json.loads(object_json)
+    if isinstance(doc, dict) and ("apiVersion" in doc or "api_version" in doc):
+        # multi-version seam: a legacy-versioned payload (e.g.
+        # work.karmada.io/v1alpha1 bindings) upgrades to the hub shape
+        # before decode, so old clients keep working against a hub store
+        from ..api.versioning import maybe_upgrade
+
+        doc = maybe_upgrade(kind, doc)
+    return from_jsonable(cls, doc)
 
 
 class StoreBusServer:

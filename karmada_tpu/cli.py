@@ -123,6 +123,28 @@ class _RemoteProxyChain:
             return ProxyResponse(
                 served_by="cluster", data=body.splitlines()
             )
+        if req.verb in ("exec", "attach"):
+            # the streaming exec/attach subresource (chunked through the
+            # proxy; a SubprocessExecRuntime member pipes a REAL process)
+            import urllib.parse as _q
+
+            cmd = (req.options or {}).get("command") or []
+            qs = "&".join(f"command={_q.quote(str(c))}" for c in cmd)
+            sub = "exec" if req.verb == "exec" else "attach"
+            status, body = self._http(
+                f"{base}/api/v1/namespaces/{req.namespace}/pods/"
+                f"{req.name}/{sub}" + (f"?{qs}" if qs else "")
+            )
+            if status != 200:
+                return ProxyResponse(served_by="cluster", error=body)
+            from .utils.member import split_exec_trailer
+
+            lines, rc = split_exec_trailer(body.splitlines())
+            return ProxyResponse(
+                served_by="cluster",
+                data={"stdout": "\n".join(lines), "rc": rc,
+                      "lines": lines},
+            )
         mapped = _plural_of().get(req.gvk)
         if mapped is None:
             return ProxyResponse(
@@ -557,6 +579,9 @@ def _manifest_to_obj(manifest: dict):
     kind = manifest.get("kind", "")
     reg = kind_registry()
     if kind in reg and kind != "Resource":
+        from .api.versioning import maybe_upgrade
+
+        manifest = maybe_upgrade(kind, manifest)
         d = {k: v for k, v in manifest.items() if k not in (
             "apiVersion", "kind",
         )}

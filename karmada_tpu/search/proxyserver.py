@@ -37,6 +37,10 @@ PROXY_RE = re.compile(
 POD_LOG_RE = re.compile(
     r"^/api/v1/namespaces/(?P<ns>[^/]+)/pods/(?P<name>[^/]+)/log$"
 )
+POD_EXEC_RE = re.compile(
+    r"^/api/v1/namespaces/(?P<ns>[^/]+)/pods/(?P<name>[^/]+)"
+    r"/(?P<verb>exec|attach)$"
+)
 RESOURCE_RE = re.compile(
     r"^/(?:api/(?P<core_version>v1)|apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
     r"/namespaces/(?P<ns>[^/]+)/(?P<plural>[^/]+)(?:/(?P<name>[^/]+))?$"
@@ -78,6 +82,10 @@ class ClusterProxyServer:
                 pass
 
             def do_GET(self):
+                outer._handle(self)
+
+            def do_POST(self):
+                # kubectl issues the exec/attach subresource as POST
                 outer._handle(self)
 
         self._httpd = ThreadingHTTPServer(address, Handler)
@@ -127,13 +135,21 @@ class ClusterProxyServer:
             "Impersonate-Group": groups,
         }
         sub_path = m.group("path") or "/"
-        query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+        multi = parse_qs(parsed.query)
+        query = {k: v[-1] for k, v in multi.items()}
         try:
-            self._dispatch(handler, member, sub_path, query, impersonation)
+            self._dispatch(
+                handler, member, sub_path, query, impersonation, multi
+            )
         except UnreachableError as e:
             self._fail(handler, 503, str(e))
         except (KeyError, ValueError) as e:
             self._fail(handler, 404 if isinstance(e, KeyError) else 400, str(e))
+        except OSError as e:
+            # e.g. an exec runtime whose command does not exist
+            # (FileNotFoundError from Popen) — a clean 400 beats a
+            # dropped connection the client sees as a protocol failure
+            self._fail(handler, 400, str(e))
 
     def _fail(self, handler, code: int, message: str) -> None:
         """Error path that respects an already-started chunked stream: once
@@ -148,11 +164,17 @@ class ClusterProxyServer:
             return
         self._error(handler, code, message)
 
-    def _dispatch(self, handler, member, path, query, impersonation) -> None:
+    def _dispatch(
+        self, handler, member, path, query, impersonation, multi=None
+    ) -> None:
         member.record_proxy_request(path, impersonation)
         log_m = POD_LOG_RE.match(path)
         if log_m is not None:
             self._serve_logs(handler, member, log_m, query)
+            return
+        exec_m = POD_EXEC_RE.match(path)
+        if exec_m is not None:
+            self._serve_exec(handler, member, exec_m, multi or {})
             return
         res_m = RESOURCE_RE.match(path)
         if res_m is not None:
@@ -177,6 +199,40 @@ class ClusterProxyServer:
             return
         self._error(handler, 501, f"path {path} not proxied in-proc")
 
+    def _serve_exec(self, handler, member, m, multi) -> None:
+        """Streaming exec/attach subresource: output lines chunk out AS
+        the member runtime produces them (the SPDY-session analogue —
+        ref pkg/karmadactl/exec/exec.go holds the stream through the
+        proxy; with SubprocessExecRuntime wired on the member this pipes
+        a real OS process end-to-end). ``command`` repeats per argv
+        element, kube-style; attach streams with no command."""
+        ns, name = m.group("ns"), m.group("name")
+        command = list(multi.get("command") or [])
+        if m.group("verb") == "attach" or not command:
+            # attach = follow the pod's log stream (no new process)
+            self._serve_logs(
+                handler, member, POD_LOG_RE.match(
+                    f"/api/v1/namespaces/{ns}/pods/{name}/log"
+                ), {"follow": "true"},
+            )
+            return
+        # pod existence (and member reachability) check BEFORE headers go
+        # out so failures are still clean HTTP errors
+        stream = member.pod_exec_stream(ns, name, command)
+        first = next(stream, None)
+        chunk = self._start_chunked(handler)
+        try:
+            if first is not None:
+                chunk(first.encode() + b"\n")
+                for line in stream:
+                    chunk(line.encode() + b"\n")
+        except Exception as exc:  # noqa: BLE001 — headers are out: report
+            # the runtime failure IN-BAND (like an SPDY session would) and
+            # still terminate the chunked stream cleanly
+            chunk(f"error: {exc}".encode() + b"\n")
+        chunk(b"")
+        handler.wfile.flush()
+
     def _serve_logs(self, handler, member, m, query) -> None:
         ns, name = m.group("ns"), m.group("name")
         tail = None
@@ -193,18 +249,7 @@ class ClusterProxyServer:
         lines = all_lines if tail is None else (
             all_lines[-tail:] if tail > 0 else []
         )
-        handler.send_response(200)
-        handler.send_header("Content-Type", "text/plain")
-        handler.send_header("Transfer-Encoding", "chunked")
-        handler.end_headers()
-        handler._streamed = True  # headers sent: errors must not re-respond
-
-        def chunk(data: bytes) -> None:
-            handler.wfile.write(f"{len(data):X}\r\n".encode())
-            handler.wfile.write(data)
-            handler.wfile.write(b"\r\n")
-            handler.wfile.flush()
-
+        chunk = self._start_chunked(handler)
         for line in lines:
             chunk(line.encode() + b"\n")
         if follow:
@@ -221,6 +266,25 @@ class ClusterProxyServer:
                 seen += len(fresh)
         chunk(b"")  # zero-length chunk terminates the stream
         handler.wfile.flush()
+
+    @staticmethod
+    def _start_chunked(handler):
+        """Send streaming headers and return the chunk writer (shared by
+        the log-follow and exec paths). Marks the handler streamed so
+        later failures terminate the stream instead of re-responding."""
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/plain")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+        handler._streamed = True
+
+        def chunk(data: bytes) -> None:
+            handler.wfile.write(f"{len(data):X}\r\n".encode())
+            handler.wfile.write(data)
+            handler.wfile.write(b"\r\n")
+            handler.wfile.flush()
+
+        return chunk
 
     @staticmethod
     def _json(handler, code: int, payload: dict) -> None:

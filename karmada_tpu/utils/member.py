@@ -90,6 +90,9 @@ class MemberCluster:
         self._pod_logs: dict[tuple[str, str], list[str]] = {}
         self._log_arrived = threading.Condition(self._lock)
         self.exec_handler: Optional[Callable[[Resource, list], dict]] = None
+        # streaming runtime seam: iterator[str] of live output lines
+        # (SubprocessExecRuntime = a real OS subprocess end-to-end)
+        self.exec_stream_handler: Optional[Callable] = None
         # proxy-passthrough audit: (path, impersonated user/groups) records
         self.proxy_audit: list[dict] = []
 
@@ -275,7 +278,39 @@ class MemberCluster:
             raise KeyError(f"pod {namespace}/{name} not found in {self.name}")
         if self.exec_handler is not None:
             return self.exec_handler(pod, command)
+        if self.exec_stream_handler is not None:
+            # collect the streaming runtime's lines (kubectl's exit-code
+            # trailer becomes the rc)
+            lines, rc = split_exec_trailer(
+                list(self.exec_stream_handler(pod, command))
+            )
+            return {"stdout": "\n".join(lines), "rc": rc}
         return {"stdout": " ".join(command), "rc": 0}
+
+    def pod_exec_stream(self, namespace: str, name: str, command: list[str]):
+        """Streaming exec: yields output lines AS THEY APPEAR (the SPDY
+        session the reference's karmadactl exec holds open through the
+        proxy, pkg/karmadactl/exec/exec.go). Pluggable via
+        ``exec_stream_handler(pod, command) -> iterator[str]`` —
+        ``SubprocessExecRuntime`` wires a real OS subprocess; the default
+        falls back to the one-shot ``pod_exec`` result."""
+        self._check()
+        pod = self.get("v1/Pod", namespace, name)
+        if pod is None:
+            raise KeyError(f"pod {namespace}/{name} not found in {self.name}")
+        if self.exec_stream_handler is not None:
+            yield from self.exec_stream_handler(pod, command)
+            return
+        res = (
+            self.exec_handler(pod, command)
+            if self.exec_handler is not None
+            else {"stdout": " ".join(command), "rc": 0}
+        )
+        for line in str(res.get("stdout", "")).splitlines():
+            yield line
+        rc = int(res.get("rc", 0))
+        if rc:
+            yield f"{EXEC_EXIT_TRAILER}{rc}"
 
     # -- member-side simulation helpers (tests / failure injection) --------
 
@@ -300,6 +335,62 @@ class MemberCluster:
             for k, v in n.requested.items():
                 total[k] = total.get(k, 0) + v
         return total
+
+
+#: kubectl's exec failure trailer — the ONE definition every producer
+#: (pod_exec, SubprocessExecRuntime) and parser (split_exec_trailer,
+#: the remote CLI chain) shares, so the wire format cannot drift
+EXEC_EXIT_TRAILER = "command terminated with exit code "
+
+
+def split_exec_trailer(lines: list[str]) -> tuple[list[str], int]:
+    """(output lines without the trailer, exit code) — rc 0 when no
+    trailer is present."""
+    if lines and lines[-1].startswith(EXEC_EXIT_TRAILER):
+        return lines[:-1], int(lines[-1].rsplit(" ", 1)[1])
+    return lines, 0
+
+
+class SubprocessExecRuntime:
+    """A real-process exec runtime for the streaming seam: runs the
+    command as an OS subprocess and yields stdout lines as they appear —
+    the end-to-end analogue of the reference's SPDY exec session
+    (pkg/karmadactl/exec/exec.go streams a real container's TTY through
+    the proxy; here the "container" is a subprocess, which is as real as
+    an in-proc member gets). Wire it per member:
+    ``member.exec_stream_handler = SubprocessExecRuntime()``. Intended
+    for tests/e2e harnesses — it executes whatever command the caller
+    sends, exactly like a kubectl-exec-able container would."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def __call__(self, pod, command):
+        import subprocess
+
+        proc = subprocess.Popen(
+            list(command), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                yield line.rstrip("\n")
+            try:
+                rc = proc.wait(timeout=self.timeout)
+            except subprocess.TimeoutExpired:
+                # stdout closed but the process lingers: kill and report
+                # (raising here would leave a chunked response
+                # unterminated mid-stream)
+                proc.kill()
+                proc.wait(timeout=5)
+                rc = proc.returncode
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=5)
+        if rc:
+            yield f"{EXEC_EXIT_TRAILER}{rc}"
 
 
 class MemberClientRegistry:

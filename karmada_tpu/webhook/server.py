@@ -53,6 +53,20 @@ class AdmissionWebhookServer:
                 pass
 
             def do_POST(self):
+                if self.path == "/convert":
+                    # CRD conversion-webhook contract (ConversionReview
+                    # in/out) — the multi-version seam's wire surface
+                    # (ref: conversion strategy Webhook; the reference
+                    # serves work/v1alpha1 <-> v1alpha2 this way)
+                    from ..api.versioning import handle_conversion_review
+
+                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        review = json.loads(self.rfile.read(length) or b"{}")
+                        self._reply(200, handle_conversion_review(review))
+                    except Exception as exc:  # noqa: BLE001 — wire surface
+                        self._reply(400, {"error": str(exc)})
+                    return
                 if self.path != "/admit":
                     self._reply(404, {"allowed": False, "message": "not found"})
                     return

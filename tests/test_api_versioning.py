@@ -1,0 +1,191 @@
+"""Multi-version API + conversion seam (VERDICT r3 missing #2).
+
+Ref: pkg/apis/work/v1alpha1/binding_types_conversion.go — v1alpha1
+bindings nest replicas/per-replica requirements inside spec.resource;
+the hub (v1alpha2) hoists them. Tests cover the pure conversions, the
+bus upgrade path (a legacy client applies v1alpha1 and the store holds
+hub objects), the CLI manifest path, and the ConversionReview wire
+contract through the real TLS webhook process.
+"""
+
+from __future__ import annotations
+
+import json
+
+from karmada_tpu.api.versioning import (
+    HUB_VERSION,
+    LEGACY_VERSION,
+    convert,
+    handle_conversion_review,
+    maybe_upgrade,
+    served_versions,
+)
+from karmada_tpu.bus.service import decode_object, encode_object
+
+
+def _legacy_binding(name="web-deployment"):
+    return {
+        "apiVersion": LEGACY_VERSION,
+        "kind": "ResourceBinding",
+        "meta": {"name": name, "namespace": "default"},
+        "spec": {
+            "resource": {
+                "api_version": "apps/v1", "kind": "Deployment",
+                "namespace": "default", "name": "web",
+                "replicas": 7,
+                "replicaResourceRequirements": {"cpu": 250, "memory": 512},
+            },
+            "clusters": [
+                {"name": "member1", "replicas": 4},
+                {"name": "member2", "replicas": 3},
+            ],
+        },
+        "status": {
+            "conditions": [{"type": "Scheduled", "status": True}],
+            "aggregated_status": [
+                {"cluster_name": "member1", "applied": True},
+            ],
+        },
+    }
+
+
+class TestConversions:
+    def test_legacy_to_hub_hoists_replica_fields(self):
+        hub = convert(_legacy_binding(), "ResourceBinding", HUB_VERSION)
+        assert hub["spec"]["replicas"] == 7
+        assert hub["spec"]["replica_requirements"]["resource_request"] == {
+            "cpu": 250, "memory": 512,
+        }
+        assert "replicas" not in hub["spec"]["resource"]
+        assert [c["name"] for c in hub["spec"]["clusters"]] == [
+            "member1", "member2",
+        ]
+
+    def test_round_trip_preserves_legacy_representable_fields(self):
+        legacy = _legacy_binding()
+        hub = convert(legacy, "ResourceBinding", HUB_VERSION)
+        back = convert(hub, "ResourceBinding", LEGACY_VERSION)
+        assert back["spec"]["resource"]["replicas"] == 7
+        assert back["spec"]["resource"]["replicaResourceRequirements"] == {
+            "cpu": 250, "memory": 512,
+        }
+        assert back["spec"]["clusters"] == legacy["spec"]["clusters"]
+        assert back["status"]["aggregated_status"] == [
+            {"cluster_name": "member1", "applied": True}
+        ]
+
+    def test_down_conversion_drops_hub_only_fields(self):
+        hub = convert(_legacy_binding(), "ResourceBinding", HUB_VERSION)
+        hub["spec"]["conflict_resolution"] = "Overwrite"
+        hub["spec"]["propagate_deps"] = True
+        down = convert(hub, "ResourceBinding", LEGACY_VERSION)
+        assert "conflict_resolution" not in down["spec"]
+        assert "propagate_deps" not in down["spec"]
+
+    def test_served_versions(self):
+        assert served_versions("ResourceBinding") == [
+            HUB_VERSION, LEGACY_VERSION,
+        ]
+        assert served_versions("ClusterResourceBinding") == [
+            HUB_VERSION, LEGACY_VERSION,
+        ]
+
+    def test_unknown_version_fails_review(self):
+        review = {
+            "request": {
+                "uid": "u1",
+                "desiredAPIVersion": "work.karmada.io/v9",
+                "objects": [_legacy_binding()],
+            }
+        }
+        resp = handle_conversion_review(review)["response"]
+        assert resp["result"]["status"] == "Failure"
+        assert "not served" in resp["result"]["message"]
+
+
+class TestBusUpgrade:
+    def test_legacy_payload_decodes_to_hub_object(self):
+        obj = decode_object(
+            "ResourceBinding", json.dumps(_legacy_binding())
+        )
+        assert obj.spec.replicas == 7
+        assert obj.spec.replica_requirements.resource_request == {
+            "cpu": 250, "memory": 512,
+        }
+        assert {tc.name: tc.replicas for tc in obj.spec.clusters} == {
+            "member1": 4, "member2": 3,
+        }
+        # the hub encode round-trips without any legacy residue
+        doc = json.loads(encode_object(obj))
+        assert "replicas" not in doc["spec"]["resource"]
+
+    def test_hub_payload_is_untouched(self):
+        hub_doc = convert(_legacy_binding(), "ResourceBinding", HUB_VERSION)
+        assert maybe_upgrade("ResourceBinding", hub_doc) is hub_doc
+
+
+class TestCliManifest:
+    def test_apply_of_legacy_manifest_lands_hub_typed(self):
+        from karmada_tpu.cli import _manifest_to_obj
+
+        manifest = _legacy_binding()
+        manifest["metadata"] = manifest.pop("meta")
+        obj = _manifest_to_obj(manifest)
+        assert type(obj).KIND == "ResourceBinding"
+        assert obj.spec.replicas == 7
+        assert obj.meta.namespace == "default"
+
+
+class TestConvertWebhook:
+    def test_convert_endpoint_over_tls_process(self, tmp_path):
+        """ConversionReview through the real HTTPS webhook process (the
+        CRD conversion strategy: Webhook deployment shape)."""
+        import ssl
+        import subprocess
+        import sys
+        import urllib.request
+
+        from karmada_tpu.localup import scrape_line, spawn_child
+
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(tmp_path / "w.key"),
+             "-out", str(tmp_path / "w.crt"),
+             "-days", "2", "-subj", "/CN=localhost",
+             "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+            check=True, capture_output=True,
+        )
+        proc = spawn_child(
+            [sys.executable, "-m", "karmada_tpu.webhook.server",
+             "--address", "127.0.0.1:0",
+             "--certfile", str(tmp_path / "w.crt"),
+             "--keyfile", str(tmp_path / "w.key")]
+        )
+        try:
+            port = scrape_line(proc, r"listening on port (\d+)")
+            ctx = ssl.create_default_context(cafile=str(tmp_path / "w.crt"))
+            review = {
+                "apiVersion": "apiextensions.k8s.io/v1",
+                "kind": "ConversionReview",
+                "request": {
+                    "uid": "abc",
+                    "desiredAPIVersion": HUB_VERSION,
+                    "objects": [_legacy_binding()],
+                },
+            }
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{port}/convert",
+                data=json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30, context=ctx) as r:
+                out = json.loads(r.read())
+            resp = out["response"]
+            assert resp["uid"] == "abc"
+            assert resp["result"]["status"] == "Success"
+            [converted] = resp["convertedObjects"]
+            assert converted["apiVersion"] == HUB_VERSION
+            assert converted["spec"]["replicas"] == 7
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
