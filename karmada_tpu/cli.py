@@ -66,7 +66,7 @@ class _RemoteProxyChain:
         self.proxy_target = proxy_target
         self.token = token
 
-    def _http(self, path: str):
+    def _http(self, path: str, timeout: float = 10.0):
         import urllib.error
         import urllib.request
 
@@ -75,7 +75,7 @@ class _RemoteProxyChain:
             headers={"Authorization": f"Bearer {self.token}"},
         )
         try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 return resp.status, resp.read().decode()
         except urllib.error.HTTPError as e:
             return e.code, e.read().decode()
@@ -131,9 +131,12 @@ class _RemoteProxyChain:
             cmd = (req.options or {}).get("command") or []
             qs = "&".join(f"command={_q.quote(str(c))}" for c in cmd)
             sub = "exec" if req.verb == "exec" else "attach"
+            # a silent-but-running command sends no chunks: outlive the
+            # member runtime's own 30s process bound with headroom
             status, body = self._http(
                 f"{base}/api/v1/namespaces/{req.namespace}/pods/"
-                f"{req.name}/{sub}" + (f"?{qs}" if qs else "")
+                f"{req.name}/{sub}" + (f"?{qs}" if qs else ""),
+                timeout=float(req.options.get("timeout", 60.0)),
             )
             if status != 200:
                 return ProxyResponse(served_by="cluster", error=body)

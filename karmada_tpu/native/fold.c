@@ -1,0 +1,73 @@
+/* Native host-side hot loops for the fleet engine's wire handling.
+ *
+ * Ref parity note: the reference's runtime hot paths are Go/C++ (the
+ * scheduler cache, codec, and informer delivery are compiled code); the
+ * TPU-native plane keeps device work in XLA and gives the HOST side of
+ * the wire the same treatment. These two loops dominate the host cost of
+ * a churn pass at scale (measured ~7-9 s of numpy fancy indexing at
+ * 1M bindings x 32M entries):
+ *
+ *  - decode3/decode2: byte-wire widening (3-byte packed entries / 2-byte
+ *    meta words -> int32) without numpy's three strided passes;
+ *  - fold_entries: scatter variable-length entry runs into the
+ *    [cap, k_res] int32 host mirror row-contiguously (memcpy + zero-fill
+ *    per row instead of a 32M-element advanced-index assignment).
+ *
+ * Compiled on demand by karmada_tpu.native (g++ -O2 -shared -fPIC);
+ * callers fall back to the numpy forms when no toolchain is present.
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+void decode3(const uint8_t *src, int64_t n, int32_t *dst) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *p = src + 3 * i;
+        dst[i] = (int32_t)p[0] | ((int32_t)p[1] << 8) | ((int32_t)p[2] << 16);
+    }
+}
+
+void decode2(const uint8_t *src, int64_t n, int32_t *dst) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t *p = src + 2 * i;
+        dst[i] = (int32_t)p[0] | ((int32_t)p[1] << 8);
+    }
+}
+
+/* 21-bit little-endian bitstream -> int32[n]; src must carry 3 pad bytes
+ * past the packed payload (the device wire appends them). */
+void decode21(const uint8_t *src, int64_t n, int32_t *dst) {
+    for (int64_t i = 0; i < n; i++) {
+        int64_t bit = 21 * i;
+        const uint8_t *p = src + (bit >> 3);
+        uint32_t v = (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+                     ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+        dst[i] = (int32_t)((v >> (bit & 7)) & 0x1FFFFF);
+    }
+}
+
+/* mirror: int32[cap * k_res]; rows/counts: per changed row; stream: the
+ * concatenated entry runs in row order. Each row's run lands at the row
+ * start, with the remainder of the row zeroed (results decode the first
+ * n_placed lanes, but a stale tail must not survive a shrink). */
+void fold_entries(int32_t *mirror, int64_t k_res, const int32_t *rows,
+                  const int64_t *counts, int64_t n_rows,
+                  const int32_t *stream) {
+    int64_t off = 0;
+    for (int64_t i = 0; i < n_rows; i++) {
+        int32_t *dst = mirror + (int64_t)rows[i] * k_res;
+        int64_t c = counts[i];
+        if (c > k_res) c = k_res;
+        memcpy(dst, stream + off, (size_t)(c * 4));
+        memset(dst + c, 0, (size_t)((k_res - c) * 4));
+        off += counts[i];
+    }
+}
+
+#ifdef __cplusplus
+}
+#endif

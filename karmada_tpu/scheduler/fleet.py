@@ -109,6 +109,38 @@ def _slot_cap(n: int) -> int:
     return _pow2(max(n, 16)) if n <= 8192 else -(-n // 4096) * 4096
 
 
+def _pack21(stream, e_cap: int):
+    """Pack int32 values < 2^21 (site<<8|count with site < 2^13) into a
+    21-bit little-endian bitstream: 2.625 bytes/entry instead of 3 — the
+    churn wire is tunnel-bandwidth-bound, so every bit shipped is pass
+    latency. Each output byte draws from at most two adjacent fields
+    (field width 21 > 8), so two static gathers + shifts produce it."""
+    nb = (e_cap * 21 + 7) // 8
+    idx = np.arange(nb, dtype=np.int64) * 8
+    k1 = (idx // 21).astype(np.int32)
+    off = (idx - 21 * k1).astype(np.int32)
+    s_ext = jnp.concatenate([stream, jnp.zeros((1,), jnp.int32)])
+    lo = s_ext[jnp.asarray(k1)] >> jnp.asarray(off)
+    hi = s_ext[jnp.asarray(np.minimum(k1 + 1, e_cap))] << (
+        21 - jnp.asarray(off)
+    )
+    return ((lo | hi) & 0xFF).astype(jnp.uint8)
+
+
+def _entry_wire(stream, e_cap: int, pack21: bool):
+    """The entry stream's byte-wire serialization (shared by both solve
+    kernels so the format cannot drift): 21-bit packed (+3 pad bytes for
+    the host's 4-byte-window decoder) or plain 3-byte entries."""
+    if pack21:
+        return jnp.concatenate(
+            [_pack21(stream, e_cap), jnp.zeros((3,), jnp.uint8)]
+        )
+    return jnp.stack(
+        [stream & 0xFF, (stream >> 8) & 0xFF, (stream >> 16) & 0xFF],
+        axis=-1,
+    ).astype(jnp.uint8).reshape(-1)
+
+
 # --------------------------------------------------------------------------
 # fused solve
 # --------------------------------------------------------------------------
@@ -119,6 +151,7 @@ def _slot_cap(n: int) -> int:
     static_argnames=(
         "chunk", "n_chunks", "k_out", "k_res", "e_cap", "wide", "fast",
         "has_aggregated", "need_bits", "all_rows", "mesh", "shard_c",
+        "pack21",
     ),
 )
 def _fleet_solve(
@@ -145,6 +178,7 @@ def _fleet_solve(
     all_rows: bool,
     mesh=None,  # jax.sharding.Mesh with axes ("b", "c") — None = single-device
     shard_c: bool = False,  # also shard the cluster axis over mesh axis "c"
+    pack21: bool = False,  # 21-bit entry packing (site < 2^13)
 ):
     c = gvk_table.shape[1]
     c_ax = "c" if (mesh is not None and shard_c) else None
@@ -307,10 +341,7 @@ def _fleet_solve(
         meta_u8 = jnp.stack(
             [meta & 0xFF, (meta >> 8) & 0xFF], axis=-1
         ).astype(jnp.uint8).reshape(-1)
-        e_u8 = jnp.stack(
-            [stream & 0xFF, (stream >> 8) & 0xFF, (stream >> 16) & 0xFF],
-            axis=-1,
-        ).astype(jnp.uint8).reshape(-1)
+        e_u8 = _entry_wire(stream, e_cap, pack21)
         flat = jnp.concatenate([total_u8, meta_u8, e_u8])
     else:
         flat = jnp.concatenate([total[None], meta, stream])
@@ -540,7 +571,9 @@ def _fleet_pass(
 
 @partial(
     jax.jit,
-    static_argnames=("chunk", "n_chunks", "k_out", "e_cap", "byte_wire"),
+    static_argnames=(
+        "chunk", "n_chunks", "k_out", "e_cap", "byte_wire", "pack21",
+    ),
 )
 def _fleet_entries(
     res_dense,  # uint8[cap, C] — the dense resident phase A just updated
@@ -551,6 +584,7 @@ def _fleet_entries(
     k_out: int,
     e_cap: int,  # exact-or-larger (host sums changed n_placed): no overflow
     byte_wire: bool,
+    pack21: bool = False,
 ):
     """Phase B: sort-compact ONLY the changed rows' dense vectors into the
     row-major (site << 8 | count) entry stream. Runs at the changed-row
@@ -582,10 +616,7 @@ def _fleet_entries(
         total_u8 = jnp.stack(
             [(total >> s) & 0xFF for s in (0, 8, 16, 24)]
         ).astype(jnp.uint8)
-        e_u8 = jnp.stack(
-            [stream & 0xFF, (stream >> 8) & 0xFF, (stream >> 16) & 0xFF],
-            axis=-1,
-        ).astype(jnp.uint8).reshape(-1)
+        e_u8 = _entry_wire(stream, e_cap, pack21)
         return jnp.concatenate([total_u8, e_u8])
     return jnp.concatenate([total[None], stream])
 
@@ -1417,7 +1448,10 @@ class FleetTable:
             n=n, n_pad=n_pad, eff_chunk=eff_chunk, n_chunks=n_chunks,
             is_all=is_all, c=c, k_out=k_out, wide=wide, fast=fast,
             has_agg=has_agg, need_bits=need_bits, is_dup=is_dup, safe=safe,
-            mesh=mesh, shard_c=shard_c, byte_wire=c <= 0xFFFF, t0=t0,
+            mesh=mesh, shard_c=shard_c, byte_wire=c <= 0xFFFF,
+            # 21-bit entry packing: 2.625 B/entry when the site id fits
+            # 13 bits — the churn wire is tunnel-bandwidth-bound
+            pack21=c <= (1 << 13), t0=t0,
         )
         if self.cap * c <= DENSE_RESIDENT_MAX_BYTES:
             return self._solve_dense(**shared)
@@ -1426,7 +1460,7 @@ class FleetTable:
     def _solve_legacy(
         self, *, problems, rows_np, rows_dev, tmr, n, n_pad, eff_chunk,
         n_chunks, is_all, c, k_out, wide, fast, has_agg, need_bits, is_dup,
-        safe, mesh, shard_c, byte_wire, t0,
+        safe, mesh, shard_c, byte_wire, pack21, t0,
     ) -> "_FleetResultList":
         """Single-dispatch entry-resident solve — the path for tables whose
         dense mirror would exceed the HBM budget (multi-million-row
@@ -1488,17 +1522,22 @@ class FleetTable:
                 all_rows=is_all,
                 mesh=mesh,
                 shard_c=shard_c,
+                pack21=pack21 and byte_wire,
             )
 
-        def decode(arr):
+        def decode(arr, cap):
             """(total, meta int32[n_pad], stream int32[*])"""
             if byte_wire:
-                a = arr.astype(np.int32)
-                total = int(a[0] | (a[1] << 8) | (a[2] << 16) | (a[3] << 24))
-                m = a[4 : 4 + 2 * n_pad]
-                meta = m[0::2] | (m[1::2] << 8)
-                e = a[4 + 2 * n_pad :]
-                stream = e[0::3] | (e[1::3] << 8) | (e[2::3] << 16)
+                from .. import native
+
+                total = native.le32(arr)
+                meta = native.decode2(arr[4 : 4 + 2 * n_pad])
+                tail = arr[4 + 2 * n_pad :]
+                stream = (
+                    native.decode21(tail, cap)
+                    if pack21
+                    else native.decode3(tail)
+                )
                 return total, meta, stream
             return int(arr[0]), arr[1 : 1 + n_pad], arr[1 + n_pad :]
 
@@ -1509,13 +1548,13 @@ class FleetTable:
         t0 = _time.perf_counter()
         raw = np.asarray(flat)
         fetched_bytes = raw.nbytes
-        total, meta, stream = decode(raw)
+        total, meta, stream = decode(raw, e_cap)
         if total > e_cap:  # overflow: rerun at the safe bound (the resident
             # base is the PRE-pass array either way — adopt the rerun's)
             flat, bits, resident = solve(rows_dev, cap_round(safe))
             raw = np.asarray(flat)
             fetched_bytes += raw.nbytes
-            total, meta, stream = decode(raw)
+            total, meta, stream = decode(raw, cap_round(safe))
         assert total <= len(stream), (total, e_cap)
         self._resident_entries = resident
         tmr["fetch"] = _time.perf_counter() - t0
@@ -1529,13 +1568,12 @@ class FleetTable:
         # fold the changed rows' entry runs into the persistent host mirror
         ch_pos = np.flatnonzero(changed[:n])
         if len(ch_pos):
-            ch_rows = rows_np[ch_pos]
-            counts = n_placed[ch_pos]
-            self._host_entries[ch_rows] = 0
-            flat_rows = np.repeat(ch_rows, counts)
-            starts_c = np.cumsum(counts) - counts
-            cols = np.arange(int(counts.sum())) - np.repeat(starts_c, counts)
-            self._host_entries[flat_rows, cols] = stream[: int(counts.sum())]
+            from .. import native
+
+            native.fold_entries(
+                self._host_entries, rows_np[ch_pos], n_placed[ch_pos],
+                np.asarray(stream, np.int32),
+            )
         tmr["changed_rows"] = float(len(ch_pos))
         self._result_gen += 1
 
@@ -1557,7 +1595,7 @@ class FleetTable:
     def _solve_dense(
         self, *, problems, rows_np, rows_dev, tmr, n, n_pad, eff_chunk,
         n_chunks, is_all, c, k_out, wide, fast, has_agg, need_bits, is_dup,
-        safe, mesh, shard_c, byte_wire, t0,
+        safe, mesh, shard_c, byte_wire, pack21, t0,
     ) -> "_FleetResultList":
         """Two-phase solve: _fleet_pass (divide + dense diff, ~13 KB wire
         on a steady pass) and, only when rows changed, _fleet_entries over
@@ -1643,14 +1681,16 @@ class FleetTable:
                 k_out=k_out,
                 e_cap=spec_cap,
                 byte_wire=byte_wire,
+                pack21=pack21 and byte_wire,
             )
         tmr["dispatch"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
         raw = np.asarray(flat)
         tmr["fetch_a"] = _time.perf_counter() - t0
         fetched_bytes = raw.nbytes
-        a = raw.astype(np.int32)
-        total = int(a[0] | (a[1] << 8) | (a[2] << 16) | (a[3] << 24))
+        from .. import native
+
+        total = native.le32(raw)
         nb = n_pad // 8
         changed_bits = np.unpackbits(
             raw[4 : 4 + nb], bitorder="little"
@@ -1659,8 +1699,7 @@ class FleetTable:
         assert len(ch_pos) == total, (len(ch_pos), total)
         ch_rows = rows_np[ch_pos] if total else np.empty(0, np.int64)
         if total <= m_cap:
-            mb = a[4 + nb : 4 + nb + 2 * m_cap]
-            metas = (mb[0::2] | (mb[1::2] << 8))[:total]
+            metas = native.decode2(raw[4 + nb : 4 + nb + 2 * m_cap])[:total]
         else:
             # tuned buffer overflow (churn onset): one gather round-trip
             m_pad_f = max(4096, _pow2(total))
@@ -1668,9 +1707,9 @@ class FleetTable:
             rows_f[:total] = ch_rows
             mraw = np.asarray(
                 _gather_meta(self._res_meta, jnp.asarray(rows_f))
-            ).astype(np.int32)
+            )
             fetched_bytes += mraw.nbytes
-            metas = (mraw[0::2] | (mraw[1::2] << 8))[:total]
+            metas = native.decode2(mraw)[:total]
         self._last_changed = total
 
         # phase B: entries for exactly the changed rows
@@ -1678,7 +1717,11 @@ class FleetTable:
             self._host_meta[ch_rows] = metas
             counts = (metas & 0xFF).astype(np.int64)
             e_total = int(counts.sum())
-            self._host_entries[ch_rows] = 0
+            if not e_total:
+                # every changed row lost its placements: clear the runs
+                # (the fold below zero-fills rows it writes, covering the
+                # mixed case without a second full sweep)
+                self._host_entries[ch_rows] = 0
             self._last_total = e_total
             if e_total:
                 raw2 = None
@@ -1690,6 +1733,7 @@ class FleetTable:
                     # the speculative B covers exactly the changed rows
                     t_b = _time.perf_counter()
                     raw2 = np.asarray(spec_flat)
+                    cap_used = spec_cap
                     tmr["fetch_b"] = _time.perf_counter() - t_b
                 else:
                     # exact fallback: churn onset (no speculation) or the
@@ -1708,27 +1752,29 @@ class FleetTable:
                         k_out=k_out,
                         e_cap=e_cap,
                         byte_wire=byte_wire,
+                        pack21=pack21 and byte_wire,
                     )
+                    cap_used = e_cap
                     tmr["dispatch_b"] = _time.perf_counter() - t_b
                     t_b = _time.perf_counter()
                     raw2 = np.asarray(flat2)
                     tmr["fetch_b"] = _time.perf_counter() - t_b
                 fetched_bytes += raw2.nbytes
                 if byte_wire:
-                    a2 = raw2.astype(np.int32)
-                    total2 = int(
-                        a2[0] | (a2[1] << 8) | (a2[2] << 16) | (a2[3] << 24)
+                    total2 = native.le32(raw2)
+                    stream = (
+                        native.decode21(raw2[4:], cap_used)
+                        if pack21
+                        else native.decode3(raw2[4:])
                     )
-                    e = a2[4:]
-                    stream = e[0::3] | (e[1::3] << 8) | (e[2::3] << 16)
                 else:
                     total2 = int(raw2[0])
                     stream = raw2[1:]
                 assert total2 == e_total, (total2, e_total)
-                flat_rows = np.repeat(ch_rows, counts)
-                starts_c = np.cumsum(counts) - counts
-                cols = np.arange(e_total) - np.repeat(starts_c, counts)
-                self._host_entries[flat_rows, cols] = stream[:e_total]
+                native.fold_entries(
+                    self._host_entries, ch_rows, counts,
+                    np.asarray(stream, np.int32),
+                )
         else:
             self._last_total = 0
         tmr["fetch"] = _time.perf_counter() - t0

@@ -246,8 +246,6 @@ class OpenSearchServer:
                 except Exception as exc:  # noqa: BLE001 — wire surface
                     self._reply(400, {"error": str(exc)})
 
-            do_POST_routes = None
-
             def do_POST(self):
                 parts = [p for p in self.path.split("/") if p]
                 try:
@@ -480,9 +478,10 @@ class OpenSearchBackend:
         with self._lock:
             for key in [k for k in self._doc_ids if k[0] == cluster]:
                 self._doc_ids.pop(key, None)
-        # any index works for the by-query route; use the prefix root
+        # wildcard across every kind index (a real node 404s a literal
+        # nonexistent index; '{prefix}-*' is the standard multi-index form)
         self._request(
-            "POST", f"/{self.prefix}-any/_delete_by_query",
+            "POST", f"/{self.prefix}-*/_delete_by_query",
             json.dumps({"query": {"match": {
                 f"metadata.annotations.{CACHE_SOURCE_ANNOTATION}": cluster,
             }}}).encode(),
@@ -515,7 +514,13 @@ class OpenSearchBackend:
                     )
                 return True
             except urllib.error.HTTPError:
-                self.dropped += len(batch)
+                # count OPERATIONS, not NDJSON lines (index ops carry an
+                # action line AND a source line)
+                self.dropped += sum(
+                    1
+                    for ln in batch
+                    if ln.startswith(('{"index"', '{"create"', '{"delete"'))
+                )
                 return False
             except (urllib.error.URLError, OSError):
                 with self._lock:
