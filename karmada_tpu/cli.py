@@ -136,7 +136,7 @@ class _RemoteProxyChain:
             status, body = self._http(
                 f"{base}/api/v1/namespaces/{req.namespace}/pods/"
                 f"{req.name}/{sub}" + (f"?{qs}" if qs else ""),
-                timeout=float(req.options.get("timeout", 60.0)),
+                timeout=float((req.options or {}).get("timeout", 60.0)),
             )
             if status != 200:
                 return ProxyResponse(served_by="cluster", error=body)
@@ -703,6 +703,264 @@ def _mutate_meta_map(
     return obj
 
 
+def cmd_create(cp, manifests: Sequence[dict]) -> list[str]:
+    """Create-only write (karmadactl create / kubectl create): unlike
+    ``apply`` an existing object is an AlreadyExists error, not an update.
+    Ref: pkg/karmadactl/karmadactl.go:98-178 (create verb wiring)."""
+    from .utils.store import obj_key, obj_kind
+
+    created = []
+    objs = []
+    seen: set = set()
+    for m in manifests:
+        obj = _manifest_to_obj(m)
+        kind, key = obj_kind(obj), obj_key(obj)
+        # batch-wide existence precheck (catches duplicates WITHIN the
+        # file too) before the first write; admission still runs per
+        # apply, so like kubectl an admission rejection mid-file reports
+        # what was already created rather than rolling it back
+        if (kind, key) in seen or cp.store.get(kind, key) is not None:
+            raise ValueError(f"{kind} {key!r} already exists")
+        seen.add((kind, key))
+        objs.append((obj, f"{kind}/{key}"))
+    for obj, ref in objs:
+        try:
+            cp.store.apply(obj)
+        except Exception as exc:
+            raise ValueError(
+                f"{ref} rejected: {exc}"
+                + (f" (already created: {', '.join(created)})" if created else "")
+            ) from exc
+        created.append(ref)
+    return created
+
+
+def cmd_edit(cp, kind: str, namespace: str, name: str, *, editor=None):
+    """kubectl-style edit: dump the object to a temp file, run the user's
+    editor on it, apply the result if it changed. ``editor`` is the command
+    line (defaults to $KUBE_EDITOR / $EDITOR / vi, as kubectl resolves it);
+    returns the applied object or None when the buffer was left unchanged.
+    Ref: pkg/karmadactl/edit/edit.go (NewCmdEdit wraps kubectl's editor
+    flow against the karmada control plane)."""
+    import os
+    import shlex
+    import subprocess
+    import tempfile
+
+    from .bus.service import decode_object
+    from .utils.codec import to_jsonable
+
+    store_kind, key, obj = _resolve(cp, kind, namespace, name)
+    if obj is None:
+        raise KeyError(f"{kind} {key} not found")
+    doc = to_jsonable(obj)
+    text = json.dumps(doc, indent=2, sort_keys=True)
+    ed = (
+        editor
+        or os.environ.get("KUBE_EDITOR")
+        or os.environ.get("EDITOR")
+        or "vi"
+    )
+    fd, path = tempfile.mkstemp(suffix=".json", prefix="karmadactl-edit-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        subprocess.run(f"{ed} {shlex.quote(path)}", shell=True, check=True)
+        with open(path) as f:
+            new_text = f.read()
+        new_doc = json.loads(new_text)
+        if new_doc == doc:
+            os.unlink(path)
+            return None  # "Edit cancelled, no changes made."
+        # identity is immutable under edit (kubectl rejects primitive
+        # changes): a changed name/namespace/kind would silently CREATE a
+        # new object under another store key, leaving the edited one as-is
+        for field, depth in (("kind", ()), ("name", ("meta",)),
+                             ("namespace", ("meta",))):
+            old_v, new_v = doc, new_doc
+            for seg in depth:
+                old_v = (old_v or {}).get(seg)
+                new_v = (new_v or {}).get(seg)
+            if (old_v or {}).get(field) != (new_v or {}).get(field):
+                raise ValueError(
+                    f"edit may not change {'.'.join(depth + (field,))}"
+                )
+        new = decode_object(store_kind, json.dumps(new_doc))
+        # canonical-form comparison, same as cmd_patch: a key the codec
+        # discards must not bump generation / wake controllers
+        if to_jsonable(new).get("spec") != doc.get("spec"):
+            new.meta.generation = obj.meta.generation + 1
+        cp.store.apply(new)
+    except Exception:
+        # a post-editor failure (parse error, identity change, admission
+        # rejection) must NOT destroy the user's edits: keep the buffer
+        # and report where it lives, as kubectl does
+        print(f"edit buffer preserved at {path}", file=sys.stderr)
+        raise
+    else:
+        os.unlink(path)
+    return new
+
+
+def cmd_explain(path: str) -> str:
+    """Field documentation for an API kind (karmadactl explain). The
+    reference serves this from the apiserver's OpenAPI schema
+    (pkg/karmadactl/explain/); here the registry's dataclasses ARE the
+    schema, so explain reflects over them — same dotted-path grammar
+    (``PropagationPolicy.spec.placement``), offline."""
+    import dataclasses
+    import typing
+
+    from .bus.service import kind_registry
+
+    kind, _, rest = path.partition(".")
+    reg = kind_registry()
+    cls = reg.get(kind)
+    if cls is None:
+        known = ", ".join(sorted(reg))
+        raise KeyError(f"unknown kind {kind!r}; served kinds: {known}")
+
+    import types as _types
+
+    def unwrap(tp):
+        """Optional[X] -> X; list[X]/dict[K,V] pass through for display."""
+        origin = typing.get_origin(tp)
+        if origin is typing.Union or origin is _types.UnionType:
+            args = [a for a in typing.get_args(tp) if a is not type(None)]
+            if len(args) == 1:
+                return unwrap(args[0])
+        return tp
+
+    def type_name(tp) -> str:
+        tp = unwrap(tp)
+        origin = typing.get_origin(tp)
+        if origin in (list, dict):
+            args = ", ".join(type_name(a) for a in typing.get_args(tp))
+            return f"{origin.__name__}[{args}]"
+        return getattr(tp, "__name__", str(tp))
+
+    def element(tp):
+        """The dataclass to descend into (through Optional/list/dict)."""
+        tp = unwrap(tp)
+        origin = typing.get_origin(tp)
+        if origin is list:
+            return element(typing.get_args(tp)[0])
+        if origin is dict:
+            return element(typing.get_args(tp)[1])
+        return tp if dataclasses.is_dataclass(tp) else None
+
+    # descend the dotted path
+    walked = [kind]
+    for seg in [s for s in rest.split(".") if s]:
+        if not dataclasses.is_dataclass(cls):
+            raise KeyError(
+                f"{'.'.join(walked)} is a scalar ({type_name(cls)}); "
+                f"cannot descend into {seg!r}"
+            )
+        hints = typing.get_type_hints(cls)
+        match = next(
+            (f for f in dataclasses.fields(cls) if f.name == seg), None
+        )
+        if match is None:
+            have = ", ".join(f.name for f in dataclasses.fields(cls))
+            raise KeyError(
+                f"field {seg!r} does not exist in {'.'.join(walked)}; "
+                f"fields: {have}"
+            )
+        nxt = element(hints[match.name])
+        cls = nxt if nxt is not None else unwrap(hints[match.name])
+        walked.append(seg)
+
+    lines = [f"KIND:     {kind}", f"PATH:     {'.'.join(walked)}", ""]
+    doc = (getattr(cls, "__doc__", "") or "").strip().splitlines()
+    if doc:
+        lines += ["DESCRIPTION:", f"     {doc[0]}", ""]
+    if dataclasses.is_dataclass(cls):
+        lines.append("FIELDS:")
+        hints = typing.get_type_hints(cls)
+        for f in dataclasses.fields(cls):
+            tn = type_name(hints[f.name])
+            mark = " <required>" if (
+                f.default is dataclasses.MISSING
+                and f.default_factory is dataclasses.MISSING
+            ) else ""
+            lines.append(f"   {f.name}\t<{tn}>{mark}")
+    else:
+        lines.append(f"TYPE:     {type_name(cls)}")
+    return "\n".join(lines)
+
+
+def cmd_completion(shell: str = "bash") -> str:
+    """Shell completion script generated from the live parser (karmadactl
+    completion; ref pkg/karmadactl/karmadactl.go — cobra emits these).
+    Bash and zsh (via bashcompinit) share the emitted script."""
+    if shell not in ("bash", "zsh"):
+        raise ValueError(f"unsupported shell {shell!r} (bash or zsh)")
+    parser, sub = build_parser()
+    cmds = sorted(sub.choices)
+    # global flags reflected from the live parser, like the per-subcommand
+    # ones — a new top-level flag must not be invisible to completion
+    global_flags = sorted(
+        opt
+        for a in parser._actions
+        for opt in a.option_strings
+        if opt.startswith("--")
+    )
+    flag_lines = []
+    for name, sp in sorted(sub.choices.items()):
+        flags = sorted(
+            opt
+            for a in sp._actions
+            for opt in a.option_strings
+            if opt.startswith("--")
+        )
+        flag_lines.append(f'    {name}) opts="{" ".join(flags)}" ;;')
+    body = "\n".join(flag_lines)
+    # value-taking global flags: the word AFTER one is its value, not the
+    # subcommand (``--bus host:1234 apply`` must resolve cmd=apply)
+    valued = sorted(
+        opt
+        for a in parser._actions
+        for opt in a.option_strings
+        # store_true / help have nargs == 0; plain store has nargs None
+        if opt.startswith("--") and a.nargs != 0
+    )
+    zsh_boot = (
+        "autoload -U +X bashcompinit && bashcompinit\n"
+        "autoload -U +X compinit && compinit\n"
+        if shell == "zsh"
+        else ""
+    )
+    return f"""# karmadactl-tpu completion ({shell}); source this file
+{zsh_boot}_karmadactl_tpu() {{
+  local cur cmd opts skip
+  COMPREPLY=()
+  cur="${{COMP_WORDS[COMP_CWORD]}}"
+  cmd=""
+  skip=0
+  for w in "${{COMP_WORDS[@]:1:COMP_CWORD-1}}"; do
+    if [ "$skip" = 1 ]; then skip=0; continue; fi
+    case "$w" in
+      {'|'.join(valued)}) skip=1 ;;
+      -*) ;;
+      *) cmd="$w"; break ;;
+    esac
+  done
+  if [ -z "$cmd" ]; then
+    COMPREPLY=( $(compgen -W "{' '.join(cmds)} {' '.join(global_flags)}" -- "$cur") )
+    return 0
+  fi
+  case "$cmd" in
+{body}
+    *) opts="" ;;
+  esac
+  COMPREPLY=( $(compgen -W "$opts" -- "$cur") )
+  return 0
+}}
+complete -F _karmadactl_tpu karmadactl-tpu
+"""
+
+
 def cmd_label(cp, kind, namespace, name, changes):
     """kubectl-style label mutation: KEY=VALUE adds/overwrites, KEY-
     removes."""
@@ -741,14 +999,9 @@ def cmd_api_resources(cp) -> list[dict]:
     return out
 
 
-def main(argv: Optional[list[str]] = None) -> int:
-    """argparse front end. With ``--bus`` (and optionally ``--proxy``) the
-    commands operate on a REMOTE plane over the wire — state through the
-    store bus, member access through the cluster proxy; without it,
-    ``local-up`` bootstraps a demo plane in-process (``--processes`` spawns
-    the full multi-process deployment instead). Applies the parent's jax
-    platform policy first — a CLI child of localup/the operator must not
-    dial the single-client accelerator tunnel."""
+def build_parser() -> tuple:
+    """The argparse surface, shared by ``main`` and ``cmd_completion``.
+    Returns (parser, subparsers)."""
     parser = argparse.ArgumentParser(prog="karmadactl-tpu")
     parser.add_argument("--bus", default="", help="remote plane bus host:port")
     parser.add_argument("--proxy", default="", help="cluster proxy host:port")
@@ -801,6 +1054,24 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("-f", "--filename", required=True,
                     help="manifest file (JSON/YAML; '-' = stdin)")
 
+    cr = sub.add_parser("create", help="create-only apply through the bus")
+    cr.add_argument("-f", "--filename", required=True,
+                    help="manifest file (JSON/YAML; '-' = stdin)")
+
+    ed = sub.add_parser("edit", help="edit a resource in $EDITOR")
+    ed.add_argument("kind")
+    ed.add_argument("namespace")
+    ed.add_argument("name")
+    ed.add_argument("--editor", default=None,
+                    help="editor command (default: $KUBE_EDITOR / $EDITOR)")
+
+    ex = sub.add_parser("explain", help="field docs for a served kind")
+    ex.add_argument("path", help="KIND[.field.subfield...]")
+
+    co = sub.add_parser("completion", help="emit a shell completion script")
+    co.add_argument("shell", nargs="?", default="bash",
+                    choices=("bash", "zsh"))
+
     dl = sub.add_parser("delete", help="delete a resource through the bus")
     dl.add_argument("kind", help="registry kind or workload gvk")
     dl.add_argument("namespace")
@@ -826,8 +1097,31 @@ def main(argv: Optional[list[str]] = None) -> int:
                         help="KEY=VALUE to set, KEY- to remove")
 
     sub.add_parser("api-resources", help="discovery: served kinds")
+    return parser, sub
 
+
+def main(argv: Optional[list[str]] = None) -> int:
+    """argparse front end. With ``--bus`` (and optionally ``--proxy``) the
+    commands operate on a REMOTE plane over the wire — state through the
+    store bus, member access through the cluster proxy; without it,
+    ``local-up`` bootstraps a demo plane in-process (``--processes`` spawns
+    the full multi-process deployment instead). Applies the parent's jax
+    platform policy first — a CLI child of localup/the operator must not
+    dial the single-client accelerator tunnel."""
+    parser, _sub = build_parser()
     args = parser.parse_args(argv)
+
+    # offline verbs: no plane, no bus
+    if args.command == "explain":
+        try:
+            print(cmd_explain(args.path))
+        except KeyError as exc:
+            print(json.dumps({"error": str(exc.args[0])}))
+            return 1
+        return 0
+    if args.command == "completion":
+        print(cmd_completion(args.shell))
+        return 0
 
     if args.command == "local-up":
         if args.processes:
@@ -895,19 +1189,21 @@ def main(argv: Optional[list[str]] = None) -> int:
         elif args.command == "promote":
             cmd_promote(rp, args.cluster, args.gvk, args.namespace, args.name)
             print(f"{args.gvk} {args.namespace}/{args.name} promoted")
-        elif args.command == "apply":
+        elif args.command in ("apply", "create"):
+            fn = cmd_apply if args.command == "apply" else cmd_create
             try:
                 if args.filename == "-":
                     text = sys.stdin.read()
                 else:
                     with open(args.filename) as f:
                         text = f.read()
-                applied = cmd_apply(rp, _load_manifests(text))
+                applied = fn(rp, _load_manifests(text))
             except Exception as exc:  # unreadable file, parse, admission
                 print(json.dumps({"error": str(exc)}))
                 return 1
+            verb = "created" if args.command == "create" else "applied"
             for ref in applied:
-                print(f"{ref} applied")
+                print(f"{ref} {verb}")
         elif args.command == "delete":
             ok = cmd_delete(
                 rp, args.kind, args.namespace, args.name, force=args.force
@@ -926,6 +1222,19 @@ def main(argv: Optional[list[str]] = None) -> int:
                 print(json.dumps({"error": str(exc)}))
                 return 1
             print(json.dumps(to_jsonable(obj)))
+        elif args.command == "edit":
+            try:
+                obj = cmd_edit(
+                    rp, args.kind, args.namespace, args.name,
+                    editor=args.editor,
+                )
+            except Exception as exc:
+                print(json.dumps({"error": str(exc)}))
+                return 1
+            if obj is None:
+                print("Edit cancelled, no changes made.")
+            else:
+                print(json.dumps(to_jsonable(obj)))
         elif args.command in ("label", "annotate"):
             fn = cmd_label if args.command == "label" else cmd_annotate
             try:

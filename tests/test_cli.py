@@ -202,3 +202,117 @@ class TestGetAcrossClusters:
                           cluster="member2")
         assert one.error == "" and one.obj is not None
         assert one.served_by in ("cluster", "cache")
+
+
+class TestGenericVerbs:
+    """create / edit / explain / completion (ref: pkg/karmadactl/{create,
+    edit,explain,completion})."""
+
+    def test_create_is_create_only(self):
+        def manifest(name):
+            return {
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "metadata": {"name": name, "namespace": "default"},
+                "spec": {"replicas": 1},
+            }
+
+        cp = cli.cmd_local_up(1)
+        created = cli.cmd_create(cp, [manifest("app")])
+        assert created == ["Resource/default/app"]
+        # second create of the same object is AlreadyExists, and a batch
+        # with one conflicting manifest writes NOTHING
+        import pytest
+
+        with pytest.raises(ValueError, match="already exists"):
+            cli.cmd_create(cp, [manifest("other"), manifest("app")])
+        assert cp.store.get("Resource", "default/other") is None
+
+    def test_edit_applies_editor_changes(self):
+        cp = cli.cmd_local_up(1)
+        cp.store.apply(new_deployment("app", replicas=1))
+        # "editor" = a python one-liner rewriting replicas in place
+        editor = (
+            f"{__import__('sys').executable} -c \""
+            "import json,sys; p=sys.argv[1]; d=json.load(open(p)); "
+            "d['spec']['replicas']=7; json.dump(d, open(p,'w'))\""
+        )
+        obj = cli.cmd_edit(cp, "Deployment", "default", "app", editor=editor)
+        assert obj is not None and obj.spec["replicas"] == 7
+        stored = cp.store.get("Resource", "default/app")
+        assert stored.spec["replicas"] == 7
+        # spec change bumps generation (apiserver contract)
+        assert stored.meta.generation >= 1
+
+    def test_edit_unchanged_is_noop(self):
+        cp = cli.cmd_local_up(1)
+        cp.store.apply(new_deployment("app", replicas=1))
+        rv_before = cp.store.get("Resource", "default/app").meta.resource_version
+        assert cli.cmd_edit(cp, "Deployment", "default", "app", editor="true") is None
+        assert (
+            cp.store.get("Resource", "default/app").meta.resource_version
+            == rv_before
+        )
+
+    def test_explain_walks_fields(self):
+        out = cli.cmd_explain("PropagationPolicy.spec.placement")
+        assert "cluster_affinity" in out and "spread_constraints" in out
+        out = cli.cmd_explain("Cluster")
+        assert "KIND:     Cluster" in out
+        import pytest
+
+        with pytest.raises(KeyError, match="does not exist"):
+            cli.cmd_explain("PropagationPolicy.spec.bogus")
+        with pytest.raises(KeyError, match="unknown kind"):
+            cli.cmd_explain("Bogus")
+
+    def test_completion_lists_all_verbs(self):
+        script = cli.cmd_completion("bash")
+        for verb in ("apply", "create", "edit", "explain", "promote",
+                     "api-resources", "completion"):
+            assert verb in script
+        # every emitted flag really exists on its subparser
+        assert "--editor" in script and "--force" in script
+
+    def test_edit_preserves_buffer_on_bad_edit(self, capsys, tmp_path):
+        import os
+        import re
+        import sys as _sys
+
+        cp = cli.cmd_local_up(1)
+        cp.store.apply(new_deployment("app", replicas=1))
+        # editor renames the object: identity changes are rejected and the
+        # buffer survives for the user to recover
+        editor = (
+            f"{_sys.executable} -c \""
+            "import json,sys; p=sys.argv[1]; d=json.load(open(p)); "
+            "d['meta']['name']='app2'; json.dump(d, open(p,'w'))\""
+        )
+        import pytest
+
+        with pytest.raises(ValueError, match="may not change meta.name"):
+            cli.cmd_edit(cp, "Deployment", "default", "app", editor=editor)
+        err = capsys.readouterr().err
+        m = re.search(r"edit buffer preserved at (\S+)", err)
+        assert m, err
+        assert os.path.exists(m.group(1))
+        os.unlink(m.group(1))
+        assert cp.store.get("Resource", "default/app2") is None
+
+    def test_completion_handles_global_flag_values(self):
+        import subprocess
+
+        script = cli.cmd_completion("bash")
+        # simulate: karmadactl-tpu --bus host:1234 <TAB> on 'ap'
+        probe = script + """
+COMP_WORDS=(karmadactl-tpu --bus host:1234 apply --f)
+COMP_CWORD=4
+_karmadactl_tpu
+echo "${COMPREPLY[@]}"
+"""
+        out = subprocess.run(
+            ["bash", "-c", probe], capture_output=True, text=True
+        )
+        assert out.returncode == 0, out.stderr
+        assert "--filename" in out.stdout
+        # zsh variant bootstraps bashcompinit
+        assert "bashcompinit" in cli.cmd_completion("zsh")

@@ -27,12 +27,16 @@ def test_decoders_match_numpy(rng):
 
 def test_fold_matches_numpy_referent(rng):
     cap, k = 3000, 24
-    for _ in range(25):
+    for rep in range(25):
         mirror_c = rng.integers(0, 9, (cap, k)).astype(np.int32)
         mirror_np = mirror_c.copy()
         n = int(rng.integers(1, 500))
         rows = rng.choice(cap, n, replace=False).astype(np.int64)
-        counts = rng.integers(0, k + 1, n).astype(np.int64)
+        # every other repetition draws counts PAST the mirror width so the
+        # clamp branch runs: the row keeps only its first k entries while
+        # the stream offset advances by the full count
+        hi = k + 1 if rep % 2 == 0 else k + 5
+        counts = rng.integers(0, hi, n).astype(np.int64)
         stream = rng.integers(1, 1 << 20, int(counts.sum())).astype(np.int32)
         native.fold_entries(mirror_c, rows, counts, stream)
         total = int(counts.sum())
@@ -40,7 +44,8 @@ def test_fold_matches_numpy_referent(rng):
         fr = np.repeat(rows, counts)
         st = np.cumsum(counts) - counts
         cols = np.arange(total) - np.repeat(st, counts)
-        mirror_np[fr, cols] = stream[:total]
+        ok = cols < k
+        mirror_np[fr[ok], cols[ok]] = stream[:total][ok]
         assert np.array_equal(mirror_c, mirror_np)
 
 
