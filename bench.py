@@ -548,7 +548,7 @@ def run_engine_north_star(args) -> dict:
         breakdown = dict(getattr(eng or engine, "last_breakdown", {}))
         parts = " ".join(
             f"{k}={v:.1f}" if k == "fetch_mb"
-            else f"{k}={int(v)}" if k == "changed_rows"
+            else f"{k}={int(v)}" if k in ("changed_rows", "delta_rows")
             else f"{k}={v * 1e3:.0f}ms"
             for k, v in breakdown.items()
         )

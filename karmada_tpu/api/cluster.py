@@ -187,13 +187,22 @@ class Cluster:
 
 @dataclass
 class Lease:
-    """Agent heartbeat for Pull clusters (coordination.k8s.io Lease
-    analogue; cluster_status_controller.go:210-213 + the cluster
-    controller's monitorClusterHealth lease observation). The agent renews
-    ``renew_time``; the control plane judges freshness — it cannot probe a
-    Pull cluster directly."""
+    """coordination.k8s.io Lease analogue, serving both reference uses:
+
+    - agent heartbeat for Pull clusters (cluster_status_controller.go:
+      210-213 + monitorClusterHealth lease observation): the agent renews
+      ``renew_time``; the control plane judges freshness — it cannot probe
+      a Pull cluster directly.
+    - leader-election resource lock (client-go leaderelection over
+      LeasesResourceLock — every reference binary's --leader-elect): the
+      holder fields + CAS applies (Store.apply expected_rv) implement
+      tryAcquireOrRenew; see utils/leaderelect.py."""
 
     KIND = "Lease"
 
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     renew_time: float = 0.0
+    holder_identity: str = ""
+    lease_duration_seconds: float = 0.0
+    acquire_time: float = 0.0
+    lease_transitions: int = 0

@@ -20,6 +20,7 @@ Run: ``python -m karmada_tpu.bus.agent --target host:port --cluster name``
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from typing import Optional
@@ -52,8 +53,8 @@ class ReplicaStoreFacade:
 
     # -- writes (primary, over the bus) ------------------------------------
 
-    def apply(self, obj):
-        return self._replica.apply(obj)
+    def apply(self, obj, *, expected_rv=None):
+        return self._replica.apply(obj, expected_rv=expected_rv)
 
     def delete(self, kind: str, key: str, force: bool = False):
         return self._replica.delete(kind, key, force=force)
@@ -110,6 +111,8 @@ def agent_main(
     root_ca: Optional[bytes] = None,
     client_cert: Optional[bytes] = None,
     client_key: Optional[bytes] = None,
+    leader_elect: bool = False,
+    identity: str = "",
 ) -> None:
     from ..controllers.remedy import KarmadaAgent
     from ..interpreter import default_interpreter
@@ -129,6 +132,30 @@ def agent_main(
     runtime = Runtime()
     member = member or _default_member(cluster_name)
     agent = KarmadaAgent(store, runtime, member, default_interpreter())
+
+    # HA agents: N replicas per member cluster, one active (the reference
+    # agent's --leader-elect over a Lease resource lock). Standbys keep
+    # their replica synced and queues filling; on takeover the first
+    # settle drains the backlog and rebuilds member state from Works.
+    elector = None
+    if leader_elect:
+        from ..utils.leaderelect import LeaderElector
+
+        ident = identity or f"{cluster_name}-{os.getpid()}"
+        elector = LeaderElector(
+            store,
+            name=f"karmada-agent-{cluster_name}",
+            identity=ident,
+            lease_duration=max(4 * lease_interval, 2.0),
+            renew_deadline=max(2 * lease_interval, 1.0),
+            on_started_leading=lambda: print(
+                f"agent {cluster_name}: leading as {ident}", flush=True
+            ),
+            on_stopped_leading=lambda: print(
+                f"agent {cluster_name}: lost leadership ({ident})",
+                flush=True,
+            ),
+        )
     print(f"agent {cluster_name}: synced, serving", flush=True)
 
     start = time.time()
@@ -139,11 +166,16 @@ def agent_main(
             tick = now - last_tick >= lease_interval
             if tick:
                 last_tick = now
-                if simulate_ready:
+            if elector is not None and tick:
+                elector.tick()
+            if elector is None or elector.is_leader:
+                if tick and simulate_ready:
                     _simulate_kubelet(member)
-            runtime.run_until_settled(tick=tick)
+                runtime.run_until_settled(tick=tick)
             time.sleep(loop_interval)
     finally:
+        if elector is not None:
+            elector.release()
         replica.close()
     # agent object kept alive by the loop above; reference it so linters
     # don't flag the construction as unused
@@ -161,6 +193,15 @@ def main(argv=None) -> None:
         "--no-simulate-ready", action="store_true",
         help="do not mark applied workloads ready (failure-injection runs)",
     )
+    p.add_argument(
+        "--leader-elect", action="store_true",
+        help="run as one of N HA replicas for this cluster; only the Lease "
+        "holder syncs (reference agent's --leader-elect)",
+    )
+    p.add_argument(
+        "--leader-elect-identity", default="",
+        help="lease holder identity (default: <cluster>-<pid>)",
+    )
     args = p.parse_args(argv)
     agent_main(
         args.target,
@@ -169,6 +210,8 @@ def main(argv=None) -> None:
         lease_interval=args.lease_interval,
         simulate_ready=not args.no_simulate_ready,
         max_seconds=args.max_seconds,
+        leader_elect=args.leader_elect,
+        identity=args.leader_elect_identity,
     )
 
 

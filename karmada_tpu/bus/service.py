@@ -28,7 +28,7 @@ import grpc
 from ..api.core import Resource
 from ..utils import Store
 from ..utils.codec import from_jsonable, to_jsonable
-from ..utils.store import Event as StoreEvent
+from ..utils.store import ConflictError, Event as StoreEvent
 from .proto import storebus_pb2 as pb
 
 SERVICE_NAME = "karmada_tpu.bus.StoreBus"
@@ -159,10 +159,19 @@ class StoreBusServer:
         def apply(request: pb.ApplyRequest, context):
             try:
                 obj = decode_object(request.kind, request.object_json)
-                applied = self.store.apply(obj)
+                applied = self.store.apply(
+                    obj,
+                    expected_rv=(
+                        request.expected_rv if request.conditional else None
+                    ),
+                )
                 return pb.ApplyResponse(
                     resource_version=applied.meta.resource_version
                 )
+            except ConflictError as e:
+                # typed over the wire — a CAS loser must see a 409, not a
+                # 500 (and never by pattern-matching error text)
+                return pb.ApplyResponse(error=str(e), conflict=True)
             except Exception as e:  # noqa: BLE001 — wire surface
                 return pb.ApplyResponse(error=str(e))
 
@@ -347,12 +356,19 @@ class StoreReplica:
 
     # -- write-through -----------------------------------------------------
 
-    def apply(self, obj) -> int:
+    def apply(self, obj, *, expected_rv=None) -> int:
         kind = type(obj).KIND if hasattr(type(obj), "KIND") else "Resource"
         resp = self._apply(
-            pb.ApplyRequest(kind=kind, object_json=encode_object(obj))
+            pb.ApplyRequest(
+                kind=kind,
+                object_json=encode_object(obj),
+                conditional=expected_rv is not None,
+                expected_rv=expected_rv or 0,
+            )
         )
         if resp.error:
+            if resp.conflict:
+                raise ConflictError(resp.error)
             raise RuntimeError(resp.error)
         return resp.resource_version
 

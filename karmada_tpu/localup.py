@@ -207,6 +207,12 @@ def serve_plane(args) -> None:
     proxy_port = proxy.start()
     metrics = MetricsServer(address=addr(args.metrics_address))
     metrics_port = metrics.start()
+    # serve mode runs against the wall clock: reconcile failures back off
+    # exponentially (workqueue DefaultControllerRateLimiter discipline)
+    # instead of burning 16 hot-loop retries inside one settle call.
+    # Set BEFORE the boot settle — a member that is slow to come up must
+    # park its keys for the serve loop, not burn the drop budget at boot.
+    cp.runtime.realtime = True
     cp.settle()
     print(
         json.dumps(
@@ -246,7 +252,12 @@ def serve_plane(args) -> None:
                     cp.store.checkpoint(args.state_file)
                     last_ckpt_rv = rv
                 last_ckpt = time.time()
-            time.sleep(args.loop_interval)
+            due = cp.runtime.next_due()
+            time.sleep(
+                max(0.001, min(args.loop_interval, due))
+                if due is not None
+                else args.loop_interval
+            )
     finally:
         if args.state_file:
             saved = cp.store.checkpoint(args.state_file)

@@ -50,6 +50,7 @@ def _build() -> Optional[ctypes.CDLL]:
     lib.decode2.argtypes = [p_u8, i64, p_i32]
     lib.decode21.argtypes = [p_u8, i64, p_i32]
     lib.fold_entries.argtypes = [p_i32, i64, p_i32, p_i64, i64, p_i32]
+    lib.apply_deltas.argtypes = [p_i32, i64, p_i32, p_i64, i64, p_i32, p_i32]
     return lib
 
 
@@ -150,4 +151,51 @@ def fold_entries(
         np.ascontiguousarray(counts, np.int64),
         len(rows),
         np.ascontiguousarray(stream, np.int32),
+    )
+
+
+def apply_deltas(
+    mirror: np.ndarray,  # int32[cap, k_res] C-contiguous
+    rows: np.ndarray,  # per delta row (any int dtype)
+    dcounts: np.ndarray,  # deltas per row
+    stream: np.ndarray,  # int32 (site<<9 | newcount+1), row order,
+    # site-ascending within each row
+) -> None:
+    """Merge cell deltas into the host mirror's sorted entry runs
+    (newcount 0 removes the site, otherwise set/insert). In-place on
+    ``mirror``; rows are clamped to k_res merged entries like
+    fold_entries."""
+    k_res = mirror.shape[1]
+    lib = get()
+    if lib is None or not mirror.flags["C_CONTIGUOUS"]:
+        off = 0
+        for r, nd in zip(rows, dcounts):
+            nd = int(nd)
+            d = stream[off : off + nd]
+            off += nd
+            if not nd:
+                continue
+            run = mirror[r]
+            sites = {int(v) >> 8: int(v) & 0xFF for v in run if v != 0}
+            for v in d:
+                v = int(v)
+                site, cnt = v >> 9, (v & 0x1FF) - 1
+                if cnt > 0:
+                    sites[site] = cnt
+                else:
+                    sites.pop(site, None)
+            merged = [
+                (s << 8) | c for s, c in sorted(sites.items())
+            ][:k_res]
+            mirror[r] = 0
+            mirror[r, : len(merged)] = merged
+        return
+    scratch = np.empty(k_res, np.int32)
+    lib.apply_deltas(
+        mirror, k_res,
+        np.ascontiguousarray(rows, np.int32),
+        np.ascontiguousarray(dcounts, np.int64),
+        len(rows),
+        np.ascontiguousarray(stream, np.int32),
+        scratch,
     )

@@ -68,6 +68,55 @@ void fold_entries(int32_t *mirror, int64_t k_res, const int32_t *rows,
     }
 }
 
+/* Cell-delta fold: merge per-row sorted (site<<9 | newcount+1) deltas
+ * into the [cap, k_res] host mirror of sorted (site<<8 | count) entry
+ * runs. newcount 0 removes the site; an existing site updates in place;
+ * a new site inserts in site order. The merged row is clamped to k_res
+ * entries (same clamp as fold_entries) and zero-padded. `scratch` must
+ * hold k_res int32s. */
+void apply_deltas(int32_t *mirror, int64_t k_res, const int32_t *rows,
+                  const int64_t *dcounts, int64_t n_rows,
+                  const int32_t *stream, int32_t *scratch) {
+    int64_t off = 0;
+    for (int64_t i = 0; i < n_rows; i++) {
+        int32_t *row = mirror + (int64_t)rows[i] * k_res;
+        int64_t nd = dcounts[i];
+        const int32_t *d = stream + off;
+        off += nd;
+        if (nd == 0) continue;
+        int64_t e = 0, j = 0, out = 0;
+        while (e < k_res && row[e] != 0 && j < nd) {
+            int32_t site_e = row[e] >> 8;
+            int32_t site_d = d[j] >> 9;
+            int32_t cnt_d = (d[j] & 0x1FF) - 1;
+            if (site_e < site_d) {
+                if (out < k_res) scratch[out++] = row[e];
+                e++;
+            } else if (site_e > site_d) {
+                if (cnt_d > 0 && out < k_res)
+                    scratch[out++] = (site_d << 8) | cnt_d;
+                j++;
+            } else {
+                if (cnt_d > 0 && out < k_res)
+                    scratch[out++] = (site_d << 8) | cnt_d;
+                e++;
+                j++;
+            }
+        }
+        while (e < k_res && row[e] != 0) {
+            if (out < k_res) scratch[out++] = row[e];
+            e++;
+        }
+        for (; j < nd; j++) {
+            int32_t cnt_d = (d[j] & 0x1FF) - 1;
+            if (cnt_d > 0 && out < k_res)
+                scratch[out++] = ((d[j] >> 9) << 8) | cnt_d;
+        }
+        memcpy(row, scratch, (size_t)(out * 4));
+        memset(row + out, 0, (size_t)((k_res - out) * 4));
+    }
+}
+
 #ifdef __cplusplus
 }
 #endif

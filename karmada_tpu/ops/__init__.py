@@ -40,6 +40,23 @@ if _cache_dir is None:
             os.path.expanduser("~"), ".cache", "karmada_tpu", "jax"
         )
 if _cache_dir:
+    # partition by platform set: a tunneled accelerator backend compiles on
+    # the REMOTE host and caches CPU AOT artifacts built for that machine's
+    # CPU features — a local CPU process loading them gets machine-feature
+    # mismatch warnings at best and SIGILL at worst (observed killing
+    # localup children mid-suite). Read the CONFIGURED platform list (the
+    # sitecustomize sets it programmatically, callers may too — the env
+    # var alone is not authoritative); every distinct set gets its own
+    # cache root. JAX_COMPILATION_CACHE_DIR overrides skip this.
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR") is None:
+        try:
+            _plat = jax.config.jax_platforms
+        except Exception:  # noqa: BLE001 — knob missing in this jax
+            _plat = None
+        _plat = _plat or os.environ.get("JAX_PLATFORMS") or "default"
+        _cache_dir = os.path.join(
+            _cache_dir, _plat.replace(",", "_") or "default"
+        )
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

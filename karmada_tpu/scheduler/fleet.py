@@ -396,13 +396,20 @@ def _dense_budget() -> int:
 
 DENSE_RESIDENT_MAX_BYTES = _dense_budget()
 M_ROUND = 1 << 15  # changed-meta buffer quantum (bounds trace churn)
+D_ROUND = 1 << 16  # cell-delta buffer quantum (bounds trace churn)
+D_FLOOR = 8192  # cell-delta floor: 24 KB of wire on every steady pass
+
+
+def d_round(v: int) -> int:
+    v = max(v, 1)
+    return -(-v // D_ROUND) * D_ROUND if v > D_FLOOR else D_FLOOR
 
 
 @partial(
     jax.jit,
     static_argnames=(
         "chunk", "n_chunks", "wide", "fast", "has_aggregated",
-        "need_bits", "all_rows", "m_cap", "mesh", "shard_c",
+        "need_bits", "all_rows", "m_cap", "d_cap", "mesh", "shard_c",
     ),
     donate_argnames=("res_dense", "res_meta"),
 )
@@ -427,15 +434,23 @@ def _fleet_pass(
     need_bits: bool,
     all_rows: bool,
     m_cap: int,
+    d_cap: int = 0,
     mesh=None,
     shard_c: bool = False,
 ):
     """Phase A: divide every row, diff against the dense resident, ship the
-    changed bitmask + changed metas. Returns (flat_wire_u8, bits|None,
+    changed bitmask + changed metas — and, when ``d_cap`` > 0, the CELL
+    deltas of changed rows (site<<9 | newcount+1, site-ascending per row)
+    so a typical churn pass (a few cells move per changed row) needs no
+    phase B at all. Returns (flat_wire_u8, bits|None, changed_rowbuf,
     new_res_dense, new_res_meta)."""
     c = gvk_table.shape[1]
     cap = res_dense.shape[0]
     c_ax = "c" if (mesh is not None and shard_c) else None
+    # per-row delta slots: 62 exact + the 63 overflow sentinel fit the
+    # meta word's 6 spare bits; rows with more changed cells fall back to
+    # a full-row phase B fetch
+    d_slots = min(64, c)
 
     def shard(a, *axes):
         if mesh is None:
@@ -520,10 +535,34 @@ def _fleet_pass(
             safe_r = jnp.where(vc, rc, cap)
             rd = rd.at[safe_r].set(dense8, mode="drop")
             rm = rm.at[safe_r].set(meta, mode="drop")
-        changed = (
-            ((dense8 != old_d).any(axis=1) | (meta != old_m)) & vc
-        )
-        outs = (changed, meta)
+        cell_changed = (dense8 != old_d) & vc[:, None]
+        dcount = cell_changed.sum(axis=1).astype(jnp.int32)
+        changed = (cell_changed.any(axis=1) | (meta != old_m)) & vc
+        if d_cap:
+            # per-row delta compaction via sort, skipped entirely on
+            # steady chunks (the sort over [chunk, C] is the only
+            # non-trivial cost and a steady pass has no changed cells)
+            idxs32 = jnp.arange(c, dtype=jnp.int32)[None, :]
+
+            def _deltas(op):
+                d8, chm = op
+                dp = jnp.where(
+                    chm,
+                    (idxs32 << 9) | (d8.astype(jnp.int32) + 1),
+                    jnp.int32(2**31 - 1),
+                )
+                srt = lax.sort(dp, is_stable=False)[:, :d_slots]
+                return jnp.where(srt == 2**31 - 1, 0, srt)
+
+            deltas = lax.cond(
+                cell_changed.any(),
+                _deltas,
+                lambda op: jnp.zeros((chunk, d_slots), jnp.int32),
+                (dense8, cell_changed),
+            )
+        else:
+            deltas = jnp.zeros((chunk, 0), jnp.int32)
+        outs = (changed, meta, dcount, deltas)
         if need_bits:
             pad = (-c) % 32
             f = jnp.pad(feasible, ((0, 0), (0, pad)))
@@ -537,13 +576,19 @@ def _fleet_pass(
     )
     changed = outs[0].reshape(-1)  # bool[n_pad]
     meta = outs[1].reshape(-1)
+    dcounts = outs[2].reshape(-1)
 
     # wire: [4B total][bitmask n_pad/8 B][m_cap x 2B changed metas in row
-    # order]. n_pad is a multiple of 256, so the bitmask packs evenly.
+    # order][4B dtotal][d_cap x 3B cell deltas] (delta section only when
+    # d_cap > 0). n_pad is a multiple of 256, so the bitmask packs evenly.
+    # The wire meta word carries state (n_placed | flags, 10 bits) plus
+    # min(dcount, 63) in the 6 spare bits; res_meta stores STATE ONLY —
+    # dcount is pass-relative and must not trip the next pass's meta diff.
+    wire_meta = meta | (jnp.minimum(dcounts, 63) << 10)
     cnt = jnp.cumsum(changed.astype(jnp.int32)) - changed
     total = cnt[-1] + changed[-1].astype(jnp.int32)
     write = jnp.where(changed & (cnt < m_cap), cnt, m_cap)
-    mbuf = jnp.zeros((m_cap + 1,), jnp.int32).at[write].set(meta)
+    mbuf = jnp.zeros((m_cap + 1,), jnp.int32).at[write].set(wire_meta)
     mstream = mbuf[:m_cap]
     # changed TABLE rows, compacted in the same bitmask order — stays on
     # device so a speculative phase B can consume it without waiting for
@@ -564,8 +609,30 @@ def _fleet_pass(
     meta_u8 = jnp.stack(
         [mstream & 0xFF, (mstream >> 8) & 0xFF], axis=-1
     ).astype(jnp.uint8).reshape(-1)
-    flat = jnp.concatenate([total_u8, mask_u8, meta_u8])
-    bits = outs[2].reshape(-1, outs[2].shape[-1]) if need_bits else None
+    parts = [total_u8, mask_u8, meta_u8]
+    if d_cap:
+        # cell-delta stream: deltas of changed rows whose dcount fits the
+        # meta field (<= 62), compacted in bitmask row order; overflow
+        # rows (sentinel 63) ship via phase B instead
+        deltas_all = outs[3].reshape(changed.shape[0], -1)
+        contrib = changed & (dcounts <= 62)
+        rowv = jnp.where(contrib[:, None], deltas_all, 0).reshape(-1)
+        validv = rowv != 0
+        doffs = jnp.cumsum(validv.astype(jnp.int32)) - validv
+        dtotal = doffs[-1] + validv[-1].astype(jnp.int32)
+        dwrite = jnp.where(validv & (doffs < d_cap), doffs, d_cap)
+        dbuf = jnp.zeros((d_cap + 1,), jnp.int32).at[dwrite].set(rowv)
+        dstream = dbuf[:d_cap]
+        dtotal_u8 = jnp.stack(
+            [(dtotal >> s) & 0xFF for s in (0, 8, 16, 24)]
+        ).astype(jnp.uint8)
+        d_u8 = jnp.stack(
+            [dstream & 0xFF, (dstream >> 8) & 0xFF, (dstream >> 16) & 0xFF],
+            axis=-1,
+        ).astype(jnp.uint8).reshape(-1)
+        parts += [dtotal_u8, d_u8]
+    flat = jnp.concatenate(parts)
+    bits = outs[4].reshape(-1, outs[4].shape[-1]) if need_bits else None
     return flat, bits, rowbuf, res_dense, res_meta
 
 
@@ -619,6 +686,21 @@ def _fleet_entries(
         e_u8 = _entry_wire(stream, e_cap, pack21)
         return jnp.concatenate([total_u8, e_u8])
     return jnp.concatenate([total[None], stream])
+
+
+def _decode_entry_wire(raw2, cap_used: int, byte_wire: bool, pack21: bool):
+    """(total, stream) from a phase-B entry wire buffer."""
+    from .. import native
+
+    if byte_wire:
+        total2 = native.le32(raw2)
+        stream = (
+            native.decode21(raw2[4:], cap_used)
+            if pack21
+            else native.decode3(raw2[4:])
+        )
+        return total2, stream
+    return int(raw2[0]), raw2[1:]
 
 
 @jax.jit
@@ -889,6 +971,14 @@ class FleetTable:
         self._m_cap_cur: Optional[int] = None
         self._m_shrink = 0
         self._last_changed: Optional[int] = None
+        # cell-delta wire (phase A tail): tuned like m_cap; _delta_live
+        # records that the last churn pass folded via deltas, which turns
+        # the speculative full-row phase B dispatch off (wasted device
+        # sort + wire when deltas carry the pass)
+        self._d_cap_cur: Optional[int] = None
+        self._d_shrink = 0
+        self._last_dtotal: Optional[int] = None
+        self._delta_live = False
         # O(1) batch reuse: (problems_list, compiled_list, rows) of the
         # last scheduled batch — the engine's batch-identity fast path
         # re-passes the SAME list objects, so identity means the row
@@ -946,10 +1036,16 @@ class FleetTable:
         switch). The next dense pass reallocates zeroed residents and a
         zeroed host meta mirror — a consistent pair, so every row whose
         current result is nonzero re-reports as changed and refills the
-        mirrors."""
+        mirrors. The host ENTRY mirror must reset with them: after a row
+        remap its runs belong to other bindings, and the cell-delta fold
+        MERGES into existing runs (a full-row phase-B fold rewrites rows
+        wholesale and would mask the staleness, but a delta-carried pass
+        diffing against zeroed residents emits insert-only deltas — merged
+        into a stale run, stale sites would survive)."""
         self._res_dense = None
         self._res_meta = None
         self._host_meta = None
+        self._host_entries = None
 
     def _grow(self, need: int) -> None:
         new_cap = max(self.chunk, _pow2(need))
@@ -1592,6 +1688,45 @@ class FleetTable:
             has_cand, is_dup,
         )
 
+    def _fetch_fold_exact(
+        self, rows, counts, *, eff_chunk, k_out, byte_wire, pack21, tmr,
+    ) -> int:
+        """Dispatch an exact phase B over ``rows``, fetch its entry wire,
+        and fold the full runs into the host mirror. The entry cap is
+        host-summed from ``counts`` so overflow is structurally
+        impossible. Returns the fetched byte count."""
+        import time as _time
+
+        e_want = int(counts.sum())
+        m_pad_b = max(2048, _pow2(len(rows)))
+        b_chunk = min(eff_chunk, m_pad_b)
+        rows_b = np.full(m_pad_b, -1, np.int32)
+        rows_b[: len(rows)] = rows
+        e_cap = _cap_round(max(e_want, 1))
+        t_b = _time.perf_counter()
+        flat2 = _fleet_entries(
+            self._res_dense,
+            jnp.asarray(rows_b),
+            chunk=b_chunk,
+            n_chunks=m_pad_b // b_chunk,
+            k_out=k_out,
+            e_cap=e_cap,
+            byte_wire=byte_wire,
+            pack21=pack21 and byte_wire,
+        )
+        tmr["dispatch_b"] = _time.perf_counter() - t_b
+        t_b = _time.perf_counter()
+        raw2 = np.asarray(flat2)
+        tmr["fetch_b"] = _time.perf_counter() - t_b
+        total2, stream = _decode_entry_wire(raw2, e_cap, byte_wire, pack21)
+        assert total2 == e_want, (total2, e_want)
+        from .. import native
+
+        native.fold_entries(
+            self._host_entries, rows, counts, np.asarray(stream, np.int32)
+        )
+        return raw2.nbytes
+
     def _solve_dense(
         self, *, problems, rows_np, rows_dev, tmr, n, n_pad, eff_chunk,
         n_chunks, is_all, c, k_out, wide, fast, has_agg, need_bits, is_dup,
@@ -1642,6 +1777,39 @@ class FleetTable:
         )
         self._m_cap_cur = m_cap
 
+        # cell-delta buffer: a typical churn pass moves ~a few cells per
+        # changed row, so shipping (site, newcount) deltas instead of the
+        # full entry runs is ~10x less wire AND removes the phase-B round
+        # trip. Gated on site ids fitting the 3B wire word (site:15 |
+        # count+1:9 = 24 bits); d_cap overflow (churn onset, table
+        # rebuild) falls back to the full-row phase B flow.
+        d_cap = 0
+        if byte_wire and c <= (1 << 15):
+            # dead-band tuning (unlike tune_cap's needed>prev grow): the
+            # cap only GROWS when the last dtotal actually threatens it
+            # (>= 8/9 of prev) and then jumps to 1.5x headroom — dtotal
+            # wobbles a few percent pass to pass, and any upward quantum
+            # crossing mid-storm is a fresh XLA trace at this kernel's
+            # size. Shrink keeps tune_cap's two-vote hysteresis.
+            last = self._last_dtotal or 0
+            need_min = d_round(last * 9 // 8) if last else D_FLOOR
+            need_tgt = min(
+                d_round(last * 3 // 2) if last else D_FLOOR,
+                d_round(n_pad * 63),
+            )
+            prev = self._d_cap_cur
+            if prev is None or prev < need_min:
+                d_cap, self._d_shrink = need_tgt, 0
+            elif need_tgt < prev:
+                self._d_shrink += 1
+                if self._d_shrink >= 2:
+                    d_cap, self._d_shrink = need_tgt, 0
+                else:
+                    d_cap = prev
+            else:
+                d_cap, self._d_shrink = prev, 0
+            self._d_cap_cur = d_cap
+
         cap_round = _cap_round
         tmr["prep"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
@@ -1659,18 +1827,21 @@ class FleetTable:
             need_bits=need_bits,
             all_rows=is_all,
             m_cap=m_cap,
+            d_cap=d_cap,
             mesh=mesh,
             shard_c=shard_c,
         )
         self._res_dense, self._res_meta = rd, rm
-        # speculative phase B: when the last pass saw churn, dispatch the
-        # entry compaction over A's device-resident changed-row buffer
-        # BEFORE fetching A — B executes back-to-back with A on device and
-        # its wire overlaps A's decode, removing a round-trip from the
-        # churn critical path. Steady passes (last_changed == 0) skip it.
+        # speculative phase B: when the last pass saw churn AND could not
+        # ride the delta wire, dispatch the entry compaction over A's
+        # device-resident changed-row buffer BEFORE fetching A — B
+        # executes back-to-back with A on device and its wire overlaps
+        # A's decode, removing a round-trip from the churn critical path.
+        # Steady passes (last_changed == 0) and delta-carried churn skip
+        # it (the full-row sort + wire would be pure waste there).
         spec_flat = None
         spec_cap = 0
-        if self._last_changed and self._last_total:
+        if self._last_changed and self._last_total and not self._delta_live:
             spec_cap = cap_round(self._last_total * 9 // 8)
             b_chunk = min(eff_chunk, m_cap)
             spec_flat = _fleet_entries(
@@ -1704,10 +1875,13 @@ class FleetTable:
         ch_pos = np.flatnonzero(changed_bits)
         assert len(ch_pos) == total, (len(ch_pos), total)
         ch_rows = rows_np[ch_pos] if total else np.empty(0, np.int64)
-        if total <= m_cap:
+        have_dcounts = total <= m_cap
+        if have_dcounts:
             metas = native.decode2(raw[4 + nb : 4 + nb + 2 * m_cap])[:total]
         else:
-            # tuned buffer overflow (churn onset): one gather round-trip
+            # tuned buffer overflow (churn onset): one gather round-trip.
+            # res_meta stores STATE only, so the per-row delta counts are
+            # lost — this pass folds via the full-row phase B flow.
             m_pad_f = max(4096, _pow2(total))
             rows_f = np.full(m_pad_f, -1, np.int32)
             rows_f[:total] = ch_rows
@@ -1717,20 +1891,54 @@ class FleetTable:
             fetched_bytes += mraw.nbytes
             metas = native.decode2(mraw)[:total]
         self._last_changed = total
+        state = metas & 0x3FF  # n_placed | unsched<<8 | has_cand<<9
+        off_d = 4 + nb + 2 * m_cap
+        dtotal = native.le32(raw[off_d : off_d + 4]) if d_cap else None
 
-        # phase B: entries for exactly the changed rows
+        # fold: cell deltas when they fit, full-row phase B otherwise
+        use_delta = False
         if total:
-            self._host_meta[ch_rows] = metas
-            counts = (metas & 0xFF).astype(np.int64)
+            self._host_meta[ch_rows] = state
+            counts = (state & 0xFF).astype(np.int64)
             e_total = int(counts.sum())
-            if not e_total:
+            self._last_total = e_total
+            use_delta = bool(
+                d_cap and have_dcounts and dtotal <= d_cap
+            )
+            if use_delta:
+                t_b = _time.perf_counter()
+                dch = metas >> 10  # min(changed cells, 63) per changed row
+                norm = dch <= 62
+                nd_norm = dch[norm].astype(np.int64)
+                assert int(nd_norm.sum()) == dtotal, (
+                    int(nd_norm.sum()), dtotal,
+                )
+                if dtotal:
+                    dstream = native.decode3(
+                        raw[off_d + 4 : off_d + 4 + 3 * dtotal]
+                    )
+                    native.apply_deltas(
+                        self._host_entries, ch_rows[norm], nd_norm, dstream
+                    )
+                # decode+merge time only; an overflow-row fetch below
+                # reports its own dispatch_b/fetch_b
+                tmr["delta_fold"] = _time.perf_counter() - t_b
+                tmr["delta_rows"] = float(int(norm.sum()))
+                rows_over = ch_rows[~norm]
+                if rows_over.size:
+                    # rows whose delta count overflowed the 6-bit meta
+                    # field: fetch their full entry runs exactly
+                    fetched_bytes += self._fetch_fold_exact(
+                        rows_over, counts[~norm], eff_chunk=eff_chunk,
+                        k_out=k_out, byte_wire=byte_wire, pack21=pack21,
+                        tmr=tmr,
+                    )
+            elif not e_total:
                 # every changed row lost its placements: clear the runs
                 # (the fold below zero-fills rows it writes, covering the
                 # mixed case without a second full sweep)
                 self._host_entries[ch_rows] = 0
-            self._last_total = e_total
-            if e_total:
-                raw2 = None
+            if e_total and not use_delta:
                 if (
                     spec_flat is not None
                     and total <= m_cap
@@ -1740,50 +1948,27 @@ class FleetTable:
                     t_b = _time.perf_counter()
                     raw2 = np.asarray(spec_flat)
                     fetched_bytes += raw2.nbytes
-                    cap_used = spec_cap
                     tmr["fetch_b"] = _time.perf_counter() - t_b
+                    total2, stream = _decode_entry_wire(
+                        raw2, spec_cap, byte_wire, pack21
+                    )
+                    assert total2 == e_total, (total2, e_total)
+                    native.fold_entries(
+                        self._host_entries, ch_rows, counts,
+                        np.asarray(stream, np.int32),
+                    )
                 else:
                     # exact fallback: churn onset (no speculation) or the
                     # speculative caps were too small
-                    m_pad_b = max(2048, _pow2(total))
-                    b_chunk = min(eff_chunk, m_pad_b)
-                    rows_b = np.full(m_pad_b, -1, np.int32)
-                    rows_b[:total] = ch_rows
-                    e_cap = cap_round(e_total)
-                    t_b = _time.perf_counter()
-                    flat2 = _fleet_entries(
-                        self._res_dense,
-                        jnp.asarray(rows_b),
-                        chunk=b_chunk,
-                        n_chunks=m_pad_b // b_chunk,
-                        k_out=k_out,
-                        e_cap=e_cap,
-                        byte_wire=byte_wire,
-                        pack21=pack21 and byte_wire,
+                    fetched_bytes += self._fetch_fold_exact(
+                        ch_rows, counts, eff_chunk=eff_chunk, k_out=k_out,
+                        byte_wire=byte_wire, pack21=pack21, tmr=tmr,
                     )
-                    cap_used = e_cap
-                    tmr["dispatch_b"] = _time.perf_counter() - t_b
-                    t_b = _time.perf_counter()
-                    raw2 = np.asarray(flat2)
-                    tmr["fetch_b"] = _time.perf_counter() - t_b
-                    fetched_bytes += raw2.nbytes
-                if byte_wire:
-                    total2 = native.le32(raw2)
-                    stream = (
-                        native.decode21(raw2[4:], cap_used)
-                        if pack21
-                        else native.decode3(raw2[4:])
-                    )
-                else:
-                    total2 = int(raw2[0])
-                    stream = raw2[1:]
-                assert total2 == e_total, (total2, e_total)
-                native.fold_entries(
-                    self._host_entries, ch_rows, counts,
-                    np.asarray(stream, np.int32),
-                )
         else:
             self._last_total = 0
+        self._delta_live = use_delta
+        if d_cap:
+            self._last_dtotal = int(dtotal)
         tmr["fetch"] = _time.perf_counter() - t0
         tmr["fetch_mb"] = fetched_bytes / 1e6
         tmr["changed_rows"] = float(total)

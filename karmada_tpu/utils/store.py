@@ -23,6 +23,12 @@ MODIFIED = "Modified"
 DELETED = "Deleted"
 
 
+class ConflictError(RuntimeError):
+    """Optimistic-concurrency precondition failed (the apiserver's 409):
+    the object's resource_version moved under the caller. Re-read and
+    retry, or give up the claim (leader election's loss signal)."""
+
+
 @dataclass(frozen=True)
 class Event:
     type: str  # Added | Modified | Deleted
@@ -84,10 +90,16 @@ class Store:
         with self._lock:
             self._rv = max(self._rv, rv - 1)
 
-    def apply(self, obj: Any) -> Any:
+    def apply(self, obj: Any, *, expected_rv: Optional[int] = None) -> Any:
         """Create-or-update. Bumps resource_version; bumps generation when a
         spec is present and changed is not detectable (callers that mutate
-        spec in place should bump generation themselves via ``bump_generation``)."""
+        spec in place should bump generation themselves via ``bump_generation``).
+
+        ``expected_rv`` is the apiserver's optimistic-concurrency
+        precondition: the write succeeds only if the CURRENT object's
+        resource_version equals it (0 = the object must not exist yet);
+        otherwise ConflictError (HTTP 409). The compare-and-swap leader
+        election and controllers racing on shared objects build on this."""
         kind = obj_kind(obj)
         key = obj_key(obj)
         if self._admission is not None:
@@ -95,6 +107,17 @@ class Store:
         with self._lock:
             bucket = self._buckets.setdefault(kind, {})
             existing = bucket.get(key)
+            if expected_rv is not None:
+                current_rv = (
+                    existing.meta.resource_version
+                    if existing is not None
+                    else 0
+                )
+                if current_rv != expected_rv:
+                    raise ConflictError(
+                        f"{kind} {key!r}: resource_version is "
+                        f"{current_rv}, precondition {expected_rv}"
+                    )
             self._rv += 1
             obj.meta.resource_version = self._rv
             if not obj.meta.uid:

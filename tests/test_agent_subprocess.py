@@ -191,3 +191,103 @@ class TestAgentOverBus:
             f"no lease from subprocess; agent output head: "
             f"{proc.stdout}"
         )
+
+
+class TestAgentLeaderElection:
+    """HA pull agents: N replicas per member, one Lease holder syncs
+    (cmd/agent --leader-elect over client-go leaderelection; here the CAS
+    elector of utils/leaderelect.py through the bus facade)."""
+
+    def test_two_agents_one_leader_and_failover(self):
+        cp = ControlPlane()
+        bus = StoreBusServer(cp.store)
+        port = bus.start()
+
+        def spawn(ident):
+            return subprocess.Popen(
+                [
+                    sys.executable, "-m", "karmada_tpu.bus.agent",
+                    "--target", f"127.0.0.1:{port}",
+                    "--cluster", "pull1",
+                    "--max-seconds", "120",
+                    "--leader-elect",
+                    "--leader-elect-identity", ident,
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+
+        a, b = spawn("agent-a"), spawn("agent-b")
+        try:
+            pull = new_cluster("pull1", cpu="100", memory="200Gi")
+            pull.spec.sync_mode = "Pull"
+            cp.join_cluster(pull, remote_agent=True)
+            cp.settle()
+
+            lock_key = "karmada-agent-pull1"
+
+            def lease_held():
+                lease = cp.store.get("Lease", lock_key)
+                return lease is not None and lease.holder_identity in (
+                    "agent-a", "agent-b",
+                )
+
+            assert settle_until(cp, lease_held, timeout=20), (
+                "no agent acquired the leader lease"
+            )
+            leader = cp.store.get("Lease", lock_key).holder_identity
+
+            # the LEADER syncs: workload propagates and reports Applied
+            cp.store.apply(new_deployment("ha-le-app", replicas=3))
+            cp.store.apply(nginx_policy(dynamic_weight_placement()))
+            work_key = (
+                f"{execution_namespace('pull1')}/default.ha-le-app-deployment"
+            )
+
+            def applied(key):
+                def check():
+                    work = cp.store.get("Work", key)
+                    return work is not None and any(
+                        c.type == "Applied" and c.status
+                        for c in work.status.conditions
+                    )
+                return check
+
+            assert settle_until(cp, applied(work_key), timeout=30), (
+                "leader agent never applied the Work"
+            )
+
+            # kill the leader: the standby must take the lease over after
+            # expiry (lease_duration 2s at the default 0.5s tick)
+            victim, survivor_id = (
+                (a, "agent-b") if leader == "agent-a" else (b, "agent-a")
+            )
+            victim.kill()
+            victim.wait(timeout=5)
+
+            def taken_over():
+                lease = cp.store.get("Lease", lock_key)
+                return (
+                    lease is not None
+                    and lease.holder_identity == survivor_id
+                    and lease.lease_transitions >= 1
+                )
+
+            assert settle_until(cp, taken_over, timeout=25), (
+                f"standby {survivor_id} never took the lease over"
+            )
+
+            # the NEW leader drains the backlog and syncs fresh work
+            cp.store.apply(new_deployment("ha-le-app2", replicas=2))
+            work_key2 = (
+                f"{execution_namespace('pull1')}/default.ha-le-app2-deployment"
+            )
+            assert settle_until(cp, applied(work_key2), timeout=30), (
+                "surviving agent never applied post-failover Work"
+            )
+        finally:
+            for p in (a, b):
+                p.kill()
+                p.wait(timeout=5)
+            bus.stop()

@@ -495,3 +495,97 @@ def test_zero_replica_spread_rows_match_host_path():
         problems, [host._compiled(p.placement) for p in problems]
     )
     _assert_same(want, got)
+
+
+def test_cell_delta_overflow_rows_fall_back_to_full_fetch():
+    """A churn pass whose rows moved MORE than 62 cells must fetch those
+    rows' full entry runs (the 6-bit delta field saturates) while normal
+    rows still ride the delta wire — and both stay host-identical."""
+    clusters = synthetic_fleet(200, seed=31)
+    snap = ClusterSnapshot(clusters)
+    pl = dynamic_weight_placement()
+    problems = [
+        BindingProblem(
+            key=f"b{i}", placement=pl, replicas=100, requests=REQ,
+            gvk="apps/v1/Deployment",
+        )
+        for i in range(128)
+    ]
+    eng = TensorScheduler(snap, chunk_size=64)
+    eng.fleet_threshold = 1
+    eng.schedule(problems)
+    eng.schedule(problems)
+    assert eng._fleet is not None and eng._fleet._delta_live is False
+    # shrink replicas 100 -> 3: ~all of each row's ~100 placed cells
+    # change, saturating the per-row delta field
+    problems = [
+        BindingProblem(
+            key=p.key, placement=p.placement, replicas=3, requests=p.requests,
+            gvk=p.gvk,
+        )
+        for p in problems
+    ]
+    res = eng.schedule(problems)
+    bd = eng.last_breakdown
+    assert bd.get("changed_rows") == 128.0
+    # every row overflowed: delta path engaged but served them via the
+    # exact full-row fetch
+    assert bd.get("delta_rows") == 0.0, bd
+    host = TensorScheduler(snap)
+    want = host._schedule_host(
+        problems, [host._compiled(p.placement) for p in problems]
+    )
+    _assert_same(want, res)
+    # ...and a subsequent small mutation (a few cells per row) rides the
+    # delta wire again
+    problems = [
+        BindingProblem(
+            key=p.key, placement=p.placement,
+            replicas=5 if i < 30 else p.replicas, requests=p.requests,
+            gvk=p.gvk,
+        )
+        for i, p in enumerate(problems)
+    ]
+    res2 = eng.schedule(problems)
+    bd2 = eng.last_breakdown
+    assert bd2.get("changed_rows", 0) >= 30, bd2
+    assert bd2.get("delta_rows", 0) >= 30, bd2
+    host2 = TensorScheduler(snap)
+    want2 = host2._schedule_host(
+        problems, [host2._compiled(p.placement) for p in problems]
+    )
+    _assert_same(want2, res2)
+
+
+def test_post_compaction_delta_pass_is_host_identical():
+    """After _compact() remaps rows, a DELTA-carried pass (small table:
+    total and dtotal under the floor caps, so use_delta engages on the
+    very first post-compact pass) must not merge insert-only deltas into
+    another binding's stale host-mirror run — the reset must drop the
+    entry mirror with the residents."""
+    clusters = synthetic_fleet(50, seed=13)
+    snap = ClusterSnapshot(clusters)
+    pl = dynamic_weight_placement()
+
+    def mk(key, reps):
+        return BindingProblem(key=key, placement=pl, replicas=reps,
+                              requests=REQ, gvk="apps/v1/Deployment")
+
+    doomed = [mk(f"d{i}", 5 + i % 7) for i in range(80)]
+    kept = [mk(f"k{i}", 3 + i % 9) for i in range(80)]
+    eng = TensorScheduler(snap, chunk_size=64)
+    eng.fleet_threshold = 1
+    eng.schedule(doomed + kept)
+    # age the doomed rows out, then compact: rows remap (kept rows shift
+    # down into the doomed rows' slots)
+    for _ in range(10):
+        eng.schedule(kept)
+    table = eng._fleet
+    assert table._compact(), "compaction must trigger for this layout"
+    res = eng.schedule(kept)
+    bd = eng.last_breakdown
+    # the point of the test: this pass must be delta-carried
+    assert bd.get("delta_rows", 0) > 0, bd
+    host = TensorScheduler(snap)
+    want = host._schedule_host(kept, [host._compiled(p.placement) for p in kept])
+    _assert_same(want, res)

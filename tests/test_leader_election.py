@@ -1,0 +1,147 @@
+"""Leader election over the Lease resource lock (client-go
+tools/leaderelection semantics: tryAcquireOrRenew via CAS on the lock
+object) + the Store/bus optimistic-concurrency precondition it builds on
+(apiserver Update-with-resourceVersion -> 409 Conflict)."""
+
+import pytest
+
+from karmada_tpu.api.cluster import Lease
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.utils.leaderelect import LeaderElector
+from karmada_tpu.utils.store import ConflictError, Store
+
+
+class Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestConflictPrecondition:
+    def test_apply_if_semantics(self):
+        store = Store()
+        obj = Resource(meta=ObjectMeta(name="a", namespace="ns"))
+        # create-only precondition: rv 0 = must not exist
+        store.apply(obj, expected_rv=0)
+        rv = obj.meta.resource_version
+        with pytest.raises(ConflictError):
+            store.apply(
+                Resource(meta=ObjectMeta(name="a", namespace="ns")),
+                expected_rv=0,
+            )
+        # update with the right precondition succeeds, wrong one conflicts
+        store.apply(
+            Resource(meta=ObjectMeta(name="a", namespace="ns")),
+            expected_rv=rv,
+        )
+        with pytest.raises(ConflictError):
+            store.apply(
+                Resource(meta=ObjectMeta(name="a", namespace="ns")),
+                expected_rv=rv,
+            )
+
+    def test_conflict_travels_the_bus(self):
+        from karmada_tpu.bus.service import StoreBusServer, StoreReplica
+
+        store = Store()
+        server = StoreBusServer(store)
+        server.start()
+        try:
+            replica = StoreReplica(f"127.0.0.1:{server.port}")
+            replica.start()
+            assert replica.wait_synced(10)
+            obj = Resource(meta=ObjectMeta(name="x", namespace="d"))
+            rv = replica.apply(obj, expected_rv=0)
+            assert rv > 0
+            with pytest.raises(ConflictError):
+                replica.apply(
+                    Resource(meta=ObjectMeta(name="x", namespace="d")),
+                    expected_rv=0,
+                )
+            replica.close()
+        finally:
+            server.stop()
+
+
+class TestLeaderElector:
+    def _pair(self, store, clock):
+        a = LeaderElector(store, "lock", "a", lease_duration=4.0,
+                          renew_deadline=2.0, clock=clock)
+        b = LeaderElector(store, "lock", "b", lease_duration=4.0,
+                          renew_deadline=2.0, clock=clock)
+        return a, b
+
+    def test_first_acquires_second_observes(self):
+        store, clock = Store(), Clock()
+        a, b = self._pair(store, clock)
+        assert a.tick() and a.is_leader
+        assert not b.tick() and not b.is_leader
+        lease = store.get("Lease", "lock")
+        assert lease.holder_identity == "a"
+        # renewal keeps b out past the original expiry
+        for _ in range(4):
+            clock.t += 1.5
+            assert a.tick()
+            assert not b.tick()
+
+    def test_expiry_hands_over_with_transition_count(self):
+        store, clock = Store(), Clock()
+        a, b = self._pair(store, clock)
+        assert a.tick()
+        clock.t += 10.0  # a stops renewing; lease expires
+        assert b.tick() and b.is_leader
+        lease = store.get("Lease", "lock")
+        assert lease.holder_identity == "b"
+        assert lease.lease_transitions == 1
+        # a comes back: observes b and steps down
+        assert not a.tick() and not a.is_leader
+
+    def test_release_hands_over_immediately(self):
+        store, clock = Store(), Clock()
+        a, b = self._pair(store, clock)
+        assert a.tick()
+        a.release()
+        assert not a.is_leader
+        clock.t += 0.1  # far inside the old lease window
+        assert b.tick() and b.is_leader
+
+    def test_cas_race_single_winner(self):
+        """Two candidates racing from the same observed state: exactly one
+        CAS lands."""
+        store, clock = Store(), Clock()
+        a, b = self._pair(store, clock)
+        # simulate the race: both read 'no lease', then both write. The
+        # second write's precondition (rv 0) must fail.
+        assert a.tick()
+        with pytest.raises(ConflictError):
+            store.apply(
+                Lease(meta=ObjectMeta(name="lock"), renew_time=clock.t,
+                      holder_identity="b", lease_duration_seconds=4.0),
+                expected_rv=0,
+            )
+        assert not b.tick()
+
+    def test_transient_write_failure_coasts_until_deadline(self):
+        store, clock = Store(), Clock()
+        a = LeaderElector(store, "lock", "a", lease_duration=4.0,
+                          renew_deadline=2.0, clock=clock)
+        assert a.tick()
+        broken = [True]
+        real_apply = store.apply
+
+        def flaky_apply(obj, **kw):
+            if broken[0]:
+                raise RuntimeError("bus down")
+            return real_apply(obj, **kw)
+
+        store.apply = flaky_apply
+        clock.t += 1.0
+        assert a.tick()  # still inside renew deadline: coasts
+        clock.t += 2.5
+        assert not a.tick()  # deadline passed: deposed
+        broken[0] = False
+        # heals: re-acquires (lease is its own, not expired for others yet
+        # -> held_by_self path)
+        assert a.tick() and a.is_leader

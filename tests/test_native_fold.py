@@ -68,3 +68,53 @@ def test_fallback_paths_are_equivalent(rng, monkeypatch):
     native.fold_entries(mirror, rows, counts, stream)
     assert list(mirror[3]) == [11, 12, 0, 0, 0, 0, 0, 0]
     assert not mirror[50].any()
+
+
+def test_apply_deltas_matches_dict_referent(rng):
+    """Merge semantics: newcount 0 removes, existing updates, new inserts
+    in site order; rows clamp at k_res; native and fallback agree."""
+    cap, k = 500, 16
+    for rep in range(40):
+        n_sites = int(rng.integers(20, 120))
+        mirror_c = np.zeros((cap, k), np.int32)
+        rows = rng.choice(cap, int(rng.integers(1, 60)), replace=False)
+        rows = rows.astype(np.int64)
+        ref: dict = {}
+        for r in rows:
+            sites = np.sort(rng.choice(n_sites, int(rng.integers(0, k + 1)),
+                                       replace=False))
+            cnts = rng.integers(1, 200, len(sites))
+            run = [(int(s) << 8) | int(c) for s, c in zip(sites, cnts)]
+            mirror_c[r, : len(run)] = run
+            ref[int(r)] = dict(zip(map(int, sites), map(int, cnts)))
+        mirror_np = mirror_c.copy()
+        dcounts = rng.integers(0, 10, len(rows)).astype(np.int64)
+        stream = []
+        for r, nd in zip(rows, dcounts):
+            dsites = np.sort(rng.choice(n_sites, int(nd), replace=False))
+            for s in dsites:
+                # ~1/3 removals (newcount 0), else a set/insert
+                c = 0 if rng.random() < 0.33 else int(rng.integers(1, 200))
+                stream.append((int(s) << 9) | (c + 1))
+                if c:
+                    ref[int(r)][int(s)] = c
+                else:
+                    ref[int(r)].pop(int(s), None)
+        stream = np.asarray(stream, np.int32)
+        native.apply_deltas(mirror_c, rows, dcounts, stream)
+        # fallback path on a copy
+        import karmada_tpu.native as nat
+
+        saved = (nat._LIB, nat._TRIED)
+        try:
+            nat._LIB, nat._TRIED = None, True
+            nat.apply_deltas(mirror_np, rows, dcounts, stream)
+        finally:
+            nat._LIB, nat._TRIED = saved
+        assert np.array_equal(mirror_c, mirror_np)
+        for r in rows:
+            want = [
+                (s << 8) | c for s, c in sorted(ref[int(r)].items())
+            ][:k]
+            got = [int(v) for v in mirror_c[r] if v != 0]
+            assert got == want, (r, got, want)

@@ -1,0 +1,78 @@
+"""Wall-clock requeue discipline (ref: pkg/util/worker.go over a
+rate-limiting workqueue — DefaultControllerRateLimiter's per-item
+exponential backoff). Cooperative mode keeps the deterministic
+immediate-requeue contract the e2e drivers depend on."""
+
+from karmada_tpu.utils.worker import DONE, REQUEUE, Runtime
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_cooperative_mode_drops_after_max_retries():
+    rt = Runtime()
+    calls = []
+    w = rt.new_worker("fail", lambda k: calls.append(k) or REQUEUE)
+    w.enqueue("x")
+    rt.run_until_settled()
+    assert len(calls) == w.MAX_RETRIES + 1
+    assert len(w) == 0 and w.delayed == 0
+
+
+def test_realtime_mode_backs_off_exponentially():
+    rt = Runtime()
+    rt.realtime = True
+    clock = FakeClock()
+    calls = []
+    w = rt.new_worker(
+        "fail", lambda k: calls.append(clock.t) or REQUEUE,
+        backoff_base=0.01, backoff_max=1.0, clock=clock,
+    )
+    w.enqueue("x")
+    assert w.process_one() and not w.process_one()  # parked, not requeued
+    assert w.delayed == 1
+    assert abs(w.next_due() - 0.01) < 1e-9
+    # not due yet: half the window passes, still parked
+    clock.t += 0.005
+    assert not w.process_one()
+    clock.t += 0.006
+    assert w.process_one()  # due: retried, parked again at 2x
+    assert abs(w.next_due() - 0.02) < 1e-9
+    # backoff caps at backoff_max
+    for _ in range(12):
+        clock.t += 2.0
+        assert w.process_one()
+    assert w.next_due() <= 1.0 + 1e-9
+    # success resets the per-key backoff
+    ok = rt.new_worker("ok", lambda k: DONE, clock=clock)
+    ok.enqueue("x")
+    assert ok.process_one()
+    assert ok._retries.get("x") is None
+
+
+def test_realtime_never_drops_and_runtime_reports_due():
+    rt = Runtime()
+    rt.realtime = True
+    clock = FakeClock()
+    n = [0]
+
+    def reconcile(k):
+        n[0] += 1
+        return REQUEUE if n[0] < 25 else DONE  # beyond MAX_RETRIES
+
+    w = rt.new_worker("flaky", reconcile, backoff_base=0.001,
+                      backoff_max=0.01, clock=clock)
+    w.enqueue("k")
+    while n[0] < 25:
+        due = rt.next_due()
+        if due is not None and due > 0:
+            clock.t += due
+        rt.run_until_settled(tick=False)
+    assert n[0] == 25  # survived past the cooperative drop threshold
+    assert w.delayed == 0 and len(w) == 0
+    assert rt.next_due() is None
