@@ -740,7 +740,15 @@ def run_engine_north_star(args) -> dict:
               file=sys.stderr)
         m_engine = TensorScheduler(snap, chunk_size=args.chunk)
         t0 = time.perf_counter()
-        m_engine.schedule(m_problems)
+        try:
+            m_engine.schedule(m_problems)
+        except Exception as e:  # noqa: BLE001 — tunnel compile drops are
+            # transient (broken pipe on long remote compiles); one retry
+            # resumes from the persistent compilation cache
+            print(f"# 1M warm failed ({e!r}); retrying once",
+                  file=sys.stderr)
+            time.sleep(10)
+            m_engine.schedule(m_problems)
         print(f"# 1M warm pass: {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
         for tag in ("tune", "stabilize", "settle"):

@@ -155,9 +155,21 @@ def serve_plane(args) -> None:
 
     solver = None
     if args.solver:
-        from .solver.client import RemoteSolver
+        # comma-separated targets = HA solver replicas: the plane sticks
+        # to the active one and fails over on transport errors
+        targets = [t for t in args.solver.split(",") if t]
+        if not targets:
+            print("error: --solver given but no targets parsed",
+                  file=sys.stderr)
+            sys.exit(2)
+        if len(targets) > 1:
+            from .solver.client import HASolver
 
-        solver = RemoteSolver(args.solver)
+            solver = HASolver(targets)
+        else:
+            from .solver.client import RemoteSolver
+
+            solver = RemoteSolver(targets[0])
     cp = cmd_init(solver=solver, enable_descheduler=args.descheduler,
                   lease_grace_seconds=args.lease_grace or None,
                   **admission_kw)
@@ -410,7 +422,11 @@ def main(argv=None) -> None:
     sv = sub.add_parser("serve", help="run the plane process (internal)")
     sv.add_argument("--members", type=int, default=2)
     sv.add_argument("--pull", action="append", default=[])
-    sv.add_argument("--solver", default="")
+    sv.add_argument(
+        "--solver", default="",
+        help="solver sidecar host:port (comma-separated = HA replicas "
+        "with client failover)",
+    )
     sv.add_argument("--estimator", action="append", default=[])
     sv.add_argument("--bus-address", default="127.0.0.1:0")
     sv.add_argument("--descheduler", action="store_true")

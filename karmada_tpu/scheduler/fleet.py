@@ -1800,9 +1800,13 @@ class FleetTable:
             prev = self._d_cap_cur
             if prev is None or prev < need_min:
                 d_cap, self._d_shrink = need_tgt, 0
-            elif need_tgt < prev:
+            elif need_tgt * 2 <= prev:
+                # shrink only on a SUSTAINED halving of demand: an oversized
+                # delta cap costs ~3B x quantum of wire (~16 ms), a shrink
+                # costs a fresh solve trace — a one-quantum wobble shrink
+                # recompiled the kernel mid-storm on the bench
                 self._d_shrink += 1
-                if self._d_shrink >= 2:
+                if self._d_shrink >= 3:
                     d_cap, self._d_shrink = need_tgt, 0
                 else:
                     d_cap = prev

@@ -4,7 +4,7 @@
 process; the scheduler controller connects with ``RemoteSolver``.
 """
 
-from .client import RemoteScheduleResult, RemoteSolver  # noqa: F401
+from .client import HASolver, RemoteScheduleResult, RemoteSolver  # noqa: F401
 from .service import (  # noqa: F401
     SolverGrpcServer,
     SolverService,

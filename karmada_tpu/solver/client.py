@@ -117,3 +117,78 @@ class RemoteSolver:
 
     def close(self) -> None:
         self._channel.close()
+
+
+class HASolver:
+    """N solver sidecars, one active: the reference runs scheduler
+    replicas behind leader election / a Service and any single live
+    backend can answer. Here ``schedule()`` sticks to the active endpoint
+    and fails over on transport errors; ``sync_clusters`` broadcasts
+    best-effort so standbys hold warm snapshots (a cold standby heals
+    anyway via the FAILED_PRECONDITION re-sync in RemoteSolver.schedule).
+
+    Satisfies the same engine seam as RemoteSolver, so
+    ``ControlPlane(solver=HASolver([...]))`` is a drop-in."""
+
+    def __init__(
+        self,
+        targets: Sequence[str],
+        *,
+        cluster_source=None,
+        **kw,
+    ):
+        if not targets:
+            raise ValueError("HASolver needs at least one target")
+        self._solvers = [
+            RemoteSolver(t, cluster_source=cluster_source, **kw)
+            for t in targets
+        ]
+        self._active = 0
+
+    @property
+    def _cluster_source(self):
+        return self._solvers[0]._cluster_source
+
+    @_cluster_source.setter
+    def _cluster_source(self, fn) -> None:
+        # the scheduler controller assigns this post-construction; every
+        # backend heals independently, so each needs the source
+        for s in self._solvers:
+            s._cluster_source = fn
+
+    @property
+    def active_target(self) -> int:
+        return self._active
+
+    def sync_clusters(self, clusters) -> int:
+        version = 0
+        last_err: Optional[Exception] = None
+        ok = 0
+        for s in self._solvers:
+            try:
+                version = max(version, s.sync_clusters(clusters))
+                ok += 1
+            except grpc.RpcError as e:  # standby down: its re-sync heals it
+                last_err = e
+        if not ok:
+            assert last_err is not None
+            raise last_err
+        return version
+
+    def schedule(self, problems: Sequence[BindingProblem]) -> list:
+        n = len(self._solvers)
+        last_err: Optional[Exception] = None
+        for i in range(n):
+            idx = (self._active + i) % n
+            try:
+                res = self._solvers[idx].schedule(problems)
+                self._active = idx
+                return res
+            except grpc.RpcError as e:
+                last_err = e
+        assert last_err is not None
+        raise last_err
+
+    def close(self) -> None:
+        for s in self._solvers:
+            s.close()

@@ -152,3 +152,81 @@ def test_propagation_e2e_with_out_of_process_solver():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+class TestHASolver:
+    """HA solver replicas: schedule() sticks to the active backend, fails
+    over on transport errors, and standbys answer correctly because syncs
+    broadcast (or the FAILED_PRECONDITION re-sync heals a cold one)."""
+
+    def _up(self):
+        from karmada_tpu.solver.client import HASolver
+
+        servers = []
+        targets = []
+        for _ in range(2):
+            svc = SolverService()
+            srv = SolverGrpcServer(svc, "127.0.0.1:0")
+            port = srv.start()
+            servers.append(srv)
+            targets.append(f"127.0.0.1:{port}")
+        return servers, HASolver(targets)
+
+    def test_failover_mid_storm_is_placement_identical(self):
+        servers, ha = self._up()
+        try:
+            clusters = synthetic_fleet(12, seed=5)
+            problems = _problems(clusters, n=30, seed=9)
+            ha._cluster_source = lambda: clusters
+            ha.sync_clusters(clusters)
+            want = TensorScheduler(
+                ClusterSnapshot(sorted(clusters, key=lambda c: c.name))
+            ).schedule(problems)
+
+            def check(res):
+                for r, w in zip(res, want):
+                    assert r.success == w.success and r.clusters == w.clusters, r.key
+
+            check(ha.schedule(problems))
+            assert ha.active_target == 0
+            # kill the active backend: the next schedule must fail over
+            # and stay identical
+            servers[0].stop()
+            check(ha.schedule(problems))
+            assert ha.active_target == 1
+        finally:
+            for s in servers:
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+            ha.close()
+
+    def test_cold_standby_heals_via_resync(self):
+        from karmada_tpu.solver.client import HASolver
+
+        # standby never saw a sync (spawned later): FAILED_PRECONDITION
+        # on failover triggers its own re-sync + retry
+        svc_a, svc_b = SolverService(), SolverService()
+        srv_a = SolverGrpcServer(svc_a, "127.0.0.1:0")
+        srv_b = SolverGrpcServer(svc_b, "127.0.0.1:0")
+        pa, pb = srv_a.start(), srv_b.start()
+        ha = HASolver([f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"])
+        try:
+            clusters = synthetic_fleet(10, seed=6)
+            problems = _problems(clusters, n=12, seed=2)
+            ha._cluster_source = lambda: clusters
+            # sync ONLY the active (simulates b joining later)
+            ha._solvers[0].sync_clusters(clusters)
+            res_a = ha.schedule(problems)
+            srv_a.stop()
+            res_b = ha.schedule(problems)  # b is cold -> re-sync path
+            for a, b in zip(res_a, res_b):
+                assert a.clusters == b.clusters and a.error == b.error
+        finally:
+            for s in (srv_a, srv_b):
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+            ha.close()
