@@ -166,13 +166,24 @@ class _RemoteProxyChain:
             status, body = self._http(path)
             if status != 200:
                 return ProxyResponse(served_by="cluster", error=body)
-            return ProxyResponse(
-                served_by="cluster",
-                items=[
-                    (req.cluster, resource_from_dict(i))
-                    for i in json.loads(body).get("items", [])
-                ],
-            )
+            items = [
+                (req.cluster, resource_from_dict(i))
+                for i in json.loads(body).get("items", [])
+            ]
+            if req.labels:
+                # the member API behind the passthrough may or may not
+                # honor a labelSelector param; filtering here guarantees
+                # the selector semantics either way (fleet-scope and
+                # in-proc proxy branches already filter)
+                items = [
+                    (c, o)
+                    for c, o in items
+                    if all(
+                        o.meta.labels.get(k) == v
+                        for k, v in req.labels.items()
+                    )
+                ]
+            return ProxyResponse(served_by="cluster", items=items)
         return ProxyResponse(
             served_by="cluster", error=f"verb {req.verb} not proxied"
         )
@@ -981,6 +992,47 @@ _CLUSTER_SCOPED = {
 }
 
 
+def _format_get(doc, output: str, gvk: str) -> str:
+    """kubectl -o rendering for get results. ``doc`` is either one
+    jsonable object or a list of {cluster, object} rows."""
+    rows = doc if isinstance(doc, list) else [{"cluster": "", "object": doc}]
+
+    def meta(o):
+        return o.get("meta") or o.get("metadata") or {}
+
+    if output == "yaml":
+        import yaml
+
+        return yaml.safe_dump(doc, sort_keys=False).rstrip()
+    if output == "name":
+        kind = gvk.rsplit("/", 1)[-1].lower()
+        return "\n".join(
+            f"{kind}/{meta(r['object']).get('name', '')}" for r in rows
+        )
+    if output == "wide":
+        # kubectl's wide table, multi-cluster flavored: one line per
+        # (cluster, object) with the status fields the aggregated
+        # deployment view carries
+        out = [f"{'CLUSTER':16} {'NAMESPACE':12} {'NAME':24} "
+               f"{'READY':8} {'GENERATION':10}"]
+        for r in rows:
+            o = r["object"]
+            m = meta(o)
+            st = o.get("status") or {}
+            ready = (
+                f"{st.get('readyReplicas', st.get('ready_replicas', 0))}"
+                f"/{(o.get('spec') or {}).get('replicas', '-')}"
+            )
+            out.append(
+                f"{r.get('cluster', '') or '-':16} "
+                f"{m.get('namespace', '') or '-':12} "
+                f"{m.get('name', ''):24} {ready:8} "
+                f"{m.get('generation', 0):<10}"
+            )
+        return "\n".join(out)
+    return json.dumps(doc)
+
+
 def cmd_api_resources(cp) -> list[dict]:
     """The discovery surface (karmadactl api-resources): registry kinds
     plus the proxied workload plurals."""
@@ -1021,6 +1073,10 @@ def build_parser() -> tuple:
     g.add_argument("--namespace", default="default")
     g.add_argument("--name", default="")
     g.add_argument("--cluster", default="")
+    g.add_argument("-l", "--selector", default="",
+                   help="label selector: key=value[,key2=value2]")
+    g.add_argument("-o", "--output", default="json",
+                   choices=("json", "yaml", "name", "wide"))
 
     d = sub.add_parser("describe", help="aggregated describe")
     d.add_argument("gvk")
@@ -1149,24 +1205,32 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     with RemotePlane(args.bus, args.proxy, token=args.token) as rp:
         if args.command == "get":
+            labels = {}
+            if args.selector:
+                for part in args.selector.split(","):
+                    k, sep, v = part.partition("=")
+                    if not sep:
+                        print(json.dumps(
+                            {"error": f"bad selector segment {part!r}"}
+                        ))
+                        return 2
+                    labels[k.strip()] = v.strip()
             resp = cmd_get(
                 rp, args.gvk, args.namespace, args.name,
-                cluster=args.cluster or None,
+                cluster=args.cluster or None, labels=labels or None,
             )
             if resp.error:
                 print(json.dumps({"error": resp.error}))
                 return 1
-            if resp.obj is not None:
-                print(json.dumps(to_jsonable(resp.obj)))
-            else:
-                print(
-                    json.dumps(
-                        [
-                            {"cluster": c, "object": to_jsonable(o)}
-                            for c, o in resp.items
-                        ]
-                    )
-                )
+            doc = (
+                to_jsonable(resp.obj)
+                if resp.obj is not None
+                else [
+                    {"cluster": c, "object": to_jsonable(o)}
+                    for c, o in resp.items
+                ]
+            )
+            print(_format_get(doc, args.output, args.gvk))
         elif args.command == "describe":
             print(cmd_describe(rp, args.gvk, args.namespace, args.name))
         elif args.command == "logs":

@@ -116,14 +116,17 @@ def _pack21(stream, e_cap: int):
     latency. Each output byte draws from at most two adjacent fields
     (field width 21 > 8), so two static gathers + shifts produce it."""
     nb = (e_cap * 21 + 7) // 8
-    idx = np.arange(nb, dtype=np.int64) * 8
-    k1 = (idx // 21).astype(np.int32)
-    off = (idx - 21 * k1).astype(np.int32)
+    # index math as traced iota, NOT host numpy: numpy arrays close over
+    # the trace as dense HLO literals — three nb-length constants made the
+    # serialized module ~24 B per e_cap entry (123 MB at the 100k tier's
+    # 5M-entry cap, 1.3 GB at the 1M tier — HTTP 413 on the tunnel's
+    # remote-compile endpoint). As iota the module is ~0.1 MB at any cap.
+    idx = jnp.arange(nb, dtype=jnp.int64) * 8
+    k1 = (idx // 21).astype(jnp.int32)
+    off = (idx - 21 * k1).astype(jnp.int32)
     s_ext = jnp.concatenate([stream, jnp.zeros((1,), jnp.int32)])
-    lo = s_ext[jnp.asarray(k1)] >> jnp.asarray(off)
-    hi = s_ext[jnp.asarray(np.minimum(k1 + 1, e_cap))] << (
-        21 - jnp.asarray(off)
-    )
+    lo = s_ext[k1] >> off
+    hi = s_ext[jnp.minimum(k1 + 1, e_cap)] << (21 - off)
     return ((lo | hi) & 0xFF).astype(jnp.uint8)
 
 

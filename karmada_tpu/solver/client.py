@@ -78,12 +78,12 @@ class RemoteSolver:
 
     # -- snapshot channel --------------------------------------------------
 
-    def sync_clusters(self, clusters) -> int:
+    def sync_clusters(self, clusters, *, timeout: Optional[float] = None) -> int:
         self._version += 1
         req = pb.SyncClustersRequest(snapshot_version=self._version)
         for cl in clusters:
             req.clusters.append(cluster_to_state(cl))
-        resp = self._sync(req, timeout=self.timeout)
+        resp = self._sync(req, timeout=timeout or self.timeout)
         return resp.snapshot_version
 
     # -- engine seam -------------------------------------------------------
@@ -160,13 +160,28 @@ class HASolver:
     def active_target(self) -> int:
         return self._active
 
+    #: standby sync deadline: standby warmth is best-effort (a cold one
+    #: heals via FAILED_PRECONDITION re-sync), so a black-holed standby
+    #: must not stall the scheduler path for the full RPC timeout
+    STANDBY_SYNC_TIMEOUT = 5.0
+
     def sync_clusters(self, clusters) -> int:
         version = 0
         last_err: Optional[Exception] = None
         ok = 0
-        for s in self._solvers:
+        for i, s in enumerate(self._solvers):
             try:
-                version = max(version, s.sync_clusters(clusters))
+                version = max(
+                    version,
+                    s.sync_clusters(
+                        clusters,
+                        timeout=(
+                            None
+                            if i == self._active
+                            else self.STANDBY_SYNC_TIMEOUT
+                        ),
+                    ),
+                )
                 ok += 1
             except grpc.RpcError as e:  # standby down: its re-sync heals it
                 last_err = e

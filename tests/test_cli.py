@@ -316,3 +316,70 @@ echo "${COMPREPLY[@]}"
         assert "--filename" in out.stdout
         # zsh variant bootstraps bashcompinit
         assert "bashcompinit" in cli.cmd_completion("zsh")
+
+
+class TestGetSelectorsAndOutput:
+    def test_get_with_label_selector(self):
+        cp = cli.cmd_local_up(1)
+        d1 = new_deployment("blue", replicas=1)
+        d1.meta.labels["tier"] = "web"
+        d2 = new_deployment("green", replicas=1)
+        d2.meta.labels["tier"] = "db"
+        cp.store.apply(d1)
+        cp.store.apply(d2)
+        resp = cli.cmd_get(cp, "apps/v1/Deployment", "default",
+                           labels={"tier": "web"})
+        names = [o.meta.name for _, o in resp.items]
+        assert names == ["blue"], names
+
+    def test_output_formats(self):
+        doc = [{
+            "cluster": "m1",
+            "object": {
+                "api_version": "apps/v1", "kind": "Deployment",
+                "meta": {"name": "app", "namespace": "default",
+                         "generation": 3},
+                "spec": {"replicas": 4},
+                "status": {"readyReplicas": 4},
+            },
+        }]
+        assert cli._format_get(doc, "name", "apps/v1/Deployment") == (
+            "deployment/app"
+        )
+        wide = cli._format_get(doc, "wide", "apps/v1/Deployment")
+        assert "CLUSTER" in wide and "4/4" in wide and "m1" in wide
+        yml = cli._format_get(doc, "yaml", "apps/v1/Deployment")
+        assert "name: app" in yml
+        import json as _json
+
+        assert _json.loads(cli._format_get(doc, "json", "x")) == doc
+
+    def test_remote_cluster_list_filters_labels(self, monkeypatch):
+        """The cluster-routed list branch must honor -l even when the
+        member API behind the passthrough ignores labelSelector."""
+        import json as _json
+
+        from karmada_tpu.cli import _RemoteProxyChain
+        from karmada_tpu.search.proxy import ProxyRequest
+
+        chain = _RemoteProxyChain(store=None, proxy_target="x:1", token="t")
+        body = _json.dumps({"items": [
+            {"apiVersion": "apps/v1", "kind": "Deployment",
+             "metadata": {"name": "blue", "namespace": "default",
+                          "labels": {"tier": "web"}}},
+            {"apiVersion": "apps/v1", "kind": "Deployment",
+             "metadata": {"name": "green", "namespace": "default",
+                          "labels": {"tier": "db"}}},
+        ]})
+        monkeypatch.setattr(chain, "_http", lambda path, timeout=10.0: (200, body))
+        resp = chain.connect(ProxyRequest(
+            verb="list", gvk="apps/v1/Deployment", namespace="default",
+            cluster="m1", labels={"tier": "web"},
+        ))
+        assert [o.meta.name for _, o in resp.items] == ["blue"]
+        # no selector: both come back
+        resp = chain.connect(ProxyRequest(
+            verb="list", gvk="apps/v1/Deployment", namespace="default",
+            cluster="m1",
+        ))
+        assert len(resp.items) == 2
