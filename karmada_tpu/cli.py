@@ -163,7 +163,16 @@ class _RemoteProxyChain:
                 served_by="cluster", obj=resource_from_dict(json.loads(body))
             )
         if req.verb == "list":
-            status, body = self._http(path)
+            qs = ""
+            if req.labels:
+                # forward the selector so a member API that honors it
+                # prunes the list server-side (the client-side filter
+                # below stays the guarantee either way)
+                import urllib.parse as _q
+
+                sel = ",".join(f"{k}={v}" for k, v in req.labels.items())
+                qs = f"?labelSelector={_q.quote(sel)}"
+            status, body = self._http(path + qs)
             if status != 200:
                 return ProxyResponse(served_by="cluster", error=body)
             items = [
@@ -1207,6 +1216,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         if args.command == "get":
             labels = {}
             if args.selector:
+                if args.name:
+                    # kubectl rejects the combination outright: a selector
+                    # on a NAMED get is never applied by any backend
+                    print(json.dumps({
+                        "error": "--selector and --name are mutually "
+                        "exclusive (kubectl semantics)"
+                    }))
+                    return 2
                 for part in args.selector.split(","):
                     k, sep, v = part.partition("=")
                     if not sep:

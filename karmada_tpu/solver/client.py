@@ -83,7 +83,9 @@ class RemoteSolver:
         req = pb.SyncClustersRequest(snapshot_version=self._version)
         for cl in clusters:
             req.clusters.append(cluster_to_state(cl))
-        resp = self._sync(req, timeout=timeout or self.timeout)
+        resp = self._sync(
+            req, timeout=self.timeout if timeout is None else timeout
+        )
         return resp.snapshot_version
 
     # -- engine seam -------------------------------------------------------
@@ -166,29 +168,33 @@ class HASolver:
     STANDBY_SYNC_TIMEOUT = 5.0
 
     def sync_clusters(self, clusters) -> int:
-        version = 0
-        last_err: Optional[Exception] = None
-        ok = 0
-        for i, s in enumerate(self._solvers):
+        from concurrent.futures import ThreadPoolExecutor
+
+        results: list = [None] * len(self._solvers)
+        errs: list = [None] * len(self._solvers)
+
+        def one(i: int) -> None:
             try:
-                version = max(
-                    version,
-                    s.sync_clusters(
-                        clusters,
-                        timeout=(
-                            None
-                            if i == self._active
-                            else self.STANDBY_SYNC_TIMEOUT
-                        ),
+                results[i] = self._solvers[i].sync_clusters(
+                    clusters,
+                    timeout=(
+                        None
+                        if i == self._active
+                        else self.STANDBY_SYNC_TIMEOUT
                     ),
                 )
-                ok += 1
             except grpc.RpcError as e:  # standby down: its re-sync heals it
-                last_err = e
-        if not ok:
-            assert last_err is not None
-            raise last_err
-        return version
+                errs[i] = e
+
+        # concurrent fan-out: N black-holed standbys cost ONE standby
+        # deadline, not N of them stacked
+        with ThreadPoolExecutor(max_workers=len(self._solvers)) as pool:
+            list(pool.map(one, range(len(self._solvers))))
+        live = [v for v in results if v is not None]
+        if not live:
+            err = next(e for e in errs if e is not None)
+            raise err
+        return max(live)
 
     def schedule(self, problems: Sequence[BindingProblem]) -> list:
         n = len(self._solvers)
