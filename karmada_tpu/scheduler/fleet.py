@@ -149,11 +149,39 @@ def _entry_wire(stream, e_cap: int, pack21: bool):
 # --------------------------------------------------------------------------
 
 
+def _row_masks(cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
+               chunk: int, c: int):
+    """Per-chunk previous-assignment scatter + THE feasibility algebra,
+    shared by every kernel that needs it (_fleet_solve, _fleet_pass,
+    _fleet_bits) so the mask expression cannot drift between the solve
+    and the lazily-computed feasibility bitsets. Returns (prev, cp_rows,
+    feasible); callers apply their own sharding constraints."""
+    prev = (
+        jnp.zeros((chunk, c), jnp.int32)
+        .at[jnp.arange(chunk)[:, None], psc]
+        .add(pcc)
+    )
+    prev_mask = prev > 0
+    # plain [B]-index row gathers: re-probed on the current backend at
+    # U in {2..3500} x W in {5k, 15k} — compiles fine and runs at
+    # bandwidth (~0.12s/pass) vs 0.29s+ for the one-hot matmul at
+    # heterogeneous U (the matmul workaround predates this backend;
+    # ops.estimate.gather_profile_rows keeps it for other callers)
+    cp_rows = cp_table[cpc]  # [chunk, 3C]
+    feasible = (
+        (cp_rows[:, :c] != 0)  # affinity & spread-field
+        & ((gvk_table[gvc] != 0) | (prev_mask & incomplete_en[None, :]))
+        & ((cp_rows[:, c : 2 * c] != 0) | prev_mask)  # taints (leniency)
+        & vc[:, None]
+    )
+    return prev, cp_rows, feasible
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "chunk", "n_chunks", "k_out", "k_res", "e_cap", "wide", "fast",
-        "has_aggregated", "need_bits", "all_rows", "mesh", "shard_c",
+        "has_aggregated", "all_rows", "mesh", "shard_c",
         "pack21",
     ),
 )
@@ -177,7 +205,6 @@ def _fleet_solve(
     wide: bool,
     fast: Optional[tuple],
     has_aggregated: bool,
-    need_bits: bool,
     all_rows: bool,
     mesh=None,  # jax.sharding.Mesh with axes ("b", "c") — None = single-device
     shard_c: bool = False,  # also shard the cluster axis over mesh axis "c"
@@ -222,32 +249,16 @@ def _fleet_solve(
         )
         cpc, gvc, pfc = shard(cpc, "b"), shard(gvc, "b"), shard(pfc, "b")
         psc, pcc = shard(psc, "b", None), shard(pcc, "b", None)
-        prev = shard(
-            jnp.zeros((chunk, c), jnp.int32)
-            .at[jnp.arange(chunk)[:, None], psc]
-            .add(pcc),
-            "b", c_ax,
+        # mask composition — same algebra as TensorScheduler._pack_chunk,
+        # via the shared helper every feasibility consumer uses
+        prev, cp_rows, feasible = _row_masks(
+            cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
+            chunk, c,
         )
-        prev_mask = prev > 0
-        # plain [B]-index row gathers: re-probed on the current backend at
-        # U in {2..3500} x W in {5k, 15k} — compiles fine and runs at
-        # bandwidth (~0.12s/pass) vs 0.29s+ for the one-hot matmul at
-        # heterogeneous U (the matmul workaround predates this backend;
-        # ops.estimate.gather_profile_rows keeps it for other callers)
-        cp_rows = cp_table[cpc]  # [chunk, 3C]
-        aff_m = cp_rows[:, :c] != 0
-        taint_m = cp_rows[:, c : 2 * c] != 0
+        prev = shard(prev, "b", c_ax)
+        feasible = shard(feasible, "b", c_ax)
         static_w = cp_rows[:, 2 * c :]
-        gvk_m = gvk_table[gvc] != 0
         general = prof_table[pfc]
-        # mask composition — same algebra as TensorScheduler._pack_chunk
-        feasible = shard(
-            aff_m
-            & (gvk_m | (prev_mask & incomplete_en[None, :]))
-            & (taint_m | prev_mask)
-            & vc[:, None],
-            "b", c_ax,
-        )
         avail = shard(merge_estimates(repsc, (general,)), "b", c_ax)
         assignment, unsched = _divide_batch(
             stc, repsc, feasible, static_w, avail, prev, frc,
@@ -276,14 +287,7 @@ def _fleet_solve(
         srt = lax.sort(packed_full, is_stable=False)[:, :k_out]
         entries = shard(jnp.where(srt == 2**31 - 1, 0, srt), "b", None)
         has_cand = feasible.any(axis=1)
-        outs = (entries, n_placed.astype(jnp.int32), unsched, has_cand)
-        if need_bits:
-            pad = (-c) % 32
-            f = jnp.pad(feasible, ((0, 0), (0, pad)))
-            w32 = f.reshape(chunk, -1, 32).astype(jnp.uint32)
-            shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
-            outs = outs + ((w32 << shifts).sum(axis=-1, dtype=jnp.uint32),)
-        return carry, outs
+        return carry, (entries, n_placed.astype(jnp.int32), unsched, has_cand)
 
     _, outs = lax.scan(body, 0, jnp.arange(n_chunks))
     entries = outs[0].reshape(-1, k_out)  # [n_pad, k_out]
@@ -348,8 +352,7 @@ def _fleet_solve(
         flat = jnp.concatenate([total_u8, meta_u8, e_u8])
     else:
         flat = jnp.concatenate([total[None], meta, stream])
-    bits = outs[4].reshape(-1, outs[4].shape[-1]) if need_bits else None
-    return flat, bits, new_resident
+    return flat, new_resident
 
 
 # --------------------------------------------------------------------------
@@ -417,7 +420,7 @@ def d_round(v: int) -> int:
     jax.jit,
     static_argnames=(
         "chunk", "n_chunks", "wide", "fast", "has_aggregated",
-        "need_bits", "all_rows", "m_cap", "d_cap", "mesh", "shard_c",
+        "all_rows", "m_cap", "d_cap", "mesh", "shard_c",
     ),
     donate_argnames=("res_dense", "res_meta"),
 )
@@ -439,7 +442,6 @@ def _fleet_pass(
     wide: bool,
     fast: Optional[tuple],
     has_aggregated: bool,
-    need_bits: bool,
     all_rows: bool,
     m_cap: int,
     d_cap: int = 0,
@@ -450,8 +452,9 @@ def _fleet_pass(
     changed bitmask + changed metas — and, when ``d_cap`` > 0, the CELL
     deltas of changed rows (site<<9 | newcount+1, site-ascending per row)
     so a typical churn pass (a few cells move per changed row) needs no
-    phase B at all. Returns (flat_wire_u8, bits|None, changed_rowbuf,
-    new_res_dense, new_res_meta)."""
+    phase B at all. Returns (flat_wire_u8, changed_rowbuf, new_res_dense,
+    new_res_meta); feasibility bitsets are _fleet_bits' separate, lazily
+    dispatched job."""
     c = gvk_table.shape[1]
     cap = res_dense.shape[0]
     c_ax = "c" if (mesh is not None and shard_c) else None
@@ -489,26 +492,14 @@ def _fleet_pass(
         )
         cpc, gvc, pfc = shard(cpc, "b"), shard(gvc, "b"), shard(pfc, "b")
         psc, pcc = shard(psc, "b", None), shard(pcc, "b", None)
-        prev = shard(
-            jnp.zeros((chunk, c), jnp.int32)
-            .at[jnp.arange(chunk)[:, None], psc]
-            .add(pcc),
-            "b", c_ax,
+        prev, cp_rows, feasible = _row_masks(
+            cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
+            chunk, c,
         )
-        prev_mask = prev > 0
-        cp_rows = cp_table[cpc]  # [chunk, 3C]
-        aff_m = cp_rows[:, :c] != 0
-        taint_m = cp_rows[:, c : 2 * c] != 0
+        prev = shard(prev, "b", c_ax)
+        feasible = shard(feasible, "b", c_ax)
         static_w = cp_rows[:, 2 * c :]
-        gvk_m = gvk_table[gvc] != 0
         general = prof_table[pfc]
-        feasible = shard(
-            aff_m
-            & (gvk_m | (prev_mask & incomplete_en[None, :]))
-            & (taint_m | prev_mask)
-            & vc[:, None],
-            "b", c_ax,
-        )
         avail = shard(merge_estimates(repsc, (general,)), "b", c_ax)
         assignment, unsched = _divide_batch(
             stc, repsc, feasible, static_w, avail, prev, frc,
@@ -570,14 +561,7 @@ def _fleet_pass(
             )
         else:
             deltas = jnp.zeros((chunk, 0), jnp.int32)
-        outs = (changed, meta, dcount, deltas)
-        if need_bits:
-            pad = (-c) % 32
-            f = jnp.pad(feasible, ((0, 0), (0, pad)))
-            w32 = f.reshape(chunk, -1, 32).astype(jnp.uint32)
-            shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
-            outs = outs + ((w32 << shifts).sum(axis=-1, dtype=jnp.uint32),)
-        return (rd, rm), outs
+        return (rd, rm), (changed, meta, dcount, deltas)
 
     (res_dense, res_meta), outs = lax.scan(
         body, (res_dense, res_meta), jnp.arange(n_chunks)
@@ -640,8 +624,7 @@ def _fleet_pass(
         ).astype(jnp.uint8).reshape(-1)
         parts += [dtotal_u8, d_u8]
     flat = jnp.concatenate(parts)
-    bits = outs[4].reshape(-1, outs[4].shape[-1]) if need_bits else None
-    return flat, bits, rowbuf, res_dense, res_meta
+    return flat, rowbuf, res_dense, res_meta
 
 
 @partial(
@@ -711,6 +694,46 @@ def _decode_entry_wire(raw2, cap_used: int, byte_wire: bool, pack21: bool):
     return int(raw2[0]), raw2[1:]
 
 
+@partial(jax.jit, static_argnames=("chunk", "n_chunks"))
+def _fleet_bits(
+    cp_table, gvk_table, prof_table, incomplete_en, rows,
+    cp_idx, gvk_idx, prof_idx, replicas, strategy, fresh,
+    prev_sites, prev_counts, *, chunk: int, n_chunks: int,
+):
+    """Feasibility bitsets as their own lazily-DISPATCHED kernel: only
+    Duplicated / zero-replica rows ever read them (their result IS the
+    feasible set), and computing + packing them inside every solve pass
+    cost a Duplicated-bearing 100k storm ~0.6 s/pass whether or not any
+    result was examined. The mask expression is the solve kernels'
+    feasibility verbatim; inputs are the pass-time device arrays (JAX
+    arrays are immutable, so a batch holding these refs stays consistent
+    even after later passes rebuild the live tables)."""
+    c = gvk_table.shape[1]
+    valid = rows >= 0
+    r = jnp.maximum(rows, 0)
+    cp = cp_idx[r]
+    gv = gvk_idx[r]
+    ps = prev_sites[r]
+    pc = jnp.where(valid[:, None], prev_counts[r], 0)
+
+    def body(carry, i):
+        sl = lambda a: lax.dynamic_slice_in_dim(a, i * chunk, chunk, axis=0)
+        cpc, gvc, vc = sl(cp), sl(gv), sl(valid)
+        psc, pcc = sl(ps), sl(pc)
+        _, _, feasible = _row_masks(
+            cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
+            chunk, c,
+        )
+        pad = (-c) % 32
+        f = jnp.pad(feasible, ((0, 0), (0, pad)))
+        w32 = f.reshape(chunk, -1, 32).astype(jnp.uint32)
+        shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+        return carry, (w32 << shifts).sum(axis=-1, dtype=jnp.uint32)
+
+    _, out = lax.scan(body, 0, jnp.arange(n_chunks))
+    return out.reshape(-1, out.shape[-1])
+
+
 @jax.jit
 def _gather_meta(res_meta, rows):
     """Changed-meta fallback when phase A's tuned meta buffer overflows:
@@ -747,7 +770,10 @@ class _FleetBatch:
         self.names = names
         self.host_entries = host_entries  # int32[cap, k_out] (site<<8|count)
         self.rows = rows  # int32[n] table row per result position
-        self._bits_dev = bits_dev  # device uint32[n_pad, W] or None
+        # device uint32[n_pad, W], a zero-arg thunk that DISPATCHES the
+        # bitset kernel over this pass's captured inputs (the lazy form —
+        # only Duplicated/zero-replica results ever need it), or None
+        self._bits_dev = bits_dev
         self._bits_np = None
         self._table = table
         self._gen = gen
@@ -763,11 +789,15 @@ class _FleetBatch:
 
     def feasible_names(self, pos: int) -> tuple:
         if self._bits_np is None:
+            bits_dev = (
+                self._bits_dev() if callable(self._bits_dev)
+                else self._bits_dev
+            )
             # force little-endian word layout before the byte view so the
             # bit positions are host-endianness-independent (the entry
             # stream is decoded with shifts for the same reason)
             self._bits_np = np.ascontiguousarray(
-                np.asarray(self._bits_dev).astype("<u4", copy=False)
+                np.asarray(bits_dev).astype("<u4", copy=False)
             )
         row = self._bits_np[pos]
         idx = np.nonzero(
@@ -1528,6 +1558,23 @@ class FleetTable:
         k_out = min(max(1, c), _pow2(max(max_n, 1)))
         is_dup = strat_sel == S_DUPLICATED
         need_bits = bool(is_dup.any() or (reps_sel == 0).any())
+        bits_src = None
+        if need_bits:
+            # lazy feasibility bitsets: capture the PASS-TIME device
+            # arrays (immutable) so a consumer decoding a Duplicated
+            # result later gets this pass's sets even if the live tables
+            # have since been rebuilt. Dispatched at most once per batch,
+            # on first feasible/cluster access.
+            _tables = self._dev_tables
+            _state = self._dev_state
+            _rows = rows_dev
+            _chunk, _n_chunks = eff_chunk, n_chunks
+
+            def bits_src():
+                return _fleet_bits(
+                    *_tables, _rows, *_state, chunk=_chunk,
+                    n_chunks=_n_chunks,
+                )
         safe = int(
             np.minimum(np.where(is_dup, 0, reps_sel), k_out).sum()
         )
@@ -1551,7 +1598,7 @@ class FleetTable:
             problems=problems, rows_np=rows_np, rows_dev=rows_dev, tmr=tmr,
             n=n, n_pad=n_pad, eff_chunk=eff_chunk, n_chunks=n_chunks,
             is_all=is_all, c=c, k_out=k_out, wide=wide, fast=fast,
-            has_agg=has_agg, need_bits=need_bits, is_dup=is_dup, safe=safe,
+            has_agg=has_agg, bits_src=bits_src, is_dup=is_dup, safe=safe,
             mesh=mesh, shard_c=shard_c, byte_wire=c <= 0xFFFF,
             # 21-bit entry packing: 2.625 B/entry when the site id fits
             # 13 bits — the churn wire is tunnel-bandwidth-bound
@@ -1563,7 +1610,7 @@ class FleetTable:
 
     def _solve_legacy(
         self, *, problems, rows_np, rows_dev, tmr, n, n_pad, eff_chunk,
-        n_chunks, is_all, c, k_out, wide, fast, has_agg, need_bits, is_dup,
+        n_chunks, is_all, c, k_out, wide, fast, has_agg, bits_src, is_dup,
         safe, mesh, shard_c, byte_wire, pack21, t0,
     ) -> "_FleetResultList":
         """Single-dispatch entry-resident solve — the path for tables whose
@@ -1622,7 +1669,6 @@ class FleetTable:
                 wide=wide,
                 fast=fast,
                 has_aggregated=has_agg,
-                need_bits=need_bits,
                 all_rows=is_all,
                 mesh=mesh,
                 shard_c=shard_c,
@@ -1647,7 +1693,7 @@ class FleetTable:
 
         tmr["prep"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        flat, bits, resident = solve(rows_dev, e_cap)
+        flat, resident = solve(rows_dev, e_cap)
         tmr["dispatch"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
         raw = np.asarray(flat)
@@ -1655,7 +1701,7 @@ class FleetTable:
         total, meta, stream = decode(raw, e_cap)
         if total > e_cap:  # overflow: rerun at the safe bound (the resident
             # base is the PRE-pass array either way — adopt the rerun's)
-            flat, bits, resident = solve(rows_dev, cap_round(safe))
+            flat, resident = solve(rows_dev, cap_round(safe))
             raw = np.asarray(flat)
             fetched_bytes += raw.nbytes
             total, meta, stream = decode(raw, cap_round(safe))
@@ -1684,7 +1730,7 @@ class FleetTable:
         names = self.engine.snapshot.names
         batches = [
             _FleetBatch(
-                names, self._host_entries, rows_np, bits,
+                names, self._host_entries, rows_np, bits_src,
                 self, self._result_gen,
             )
         ]
@@ -1737,7 +1783,7 @@ class FleetTable:
 
     def _solve_dense(
         self, *, problems, rows_np, rows_dev, tmr, n, n_pad, eff_chunk,
-        n_chunks, is_all, c, k_out, wide, fast, has_agg, need_bits, is_dup,
+        n_chunks, is_all, c, k_out, wide, fast, has_agg, bits_src, is_dup,
         safe, mesh, shard_c, byte_wire, pack21, t0,
     ) -> "_FleetResultList":
         """Two-phase solve: _fleet_pass (divide + dense diff, ~13 KB wire
@@ -1825,7 +1871,7 @@ class FleetTable:
         cap_round = _cap_round
         tmr["prep"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        flat, bits, rowbuf, rd, rm = _fleet_pass(
+        flat, rowbuf, rd, rm = _fleet_pass(
             *self._dev_tables,
             rows_dev,
             *self._dev_state,
@@ -1836,7 +1882,6 @@ class FleetTable:
             wide=wide,
             fast=fast,
             has_aggregated=has_agg,
-            need_bits=need_bits,
             all_rows=is_all,
             m_cap=m_cap,
             d_cap=d_cap,
@@ -1994,7 +2039,7 @@ class FleetTable:
         names = self.engine.snapshot.names
         batches = [
             _FleetBatch(
-                names, self._host_entries, rows_np, bits,
+                names, self._host_entries, rows_np, bits_src,
                 self, self._result_gen,
             )
         ]
