@@ -632,7 +632,11 @@ def run_engine_north_star(args) -> dict:
         t0 = time.perf_counter()
         h_engine.schedule(h_problems)
         print(f"# hetero warm pass: {time.perf_counter() - t0:.1f}s", file=sys.stderr)
-        h_engine.schedule(h_problems)  # stabilize (entry-cap settles)
+        # THREE stabilize passes: cap shrink fires after up to 3 votes and
+        # every cap change is a fresh trace — it must land here, not in a
+        # timed pass
+        for _ in range(3):
+            h_engine.schedule(h_problems)
         h_times = []
         for rep in range(3):
             t0 = time.perf_counter()
@@ -683,7 +687,7 @@ def run_engine_north_star(args) -> dict:
         print(f"# hetero-9000 warm pass: {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
         table_obj = k_engine._fleet
-        for _ in range(3):  # caps settle (shrink = 2 votes + 1 observe)
+        for _ in range(4):  # caps settle (shrink = up to 3 votes + observe)
             k_engine.schedule(k_problems)
         k_times = []
         for rep in range(2):
@@ -751,13 +755,13 @@ def run_engine_north_star(args) -> dict:
             m_engine.schedule(m_problems)
         print(f"# 1M warm pass: {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
-        for tag in ("tune", "stabilize", "settle"):
+        for tag in ("tune", "stabilize", "settle", "cool"):
             t0 = time.perf_counter()
             m_engine.schedule(m_problems)
             print(f"# 1M {tag} pass: {time.perf_counter() - t0:.1f}s",
                   file=sys.stderr)
         m_times = []
-        for rep in range(2):
+        for rep in range(3):
             t0 = time.perf_counter()
             m_res = m_engine.schedule(m_problems)
             m_times.append(time.perf_counter() - t0)
