@@ -30,17 +30,22 @@ class MetricSpec:
     - type "Pods": a custom per-pod metric (custom.metrics.k8s.io) named by
       metric_name, optionally filtered by metric_selector, compared against
       target_average_value per pod;
+    - type "Object": a metric describing a single cluster object
+      (described_object), compared against target_value (Value) or
+      target_average_value (AverageValue per pod) —
+      federatedhpa_controller.go computeStatusForObjectMetric;
     - type "External": an external series (external.metrics.k8s.io) named
       by metric_name + metric_selector, compared against target_value
       (total) or target_average_value (per pod)."""
 
-    type: str = "Resource"  # Resource | Pods | External
+    type: str = "Resource"  # Resource | Pods | Object | External
     resource_name: str = "cpu"
     target_average_utilization: Optional[int] = None
     target_average_value: Optional[float] = None
     metric_name: str = ""
     metric_selector: Optional[dict] = None  # label selector (match_labels)
     target_value: Optional[float] = None
+    described_object: Optional[ScaleTargetRef] = None  # Object flavor
 
 
 @dataclass

@@ -27,6 +27,19 @@ from ..utils.cron import cron_matches
 from .detector import binding_name
 
 
+def _resource_plural(kind: str) -> str:
+    """Kube-style lowercase plural resource name for a kind (the custom
+    metrics API keys series by resource, e.g. Ingress -> ingresses)."""
+    k = kind.lower()
+    if not k:
+        return k
+    if k.endswith(("s", "x", "z", "ch", "sh")):
+        return k + "es"
+    if k.endswith("y") and k[-2:-1] not in "aeiou":
+        return k[:-1] + "ies"
+    return k + "s"
+
+
 class FederatedHPAController:
     def __init__(
         self, store: Store, runtime: Runtime, members, clock=time.time
@@ -86,6 +99,40 @@ class FederatedHPAController:
             return None
         return total_util / total_pods, ready, total_pods
 
+    def _pod_list(
+        self, hpa: FederatedHPA, clusters: list[str]
+    ) -> tuple[list, bool]:
+        """The federated podList (federatedhpa_controller.go:540 — member
+        pod informers merged): each member's per-pod samples for the target
+        workload, as PodSample records. Also reports whether EVERY reachable
+        target cluster published per-pod data — a partial list must not
+        silently stand in for the federation (a member still on aggregate
+        samples would have its load ignored)."""
+        from .replica_calculator import PodSample
+
+        target = hpa.spec.scale_target_ref
+        workload_key = (
+            f"{hpa.meta.namespace}/{target.name}"
+            if hpa.meta.namespace
+            else target.name
+        )
+        pods = []
+        complete = False
+        for name in clusters:
+            member = self.members.get(name)
+            if member is None or not member.reachable:
+                continue
+            samples = member.workload_pods.get(workload_key)
+            if samples is None:
+                # a reachable target cluster without per-pod data: the
+                # federated list would be partial — callers fall back to
+                # the aggregate path
+                return [], False
+            complete = True
+            for d in samples:
+                pods.append(PodSample(cluster=name, **d))
+        return pods, complete and bool(pods)
+
     # -- reconcile ---------------------------------------------------------
 
     def _reconcile(self, key: str) -> Optional[str]:
@@ -117,56 +164,157 @@ class FederatedHPAController:
             self._update_status(hpa, current, current)
             return DONE
 
-        # desired = max over metrics of ceil(current * currentMetric /
-        # targetMetric), calibrated by ready ratio (replica_calculator.go);
-        # no computable metric keeps the current size
+        # desired = max over metrics of each flavor's calculator proposal
+        # (replica_calculator.go:62-314 via controllers.replica_calculator);
+        # no computable metric keeps the current size. Per-pod sets come
+        # from the members' workload_pods (the federated podList); workloads
+        # without per-pod detail fall back to the aggregate utilization
+        # sample. An uncomputable metric (MetricsError) is skipped like the
+        # reference's invalid-metric tally.
+        from .replica_calculator import (
+            MetricsError, PodSample, ReplicaCalculator,
+        )
+
+        calc = ReplicaCalculator()
+        pods, pods_complete = self._pod_list(hpa, clusters)
+        # calibration = materialized replicas / template replicas
+        # (federatedhpa_controller.go:601 — member scale specs vs template)
+        assigned = (
+            sum(int(tc.replicas or 0) for tc in rb.spec.clusters)
+            if rb is not None
+            else 0
+        )
+        calibration = assigned / current if assigned and current else 1.0
+
+        def _milli(v: float) -> int:
+            return max(1, int(round(float(v) * 1000)))
+
         proposals = []
         for metric in hpa.spec.metrics or []:
             mtype = getattr(metric, "type", "Resource") or "Resource"
-            if mtype == "Resource" and metric.target_average_utilization:
-                if metrics is None:
-                    continue
-                avg_util, ready, total = metrics
-                calibration = ready / total if total else 1.0
-                raw = current * (avg_util / metric.target_average_utilization)
-                proposals.append(math.ceil(raw * calibration))
-            elif mtype == "Pods" and metric.target_average_value:
-                # custom per-pod metric (custom.metrics.k8s.io): usage
-                # ratio = sum(values) / (target * currentReplicas)
-                # (replica_calculator.go GetMetricReplicas semantics)
-                samples = [
-                    s
-                    for s in self._adapter().custom.get_metric_by_selector(
-                        "pods",
+            try:
+                if mtype == "Resource" and metric.target_average_utilization:
+                    done = False
+                    if pods_complete:
+                        try:
+                            n, _, _ = calc.get_resource_replicas(
+                                current, metric.target_average_utilization,
+                                metric.resource_name or "cpu", pods,
+                                calibration,
+                            )
+                            proposals.append(n)
+                            done = True
+                        except MetricsError:
+                            # per-pod data uncomputable (e.g. missing
+                            # requests): the aggregate sample still drives
+                            # scaling rather than freezing it
+                            done = False
+                    if not done and metrics is not None:
+                        # aggregate fallback (no complete per-pod detail):
+                        # ready-ratio calibration over the merged sample
+                        avg_util, ready, total = metrics
+                        agg_cal = ready / total if total else 1.0
+                        raw = current * (
+                            avg_util / metric.target_average_utilization
+                        )
+                        proposals.append(math.ceil(raw * agg_cal))
+                elif mtype == "Resource" and metric.target_average_value:
+                    if pods_complete:
+                        n, _ = calc.get_raw_resource_replicas(
+                            current, _milli(metric.target_average_value),
+                            metric.resource_name or "cpu", pods, calibration,
+                        )
+                        proposals.append(n)
+                elif mtype == "Pods" and metric.target_average_value:
+                    # custom per-pod metric (custom.metrics.k8s.io): the
+                    # sample set joins the federated pod list so missing/
+                    # unready pods get the reference's backfill treatment
+                    samples = [
+                        s
+                        for s in self._adapter().custom.get_metric_by_selector(
+                            "pods",
+                            hpa.meta.namespace,
+                            metric.metric_name,
+                            metric_selector=metric.metric_selector,
+                        )
+                        if s.cluster in clusters
+                    ]
+                    if not samples:
+                        continue
+                    msamples = {
+                        s.object_name: _milli(s.value) for s in samples
+                    }
+                    plist = pods if pods_complete else [
+                        PodSample(name=s.object_name, cluster=s.cluster)
+                        for s in samples
+                    ]
+                    n, _ = calc.get_metric_replicas(
+                        current, _milli(metric.target_average_value),
+                        msamples, plist, calibration,
+                    )
+                    proposals.append(n)
+                elif mtype == "Object" and (
+                    metric.target_value or metric.target_average_value
+                ):
+                    obj = metric.described_object
+                    if obj is None:
+                        continue
+                    samples = [
+                        s
+                        for s in self._adapter().custom.get_metric_by_name(
+                            _resource_plural(obj.kind or ""),
+                            hpa.meta.namespace,
+                            obj.name,
+                            metric.metric_name,
+                            metric_selector=metric.metric_selector,
+                        )
+                        if s.cluster in clusters
+                    ]
+                    if not samples:
+                        continue
+                    usage = sum(_milli(s.value) for s in samples)
+                    if metric.target_value:
+                        n, _ = calc.get_object_metric_replicas(
+                            current, _milli(metric.target_value), usage,
+                            pods if pods_complete else [
+                                PodSample(name=f"p{i}")
+                                for i in range(max(current, 1))
+                            ],
+                            calibration,
+                        )
+                    else:
+                        status_replicas = (
+                            len(pods) if pods_complete else current
+                        )
+                        n, _ = calc.get_object_per_pod_metric_replicas(
+                            max(status_replicas, 1),
+                            _milli(metric.target_average_value), usage,
+                            calibration,
+                        )
+                    proposals.append(n)
+                elif mtype == "External":
+                    samples = self._adapter().external.get_external_metric(
                         hpa.meta.namespace,
                         metric.metric_name,
-                        metric_selector=metric.metric_selector,
+                        selector=metric.metric_selector,
                     )
-                    if s.cluster in clusters
-                ]
-                if not samples:
-                    continue
-                usage = sum(s.value for s in samples)
-                proposals.append(
-                    math.ceil(usage / metric.target_average_value)
-                )
-            elif mtype == "External":
-                samples = self._adapter().external.get_external_metric(
-                    hpa.meta.namespace,
-                    metric.metric_name,
-                    selector=metric.metric_selector,
-                )
-                if not samples:
-                    continue
-                usage = sum(s.value for s in samples)
-                if metric.target_value:
-                    proposals.append(math.ceil(usage / metric.target_value))
-                elif metric.target_average_value:
-                    # GetExternalPerPodMetricReplicas: per-pod average
-                    proposals.append(
-                        math.ceil(usage / metric.target_average_value)
-                    )
-        if not proposals and metrics is None:
+                    if not samples:
+                        continue
+                    usage = sum(s.value for s in samples)
+                    if metric.target_value:
+                        proposals.append(
+                            math.ceil(usage / metric.target_value)
+                        )
+                    elif metric.target_average_value:
+                        # GetExternalPerPodMetricReplicas: per-pod average
+                        proposals.append(
+                            math.ceil(usage / metric.target_average_value)
+                        )
+            except MetricsError:
+                # reference: tally as invalid metric and keep going — the
+                # remaining metrics still drive scaling
+                continue
+        if not proposals and metrics is None and not pods_complete:
             self._update_status(hpa, current, current)
             return DONE
         self._last_eval[key] = now

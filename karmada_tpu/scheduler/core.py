@@ -311,6 +311,17 @@ class TensorScheduler:
         self._snapshot_gen += 1
         return True
 
+    @property
+    def last_pass_new_trace(self) -> bool:
+        """True when the last schedule() pass dispatched at least one XLA
+        trace signature the fleet table had not dispatched before (a compile
+        ran, or — on the async tunnel — is still queued). Bench warmup loops
+        poll this until a pass is compile-stable before opening a timed
+        window."""
+        return bool(
+            self._fleet is not None and self._fleet.new_trace_last_pass
+        )
+
     def schedule(self, problems: Sequence[BindingProblem]) -> list[ScheduleResult]:
         import time as _time
 

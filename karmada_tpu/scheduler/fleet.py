@@ -1031,6 +1031,21 @@ class FleetTable:
         self._result_gen = 0
         # per-phase wall times of the last pass (bench breakdown surface)
         self.last_breakdown: dict[str, float] = {}
+        # trace-signature ledger: every distinct static-arg combination we
+        # dispatch is one XLA trace — and on the async tunnel a fresh trace's
+        # remote compile does NOT block at dispatch; it surfaces at the next
+        # blocking fetch. Warmup loops poll ``new_trace_last_pass`` until a
+        # pass introduces no unseen signature, so timed windows only ever run
+        # already-compiled traces.
+        self._seen_traces: set = set()
+        self.new_trace_last_pass = False
+
+    def _mark_trace(self, *key) -> None:
+        """Record a dispatched trace signature; flips the per-pass
+        new-trace flag when the signature is unseen (a compile will run)."""
+        if key not in self._seen_traces:
+            self._seen_traces.add(key)
+            self.new_trace_last_pass = True
 
     # -- rows --------------------------------------------------------------
 
@@ -1472,9 +1487,19 @@ class FleetTable:
                     jnp.asarray(self._st[k]) for k in _STATE_FIELDS
                 )
             else:
-                vals = tuple(self._st[k][rows] for k in _STATE_FIELDS)
+                # pow2-pad the scatter (repeating the first row: duplicate
+                # writes of identical values are idempotent) so distinct
+                # dirty-row counts yield log-many traces, and ledger the
+                # signature — an unmarked compile here would break the
+                # warm-loop contract new_trace_last_pass carries
+                pad = _pow2(len(rows))
+                rows_p = np.concatenate(
+                    [rows, np.full(pad - len(rows), rows[0], np.int64)]
+                )
+                vals = tuple(self._st[k][rows_p] for k in _STATE_FIELDS)
+                self._mark_trace("S", self.cap, pad)
                 self._dev_state = _scatter_rows(
-                    self._dev_state, jnp.asarray(rows), vals
+                    self._dev_state, jnp.asarray(rows_p), vals
                 )
             self._dirty.clear()
 
@@ -1486,6 +1511,7 @@ class FleetTable:
         tmr: dict[str, float] = {}
         t0 = _time.perf_counter()
         self._pass += 1
+        self.new_trace_last_pass = False
         ru = self._reuse
         if ru is not None and ru[0] is problems and ru[1] is compiled:
             # same batch objects as last pass: rows are current (upsert
@@ -1571,6 +1597,7 @@ class FleetTable:
             _chunk, _n_chunks = eff_chunk, n_chunks
 
             def bits_src():
+                self._mark_trace("B", _chunk, _n_chunks, len(_tables))
                 return _fleet_bits(
                     *_tables, _rows, *_state, chunk=_chunk,
                     n_chunks=_n_chunks,
@@ -1656,6 +1683,11 @@ class FleetTable:
         self._e_cap_cur = e_cap
 
         def solve(rows_slice, cap):
+            self._mark_trace(
+                "L", self.cap, c, self._dev_tables[0].shape, eff_chunk,
+                n_chunks, k_out, k_res, cap, wide, fast, has_agg, is_all,
+                mesh is not None, shard_c, pack21 and byte_wire,
+            )
             return _fleet_solve(
                 *self._dev_tables,
                 rows_slice,
@@ -1758,6 +1790,11 @@ class FleetTable:
         rows_b[: len(rows)] = rows
         e_cap = _cap_round(max(e_want, 1))
         t_b = _time.perf_counter()
+        self._mark_trace(
+            "E", self.cap, self._res_dense.shape[1], b_chunk,
+            m_pad_b // b_chunk, k_out, e_cap, byte_wire,
+            pack21 and byte_wire,
+        )
         flat2 = _fleet_entries(
             self._res_dense,
             jnp.asarray(rows_b),
@@ -1871,6 +1908,11 @@ class FleetTable:
         cap_round = _cap_round
         tmr["prep"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
+        self._mark_trace(
+            "A", self.cap, c, self._dev_tables[0].shape, eff_chunk,
+            n_chunks, wide, fast, has_agg, is_all, m_cap, d_cap,
+            mesh is not None, shard_c,
+        )
         flat, rowbuf, rd, rm = _fleet_pass(
             *self._dev_tables,
             rows_dev,
@@ -1898,9 +1940,28 @@ class FleetTable:
         # it (the full-row sort + wire would be pure waste there).
         spec_flat = None
         spec_cap = 0
-        if self._last_changed and self._last_total and not self._delta_live:
+        spec_used = False
+        # skip the speculation when the cell-delta wire is expected to carry
+        # this pass (cap already grown past the last observed demand): the
+        # full-row sort + wire would be pure waste — and on the async tunnel
+        # an unfetched speculative dispatch is WORSE than waste: its compile
+        # + execution stay queued on device and surface in the NEXT pass's
+        # blocking fetch (round 4's recorded 136s 1M churn onset was exactly
+        # the warm pass's unused speculative _fleet_entries compile draining
+        # into timed pass 0).
+        delta_expected = bool(
+            d_cap and self._last_dtotal and self._last_dtotal <= d_cap
+        )
+        if (
+            self._last_changed and self._last_total
+            and not self._delta_live and not delta_expected
+        ):
             spec_cap = cap_round(self._last_total * 9 // 8)
             b_chunk = min(eff_chunk, m_cap)
+            self._mark_trace(
+                "E", self.cap, c, b_chunk, m_cap // b_chunk, k_out,
+                spec_cap, byte_wire, pack21 and byte_wire,
+            )
             spec_flat = _fleet_entries(
                 self._res_dense,
                 rowbuf,
@@ -1942,6 +2003,7 @@ class FleetTable:
             m_pad_f = max(4096, _pow2(total))
             rows_f = np.full(m_pad_f, -1, np.int32)
             rows_f[:total] = ch_rows
+            self._mark_trace("G", self.cap, m_pad_f)
             mraw = np.asarray(
                 _gather_meta(self._res_meta, jnp.asarray(rows_f))
             )
@@ -2002,6 +2064,7 @@ class FleetTable:
                     and e_total <= spec_cap
                 ):
                     # the speculative B covers exactly the changed rows
+                    spec_used = True
                     t_b = _time.perf_counter()
                     raw2 = np.asarray(spec_flat)
                     fetched_bytes += raw2.nbytes
@@ -2023,6 +2086,13 @@ class FleetTable:
                     )
         else:
             self._last_total = 0
+        if spec_flat is not None and not spec_used:
+            # speculation mispredicted (the pass folded another way): block
+            # it out NOW and account the cost in this pass — an unfetched
+            # dispatch would otherwise drain into the next pass's fetch
+            t_b = _time.perf_counter()
+            spec_flat.block_until_ready()
+            tmr["spec_drain"] = _time.perf_counter() - t_b
         self._delta_live = use_delta
         if d_cap:
             self._last_dtotal = int(dtotal)

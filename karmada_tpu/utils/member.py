@@ -74,6 +74,12 @@ class MemberCluster:
         # workload-key -> metric sample {"pods", "ready_pods",
         # "cpu_utilization"} (metrics.k8s.io stand-in for the metrics adapter)
         self.pod_metrics: dict[str, dict] = {}
+        # workload-key -> PER-POD sample set (the federated podList the
+        # FederatedHPA replica calculator groups by readiness; field names
+        # are controllers.replica_calculator.PodSample kwargs — request/
+        # value in milli-units): [{"name", "phase", "ready", "request",
+        # "value", ...}, ...]
+        self.workload_pods: dict[str, list[dict]] = {}
         # metrics.k8s.io per-object surfaces (metricsadapter ResourceMetrics):
         # "namespace/pod" -> {"cpu": milli, "memory": bytes, "labels": {...}}
         self.pod_metrics_detail: dict[str, dict] = {}

@@ -93,6 +93,77 @@ class TestFederatedHPA:
         template = cp.store.get("Resource", "default/web")
         assert template.spec["replicas"] == 1
 
+    def test_per_pod_resource_metrics_scale_up(self):
+        # per-pod sets (workload_pods) route through the full replica
+        # calculator: 4 pods at 150m vs 100m request, 50% target -> 12
+        clock = [0.0]
+        cp = make_plane(lambda: clock[0])
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        for tc in rb.spec.clusters:
+            cp.members.get(tc.name).workload_pods["default/web"] = [
+                {"name": f"{tc.name}-p{i}", "request": 100, "value": 150}
+                for i in range(tc.replicas)
+            ]
+        cp.store.apply(make_hpa(target_util=50, max_r=20))
+        cp.settle()
+        # calibration = assigned/current = 1; ratio 3.0 over 4 ready pods
+        assert cp.store.get("Resource", "default/web").spec["replicas"] == 12
+
+    def test_per_pod_unready_holds_scale_up(self):
+        # an unready pod backfills 0 on scale-up; ratio falls back inside
+        # the tolerance band -> current size holds (calculator semantics
+        # the aggregate path cannot express)
+        clock = [0.0]
+        cp = make_plane(lambda: clock[0])
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        pods_left = 4
+        for tc in rb.spec.clusters:
+            samples = []
+            for i in range(tc.replicas):
+                if pods_left == 1:
+                    samples.append({
+                        "name": f"{tc.name}-p{i}", "request": 100,
+                        "ready": False,
+                    })
+                else:
+                    samples.append({
+                        "name": f"{tc.name}-p{i}", "request": 100,
+                        "value": 150,
+                    })
+                pods_left -= 1
+            cp.members.get(tc.name).workload_pods["default/web"] = samples
+        cp.store.apply(make_hpa(target_util=100, max_r=20))
+        cp.settle()
+        # 3 ready at 150% of a 100% target with one unready backfilled to
+        # 0 -> new ratio (450/400)=1.125 -> ceil(1.125*4)=5
+        assert cp.store.get("Resource", "default/web").spec["replicas"] == 5
+
+    def test_object_metric_scale(self):
+        clock = [0.0]
+        cp = make_plane(lambda: clock[0])
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        first = rb.spec.clusters[0].name
+        cp.members.get(first).custom_metric_series.append({
+            "resource": "services", "namespaced": True,
+            "namespace": "default", "object": "web-svc",
+            "metric": "queue_length", "value": 30.0,
+        })
+        hpa = make_hpa(max_r=20)
+        hpa.spec.metrics = [
+            MetricSpec(
+                type="Object", metric_name="queue_length",
+                target_value=10.0,
+                described_object=ScaleTargetRef(
+                    kind="Service", name="web-svc"
+                ),
+            )
+        ]
+        cp.store.apply(hpa)
+        cp.settle()
+        # usage 30 / target 10 = ratio 3 over current 4 (no per-pod sets:
+        # the synthesized ready list has len=current) -> 12
+        assert cp.store.get("Resource", "default/web").spec["replicas"] == 12
+
     def test_max_replicas_clamp(self):
         clock = [0.0]
         cp = make_plane(lambda: clock[0])

@@ -1,0 +1,306 @@
+"""Table-driven FederatedHPA replica-calculator tests.
+
+Mirrors the reference's calculator tables case by case
+(pkg/controllers/federatedhpa/replica_calculator_test.go:114-281 resource,
+:284-455 raw resource, :457-628 metric, :630-815 plain-metric grouping,
+:829-1010 object / object-per-pod; metrics/utilization_test.go:67-140
+ratio helpers) over the PodSample model.
+"""
+
+import pytest
+
+from karmada_tpu.controllers.replica_calculator import (
+    MetricsError,
+    PodSample,
+    ReplicaCalculator,
+    group_pods,
+    metric_usage_ratio,
+    resource_utilization_ratio,
+)
+
+
+def pod(name, request=100, value=None, **kw):
+    return PodSample(name=name, request=request, value=value, **kw)
+
+
+def unready_pod(name, request=100, value=None):
+    # createUnreadyPod (replica_calculator_test.go:818-827): Ready=False,
+    # transition at pod start -> never been ready within the initial delay
+    return PodSample(
+        name=name, request=request, value=value, ready=False,
+        start_age=1e9, transition_age=1e9,
+    )
+
+
+CALC = ReplicaCalculator(tolerance=0.1)
+
+
+# -- GetResourceReplicas (replica_calculator_test.go:114-281) --------------
+
+RESOURCE_CASES = [
+    # (name, current, target_util, pods, want_replicas, want_util, want_raw)
+    ("scale up", 2, 50,
+     [pod("pod1", 100, 150), pod("pod2", 100, 150)], 6, 150, 150),
+    ("scale down", 4, 50,
+     [pod(f"pod{i}", 100, 50) for i in range(1, 5)], 4, 50, 50),
+    ("no change within tolerance", 2, 50,
+     [pod("pod1", 100, 52), pod("pod2", 100, 48)], 2, 50, 50),
+    ("scale up with unready pods", 3, 50,
+     [pod("pod1", 100, 150), pod("pod2", 100, 150),
+      unready_pod("pod3", 100)], 6, 150, 150),
+]
+
+
+@pytest.mark.parametrize(
+    "name,current,target,pods,want_n,want_util,want_raw", RESOURCE_CASES
+)
+def test_get_resource_replicas(name, current, target, pods, want_n,
+                               want_util, want_raw):
+    n, util, raw = CALC.get_resource_replicas(current, target, "cpu", pods)
+    assert (n, util, raw) == (want_n, want_util, want_raw), name
+
+
+def test_get_resource_replicas_calibration():
+    # "Scale with calibration": calibration 0.5 doubles the proposal
+    pods = [pod("pod1", 100, 150), pod("pod2", 100, 150)]
+    n, util, raw = CALC.get_resource_replicas(2, 50, "cpu", pods, 0.5)
+    assert (n, util, raw) == (12, 150, 150)
+
+
+def test_get_resource_replicas_errors():
+    with pytest.raises(MetricsError):
+        CALC.get_resource_replicas(2, 50, "cpu", [])
+    with pytest.raises(MetricsError):  # no metrics for any pod
+        CALC.get_resource_replicas(
+            2, 50, "cpu", [pod("pod1", 100), pod("pod2", 100)]
+        )
+
+
+def test_get_resource_replicas_missing_request():
+    with pytest.raises(MetricsError):
+        CALC.get_resource_replicas(
+            2, 50, "cpu",
+            [pod("pod1", 100, 150), pod("pod2", None, 150)],
+        )
+
+
+# -- GetRawResourceReplicas (:284-455) -------------------------------------
+
+RAW_CASES = [
+    ("scale up", 2, 100,
+     [pod("pod1", 100, 150), pod("pod2", 100, 150)], 1.0, 3, 150),
+    ("scale down", 4, 100,
+     [pod(f"pod{i}", 100, 50) for i in range(1, 5)], 1.0, 2, 50),
+    ("no change", 2, 100,
+     [pod("pod1", 100, 100), pod("pod2", 100, 100)], 1.0, 2, 100),
+    ("calibration", 2, 100,
+     [pod("pod1", 100, 150), pod("pod2", 100, 150)], 0.8, 4, 150),
+]
+
+
+@pytest.mark.parametrize(
+    "name,current,target,pods,cal,want_n,want_usage", RAW_CASES
+)
+def test_get_raw_resource_replicas(name, current, target, pods, cal,
+                                   want_n, want_usage):
+    n, usage = CALC.get_raw_resource_replicas(
+        current, target, "cpu", pods, cal
+    )
+    assert (n, usage) == (want_n, want_usage), name
+
+
+# -- GetMetricReplicas (:457-628) ------------------------------------------
+
+METRIC_CASES = [
+    ("scale up", 2, 10, {"pod1": 15, "pod2": 15},
+     [pod("pod1"), pod("pod2")], 1.0, 3, 15),
+    ("scale down", 4, 20, {f"pod{i}": 10 for i in range(1, 5)},
+     [pod(f"pod{i}") for i in range(1, 5)], 1.0, 2, 10),
+    ("no change", 2, 15, {"pod1": 15, "pod2": 15},
+     [pod("pod1"), pod("pod2")], 1.0, 2, 15),
+    ("calibration", 2, 10, {"pod1": 15, "pod2": 15},
+     [pod("pod1"), pod("pod2")], 0.8, 4, 15),
+]
+
+
+@pytest.mark.parametrize(
+    "name,current,target,metrics,pods,cal,want_n,want_usage", METRIC_CASES
+)
+def test_get_metric_replicas(name, current, target, metrics, pods, cal,
+                             want_n, want_usage):
+    n, usage = CALC.get_metric_replicas(current, target, metrics, pods, cal)
+    assert (n, usage) == (want_n, want_usage), name
+
+
+# -- calcPlainMetricReplicas grouping behaviors (:630-815) ------------------
+
+
+def test_plain_scale_up_with_unready_holds():
+    # ratio 1.5 > 1 with an unready pod: backfill 0 -> new ratio 1.0 is
+    # within tolerance -> keep current (the reference expects 3, NOT 5)
+    n, usage = CALC.get_metric_replicas(
+        3, 10, {"pod1": 15, "pod2": 15},
+        [pod("pod1"), pod("pod2"), unready_pod("pod3")],
+    )
+    assert (n, usage) == (3, 15)
+
+
+def test_plain_scale_down_with_missing_pods():
+    # ratio 0.5 < 1 with a missing pod: backfill the target -> new ratio
+    # (5+5+10)/3/10 = 0.667 -> ceil(0.667 * 3) = 2
+    n, usage = CALC.get_metric_replicas(
+        3, 10, {"pod1": 5, "pod2": 5},
+        [pod("pod1"), pod("pod2"), pod("pod3")],
+    )
+    assert (n, usage) == (2, 5)
+
+
+def test_plain_no_ready_metrics_errors():
+    with pytest.raises(MetricsError):
+        CALC.get_metric_replicas(
+            2, 10, {}, [unready_pod("pod1"), unready_pod("pod2")]
+        )
+    with pytest.raises(MetricsError):
+        CALC.get_metric_replicas(2, 10, {}, [])
+
+
+def test_group_pods_phases():
+    pods = [
+        pod("ok", value=10),
+        PodSample(name="failed", phase="Failed", value=10),
+        PodSample(name="deleted", deleted=True, value=10),
+        PodSample(name="pending", phase="Pending"),
+        pod("missing"),
+    ]
+    g = group_pods(pods, {"ok": 10, "failed": 10, "deleted": 10}, "", 300, 30)
+    assert g.ready_count == 1
+    assert g.ignored == {"failed", "deleted"}
+    assert g.unready == {"pending"}
+    assert g.missing == {"missing"}
+
+
+def test_group_pods_cpu_initialization_window():
+    # within the CPU initialisation period a READY pod's sample only counts
+    # once a full metric window has passed since the ready transition
+    fresh_sample = PodSample(
+        name="warm", start_age=100, transition_age=90, sample_age=10,
+        window=60, value=10,
+    )
+    stale_sample = PodSample(
+        name="cold", start_age=100, transition_age=30, sample_age=10,
+        window=60, value=10,
+    )
+    g = group_pods(
+        [fresh_sample, stale_sample], {"warm": 10, "cold": 10}, "cpu",
+        300, 30,
+    )
+    assert g.ready_count == 1
+    assert g.unready == {"cold"}
+
+
+def test_group_pods_cpu_never_ready():
+    # past initialisation, unready counts only when the pod has never been
+    # ready (transition within the initial-readiness delay of start)
+    never_ready = PodSample(
+        name="never", ready=False, start_age=1000, transition_age=990,
+        value=10,
+    )
+    was_ready = PodSample(
+        name="flap", ready=False, start_age=1000, transition_age=100,
+        value=10,
+    )
+    g = group_pods(
+        [never_ready, was_ready], {"never": 10, "flap": 10}, "cpu", 300, 30
+    )
+    assert g.unready == {"never"}
+    assert g.ready_count == 1
+
+
+# -- Object metrics (:829-1010) --------------------------------------------
+
+
+def test_get_object_metric_replicas_scale_up():
+    pods = [pod("pod1"), pod("pod2")]
+    n, usage = CALC.get_object_metric_replicas(2, 10, 30, pods)
+    assert (n, usage) == (6, 30)
+
+
+def test_get_object_metric_replicas_tolerance_holds():
+    pods = [pod("pod1"), pod("pod2")]
+    n, usage = CALC.get_object_metric_replicas(2, 10, 10, pods)
+    assert (n, usage) == (2, 10)
+
+
+def test_get_object_metric_replicas_scale_to_zero():
+    # currentReplicas == 0 bypasses tolerance and ready counts
+    n, usage = CALC.get_object_metric_replicas(0, 10, 30, [])
+    assert n == 3
+
+
+def test_get_object_per_pod_metric_replicas():
+    # usage 30 across 2 status replicas vs average target 10 -> 3 replicas,
+    # per-pod usage ceil(30/2) = 15
+    n, usage = CALC.get_object_per_pod_metric_replicas(2, 10, 30)
+    assert (n, usage) == (3, 15)
+
+
+def test_get_object_per_pod_metric_replicas_calibration():
+    n, usage = CALC.get_object_per_pod_metric_replicas(2, 10, 30, 0.5)
+    assert (n, usage) == (12, 15)  # ceil(ceil(30/10/0.5) / 0.5)
+
+
+def test_get_object_per_pod_metric_replicas_tolerance():
+    n, usage = CALC.get_object_per_pod_metric_replicas(3, 10, 30)
+    assert (n, usage) == (3, 10)
+
+
+# -- direction-change guards (replica_calculator.go:130-140) ----------------
+
+
+def test_direction_change_guard_holds_current():
+    # ratio < 1 (scale-down) but the missing-pod backfill flips the new
+    # ratio above 1 -> keep current
+    n, _ = CALC.get_metric_replicas(
+        4, 10, {"pod1": 9, "pod2": 9},
+        [pod("pod1"), pod("pod2"), pod("pod3"), pod("pod4")],
+    )
+    assert n == 4
+
+
+# -- utilization helpers (metrics/utilization_test.go:67-140) ---------------
+
+
+def test_resource_utilization_ratio_base():
+    ratio, util, raw = resource_utilization_ratio(
+        {"pod1": 300, "pod2": 500}, {"pod1": 500, "pod2": 500}, 50
+    )
+    assert (util, raw) == (80, 400)
+    assert ratio == pytest.approx(1.6)
+
+
+def test_resource_utilization_ratio_ignores_extraneous_metrics():
+    # metrics without a matching request are skipped (extraneous)
+    ratio, util, _ = resource_utilization_ratio(
+        {"pod1": 250, "ghost": 9999}, {"pod1": 500}, 50
+    )
+    assert util == 50
+    assert ratio == pytest.approx(1.0)
+
+
+def test_resource_utilization_ratio_extra_request_ok():
+    # requests for pods without metrics don't count toward the total
+    _, util, _ = resource_utilization_ratio(
+        {"pod1": 250}, {"pod1": 500, "unsampled": 500}, 50
+    )
+    assert util == 50
+
+
+def test_resource_utilization_ratio_no_requests_errors():
+    with pytest.raises(MetricsError):
+        resource_utilization_ratio({"pod1": 100}, {}, 50)
+
+
+def test_metric_usage_ratio():
+    ratio, usage = metric_usage_ratio({"pod1": 15, "pod2": 15}, 10)
+    assert usage == 15
+    assert ratio == pytest.approx(1.5)
