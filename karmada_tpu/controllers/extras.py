@@ -139,24 +139,39 @@ class WorkloadRebalancerController:
         rebalancer = self.store.get("WorkloadRebalancer", key)
         if rebalancer is None:
             return DONE
+        # one (kind, name) -> bindings index per reconcile (the reference
+        # resolves each workload through an indexed lister): a 20k-workload
+        # rebalancer over 20k bindings was O(W x B) = 400M scans — 330 s of
+        # a measured whole-plane storm wave; indexed it is O(W + B)
+        by_ref: dict[tuple[str, str], list] = {}
+        for rb in self.store.list("ResourceBinding"):
+            ref = rb.spec.resource
+            by_ref.setdefault((ref.kind, ref.name), []).append(rb)
         observed = []
+        triggered = []
         for target in rebalancer.spec.workloads:
             result = "NotFound"
-            for rb in self.store.list("ResourceBinding"):
-                ref = rb.spec.resource
+            for rb in by_ref.get((target.kind, target.name), ()):
                 if (
-                    ref.kind == target.kind
-                    and ref.name == target.name
-                    and (not target.namespace or ref.namespace == target.namespace)
+                    target.namespace
+                    and rb.spec.resource.namespace != target.namespace
                 ):
-                    rb.spec.reschedule_triggered_at = self.clock()
-                    rb.meta.generation += 1
-                    self.store.apply(rb)
-                    result = "Successful"
+                    continue
+                rb.spec.reschedule_triggered_at = self.clock()
+                rb.meta.generation += 1
+                triggered.append(rb)
+                result = "Successful"
             observed.append(
                 {"workload": f"{target.kind}/{target.namespace}/{target.name}",
                  "result": result}
             )
+        # one batched store sweep for the whole trigger wave
+        apply_many = getattr(self.store, "apply_many", None)
+        if apply_many is not None:
+            apply_many(triggered)
+        else:
+            for rb in triggered:
+                self.store.apply(rb)
         finished = all(o["result"] != "Pending" for o in observed)
         finish_time = rebalancer.status.finish_time
         if finished and finish_time is None:

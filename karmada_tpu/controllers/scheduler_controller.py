@@ -34,9 +34,15 @@ class SchedulerController:
         custom_filters=(),
         clock=None,
         solver=None,
+        estimator_registry=None,
     ) -> None:
         self.store = store
+        self.runtime = runtime
         self.scheduler_name = scheduler_name
+        # the plane's EstimatorRegistry (when accurate estimators feed
+        # extra_estimators): cluster events invalidate its memoized
+        # estimates so the gRPC path re-queries live member state
+        self.estimator_registry = estimator_registry
         # out-of-process solver sidecar (karmada_tpu.solver.RemoteSolver):
         # when set, scheduling goes over its gRPC channel instead of the
         # in-proc engine, with cluster state pushed on cluster events
@@ -56,6 +62,12 @@ class SchedulerController:
         self.custom_filters = list(custom_filters)
         self._snapshot: Optional[ClusterSnapshot] = None
         self._engine: Optional[TensorScheduler] = None
+        # id()s of binding objects whose writeback WE are applying right
+        # now: the in-proc store delivers the echo synchronously with the
+        # very same object, so identity marks it (one re-gate queue wave
+        # per storm saved). Cleared after the batch; a bus-replica's
+        # decoded echo has a different identity and just re-gates cheaply.
+        self._pending_writeback: set[int] = set()
         # the batch cap bounds ONE engine pass; the device-resident fleet
         # path amortizes per-pass dispatch+fetch costs over the whole batch,
         # so a storm should drain in as few passes as possible
@@ -75,11 +87,17 @@ class SchedulerController:
         rb = event.obj
         if rb.spec.scheduler_name != self.scheduler_name:
             return  # scheduler-name filter (event_handler.go:93-113)
+        if id(rb) in self._pending_writeback:
+            return  # our own writeback echo
         self.worker.enqueue((event.kind, event.key))
 
     def _on_cluster_event(self, event) -> None:
         self._snapshot = None  # invalidate; rebuild lazily
         self._solver_synced = False  # sidecar re-sync before next schedule
+        if self.estimator_registry is not None:
+            # member state moved: memoized accurate estimates are stale
+            # (EstimatorRegistry.invalidate staleness contract)
+            self.estimator_registry.invalidate()
         for kind in ("ResourceBinding", "ClusterResourceBinding"):
             for rb in self.store.list(kind):
                 if rb.spec.scheduler_name == self.scheduler_name:
@@ -178,14 +196,49 @@ class SchedulerController:
         engine = self._get_engine()
         results = engine.schedule([p for _, _, p, _ in todo])
         per_item = (time.perf_counter() - start) / len(todo)
+        # leadership check at the write barrier: a batched engine pass can
+        # outlast a lease (first-compile stalls), and the heartbeat seam
+        # only fires BETWEEN work items — one storm batch is one item. A
+        # plane deposed during the pass must discard its results unwritten
+        # (the standby owns the storm now); the keys park for the next
+        # leadership. In-proc planes have no heartbeat and skip this.
+        hb = getattr(self.runtime, "heartbeat", None)
+        if hb is not None and hb() is False:
+            for kind_key, _, _, _ in todo:
+                self.worker.enqueue(kind_key)
+                out[kind_key] = DONE
+            return out
+        changed_rbs = []
         for (kind_key, rb, _, fresh), result in zip(todo, results):
-            self._write_back(rb, result)
+            if self._write_back(rb, result):
+                changed_rbs.append(rb)
             e2e_scheduling_duration.observe(per_item)
             schedule_attempts.inc(
                 result="success" if result.success else "error",
                 schedule_type="FreshSchedule" if fresh else "ReconcileSchedule",
             )
             out[kind_key] = DONE
+        # batched writeback: one locked sweep + one delivery sweep instead
+        # of len(changed) apply calls (storm hot path); HA replica facades
+        # lack the batch API and fall back to per-object write-through
+        self._pending_writeback = {id(rb) for rb in changed_rbs}
+        try:
+            apply_many = getattr(self.store, "apply_many", None)
+            if apply_many is not None:
+                for rb, err in apply_many(changed_rbs):
+                    # per-object admission rejection: surface it (an engine
+                    # result the webhook refuses is a bug worth seeing),
+                    # the rest of the wave committed
+                    print(
+                        f"# scheduler writeback rejected for "
+                        f"{rb.meta.namespaced_name}: {err}",
+                        flush=True,
+                    )
+            else:
+                for rb in changed_rbs:
+                    self.store.apply(rb)
+        finally:
+            self._pending_writeback.clear()
         return out
 
     def _problem_for(self, key: str, rb: ResourceBinding, fresh: bool) -> BindingProblem:
@@ -206,7 +259,9 @@ class SchedulerController:
             fresh=fresh,
         )
 
-    def _write_back(self, rb: ResourceBinding, result) -> None:
+    def _write_back(self, rb: ResourceBinding, result) -> bool:
+        """Mutate ``rb`` from the schedule result; returns whether it
+        changed (the batch caller owns the store write)."""
         before = [(tc.name, tc.replicas) for tc in rb.spec.clusters]
         changed = rb.status.scheduler_observed_generation != rb.meta.generation
         if result.success:
@@ -247,5 +302,4 @@ class SchedulerController:
                 ),
             ):
                 changed = True
-        if changed:
-            self.store.apply(rb)
+        return changed

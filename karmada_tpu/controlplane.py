@@ -68,6 +68,12 @@ class ControlPlane:
         # in-proc chain (cmd/webhook deployment shape)
         admission_override=None,
         delete_admission_override=None,
+        # HA replica mode: run the controller fleet over an EXTERNAL store
+        # (a bus ReplicaStoreFacade) — reads hit the local mirror, writes
+        # round-trip the primary which owns admission. Two planes over one
+        # store + Lease leader election = the reference's --leader-elect
+        # active-standby shape for controller-manager/scheduler.
+        store=None,
     ) -> None:
         import time as _time
 
@@ -75,12 +81,15 @@ class ControlPlane:
         from .webhook import default_admission_chain
 
         self.admission = default_admission_chain()
-        self.store = Store(
-            admission=admission_override or self.admission.admit,
-            delete_admission=(
-                delete_admission_override or self.admission.admit_delete
-            ),
-        )
+        if store is not None:
+            self.store = store
+        else:
+            self.store = Store(
+                admission=admission_override or self.admission.admit,
+                delete_admission=(
+                    delete_admission_override or self.admission.admit_delete
+                ),
+            )
         self.runtime = Runtime()
         self.members = MemberClientRegistry()
         self.interpreter = default_interpreter()
@@ -139,6 +148,7 @@ class ControlPlane:
             custom_filters=scheduler_filter_plugins,
             clock=self.clock,
             solver=solver,
+            estimator_registry=self.estimators,
         )
         self.descheduler = (
             Descheduler(self.store, self.runtime, self.members, clock=self.clock)

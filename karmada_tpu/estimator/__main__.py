@@ -21,31 +21,73 @@ DIMS = ["cpu", "memory", "pods", "ephemeral-storage"]
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="karmada-tpu estimator server")
-    p.add_argument("--cluster", required=True)
+    p.add_argument("--cluster", default="")
     p.add_argument("--address", default="127.0.0.1:0")
     p.add_argument("--nodes", type=int, default=3)
     p.add_argument("--cpu", type=int, default=16000, help="milli-cpu per node")
     p.add_argument("--memory", type=int, default=64 << 30)
     p.add_argument("--pods", type=int, default=110)
+    p.add_argument(
+        "--spec-file", default="",
+        help="JSON {cluster: {dim: capacity}} — host MANY clusters' "
+        "estimators in THIS process (MultiClusterEstimatorService routes "
+        "by request.cluster; the consolidated deployment shape for "
+        "hundreds of members). Each cluster gets one node whose "
+        "allocatable IS the given free capacity.",
+    )
     args = p.parse_args(argv)
+    if bool(args.cluster) == bool(args.spec_file):
+        p.error("exactly one of --cluster / --spec-file is required")
 
-    nodes = [
-        NodeState(
-            name=f"{args.cluster}-node-{i}",
-            allocatable={
-                "cpu": args.cpu,
-                "memory": args.memory,
-                "pods": args.pods,
-                "ephemeral-storage": 100 << 30,
-            },
+    if args.spec_file:
+        import json
+
+        from .service import MultiClusterEstimatorService
+
+        with open(args.spec_file) as f:
+            spec: dict = json.load(f)
+        dims = sorted({d for caps in spec.values() for d in caps})
+        services = {
+            name: EstimatorService(
+                AccurateEstimator(
+                    name,
+                    NodeSnapshot(
+                        [NodeState(name=f"{name}-node-0",
+                                   allocatable=dict(caps))],
+                        dims,
+                    ),
+                )
+            )
+            for name, caps in spec.items()
+        }
+        server = EstimatorGrpcServer(
+            MultiClusterEstimatorService(services), args.address,
+            max_workers=32,
         )
-        for i in range(args.nodes)
-    ]
-    est = AccurateEstimator(args.cluster, NodeSnapshot(nodes, DIMS))
-    server = EstimatorGrpcServer(EstimatorService(est), args.address)
-    port = server.start()
-    # the parent process scrapes this line to learn the bound port
-    print(f"estimator {args.cluster} listening on port {port}", flush=True)
+        port = server.start()
+        print(
+            f"estimator multi ({len(services)} clusters) listening on "
+            f"port {port}",
+            flush=True,
+        )
+    else:
+        nodes = [
+            NodeState(
+                name=f"{args.cluster}-node-{i}",
+                allocatable={
+                    "cpu": args.cpu,
+                    "memory": args.memory,
+                    "pods": args.pods,
+                    "ephemeral-storage": 100 << 30,
+                },
+            )
+            for i in range(args.nodes)
+        ]
+        est = AccurateEstimator(args.cluster, NodeSnapshot(nodes, DIMS))
+        server = EstimatorGrpcServer(EstimatorService(est), args.address)
+        port = server.start()
+        # the parent process scrapes this line to learn the bound port
+        print(f"estimator {args.cluster} listening on port {port}", flush=True)
     try:
         server._server.wait_for_termination()
     except KeyboardInterrupt:

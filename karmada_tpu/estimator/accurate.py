@@ -304,33 +304,120 @@ class AccurateEstimator:
 
 class EstimatorRegistry:
     """Scheduler-side estimator fan-out (ref: client/accurate.go:33-68 — the
-    per-cluster connection cache + concurrent fan-out, minus the wire)."""
+    per-cluster connection cache + concurrent fan-out)."""
 
     def __init__(self) -> None:
         self._by_cluster: dict[str, AccurateEstimator] = {}
+        self._pool = None
+        # wall seconds spent in live estimator fan-outs (memo misses) since
+        # construction — benches diff this across passes to report the
+        # snapshot-refresh latency of estimator-backed availability
+        self.fanout_seconds_total = 0.0
+        self._memo: dict[tuple, np.ndarray] = {}
 
     def register(self, est: AccurateEstimator) -> None:
         self._by_cluster[est.cluster_name] = est
+        # memoized columns are positional over a batch estimator's name
+        # list; any membership change invalidates them (a stale shorter
+        # column would shape-mismatch a rebuilt, longer fan-out)
+        self._memo.clear()
 
     def deregister(self, cluster_name: str) -> None:
         self._by_cluster.pop(cluster_name, None)
+        self._memo.clear()
 
     def get(self, cluster_name: str) -> Optional[AccurateEstimator]:
         return self._by_cluster.get(cluster_name)
 
-    def make_batch_estimator(self, cluster_names: Sequence[str]):
+    def invalidate(self) -> None:
+        """Drop memoized estimates. Staleness contract: an estimate is a
+        point-in-time answer memoized per unique request profile until the
+        owner observes member state change (cluster status heartbeat /
+        snapshot swap) and invalidates — the informer-cache granularity the
+        reference's general estimator gets for free, applied to the gRPC
+        accurate path. Without invalidation a long steady storm re-uses
+        the first pass's fan-out; after it, the next pass re-queries every
+        cluster live."""
+        self._memo.clear()
+
+    def make_batch_estimator(
+        self,
+        cluster_names: Sequence[str],
+        *,
+        max_workers: int = 64,
+        timeout_seconds: Optional[float] = None,
+    ):
         """Adapter for TensorScheduler.extra_estimators: returns
         fn(requests[B,R], replicas[B]) -> int32[B,C] with -1 where no
-        estimator serves the cluster."""
+        estimator serves the cluster.
+
+        Fan-out is CONCURRENT under one shared deadline
+        (client/accurate.go:139-162): each cluster's per-profile queries
+        run on a worker pool; a cluster missing the deadline answers
+        UnauthenticReplica (-1) for this pass, so the min-merge ignores it
+        instead of blocking scheduling — its late result is discarded,
+        never applied to a later pass."""
+        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import wait as _fwait
+        import time as _time
+
+        names = list(cluster_names)
 
         def estimate(requests: np.ndarray, replicas: np.ndarray) -> np.ndarray:
-            b = len(requests)
-            out = np.full((b, len(cluster_names)), UNAUTHENTIC, np.int32)
-            for ci, name in enumerate(cluster_names):
-                est = self._by_cluster.get(name)
-                if est is None:
-                    continue
-                out[:, ci] = est.max_available_replicas(None, requests)
+            reqs = np.asarray(requests)
+            b = len(reqs)
+            out = np.full((b, len(names)), UNAUTHENTIC, np.int32)
+            # intern the batch to unique profiles; answer memo hits without
+            # touching the wire, fan out the misses concurrently
+            uniq, inv = np.unique(reqs, axis=0, return_inverse=True)
+            cols = [self._memo.get(row.tobytes()) for row in uniq]
+            miss = [u for u, col in enumerate(cols) if col is None]
+            if miss:
+                if self._pool is None:
+                    self._pool = ThreadPoolExecutor(max_workers)
+                t0 = _time.perf_counter()
+                miss_reqs = uniq[miss]
+                futs = {}
+                # clusters with no registered estimator answer -1
+                # STRUCTURALLY (deterministic) and don't block memoization;
+                # a TIMED-OUT or errored cluster answers -1 for this pass
+                # only — memoizing a transient failure would pin the
+                # snapshot-only fallback until the next invalidation
+                complete = True
+                for ci, name in enumerate(names):
+                    est = self._by_cluster.get(name)
+                    if est is None:
+                        continue
+                    futs[
+                        self._pool.submit(
+                            est.max_available_replicas, None, miss_reqs
+                        )
+                    ] = ci
+                done, not_done = _fwait(futs, timeout=timeout_seconds)
+                fresh = np.full(
+                    (len(miss), len(names)), UNAUTHENTIC, np.int32
+                )
+                for f in done:
+                    try:
+                        vals = np.asarray(f.result(), np.int32)
+                        fresh[:, futs[f]] = vals
+                        if (vals < 0).any():
+                            # the remote adapter reports its own per-RPC
+                            # wire failures as -1 rows — same transient
+                            complete = False
+                    except Exception:  # noqa: BLE001 — wire failure = -1
+                        complete = False
+                for f in not_done:
+                    f.cancel()
+                    complete = False
+                for k, u in enumerate(miss):
+                    col = fresh[k]
+                    cols[u] = col
+                    if complete:
+                        self._memo[uniq[u].tobytes()] = col
+                self.fanout_seconds_total += _time.perf_counter() - t0
+            table = np.stack(cols)  # [U, C]
+            out[:] = table[inv]
             return out
 
         return estimate

@@ -98,6 +98,33 @@ class EstimatorService:
         )
 
 
+class MultiClusterEstimatorService:
+    """One server PROCESS hosting many clusters' estimators, routed by
+    ``request.cluster`` — the multiplexed deployment shape (the reference
+    runs one estimator deployment per member; at hundreds of members an
+    operator consolidates them, and the wire contract already carries the
+    cluster name on every request, so the scheduler side is unchanged)."""
+
+    def __init__(self, services: dict[str, EstimatorService]):
+        self._services = services
+
+    def max_available_replicas(
+        self, req: MaxAvailableReplicasRequest
+    ) -> MaxAvailableReplicasResponse:
+        svc = self._services.get(req.cluster)
+        if svc is None:
+            raise KeyError(f"no estimator for cluster {req.cluster!r}")
+        return svc.max_available_replicas(req)
+
+    def get_unschedulable_replicas(
+        self, req: UnschedulableReplicasRequest
+    ) -> UnschedulableReplicasResponse:
+        svc = self._services.get(req.cluster)
+        if svc is None:
+            raise KeyError(f"no estimator for cluster {req.cluster!r}")
+        return svc.get_unschedulable_replicas(req)
+
+
 class EstimatorConnection:
     """One cluster's channel. ``call`` is the transport seam."""
 

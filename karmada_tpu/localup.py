@@ -124,8 +124,124 @@ def _scrape_port(proc: subprocess.Popen, pattern: str, timeout: float = 240.0) -
 # --------------------------------------------------------------------------
 
 
+def serve_plane_replica(args) -> None:
+    """HA plane replica (the reference's --leader-elect active-standby
+    shape, cmd/scheduler/app/options/options.go:130-165): the controller
+    fleet runs over a bus StoreReplica of an EXTERNAL store process
+    (python -m karmada_tpu.bus), and only the Lease-elected leader
+    reconciles. Standbys stay warm — their mirrors track every event and
+    their workqueues accumulate keys — so takeover is one settle away.
+    No double-scheduling: leadership is CAS-exclusive per tick, and the
+    scheduler's observed-generation guard makes a raced duplicate
+    reconcile idempotent."""
+    import os
+
+    from .bus.agent import ReplicaStoreFacade
+    from .bus.service import StoreReplica
+    from .controlplane import ControlPlane
+    from .utils.builders import new_cluster
+    from .utils.leaderelect import LeaderElector
+    from .utils.member import MemberCluster
+    from .utils.metrics import MetricsServer
+    from .utils.net import parse_hostport as addr
+
+    replica = StoreReplica(args.connect_bus)
+    replica.start()
+    if not replica.wait_synced(30):
+        print("error: bus replica failed to sync", file=sys.stderr)
+        sys.exit(2)
+    facade = ReplicaStoreFacade(replica)
+    cp = ControlPlane(
+        store=facade,
+        enable_descheduler=args.descheduler,
+        lease_grace_seconds=args.lease_grace or None,
+    )
+    from .utils.store import ConflictError
+
+    for name in args.pull:
+        # every replica registers the local inventory shell + status
+        # watch; the Cluster OBJECT is created create-only (expected_rv=0)
+        # so two concurrently booting replicas cannot clobber the agent's
+        # already-written status through their async mirrors (a check-
+        # then-act on the mirror races; the CAS loses cleanly instead)
+        member = MemberCluster(name)
+        cp.members.register(member)
+        cp.work_status_controller.watch_member(member)
+        if facade.get("Cluster", name) is None:
+            cluster = new_cluster(name, cpu="100", memory="200Gi")
+            cluster.spec.sync_mode = "Pull"
+            try:
+                facade.apply(cluster, expected_rv=0)
+            except ConflictError:
+                pass  # a peer replica won the create
+    cp.runtime.realtime = True
+    metrics = MetricsServer(address=addr(args.metrics_address))
+    metrics_port = metrics.start()
+    identity = args.identity or f"plane-{os.getpid()}"
+    elector = LeaderElector(
+        facade,
+        "karmada-plane",
+        identity,
+        lease_duration=args.lease_duration,
+        renew_deadline=args.renew_deadline,
+        on_started_leading=lambda: print(
+            json.dumps({"leading": identity}), flush=True
+        ),
+        on_stopped_leading=lambda: print(
+            json.dumps({"standby": identity}), flush=True
+        ),
+    )
+    # renewals must survive long settles (client-go renews on its own
+    # goroutine; this runtime is cooperative, so renewal rides the drain
+    # loop via the heartbeat seam), throttled to lease/5 so neither the
+    # settle loop nor the serve loop hammers the bus with CAS writes —
+    # and the moment leadership is lost mid-settle, the heartbeat's False
+    # aborts the drain so a deposed leader stops writing immediately
+    last_tick = [0.0]
+
+    def renew_tick() -> bool:
+        now = time.time()
+        if now - last_tick[0] >= args.lease_duration / 5:
+            last_tick[0] = now
+            elector.tick()
+        return elector.is_leader
+
+    cp.runtime.heartbeat = renew_tick
+    print(
+        json.dumps({"metrics": metrics_port, "identity": identity}),
+        flush=True,
+    )
+
+    stop = [False]
+
+    def on_term(signum, frame):
+        stop[0] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop[0]:
+            leading = renew_tick()
+            if leading:
+                cp.settle()
+                due = cp.runtime.next_due()
+                time.sleep(
+                    max(0.001, min(args.loop_interval, due))
+                    if due is not None
+                    else args.loop_interval
+                )
+            else:
+                time.sleep(args.loop_interval)
+    finally:
+        elector.release()
+        metrics.stop()
+        replica.close()
+
+
 def serve_plane(args) -> None:
     """Run the control plane + its network surfaces until SIGTERM."""
+    if args.connect_bus:
+        return serve_plane_replica(args)
     from .bus.service import StoreBusServer
     from .cli import cmd_init, cmd_join
     from .controlplane import ControlPlane  # noqa: F401 (docs)
@@ -448,6 +564,18 @@ def main(argv=None) -> None:
                     help="pin the cluster-proxy bind address")
     sv.add_argument("--metrics-address", default="127.0.0.1:0",
                     help="pin the /metrics bind address")
+    sv.add_argument("--connect-bus", default="",
+                    help="HA replica mode: run the controller fleet over a "
+                    "StoreReplica of this external store-bus address "
+                    "(python -m karmada_tpu.bus) instead of hosting the "
+                    "store; pairs with --leader-elect")
+    sv.add_argument("--leader-elect", action="store_true",
+                    help="Lease-CAS active-standby (every reference binary's "
+                    "--leader-elect); implied by --connect-bus")
+    sv.add_argument("--identity", default="",
+                    help="leader-election identity (default plane-<pid>)")
+    sv.add_argument("--lease-duration", type=float, default=15.0)
+    sv.add_argument("--renew-deadline", type=float, default=10.0)
 
     up = sub.add_parser("up", help="spawn the full multi-process deployment")
     up.add_argument("--members", type=int, default=2)
@@ -459,6 +587,12 @@ def main(argv=None) -> None:
     if args.command == "up" and args.pull is None:
         args.pull = ["pull1"]
     if args.command == "serve":
+        if args.leader_elect and not args.connect_bus:
+            # election needs the shared store: a lone plane hosting its own
+            # store has nothing to elect against — failing loudly beats an
+            # operator believing a single-writer plane is HA
+            p.error("--leader-elect requires --connect-bus (the shared "
+                    "store-bus the replicas elect over)")
         serve_plane(args)
     elif args.command == "up":
         with LocalUp(members=args.members, pull=tuple(args.pull)) as lu:

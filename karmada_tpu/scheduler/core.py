@@ -135,20 +135,6 @@ def host_profile_table(
     return np.where(np.asarray(snapshot.has_summary)[None, :], table, mi)
 
 
-def tune_cap(needed: int, prev: Optional[int], votes: int,
-             ceil: Optional[int] = None) -> tuple[int, int]:
-    """Grow-immediately / shrink-after-two-votes cap hysteresis, shared by
-    the fleet's entry and changed-meta buffers (every distinct cap is a
-    fresh XLA trace; a demand oscillating across a quantum boundary was
-    recompiling the solve once per storm wave). Returns (cap, votes)."""
-    if prev is None or (ceil is not None and prev > ceil) or needed >= prev:
-        return needed, 0
-    votes += 1
-    if votes >= 2:
-        return needed, 0
-    return prev, votes
-
-
 @dataclass
 class BindingProblem:
     """Engine-level scheduling unit (decoupled from the API object; the
@@ -279,7 +265,16 @@ class TensorScheduler:
             or should_ignore_spread_constraint(cp.placement or Placement())
         )
         self._placement_cache[key] = (placement, cp)
-        if len(self._placement_cache) > self.PLACEMENT_CACHE_CAP:
+        # the cap must exceed the fleet table's live-slot budget: a live
+        # placement set larger than the LRU turns a storm's cyclic access
+        # into a 100% miss rate (~every row recompiles its selector, tens
+        # of seconds per pass), and each recompile mints a NEW compiled
+        # object whose id() mints a NEW fleet slot — ballooning the slot
+        # table until it dies (observed on the 9k-unique rotation bench)
+        cache_cap = self.PLACEMENT_CACHE_CAP
+        if self._fleet is not None:
+            cache_cap = max(cache_cap, 2 * self._fleet._max_slots())
+        if len(self._placement_cache) > cache_cap:
             self._placement_cache.popitem(last=False)
         return cp
 

@@ -409,6 +409,7 @@ DENSE_RESIDENT_MAX_BYTES = _dense_budget()
 M_ROUND = 1 << 15  # changed-meta buffer quantum (bounds trace churn)
 D_ROUND = 1 << 16  # cell-delta buffer quantum (bounds trace churn)
 D_FLOOR = 8192  # cell-delta floor: 24 KB of wire on every steady pass
+SHRINK_SUSTAIN = 5  # passes a frozen shrink must stay desired to compile
 
 
 def d_round(v: int) -> int:
@@ -993,7 +994,6 @@ class FleetTable:
         # far under max replicas); overflow falls back to the safe bound
         self._last_total: Optional[int] = None  # None = no pass observed yet
         self._e_cap_cur: Optional[int] = None
-        self._shrink_votes = 0
         # delta-fetch base: device-resident [cap, k_out] per-row entry
         # vectors from the last pass + the host mirror results read from.
         # None = next pass reports every row changed and refills both.
@@ -1007,16 +1007,18 @@ class FleetTable:
         self._res_meta = None  # int32[cap] device
         self._host_meta: Optional[np.ndarray] = None
         self._m_cap_cur: Optional[int] = None
-        self._m_shrink = 0
         self._last_changed: Optional[int] = None
         # cell-delta wire (phase A tail): tuned like m_cap; _delta_live
         # records that the last churn pass folded via deltas, which turns
         # the speculative full-row phase B dispatch off (wasted device
         # sort + wire when deltas carry the pass)
         self._d_cap_cur: Optional[int] = None
-        self._d_shrink = 0
         self._last_dtotal: Optional[int] = None
         self._delta_live = False
+        # (target, consecutive passes desired) for a frozen shrink — see
+        # the shrink-to-seen-only block in _solve_dense / _solve_legacy
+        self._shrink_desire: tuple = (None, 0)
+        self._e_shrink_desire: tuple = (None, 0)
         # O(1) batch reuse: (problems_list, compiled_list, rows) of the
         # last scheduled batch — the engine's batch-identity fast path
         # re-passes the SAME list objects, so identity means the row
@@ -1672,22 +1674,38 @@ class FleetTable:
         # (and with it the fetched buffer) collapses to the floor quantum;
         # a churn burst overflows once, reruns at the safe bound, and the
         # cap follows it back up
-        from .core import tune_cap
-
-        needed = cap_round(safe)
-        if self._last_total is not None and self._last_total * 5 // 4 < safe:
-            needed = min(needed, cap_round(self._last_total * 5 // 4))
-        e_cap, self._shrink_votes = tune_cap(
-            needed, self._e_cap_cur, self._shrink_votes
-        )
-        self._e_cap_cur = e_cap
-
-        def solve(rows_slice, cap):
-            self._mark_trace(
+        def l_key(cap: int) -> tuple:
+            return (
                 "L", self.cap, c, self._dev_tables[0].shape, eff_chunk,
                 n_chunks, k_out, k_res, cap, wide, fast, has_agg, is_all,
                 mesh is not None, shard_c, pack21 and byte_wire,
             )
+
+        prev_e = self._e_cap_cur
+        needed = cap_round(safe)
+        if self._last_total is not None and self._last_total * 5 // 4 < safe:
+            needed = min(needed, cap_round(self._last_total * 5 // 4))
+        # demand-based grow-immediately / shrink-on-sustained-desire (same
+        # policy as the dense pair: 2 passes to switch to an already-
+        # compiled trace, SHRINK_SUSTAIN to compile a smaller one)
+        if prev_e is None or needed >= prev_e:
+            e_cap = needed
+            self._e_shrink_desire = (None, 0)
+        else:
+            e_cap = prev_e
+            tgt, cnt = self._e_shrink_desire
+            cnt = cnt + 1 if tgt == needed else 1
+            self._e_shrink_desire = (needed, cnt)
+            sustain = (
+                2 if l_key(needed) in self._seen_traces else SHRINK_SUSTAIN
+            )
+            if cnt >= sustain:
+                e_cap = needed
+                self._e_shrink_desire = (None, 0)
+        self._e_cap_cur = e_cap
+
+        def solve(rows_slice, cap):
+            self._mark_trace(*l_key(cap))
             return _fleet_solve(
                 *self._dev_tables,
                 rows_slice,
@@ -1856,63 +1874,83 @@ class FleetTable:
             q = -(-v // M_ROUND) * M_ROUND if v > 4096 else 4096
             return min(q, n_pad)
 
-        from .core import tune_cap
+        def a_key(m: int, d: int) -> tuple:
+            return (
+                "A", self.cap, c, self._dev_tables[0].shape, eff_chunk,
+                n_chunks, wide, fast, has_agg, is_all, m, d,
+                mesh is not None, shard_c,
+            )
 
-        needed = m_round(n)
+        # cap tuning, demand-based. Every distinct (m_cap, d_cap) pair is a
+        # fresh XLA trace, so the policy is built around compile cost:
+        # - GROW immediately when demand threatens a cap (overflow costs a
+        #   round-trip or a full-row fold; growth normally lands in churn
+        #   onset, which warm loops cover);
+        # - SHRINK only on sustained desire: 2 consecutive passes when the
+        #   smaller pair is already compiled (cheap switch), SHRINK_SUSTAIN
+        #   when it would compile a new trace (a demand-regime shift like
+        #   onset-overshoot -> steady churn; a wobble never qualifies, and
+        #   warm loops that run past the window absorb the one compile —
+        #   vote-delayed shrinks used to fire mid-storm: a 94s dispatch
+        #   stall on the bench).
+        # m demand: the changed-row count; d demand: the cell-delta count
+        # with 1.5x headroom (dtotal wobbles a few percent pass to pass).
+        needed_m = m_round(n)
         if self._last_changed is not None and (
             self._last_changed * 5 // 4 < n
         ):
-            needed = min(needed, m_round(self._last_changed * 5 // 4))
-        m_cap, self._m_shrink = tune_cap(
-            needed, self._m_cap_cur, self._m_shrink, ceil=n_pad
+            needed_m = min(needed_m, m_round(self._last_changed * 5 // 4))
+        d_on = byte_wire and c <= (1 << 15)
+        last = self._last_dtotal or 0
+        d_need_min = (d_round(last * 9 // 8) if last else D_FLOOR) if d_on else 0
+        d_need_tgt = (
+            min(d_round(last * 3 // 2) if last else D_FLOOR,
+                d_round(n_pad * 63))
+            if d_on
+            else 0
         )
-        self._m_cap_cur = m_cap
-
-        # cell-delta buffer: a typical churn pass moves ~a few cells per
-        # changed row, so shipping (site, newcount) deltas instead of the
-        # full entry runs is ~10x less wire AND removes the phase-B round
-        # trip. Gated on site ids fitting the 3B wire word (site:15 |
-        # count+1:9 = 24 bits); d_cap overflow (churn onset, table
-        # rebuild) falls back to the full-row phase B flow.
-        d_cap = 0
-        if byte_wire and c <= (1 << 15):
-            # dead-band tuning (unlike tune_cap's needed>prev grow): the
-            # cap only GROWS when the last dtotal actually threatens it
-            # (>= 8/9 of prev) and then jumps to 1.5x headroom — dtotal
-            # wobbles a few percent pass to pass, and any upward quantum
-            # crossing mid-storm is a fresh XLA trace at this kernel's
-            # size. Shrink keeps tune_cap's two-vote hysteresis.
-            last = self._last_dtotal or 0
-            need_min = d_round(last * 9 // 8) if last else D_FLOOR
-            need_tgt = min(
-                d_round(last * 3 // 2) if last else D_FLOOR,
-                d_round(n_pad * 63),
-            )
-            prev = self._d_cap_cur
-            if prev is None or prev < need_min:
-                d_cap, self._d_shrink = need_tgt, 0
-            elif need_tgt * 2 <= prev:
-                # shrink only on a SUSTAINED halving of demand: an oversized
-                # delta cap costs ~3B x quantum of wire (~16 ms), a shrink
-                # costs a fresh solve trace — a one-quantum wobble shrink
-                # recompiled the kernel mid-storm on the bench
-                self._d_shrink += 1
-                if self._d_shrink >= 3:
-                    d_cap, self._d_shrink = need_tgt, 0
-                else:
-                    d_cap = prev
+        cur_m, cur_d = self._m_cap_cur, self._d_cap_cur
+        if cur_m is None:
+            m_cap, d_cap = needed_m, d_need_tgt
+            self._shrink_desire = (None, 0)
+        else:
+            m_cap, d_cap = cur_m, (cur_d or 0) if d_on else 0
+            grow_m = needed_m > cur_m
+            grow_d = d_on and d_cap < d_need_min
+            if grow_m:
+                m_cap = needed_m
+            if grow_d:
+                d_cap = d_need_tgt
+            if grow_m or grow_d:
+                self._shrink_desire = (None, 0)
             else:
-                d_cap, self._d_shrink = prev, 0
-            self._d_cap_cur = d_cap
+                want_m = min(needed_m, m_cap)
+                want_d = (
+                    d_need_tgt
+                    if d_on and d_need_tgt * 2 <= d_cap
+                    else d_cap
+                )
+                want = (want_m, want_d)
+                if want != (m_cap, d_cap):
+                    tgt, cnt = self._shrink_desire
+                    cnt = cnt + 1 if tgt == want else 1
+                    self._shrink_desire = (want, cnt)
+                    sustain = (
+                        2 if a_key(*want) in self._seen_traces
+                        else SHRINK_SUSTAIN
+                    )
+                    if cnt >= sustain:
+                        m_cap, d_cap = want
+                        self._shrink_desire = (None, 0)
+                else:
+                    self._shrink_desire = (None, 0)
+        self._m_cap_cur = m_cap
+        self._d_cap_cur = d_cap if d_on else None
 
         cap_round = _cap_round
         tmr["prep"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        self._mark_trace(
-            "A", self.cap, c, self._dev_tables[0].shape, eff_chunk,
-            n_chunks, wide, fast, has_agg, is_all, m_cap, d_cap,
-            mesh is not None, shard_c,
-        )
+        self._mark_trace(*a_key(m_cap, d_cap))
         flat, rowbuf, rd, rm = _fleet_pass(
             *self._dev_tables,
             rows_dev,

@@ -43,12 +43,25 @@ from typing import Iterable, Optional
 from ..api.core import ObjectMeta, Resource
 from .backend import InvertedIndexBackend
 
-CACHE_SOURCE_ANNOTATION = "cluster.karmada.io/cache-source"
-DEFAULT_PREFIX = "karmada"  # opensearch.go defaultPrefix
+CACHE_SOURCE_ANNOTATION = "resource.karmada.io/cached-from-cluster"
+# cluster/v1alpha1/well_known_constants.go:35 CacheSourceAnnotationKey
+DEFAULT_PREFIX = "kubernetes"  # opensearch.go:39 defaultPrefix
 
 
 def index_name(kind: str, prefix: str = DEFAULT_PREFIX) -> str:
     return f"{prefix}-{kind.lower()}"
+
+
+def rfc3339(epoch: Optional[float]) -> str:
+    """Go time.Format(RFC3339): the zero Time renders as year 1, which is
+    exactly what GetCreationTimestamp() yields for objects without one."""
+    if not epoch:
+        return "0001-01-01T00:00:00Z"
+    from datetime import datetime, timezone
+
+    return datetime.fromtimestamp(epoch, timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
 
 
 def doc_id(cluster: str, obj: Resource) -> str:
@@ -72,8 +85,14 @@ def resource_to_doc(cluster: str, obj: Resource) -> dict:
         "metadata": {
             "name": obj.meta.name,
             "namespace": obj.meta.namespace,
+            "creationTimestamp": rfc3339(obj.meta.creation_timestamp),
             "labels": dict(obj.meta.labels),
             "annotations": annotations,
+            "deletionTimestamp": (
+                rfc3339(obj.meta.deletion_timestamp)
+                if obj.meta.deletion_timestamp
+                else None
+            ),
         },
         "spec": json.dumps(obj.spec),
         "status": json.dumps(obj.status),
@@ -373,15 +392,56 @@ class OpenSearchBackend:
     ``_bulk`` flushes. Points at ``OpenSearchServer`` in tests and at a
     real OpenSearch node in production — the wire is the same."""
 
+    # the index-create body, field for field the reference's ``mapping``
+    # const (opensearch.go:41-116): 1 shard / 0 replicas, searchable
+    # name/namespace with 256-char keyword subfields, annotations/labels
+    # stored-not-indexed, and spec/status disabled objects (the documents
+    # carry them as JSON strings)
     MAPPING = {
+        "settings": {
+            "index": {"number_of_shards": 1, "number_of_replicas": 0}
+        },
         "mappings": {
             "properties": {
-                "metadata": {"properties": {
-                    "name": {"type": "keyword"},
-                    "namespace": {"type": "keyword"},
-                }},
+                "apiVersion": {"type": "text"},
+                "kind": {"type": "text"},
+                "metadata": {
+                    "properties": {
+                        "annotations": {"type": "object", "enabled": False},
+                        "creationTimestamp": {"type": "text"},
+                        "deletionTimestamp": {"type": "text"},
+                        "labels": {"type": "object", "enabled": False},
+                        "name": {
+                            "type": "text",
+                            "fields": {
+                                "keyword": {
+                                    "type": "keyword", "ignore_above": 256
+                                }
+                            },
+                        },
+                        "namespace": {
+                            "type": "text",
+                            "fields": {
+                                "keyword": {
+                                    "type": "keyword", "ignore_above": 256
+                                }
+                            },
+                        },
+                        "ownerReferences": {"type": "text"},
+                        "resourceVersion": {
+                            "type": "text",
+                            "fields": {
+                                "keyword": {
+                                    "type": "keyword", "ignore_above": 256
+                                }
+                            },
+                        },
+                    }
+                },
+                "spec": {"type": "object", "enabled": False},
+                "status": {"type": "object", "enabled": False},
             }
-        }
+        },
     }
 
     def __init__(

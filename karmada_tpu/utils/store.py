@@ -131,6 +131,61 @@ class Store:
         self._deliver(event)
         return obj
 
+    def apply_many(self, objs: list) -> list:
+        """Batched create-or-update for INDEPENDENT objects: admission runs
+        per object (against pre-batch state — use only for sweeps whose
+        objects don't admit against each other, like a storm writeback
+        over distinct bindings), then one lock acquisition commits every
+        ACCEPTED mutation, then one delivery sweep fans the events out.
+        A 100k-binding writeback is 100k ``apply`` calls otherwise —
+        per-call lock churn and bookkeeping were ~30% of the measured
+        whole-plane wave.
+
+        Admission rejections do NOT abort the batch: each object's write
+        is independent (the reference's controller writebacks are
+        per-object patches — one invalid binding must not void a storm
+        wave). Rejected objects are skipped (no rv bump, no event) and
+        returned as ``[(obj, exception), ...]`` for the caller to surface.
+        No ``expected_rv`` support: CAS writers want the single-object
+        path."""
+        import time as _time
+
+        if not objs:
+            return []
+        errors: list = []
+        keyed = []
+        for obj in objs:
+            kind = obj_kind(obj)
+            key = obj_key(obj)
+            if self._admission is not None:
+                try:
+                    self._admission(kind, obj)
+                except Exception as e:  # noqa: BLE001 — per-object verdict
+                    errors.append((obj, e))
+                    continue
+            keyed.append((kind, key, obj))
+        events = []
+        with self._lock:
+            for kind, key, obj in keyed:
+                bucket = self._buckets.setdefault(kind, {})
+                existing = bucket.get(key)
+                self._rv += 1
+                obj.meta.resource_version = self._rv
+                if not obj.meta.uid:
+                    obj.meta.uid = existing.meta.uid if existing else new_uid()
+                if existing is None and not obj.meta.creation_timestamp:
+                    obj.meta.creation_timestamp = _time.time()
+                bucket[key] = obj
+                events.append(
+                    Event(
+                        MODIFIED if existing is not None else ADDED,
+                        kind, key, obj,
+                    )
+                )
+        for ev in events:
+            self._deliver(ev)
+        return errors
+
     def bump_generation(self, obj: Any) -> None:
         obj.meta.generation += 1
 

@@ -269,16 +269,28 @@ class Runtime:
     def pending(self) -> int:
         return sum(len(w) for w in self.workers)
 
+    # called every HEARTBEAT_EVERY drained items mid-settle (None = off).
+    # Returning False aborts the drain with work still queued — the seam a
+    # leader-elected plane uses to renew its Lease during a storm settle
+    # and to STOP reconciling the moment it is deposed (client-go renews on
+    # a background goroutine; this runtime is cooperative, so renewal must
+    # ride the drain loop itself)
+    heartbeat = None
+    HEARTBEAT_EVERY = 256
+
     def run_until_settled(self, max_steps: int = 100_000, *, tick: bool = True) -> int:
         """Process queued work until quiescent. Returns steps executed.
 
         Tickers run once at the start (not per pass — a ticker that always
         enqueues would never settle); wall-clock periodicity comes from the
         caller invoking this repeatedly, as a real deployment's main loop
-        does."""
+        does. ``heartbeat`` (if set) is invoked every HEARTBEAT_EVERY items
+        so long drains cannot starve time-critical duties; a False return
+        aborts the drain (remaining keys stay queued for the next call)."""
         if tick:
             self.tick()
         steps = 0
+        next_beat = self.HEARTBEAT_EVERY
         while steps < max_steps:
             progressed = False
             for w in self.workers:
@@ -287,6 +299,10 @@ class Runtime:
                     steps += 1
                     if steps >= max_steps:
                         return steps
+                    if self.heartbeat is not None and steps >= next_beat:
+                        next_beat = steps + self.HEARTBEAT_EVERY
+                        if self.heartbeat() is False:
+                            return steps
             if not progressed:
                 break
         return steps

@@ -593,3 +593,52 @@ def test_post_compaction_delta_pass_is_host_identical():
     host = TensorScheduler(snap)
     want = host._schedule_host(kept, [host._compiled(p.placement) for p in kept])
     _assert_same(want, res)
+
+
+def test_caps_compile_stable_after_warm_window():
+    """Cap tuning must never dispatch an unseen XLA trace once the warm
+    window (SHRINK_SUSTAIN + a couple of passes) has run: growth lands at
+    churn onset, sustained shrinks land inside the window, and wobbles
+    ride already-compiled traces. A vote-delayed shrink used to fire MID
+    storm — a 94s dispatch stall on the TPU bench."""
+    import copy
+
+    clusters = synthetic_fleet(48, seed=21)
+    snap = ClusterSnapshot(clusters)
+    pl = dynamic_weight_placement()
+    problems = [
+        BindingProblem(key=f"b{i}", placement=pl, replicas=(i % 25) + 1,
+                       requests=REQ, gvk="apps/v1/Deployment")
+        for i in range(1500)
+    ]
+    eng = TensorScheduler(snap, chunk_size=256)
+    eng.schedule(problems)  # warm/compile
+
+    rng = np.random.default_rng(3)
+
+    def drift():
+        for cl in clusters:
+            rs = cl.status.resource_summary
+            for dim, q in list(rs.allocated.items()):
+                alloc = rs.allocatable.get(dim, 0)
+                rs.allocated[dim] = int(min(max(
+                    0, q + int(rng.integers(-2, 3)) * max(1, alloc // 100)
+                ), alloc))
+        assert eng.update_snapshot(ClusterSnapshot(clusters))
+
+    # warm window: steady settle + churn onset + the sustained-shrink span
+    window = fleet_mod.SHRINK_SUSTAIN + 4
+    for _ in range(3):
+        eng.schedule(problems)
+    for _ in range(window):
+        drift()
+        eng.schedule(problems)
+    # beyond the window: alternate steady and churn passes — no pass may
+    # compile anything new, whatever the cap tuner wants
+    for i in range(8):
+        if i % 3:
+            drift()
+        eng.schedule(problems)
+        assert not eng.last_pass_new_trace, (
+            f"pass {i} dispatched an unseen trace after the warm window"
+        )

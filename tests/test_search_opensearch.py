@@ -67,7 +67,7 @@ class TestProtocol:
         # already-exists 400 and tolerates it
         be2 = OpenSearchBackend(target)
         be2._ensure_index("Deployment")
-        assert "karmada-deployment" in server.indices
+        assert "kubernetes-deployment" in server.indices
 
     def test_doc_crud_and_search(self, node):
         _, target = node
@@ -99,13 +99,13 @@ class TestProtocol:
             with urllib.request.urlopen(req, timeout=5) as r:
                 return json.loads(r.read())
 
-        assert call("PUT", "/karmada-deployment",
+        assert call("PUT", "/kubernetes-deployment",
                     json.dumps({"mappings": {}}).encode())["acknowledged"]
         doc = resource_to_doc("m1", mk("raw", uid="u-raw"))
-        out = call("PUT", "/karmada-deployment/_doc/u-raw",
+        out = call("PUT", "/kubernetes-deployment/_doc/u-raw",
                    json.dumps(doc).encode())
         assert out["result"] == "created"
-        out = call("PUT", "/karmada-deployment/_doc/u-raw",
+        out = call("PUT", "/kubernetes-deployment/_doc/u-raw",
                    json.dumps(doc).encode())
         assert out["result"] == "updated"
         res = call("POST", "/_search", json.dumps(
@@ -113,9 +113,9 @@ class TestProtocol:
         ).encode())
         assert res["hits"]["total"]["value"] == 1
         assert res["hits"]["hits"][0]["_id"] == "u-raw"
-        out = call("DELETE", "/karmada-deployment/_doc/u-raw")
+        out = call("DELETE", "/kubernetes-deployment/_doc/u-raw")
         assert out["result"] == "deleted"
-        out = call("DELETE", "/karmada-deployment/_doc/u-raw")
+        out = call("DELETE", "/kubernetes-deployment/_doc/u-raw")
         assert out["result"] == "not_found"
 
     def test_bulk_ndjson(self, node):
@@ -124,11 +124,11 @@ class TestProtocol:
         for i in range(3):
             doc = resource_to_doc("m1", mk(f"b{i}", uid=f"ub{i}"))
             lines.append(json.dumps(
-                {"index": {"_index": "karmada-deployment", "_id": f"ub{i}"}}
+                {"index": {"_index": "kubernetes-deployment", "_id": f"ub{i}"}}
             ))
             lines.append(json.dumps(doc))
         lines.append(json.dumps(
-            {"delete": {"_index": "karmada-deployment", "_id": "ub1"}}
+            {"delete": {"_index": "kubernetes-deployment", "_id": "ub1"}}
         ))
         req = urllib.request.Request(
             f"http://{target}/_bulk",
