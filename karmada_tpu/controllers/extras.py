@@ -148,7 +148,7 @@ class WorkloadRebalancerController:
             ref = rb.spec.resource
             by_ref.setdefault((ref.kind, ref.name), []).append(rb)
         observed = []
-        triggered = []
+        triggered = []  # (observed index, rb) — maps rejections back
         for target in rebalancer.spec.workloads:
             result = "NotFound"
             for rb in by_ref.get((target.kind, target.name), ()):
@@ -159,19 +159,35 @@ class WorkloadRebalancerController:
                     continue
                 rb.spec.reschedule_triggered_at = self.clock()
                 rb.meta.generation += 1
-                triggered.append(rb)
+                triggered.append((len(observed), rb))
                 result = "Successful"
             observed.append(
                 {"workload": f"{target.kind}/{target.namespace}/{target.name}",
                  "result": result}
             )
-        # one batched store sweep for the whole trigger wave
+        # one batched store sweep for the whole trigger wave; a per-object
+        # admission rejection rolls the in-place bump back and surfaces as
+        # Failed on the observed workload (the old per-object apply path
+        # raised; swallowing it would report Successful for a binding that
+        # will never reschedule)
         apply_many = getattr(self.store, "apply_many", None)
         if apply_many is not None:
-            apply_many(triggered)
+            rejected = apply_many([rb for _, rb in triggered])
+            for rb, err in rejected:
+                rb.meta.generation -= 1
+                rb.spec.reschedule_triggered_at = None
+                for idx, t_rb in triggered:
+                    if t_rb is rb:
+                        observed[idx]["result"] = f"Failed: {err}"
+                        break
         else:
-            for rb in triggered:
-                self.store.apply(rb)
+            for idx, rb in triggered:
+                try:
+                    self.store.apply(rb)
+                except Exception as err:  # noqa: BLE001 — per-object verdict
+                    rb.meta.generation -= 1
+                    rb.spec.reschedule_triggered_at = None
+                    observed[idx]["result"] = f"Failed: {err}"
         finished = all(o["result"] != "Pending" for o in observed)
         finish_time = rebalancer.status.finish_time
         if finished and finish_time is None:

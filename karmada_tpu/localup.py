@@ -55,6 +55,21 @@ def spawn_child(cmd: list[str], platform: str = "cpu") -> subprocess.Popen:
     env = dict(
         os.environ, JAX_PLATFORMS=platform, KARMADA_TPU_PLATFORM=platform
     )
+    if platform != "cpu":
+        # the test harness exports --xla_force_host_platform_device_count
+        # for its own virtual CPU mesh (tests/conftest.py); the tunnel
+        # client DEADLOCKS at backend init when an accelerator child
+        # inherits it (observed: the solver sidecar silent for 600 s under
+        # pytest, instant standalone). The accelerator-owning child starts
+        # with that flag stripped.
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        if flags:
+            env["XLA_FLAGS"] = " ".join(flags)
+        else:
+            env.pop("XLA_FLAGS", None)
     pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = (
         pkg_parent + os.pathsep + env["PYTHONPATH"]
@@ -444,21 +459,36 @@ class LocalUp:
         py = sys.executable
         try:
             if self.with_solver:
-                p = self._spawn(
-                    "solver",
-                    [py, "-m", "karmada_tpu.solver", "--address",
-                     "127.0.0.1:0", "--report-backend"],
-                    platform=self.solver_platform,
-                )
-                self.endpoints["solver"] = _scrape_port(p, r"port (\d+)")
-                # backend init can take minutes on an accelerator tunnel
-                # (single-client grant: a predecessor's unclean exit can
-                # hold the claim until the server-side session expires);
-                # the line is printed after the port so CPU deployments
-                # scrape both instantly
-                self.solver_backend = scrape_line(
-                    p, r"solver backend (\S+)", timeout=600.0
-                )
+                # claim-with-retry: the accelerator tunnel is single-client
+                # and a predecessor's unclean exit holds the claim for
+                # minutes with NO timeout client-side — a stuck claimant
+                # hangs forever. The sidecar watchdogs its own backend init
+                # (--backend-timeout -> 'solver backend timeout', rc=3) and
+                # we respawn a FRESH claimant until one lands post-expiry.
+                attempts = 6 if self.solver_platform != "cpu" else 1
+                for attempt in range(attempts):
+                    p = self._spawn(
+                        "solver",
+                        [py, "-m", "karmada_tpu.solver", "--address",
+                         "127.0.0.1:0", "--report-backend",
+                         "--backend-timeout", "90"],
+                        platform=self.solver_platform,
+                    )
+                    self.endpoints["solver"] = _scrape_port(p, r"port (\d+)")
+                    self.solver_backend = scrape_line(
+                        p, r"solver backend (\S+)", timeout=150.0
+                    )
+                    if self.solver_backend != "timeout":
+                        break
+                    p.kill()
+                    p.wait(timeout=5)
+                    if attempt == attempts - 1:
+                        raise RuntimeError(
+                            "solver backend init timed out on every "
+                            f"attempt ({attempts}) — the accelerator "
+                            "claim never freed"
+                        )
+                    time.sleep(20)  # let the held claim expire
             if self.with_estimator:
                 p = self._spawn(
                     "estimator",

@@ -8,7 +8,7 @@ Ref: pkg/search/backendstore/opensearch.go — the reference's
   opensearch.go:250-284);
 - one document per object keyed by UID (``PUT /{index}/_doc/{uid}``,
   ``DELETE /{index}/_doc/{uid}``; opensearch.go:158-247), with the
-  member cluster recorded in the ``cluster.karmada.io/cache-source``
+  member cluster recorded in the ``resource.karmada.io/cached-from-cluster``
   annotation and ``spec``/``status`` serialized as JSON STRINGS inside
   the document (opensearch.go:203-218).
 

@@ -21,10 +21,26 @@ def main(argv=None) -> None:
         "accelerator (forces backend init, which can take tens of "
         "seconds over a TPU tunnel)",
     )
+    p.add_argument(
+        "--backend-timeout", type=float, default=90.0,
+        help="seconds to wait for accelerator backend init before printing "
+        "'solver backend timeout' and exiting rc=3 — a single-client "
+        "tunnel whose previous claimant died uncleanly holds the claim "
+        "for minutes and the stuck claim cannot be cancelled in-process; "
+        "fail-fast lets the orchestrator respawn a fresh claimant",
+    )
     args = p.parse_args(argv)
 
     def read(path):
         return open(path, "rb").read() if path else None
+
+    # graceful SIGTERM: run the interpreter's normal exit path so the
+    # accelerator client's destructors release the tunnel session — a
+    # default-action SIGTERM death leaves the claim held server-side and
+    # blocks the NEXT claimant for minutes (observed on the e2e)
+    import signal as _signal
+
+    _signal.signal(_signal.SIGTERM, lambda s, f: sys.exit(0))
 
     server = SolverGrpcServer(
         SolverService(),
@@ -37,9 +53,24 @@ def main(argv=None) -> None:
     # the parent process scrapes this line to learn the bound port
     print(f"solver listening on port {port}", flush=True)
     if args.report_backend:
-        import jax
+        import os as _os
+        import threading
 
-        print(f"solver backend {jax.devices()[0].platform}", flush=True)
+        done = threading.Event()
+        platform = [""]
+
+        def probe() -> None:
+            import jax
+
+            platform[0] = jax.devices()[0].platform
+            done.set()
+
+        threading.Thread(target=probe, daemon=True).start()
+        if done.wait(args.backend_timeout):
+            print(f"solver backend {platform[0]}", flush=True)
+        else:
+            print("solver backend timeout", flush=True)
+            _os._exit(3)
     try:
         server.wait()
     except KeyboardInterrupt:

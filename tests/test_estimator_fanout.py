@@ -11,20 +11,14 @@ touching the wire until invalidated.
 Ref: client/accurate.go:139-162 (fan-out), core/util.go:54-104 (min-merge).
 """
 
-import json
-import sys
-import tempfile
-import time
-
 import numpy as np
 import pytest
 
-from karmada_tpu.estimator import EstimatorRegistry
+from karmada_tpu.estimator.fleet import spawn_estimator_fleet
 from karmada_tpu.estimator.grpc_transport import (
     GrpcEstimatorConnection,
     RemoteAccurateEstimator,
 )
-from karmada_tpu.localup import scrape_line, spawn_child
 from karmada_tpu.scheduler import BindingProblem, ClusterSnapshot, TensorScheduler
 from karmada_tpu.utils.builders import dynamic_weight_placement, synthetic_fleet
 from karmada_tpu.utils.quantity import parse_resource_list
@@ -38,53 +32,11 @@ def estimator_fleet():
     snap = ClusterSnapshot(clusters)
     dims = list(snap.dims)
     free = np.maximum(np.asarray(snap.available_cap), 0)
-    procs, conns, paths = [], [], []
-    registry = EstimatorRegistry()
-    try:
-        shard = C // SERVERS
-        for s in range(SERVERS):
-            names_s = snap.names[s * shard:(s + 1) * shard]
-            spec = {
-                name: {
-                    d: int(free[snap.index[name], r])
-                    for r, d in enumerate(dims)
-                }
-                for name in names_s
-            }
-            f = tempfile.NamedTemporaryFile("w", suffix=".json", delete=False)
-            json.dump(spec, f)
-            f.close()
-            paths.append(f.name)
-            proc = spawn_child(
-                [sys.executable, "-m", "karmada_tpu.estimator",
-                 "--spec-file", f.name]
-            )
-            procs.append(proc)
-            port = scrape_line(proc, r"port (\d+)", timeout=90)
-            conn = GrpcEstimatorConnection(
-                "multi", f"127.0.0.1:{port}", timeout_seconds=5.0
-            )
-            conns.append(conn)
-            for name in names_s:
-                registry.register(
-                    RemoteAccurateEstimator(name, conn, lambda: dims)
-                )
-        yield snap, registry
-    finally:
-        for conn in conns:
-            conn.close()
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        for p in procs:
-            p.wait(timeout=5)
-        import os
-
-        for path in paths:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+    with spawn_estimator_fleet(
+        snap.names, free, dims, n_servers=SERVERS, index=snap.index,
+        timeout_seconds=5.0,
+    ) as fleet:
+        yield snap, fleet.registry
 
 
 def make_problems(snap):
