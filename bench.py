@@ -532,7 +532,7 @@ def run_engine_north_star(args) -> dict:
     # pass dispatches no unseen trace signature (engine.last_pass_new_trace)
     # with a 4-pass floor covering the 2-3-vote shrink windows — the timed
     # window below must only ever run already-compiled traces
-    for i in range(8):
+    for i in range(12):
         t0 = time.perf_counter()
         engine.schedule(problems)
         fresh = engine.last_pass_new_trace
@@ -541,7 +541,7 @@ def run_engine_north_star(args) -> dict:
             f"new_trace={fresh}",
             file=sys.stderr,
         )
-        if i >= 3 and not fresh:
+        if i >= 3 and not fresh and not engine.cap_shrink_pending:
             break
 
     import contextlib
@@ -583,7 +583,7 @@ def run_engine_north_star(args) -> dict:
     n_churn_timed = max(4, args.repeats)
     drift_snaps = []
     rng_c = np.random.default_rng(99)
-    for _ in range(6 + n_churn_timed):
+    for _ in range(8 + n_churn_timed):
         for cl in clusters:
             rs = cl.status.resource_summary
             for dim, q in list(rs.allocated.items()):
@@ -597,7 +597,7 @@ def run_engine_north_star(args) -> dict:
     # no unseen trace (min 2 passes: onset re-tiers the caps, the next
     # compiles whichever of the delta/speculative traces engages)
     n_warm = 0
-    for warm_snap in drift_snaps[:6]:
+    for warm_snap in drift_snaps[:8]:
         swapped = engine.update_snapshot(warm_snap)
         assert swapped
         t0 = time.perf_counter()
@@ -609,7 +609,7 @@ def run_engine_north_star(args) -> dict:
             file=sys.stderr,
         )
         n_warm += 1
-        if n_warm >= 2 and not fresh:
+        if n_warm >= 2 and not fresh and not engine.cap_shrink_pending:
             break
     churn_times = []
     for rep, snap_r in enumerate(drift_snaps[n_warm:n_warm + n_churn_timed]):
@@ -671,7 +671,10 @@ def run_engine_north_star(args) -> dict:
         # timed pass
         for i in range(6):
             h_engine.schedule(h_problems)
-            if i >= 2 and not h_engine.last_pass_new_trace:
+            if (
+                i >= 2 and not h_engine.last_pass_new_trace
+                and not h_engine.cap_shrink_pending
+            ):
                 break
         h_times = []
         for rep in range(3):
@@ -725,9 +728,12 @@ def run_engine_north_star(args) -> dict:
         print(f"# hetero-9000 warm pass: {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
         table_obj = k_engine._fleet
-        for i in range(6):  # caps settle (shrink = up to 3 votes + observe)
+        for i in range(8):  # caps settle until compile-stable
             k_engine.schedule(k_problems)
-            if i >= 3 and not k_engine.last_pass_new_trace:
+            if (
+                i >= 3 and not k_engine.last_pass_new_trace
+                and not k_engine.cap_shrink_pending
+            ):
                 break
         k_times = []
         for rep in range(2):
@@ -787,7 +793,10 @@ def run_engine_north_star(args) -> dict:
                     file=sys.stderr,
                 )
                 rot += 1
-                if rot >= 2 and not fresh:
+                if (
+                    rot >= 2 and not fresh
+                    and not k_engine.cap_shrink_pending
+                ):
                     break
             kc_times = []
             for i in range(3):
@@ -974,7 +983,7 @@ def run_engine_north_star(args) -> dict:
               file=sys.stderr)
         # adaptive settle (same contract as the headline tier: no timed
         # pass may dispatch an unseen trace)
-        for i in range(8):
+        for i in range(12):
             t0 = time.perf_counter()
             m_engine.schedule(m_problems)
             fresh = m_engine.last_pass_new_trace
@@ -983,7 +992,7 @@ def run_engine_north_star(args) -> dict:
                 f"new_trace={fresh}",
                 file=sys.stderr,
             )
-            if i >= 3 and not fresh:
+            if i >= 3 and not fresh and not m_engine.cap_shrink_pending:
                 break
         m_times = []
         for rep in range(3):
@@ -996,7 +1005,7 @@ def run_engine_north_star(args) -> dict:
         # re-tiers the caps, the next compiles the delta-wire trace those
         # caps select; loop until compile-stable) + 4 timed passes
         m_drifts = []
-        for _ in range(9):
+        for _ in range(12):
             for cl in clusters:
                 rs = cl.status.resource_summary
                 for dim, q in list(rs.allocated.items()):
@@ -1006,7 +1015,7 @@ def run_engine_north_star(args) -> dict:
                     ), alloc))
             m_drifts.append(ClusterSnapshot(clusters))
         m_warm = 0
-        for warm_snap in m_drifts[:5]:
+        for warm_snap in m_drifts[:8]:
             swapped = m_engine.update_snapshot(warm_snap)
             assert swapped
             t0 = time.perf_counter()
@@ -1018,7 +1027,7 @@ def run_engine_north_star(args) -> dict:
                 file=sys.stderr,
             )
             m_warm += 1
-            if m_warm >= 2 and not fresh:
+            if m_warm >= 2 and not fresh and not m_engine.cap_shrink_pending:
                 break
         m_churn_times = []
         for rep, snap_m in enumerate(m_drifts[m_warm:m_warm + 4]):

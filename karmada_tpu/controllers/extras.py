@@ -148,7 +148,11 @@ class WorkloadRebalancerController:
             ref = rb.spec.resource
             by_ref.setdefault((ref.kind, ref.name), []).append(rb)
         observed = []
-        triggered = []  # (observed index, rb) — maps rejections back
+        # (observed index, rb, pre-bump trigger) — maps rejections back and
+        # lets the rollback RESTORE a still-pending earlier trigger (the
+        # store hands out live references: zeroing the field would erase a
+        # legitimate trigger the scheduler had not yet consumed)
+        triggered = []
         for target in rebalancer.spec.workloads:
             result = "NotFound"
             for rb in by_ref.get((target.kind, target.name), ()):
@@ -157,9 +161,10 @@ class WorkloadRebalancerController:
                     and rb.spec.resource.namespace != target.namespace
                 ):
                     continue
+                prior = rb.spec.reschedule_triggered_at
                 rb.spec.reschedule_triggered_at = self.clock()
                 rb.meta.generation += 1
-                triggered.append((len(observed), rb))
+                triggered.append((len(observed), rb, prior))
                 result = "Successful"
             observed.append(
                 {"workload": f"{target.kind}/{target.namespace}/{target.name}",
@@ -170,23 +175,24 @@ class WorkloadRebalancerController:
         # Failed on the observed workload (the old per-object apply path
         # raised; swallowing it would report Successful for a binding that
         # will never reschedule)
+        by_id = {
+            id(rb): (idx, prior) for idx, rb, prior in triggered
+        }
         apply_many = getattr(self.store, "apply_many", None)
         if apply_many is not None:
-            rejected = apply_many([rb for _, rb in triggered])
+            rejected = apply_many([rb for _, rb, _ in triggered])
             for rb, err in rejected:
+                idx, prior = by_id[id(rb)]
                 rb.meta.generation -= 1
-                rb.spec.reschedule_triggered_at = None
-                for idx, t_rb in triggered:
-                    if t_rb is rb:
-                        observed[idx]["result"] = f"Failed: {err}"
-                        break
+                rb.spec.reschedule_triggered_at = prior
+                observed[idx]["result"] = f"Failed: {err}"
         else:
-            for idx, rb in triggered:
+            for idx, rb, prior in triggered:
                 try:
                     self.store.apply(rb)
                 except Exception as err:  # noqa: BLE001 — per-object verdict
                     rb.meta.generation -= 1
-                    rb.spec.reschedule_triggered_at = None
+                    rb.spec.reschedule_triggered_at = prior
                     observed[idx]["result"] = f"Failed: {err}"
         finished = all(o["result"] != "Pending" for o in observed)
         finish_time = rebalancer.status.finish_time

@@ -478,6 +478,19 @@ class LocalUp:
                     self.solver_backend = scrape_line(
                         p, r"solver backend (\S+)", timeout=150.0
                     )
+                    if self.solver_backend == "error":
+                        # deterministic init failure: retrying replays the
+                        # same traceback — surface it instead
+                        detail = ""
+                        try:
+                            p.kill()
+                            p.wait(timeout=5)
+                            detail = (p.stdout.read() or "")[-2000:]
+                        except Exception:  # noqa: BLE001 — diagnostics
+                            pass
+                        raise RuntimeError(
+                            f"solver backend init failed:\n{detail}"
+                        )
                     if self.solver_backend != "timeout":
                         break
                     p.kill()

@@ -317,6 +317,13 @@ class TensorScheduler:
             self._fleet is not None and self._fleet.new_trace_last_pass
         )
 
+    @property
+    def cap_shrink_pending(self) -> bool:
+        """A buffer-cap shrink desire is accumulating in the fleet table
+        (see FleetTable.shrink_pending) — warm loops should continue until
+        it either fires (compiling inside warmup) or clears."""
+        return bool(self._fleet is not None and self._fleet.shrink_pending)
+
     def schedule(self, problems: Sequence[BindingProblem]) -> list[ScheduleResult]:
         import time as _time
 

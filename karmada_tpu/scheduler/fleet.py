@@ -149,13 +149,29 @@ def _entry_wire(stream, e_cap: int, pack21: bool):
 # --------------------------------------------------------------------------
 
 
-def _row_masks(cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
-               chunk: int, c: int):
+def _unpack_bits(bits_u8, c: int):
+    """uint8[B, W8] (little bit order) -> bool[B, C]: the device-side
+    inverse of np.packbits(bitorder='little'). Pure shifts/compares — the
+    cost is one [B, C] elementwise pass, bought back eightfold in gather
+    bandwidth."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    x = (bits_u8[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1)
+    return x.reshape(bits_u8.shape[0], -1)[:, :c] != 0
+
+
+def _row_masks(cp_bits, cp_static, gvk_bits, incomplete_en, cpc, gvc, psc,
+               pcc, vc, chunk: int, c: int):
     """Per-chunk previous-assignment scatter + THE feasibility algebra,
     shared by every kernel that needs it (_fleet_solve, _fleet_pass,
     _fleet_bits) so the mask expression cannot drift between the solve
-    and the lazily-computed feasibility bitsets. Returns (prev, cp_rows,
-    feasible); callers apply their own sharding constraints."""
+    and the lazily-computed feasibility bitsets. Returns (prev, static_w,
+    feasible); callers apply their own sharding constraints.
+
+    The affinity and taint planes ship BITPACKED (uint8, 8 clusters per
+    byte): the per-row cp gather was the second-largest term of the 1M
+    steady pass (60 KB/row as int32 planes -> 21 KB packed+static,
+    measured 0.57 s -> ~0.2 s over 245 chunks), and the slot table's HBM
+    footprint drops ~3x with it."""
     prev = (
         jnp.zeros((chunk, c), jnp.int32)
         .at[jnp.arange(chunk)[:, None], psc]
@@ -164,17 +180,22 @@ def _row_masks(cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
     prev_mask = prev > 0
     # plain [B]-index row gathers: re-probed on the current backend at
     # U in {2..3500} x W in {5k, 15k} — compiles fine and runs at
-    # bandwidth (~0.12s/pass) vs 0.29s+ for the one-hot matmul at
-    # heterogeneous U (the matmul workaround predates this backend;
-    # ops.estimate.gather_profile_rows keeps it for other callers)
-    cp_rows = cp_table[cpc]  # [chunk, 3C]
+    # bandwidth vs 0.29s+ for the one-hot matmul at heterogeneous U (the
+    # matmul workaround predates this backend; ops.estimate.
+    # gather_profile_rows keeps it for other callers)
+    bits = cp_bits[cpc]  # [chunk, 2*W8] u8
+    w8 = bits.shape[1] // 2
+    aff_ok = _unpack_bits(bits[:, :w8], c)  # affinity & spread-field
+    taint_ok = _unpack_bits(bits[:, w8:], c)
+    static_w = cp_static[cpc]  # [chunk, C] i32
+    gvk_ok = _unpack_bits(gvk_bits[gvc], c)
     feasible = (
-        (cp_rows[:, :c] != 0)  # affinity & spread-field
-        & ((gvk_table[gvc] != 0) | (prev_mask & incomplete_en[None, :]))
-        & ((cp_rows[:, c : 2 * c] != 0) | prev_mask)  # taints (leniency)
+        aff_ok
+        & (gvk_ok | (prev_mask & incomplete_en[None, :]))
+        & (taint_ok | prev_mask)  # taints (leniency)
         & vc[:, None]
     )
-    return prev, cp_rows, feasible
+    return prev, static_w, feasible
 
 
 @partial(
@@ -186,8 +207,9 @@ def _row_masks(cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
     ),
 )
 def _fleet_solve(
-    cp_table,  # int32[U, 3C]: [aff&spread_field | taint | static_w]
-    gvk_table,  # int32[G, C]
+    cp_bits,  # uint8[U, 2*W8]: bitpacked [aff&spread_field | taint]
+    cp_static,  # int32[U, C]: static weights
+    gvk_bits,  # uint8[G, W8] bitpacked enablement masks
     prof_table,  # int32[P, C] general availability (-1 = no answer)
     incomplete_en,  # bool[C] — ~CompleteAPIEnablements
     rows,  # int32[n_pad] table rows (-1 = padding)
@@ -210,7 +232,7 @@ def _fleet_solve(
     shard_c: bool = False,  # also shard the cluster axis over mesh axis "c"
     pack21: bool = False,  # 21-bit entry packing (site < 2^13)
 ):
-    c = gvk_table.shape[1]
+    c = cp_static.shape[1]
     c_ax = "c" if (mesh is not None and shard_c) else None
 
     def shard(a, *axes):
@@ -251,13 +273,12 @@ def _fleet_solve(
         psc, pcc = shard(psc, "b", None), shard(pcc, "b", None)
         # mask composition — same algebra as TensorScheduler._pack_chunk,
         # via the shared helper every feasibility consumer uses
-        prev, cp_rows, feasible = _row_masks(
-            cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
-            chunk, c,
+        prev, static_w, feasible = _row_masks(
+            cp_bits, cp_static, gvk_bits, incomplete_en, cpc, gvc, psc,
+            pcc, vc, chunk, c,
         )
         prev = shard(prev, "b", c_ax)
         feasible = shard(feasible, "b", c_ax)
-        static_w = cp_rows[:, 2 * c :]
         general = prof_table[pfc]
         avail = shard(merge_estimates(repsc, (general,)), "b", c_ax)
         assignment, unsched = _divide_batch(
@@ -335,7 +356,7 @@ def _fleet_solve(
         | (has_cand.astype(jnp.int32) << 9)
         | (changed.astype(jnp.int32) << 10)
     )
-    c_total = gvk_table.shape[1]
+    c_total = cp_static.shape[1]
     if c_total <= 0xFFFF:
         # byte wire: transfer bytes are the pass's budget, and a packed
         # entry fits 3 bytes when the site index fits 16 bits (counts are
@@ -426,8 +447,9 @@ def d_round(v: int) -> int:
     donate_argnames=("res_dense", "res_meta"),
 )
 def _fleet_pass(
-    cp_table,  # int32[U, 3C]: [aff&spread_field | taint | static_w]
-    gvk_table,  # int32[G, C]
+    cp_bits,  # uint8[U, 2*W8]: bitpacked [aff&spread_field | taint]
+    cp_static,  # int32[U, C]: static weights
+    gvk_bits,  # uint8[G, W8] bitpacked enablement masks
     prof_table,  # int32[P, C] general availability (-1 = no answer)
     incomplete_en,  # bool[C] — ~CompleteAPIEnablements
     rows,  # int32[n_pad] table rows (-1 = padding)
@@ -456,7 +478,7 @@ def _fleet_pass(
     phase B at all. Returns (flat_wire_u8, changed_rowbuf, new_res_dense,
     new_res_meta); feasibility bitsets are _fleet_bits' separate, lazily
     dispatched job."""
-    c = gvk_table.shape[1]
+    c = cp_static.shape[1]
     cap = res_dense.shape[0]
     c_ax = "c" if (mesh is not None and shard_c) else None
     # per-row delta slots: 62 exact + the 63 overflow sentinel fit the
@@ -493,13 +515,12 @@ def _fleet_pass(
         )
         cpc, gvc, pfc = shard(cpc, "b"), shard(gvc, "b"), shard(pfc, "b")
         psc, pcc = shard(psc, "b", None), shard(pcc, "b", None)
-        prev, cp_rows, feasible = _row_masks(
-            cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
-            chunk, c,
+        prev, static_w, feasible = _row_masks(
+            cp_bits, cp_static, gvk_bits, incomplete_en, cpc, gvc, psc,
+            pcc, vc, chunk, c,
         )
         prev = shard(prev, "b", c_ax)
         feasible = shard(feasible, "b", c_ax)
-        static_w = cp_rows[:, 2 * c :]
         general = prof_table[pfc]
         avail = shard(merge_estimates(repsc, (general,)), "b", c_ax)
         assignment, unsched = _divide_batch(
@@ -697,7 +718,7 @@ def _decode_entry_wire(raw2, cap_used: int, byte_wire: bool, pack21: bool):
 
 @partial(jax.jit, static_argnames=("chunk", "n_chunks"))
 def _fleet_bits(
-    cp_table, gvk_table, prof_table, incomplete_en, rows,
+    cp_bits, cp_static, gvk_bits, prof_table, incomplete_en, rows,
     cp_idx, gvk_idx, prof_idx, replicas, strategy, fresh,
     prev_sites, prev_counts, *, chunk: int, n_chunks: int,
 ):
@@ -709,7 +730,7 @@ def _fleet_bits(
     feasibility verbatim; inputs are the pass-time device arrays (JAX
     arrays are immutable, so a batch holding these refs stays consistent
     even after later passes rebuild the live tables)."""
-    c = gvk_table.shape[1]
+    c = cp_static.shape[1]
     valid = rows >= 0
     r = jnp.maximum(rows, 0)
     cp = cp_idx[r]
@@ -722,7 +743,7 @@ def _fleet_bits(
         cpc, gvc, vc = sl(cp), sl(gv), sl(valid)
         psc, pcc = sl(ps), sl(pc)
         _, _, feasible = _row_masks(
-            cp_table, gvk_table, incomplete_en, cpc, gvc, psc, pcc, vc,
+            cp_bits, cp_static, gvk_bits, incomplete_en, cpc, gvc, psc, pcc, vc,
             chunk, c,
         )
         pad = (-c) % 32
@@ -1042,6 +1063,15 @@ class FleetTable:
         self._seen_traces: set = set()
         self.new_trace_last_pass = False
 
+    @property
+    def shrink_pending(self) -> bool:
+        """A sustained-shrink desire is accumulating: within SHRINK_SUSTAIN
+        passes a smaller cap pair may compile a fresh trace. Bench warm
+        loops poll this alongside ``new_trace_last_pass`` — breaking warmup
+        while a desire is pending parks the compile inside the timed
+        window (an 18s dispatch stall on the 1M tier)."""
+        return bool(self._shrink_desire[1] or self._e_shrink_desire[1])
+
     def _mark_trace(self, *key) -> None:
         """Record a dispatched trace signature; flips the per-pass
         new-trace flag when the signature is unseen (a compile will run)."""
@@ -1344,26 +1374,25 @@ class FleetTable:
         _mark("recompile")
         c = snap.num_clusters
 
-        def cp_rows_np(slots) -> np.ndarray:
+        def cp_bits_np(slots) -> np.ndarray:
+            """Bitpacked [aff&spread_field | taint] planes: uint8[k, 2*W8]
+            (little bit order — _unpack_bits is the device inverse)."""
+            aff = np.stack(
+                [(cp.terms[0][1] & cp.spread_field_ok) for _, cp in slots]
+            )
+            taint = np.stack([cp.taint_ok for _, cp in slots])
             return np.concatenate(
                 [
-                    np.stack(
-                        [
-                            (cp.terms[0][1] & cp.spread_field_ok).astype(
-                                np.int32
-                            )
-                            for _, cp in slots
-                        ]
-                    ),
-                    np.stack(
-                        [cp.taint_ok.astype(np.int32) for _, cp in slots]
-                    ),
-                    np.stack(
-                        [cp.static_weights.astype(np.int32) for _, cp in slots]
-                    ),
+                    np.packbits(aff, axis=1, bitorder="little"),
+                    np.packbits(taint, axis=1, bitorder="little"),
                 ],
                 axis=1,
-            )  # [k, 3C]
+            )
+
+        def cp_static_np(slots) -> np.ndarray:
+            return np.stack(
+                [cp.static_weights.astype(np.int32) for _, cp in slots]
+            )  # [k, C]
 
         # the mask tables are functions of the snapshot's FILTER fields only
         # (labels/taints/enablements/topology — snapshot.mask_token) and the
@@ -1382,37 +1411,47 @@ class FleetTable:
             or self._cp_remapped
             or self._cp_uploaded == 0
         )
+        w8 = (c + 7) // 8
         if full:
             # quantized capacity, padded with on-device zeros via concat
             # (a functional .at[:n].set on a zeros table would hold TWO
-            # full-size buffers transiently — at 10k slots x 5k clusters
-            # that is most of a GB each); only live rows ship the wire
+            # full-size buffers transiently); only live rows ship the wire
             cap_s = _slot_cap(n_slots)
-            live = jnp.asarray(cp_rows_np(self._cp_pl))
+            bits_live = jnp.asarray(cp_bits_np(self._cp_pl))
+            static_live = jnp.asarray(cp_static_np(self._cp_pl))
             if cap_s > n_slots:
-                cp_dev = jnp.concatenate(
-                    [live, jnp.zeros((cap_s - n_slots, 3 * c), jnp.int32)]
+                pad = cap_s - n_slots
+                cp_bits_dev = jnp.concatenate(
+                    [bits_live, jnp.zeros((pad, 2 * w8), jnp.uint8)]
+                )
+                cp_static_dev = jnp.concatenate(
+                    [static_live, jnp.zeros((pad, c), jnp.int32)]
                 )
             else:
-                cp_dev = live
+                cp_bits_dev = bits_live
+                cp_static_dev = static_live
             self._cp_uploaded = n_slots
             self._cp_remapped = False
         else:
-            cp_dev = self._dev_tables[0]
+            cp_bits_dev = self._dev_tables[0]
+            cp_static_dev = self._dev_tables[1]
             if n_slots > self._cp_uploaded:
-                if n_slots > cp_dev.shape[0]:  # grow device capacity
-                    cp_dev = jnp.concatenate(
-                        [
-                            cp_dev,
-                            jnp.zeros(
-                                (_slot_cap(n_slots) - cp_dev.shape[0], 3 * c),
-                                jnp.int32,
-                            ),
-                        ]
+                if n_slots > cp_bits_dev.shape[0]:  # grow device capacity
+                    grow = _slot_cap(n_slots) - cp_bits_dev.shape[0]
+                    cp_bits_dev = jnp.concatenate(
+                        [cp_bits_dev, jnp.zeros((grow, 2 * w8), jnp.uint8)]
                     )
-                new = cp_rows_np(self._cp_pl[self._cp_uploaded :])
+                    cp_static_dev = jnp.concatenate(
+                        [cp_static_dev, jnp.zeros((grow, c), jnp.int32)]
+                    )
+                new_slots = self._cp_pl[self._cp_uploaded :]
                 idx = jnp.arange(self._cp_uploaded, n_slots)
-                cp_dev = cp_dev.at[idx].set(jnp.asarray(new))
+                cp_bits_dev = cp_bits_dev.at[idx].set(
+                    jnp.asarray(cp_bits_np(new_slots))
+                )
+                cp_static_dev = cp_static_dev.at[idx].set(
+                    jnp.asarray(cp_static_np(new_slots))
+                )
                 self._cp_uploaded = n_slots
         if full or slots_changed:
             gvk_rows = []
@@ -1427,15 +1466,18 @@ class FleetTable:
                 else:
                     word, bit = gid // 32, gid % 32
                     mask = (snap.gvk_bits[:, word] >> np.uint32(bit)) & 1 != 0
-                gvk_rows.append(mask.astype(np.int32))
+                gvk_rows.append(mask)
+            gvk_packed = np.packbits(
+                np.stack(gvk_rows), axis=1, bitorder="little"
+            )
             gvk_dev = (
-                jnp.zeros((_pow2(max(len(gvk_rows), 4)), c), jnp.int32)
+                jnp.zeros((_pow2(max(len(gvk_rows), 4)), w8), jnp.uint8)
                 .at[: len(gvk_rows)]
-                .set(jnp.asarray(np.stack(gvk_rows)))
+                .set(jnp.asarray(gvk_packed))
             )
             inc_dev = jnp.asarray(~snap.complete_enablements)
         else:
-            _, gvk_dev, _, inc_dev = self._dev_tables
+            _, _, gvk_dev, _, inc_dev = self._dev_tables
         _mark("masks")
         profs = np.stack(self._profiles)
         # pow2 row padding keeps the solve trace stable as profiles intern
@@ -1453,7 +1495,9 @@ class FleetTable:
         # this rebuild runs EVERY churn pass (snapshot gen bumps per drift)
         self._avail_max = self._host_avail_max(profs)
         _mark("avail_max")
-        self._dev_tables = (cp_dev, gvk_dev, prof_table, inc_dev)
+        self._dev_tables = (
+            cp_bits_dev, cp_static_dev, gvk_dev, prof_table, inc_dev
+        )
         self._mask_token = token
         self._tables_dirty = False
 

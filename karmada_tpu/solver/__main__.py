@@ -55,22 +55,37 @@ def main(argv=None) -> None:
     if args.report_backend:
         import os as _os
         import threading
+        import traceback
 
         done = threading.Event()
         platform = [""]
+        failure = [None]
 
         def probe() -> None:
-            import jax
+            try:
+                import jax
 
-            platform[0] = jax.devices()[0].platform
-            done.set()
+                platform[0] = jax.devices()[0].platform
+            except BaseException as e:  # noqa: BLE001 — reported below
+                failure[0] = e
+            finally:
+                done.set()
 
         threading.Thread(target=probe, daemon=True).start()
-        if done.wait(args.backend_timeout):
-            print(f"solver backend {platform[0]}", flush=True)
-        else:
+        if not done.wait(args.backend_timeout):
+            # a true HANG (single-client claim held): retryable — the
+            # orchestrator respawns a fresh claimant
             print("solver backend timeout", flush=True)
             _os._exit(3)
+        if failure[0] is not None:
+            # a deterministic init FAILURE: retrying would burn the whole
+            # retry budget on the same traceback — distinct marker + the
+            # traceback after it so the orchestrator can surface it
+            print("solver backend error", flush=True)
+            traceback.print_exception(failure[0], file=sys.stdout)
+            sys.stdout.flush()
+            _os._exit(4)
+        print(f"solver backend {platform[0]}", flush=True)
     try:
         server.wait()
     except KeyboardInterrupt:
