@@ -112,6 +112,30 @@ def build_parser():
     return p
 
 
+
+def settle_engine(engine, run_pass, *, floor: int, cap: int, label: str) -> int:
+    """THE warm-loop contract, shared by every tier: keep running passes
+    until one dispatches no unseen XLA trace AND no cap-shrink desire is
+    accumulating (a pending sustained shrink compiles its one allowed
+    trace within SHRINK_SUSTAIN passes — it must land here, not in a
+    timed window). Returns the number of passes run."""
+    for i in range(cap):
+        t0 = time.perf_counter()
+        run_pass(i)
+        fresh = engine.last_pass_new_trace
+        print(
+            f"# {label} {i}: {time.perf_counter() - t0:.1f}s "
+            f"new_trace={fresh}",
+            file=sys.stderr,
+        )
+        if (
+            i + 1 >= floor and not fresh
+            and not engine.cap_shrink_pending
+        ):
+            return i + 1
+    return cap
+
+
 # --------------------------------------------------------------------------
 # shared verification helpers
 # --------------------------------------------------------------------------
@@ -532,17 +556,10 @@ def run_engine_north_star(args) -> dict:
     # pass dispatches no unseen trace signature (engine.last_pass_new_trace)
     # with a 4-pass floor covering the 2-3-vote shrink windows — the timed
     # window below must only ever run already-compiled traces
-    for i in range(12):
-        t0 = time.perf_counter()
-        engine.schedule(problems)
-        fresh = engine.last_pass_new_trace
-        print(
-            f"# settle pass {i}: {time.perf_counter() - t0:.1f}s "
-            f"new_trace={fresh}",
-            file=sys.stderr,
-        )
-        if i >= 3 and not fresh and not engine.cap_shrink_pending:
-            break
+    settle_engine(
+        engine, lambda i: engine.schedule(problems),
+        floor=4, cap=12, label="settle pass",
+    )
 
     import contextlib
 
@@ -596,21 +613,13 @@ def run_engine_north_star(args) -> dict:
     # distinct cap is one XLA trace — warm until a drift pass dispatches
     # no unseen trace (min 2 passes: onset re-tiers the caps, the next
     # compiles whichever of the delta/speculative traces engages)
-    n_warm = 0
-    for warm_snap in drift_snaps[:8]:
-        swapped = engine.update_snapshot(warm_snap)
-        assert swapped
-        t0 = time.perf_counter()
+    def churn_warm_pass(i):
+        assert engine.update_snapshot(drift_snaps[i])
         engine.schedule(problems)
-        fresh = engine.last_pass_new_trace
-        print(
-            f"# churn warm pass {n_warm}: {time.perf_counter() - t0:.1f}s "
-            f"new_trace={fresh}",
-            file=sys.stderr,
-        )
-        n_warm += 1
-        if n_warm >= 2 and not fresh and not engine.cap_shrink_pending:
-            break
+
+    n_warm = settle_engine(
+        engine, churn_warm_pass, floor=2, cap=8, label="churn warm pass",
+    )
     churn_times = []
     for rep, snap_r in enumerate(drift_snaps[n_warm:n_warm + n_churn_timed]):
         t0 = time.perf_counter()
@@ -669,13 +678,10 @@ def run_engine_north_star(args) -> dict:
         # adaptive stabilize: cap shrink fires after up to 3 votes and
         # every cap change is a fresh trace — it must land here, not in a
         # timed pass
-        for i in range(6):
-            h_engine.schedule(h_problems)
-            if (
-                i >= 2 and not h_engine.last_pass_new_trace
-                and not h_engine.cap_shrink_pending
-            ):
-                break
+        settle_engine(
+            h_engine, lambda i: h_engine.schedule(h_problems),
+            floor=3, cap=8, label="hetero settle",
+        )
         h_times = []
         for rep in range(3):
             t0 = time.perf_counter()
@@ -728,13 +734,10 @@ def run_engine_north_star(args) -> dict:
         print(f"# hetero-9000 warm pass: {time.perf_counter() - t0:.1f}s",
               file=sys.stderr)
         table_obj = k_engine._fleet
-        for i in range(8):  # caps settle until compile-stable
-            k_engine.schedule(k_problems)
-            if (
-                i >= 3 and not k_engine.last_pass_new_trace
-                and not k_engine.cap_shrink_pending
-            ):
-                break
+        settle_engine(
+            k_engine, lambda i: k_engine.schedule(k_problems),
+            floor=4, cap=8, label="hetero-9000 settle",
+        )
         k_times = []
         for rep in range(2):
             t0 = time.perf_counter()
@@ -781,23 +784,15 @@ def run_engine_north_star(args) -> dict:
 
         def _rotation_churn() -> float:
             nonlocal k_problems, k_res
-            rot = 0
-            while rot < 5:  # warm rotations until compile-stable (min 2)
-                k_problems = rotate(rot)
-                t0 = time.perf_counter()
+            def rotation_warm_pass(i):
+                nonlocal k_problems
+                k_problems = rotate(i)
                 k_engine.schedule(k_problems)
-                fresh = k_engine.last_pass_new_trace
-                print(
-                    f"# hetero-9000 rotation warm {rot}: "
-                    f"{time.perf_counter() - t0:.1f}s new_trace={fresh}",
-                    file=sys.stderr,
-                )
-                rot += 1
-                if (
-                    rot >= 2 and not fresh
-                    and not k_engine.cap_shrink_pending
-                ):
-                    break
+
+            rot = settle_engine(
+                k_engine, rotation_warm_pass, floor=2, cap=5,
+                label="hetero-9000 rotation warm",
+            )
             kc_times = []
             for i in range(3):
                 k_problems = rotate(rot + i)
@@ -990,17 +985,10 @@ def run_engine_north_star(args) -> dict:
               file=sys.stderr)
         # adaptive settle (same contract as the headline tier: no timed
         # pass may dispatch an unseen trace)
-        for i in range(12):
-            t0 = time.perf_counter()
-            m_engine.schedule(m_problems)
-            fresh = m_engine.last_pass_new_trace
-            print(
-                f"# 1M settle pass {i}: {time.perf_counter() - t0:.1f}s "
-                f"new_trace={fresh}",
-                file=sys.stderr,
-            )
-            if i >= 3 and not fresh and not m_engine.cap_shrink_pending:
-                break
+        settle_engine(
+            m_engine, lambda i: m_engine.schedule(m_problems),
+            floor=4, cap=12, label="1M settle pass",
+        )
         m_times = []
         for rep in range(3):
             t0 = time.perf_counter()
@@ -1021,21 +1009,14 @@ def run_engine_north_star(args) -> dict:
                         0, q + int(rng_m.integers(-3, 4)) * max(1, alloc // 200)
                     ), alloc))
             m_drifts.append(ClusterSnapshot(clusters))
-        m_warm = 0
-        for warm_snap in m_drifts[:8]:
-            swapped = m_engine.update_snapshot(warm_snap)
-            assert swapped
-            t0 = time.perf_counter()
+        def m_churn_warm_pass(i):
+            assert m_engine.update_snapshot(m_drifts[i])
             m_engine.schedule(m_problems)
-            fresh = m_engine.last_pass_new_trace
-            print(
-                f"# 1M churn warm pass {m_warm}: "
-                f"{time.perf_counter() - t0:.1f}s new_trace={fresh}",
-                file=sys.stderr,
-            )
-            m_warm += 1
-            if m_warm >= 2 and not fresh and not m_engine.cap_shrink_pending:
-                break
+
+        m_warm = settle_engine(
+            m_engine, m_churn_warm_pass, floor=2, cap=8,
+            label="1M churn warm pass",
+        )
         m_churn_times = []
         for rep, snap_m in enumerate(m_drifts[m_warm:m_warm + 4]):
             t0 = time.perf_counter()
@@ -1078,20 +1059,10 @@ def run_engine_north_star(args) -> dict:
             # is longer than three fixed passes — breaking early parked
             # its one allowed recompile inside the timed window (14.6s
             # recorded where the clean pass runs ~4s)
-            for i in range(12):
-                t0 = time.perf_counter()
-                l_engine.schedule(m_problems)
-                fresh = l_engine.last_pass_new_trace
-                print(
-                    f"# 1M legacy settle {i}: {time.perf_counter() - t0:.1f}s"
-                    f" new_trace={fresh}",
-                    file=sys.stderr,
-                )
-                if (
-                    i >= 2 and not fresh
-                    and not l_engine.cap_shrink_pending
-                ):
-                    break
+            settle_engine(
+                l_engine, lambda i: l_engine.schedule(m_problems),
+                floor=3, cap=12, label="1M legacy settle",
+            )
             l_times = []
             for _ in range(3):
                 t0 = time.perf_counter()
@@ -1191,13 +1162,23 @@ def run_engine_north_star(args) -> dict:
             cp.settle()
             return time.perf_counter() - t0
 
-        w = storm_wave("warm")
-        print(
-            f"# whole-plane warm wave: {w:.1f}s = {n_wp / w:.0f} bindings/s",
-            file=sys.stderr,
-        )
+        # adaptive warm: the first storms after the cold build still pay
+        # heap/queue settlement (measured 48 s -> 33.8 s -> 11.3 s wave
+        # sequence); warm until the wave cost FLATTENS (<30% improvement)
+        # so the timed window records steady-state throughput
+        prev_w = None
+        for wi in range(4):
+            w = storm_wave(f"warm{wi}")
+            print(
+                f"# whole-plane warm{wi} wave: {w:.1f}s = "
+                f"{n_wp / w:.0f} bindings/s",
+                file=sys.stderr,
+            )
+            if prev_w is not None and w > prev_w * 0.7:
+                break
+            prev_w = w
         waves = []
-        for k in range(2):
+        for k in range(3):
             waves.append(storm_wave(f"t{k}"))
             print(
                 f"# whole-plane wave {k}: {waves[-1]:.1f}s = "

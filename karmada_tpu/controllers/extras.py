@@ -216,7 +216,16 @@ class WorkloadRebalancerController:
                     observed[idx]["result"] = f"Failed: {err}"
         finished = all(o["result"] != "Pending" for o in observed)
         finish_time = rebalancer.status.finish_time
-        if finished and finish_time is None:
+        reprocessed = (
+            rebalancer.status.observed_workloads != observed
+            or rebalancer.status.observed_generation
+            != rebalancer.meta.generation
+        )
+        if finished and (finish_time is None or reprocessed):
+            # a fresh observation wave RESTAMPS the finish: the TTL window
+            # (ttlSecondsAfterFinished) must count from the LATEST finish,
+            # or a spec update near the deadline would complete its
+            # re-trigger and be swept with the new results seconds later
             finish_time = self.clock()
         elif not finished:
             # new unfinished work (e.g. a spec update added workloads) must
