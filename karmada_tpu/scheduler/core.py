@@ -405,7 +405,17 @@ class TensorScheduler:
             if len(fast_idx) >= self.fleet_threshold:
                 from .fleet import FleetTable
 
-                if self._fleet is None or self._fleet.slots_exhausted:
+                if self._fleet is not None and self._fleet.slots_exhausted:
+                    import sys as _sys
+
+                    print(
+                        "# fleet table rebuild: "
+                        + self._fleet.exhaustion_summary(),
+                        file=_sys.stderr,
+                        flush=True,
+                    )
+                    self._fleet = None
+                if self._fleet is None:
                     self._fleet = FleetTable(self)
                 fp = [problems[i] for i in fast_idx]
                 fc = [compiled[i] for i in fast_idx]

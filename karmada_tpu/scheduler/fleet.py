@@ -69,11 +69,12 @@ K_PREV = 32  # max previous-assignment sites on the fast path (small fleets
 # take the general host path)
 MAX_REPLICAS_FAST = 128  # divided-strategy replica cap (bounds the entry vector)
 MAX_SLOTS = 8192  # unique placements/gvks/profiles FLOOR before slot
-# eviction engages. Sizing: the cp table is [U, 3C] int32 = 8192 x 15000
-# x 4B ~ 0.5 GB at C=5000; plain row gathers make the per-pass cost
-# independent of U. The EFFECTIVE cap scales with the cluster count up to
-# CP_TABLE_MAX_BYTES (so a 5k-cluster fleet carries ~26k unique
-# placements before any fallback), and crossing 3/4 of it first evicts
+# eviction engages. Sizing (bitpacked layout): a slot costs two packed
+# mask planes (2*ceil(C/8) uint8) + an int32 static-weight row (4C) ~
+# 21 KB at C=5000; plain row gathers make the per-pass cost independent
+# of U. The EFFECTIVE cap scales with the cluster count up to
+# CP_TABLE_MAX_BYTES (a 5k-cluster fleet carries the MAX_SLOTS_HARD
+# 65536 uniques within ~1.4 GB), and crossing 3/4 of it first evicts
 # slots no live row references — only a fleet whose LIVE rows reference
 # more uniques than the budget allows falls back to a rebuild per call.
 CP_TABLE_MAX_BYTES = 1536 << 20  # device cp-table budget (HBM)
@@ -1072,6 +1073,18 @@ class FleetTable:
         window (an 18s dispatch stall on the 1M tier)."""
         return bool(self._shrink_desire[1] or self._e_shrink_desire[1])
 
+    def exhaustion_summary(self) -> str:
+        """One line of WHY this table reports slots_exhausted — printed by
+        the engine before a rebuild (a rebuild costs a full repack +
+        re-trace; the slot-rotation bench observed one with the slot count
+        apparently under the cap, and this breadcrumb is how the next
+        occurrence gets root-caused)."""
+        return (
+            f"slots={len(self._cp_pl)} max={self._max_slots()} "
+            f"gvk={len(self._gvk_list)} profiles={len(self._profiles)} "
+            f"rows={self.n_rows} cap={self.cap}"
+        )
+
     def _mark_trace(self, *key) -> None:
         """Record a dispatched trace signature; flips the per-pass
         new-trace flag when the signature is unseen (a compile will run)."""
@@ -1291,14 +1304,24 @@ class FleetTable:
 
     def _max_slots(self) -> int:
         """Effective unique-placement cap: MAX_SLOTS floor, scaled up to
-        the CP_TABLE_MAX_BYTES device budget (3C int32 words per slot).
-        Snapped DOWN to a power of two so the pow2 device capacity the
-        cap implies actually fits the budget (a raw quotient would let
-        the allocated table overshoot it by up to 2x)."""
+        the CP_TABLE_MAX_BYTES device budget. Per-slot bytes under the
+        bitpacked layout: two packed mask planes (2*ceil(C/8) uint8) plus
+        the int32 static-weight row (4C) — the pre-bitpack formula (12C)
+        understated capacity ~2.8x. Snapped DOWN to _slot_cap's own
+        quantization grid so the device capacity the cap implies actually
+        fits the budget (a raw quotient would let the allocated table
+        overshoot its quantum)."""
         c = max(1, self.engine.snapshot.num_clusters)
-        by_budget = max(1, CP_TABLE_MAX_BYTES // (12 * c))
-        pow2_floor = 1 << (by_budget.bit_length() - 1)
-        return min(MAX_SLOTS_HARD, max(MAX_SLOTS, pow2_floor))
+        per_slot = 2 * ((c + 7) // 8) + 4 * c
+        by_budget = max(1, CP_TABLE_MAX_BYTES // per_slot)
+        if by_budget > 8192:
+            # _slot_cap quantizes device capacity in 4096-slot multiples
+            # above 8192 — snap to ITS grid (a pow2 floor here forfeited
+            # up to ~2x of the budgeted slots just above a power of two)
+            snapped = by_budget // 4096 * 4096
+        else:
+            snapped = 1 << (by_budget.bit_length() - 1)
+        return min(MAX_SLOTS_HARD, max(MAX_SLOTS, snapped))
 
     @property
     def slots_exhausted(self) -> bool:
