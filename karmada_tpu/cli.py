@@ -1162,7 +1162,34 @@ def build_parser() -> tuple:
                         help="KEY=VALUE to set, KEY- to remove")
 
     sub.add_parser("api-resources", help="discovery: served kinds")
+
+    wu = sub.add_parser(
+        "warmup",
+        help="AOT-prewarm the scheduler's XLA traces from the trace "
+        "manifest (kills the plane's cold start; run before serving or "
+        "after deploying a new build)",
+    )
+    wu.add_argument(
+        "--manifest", default="",
+        help="trace-manifest path (default: KARMADA_TPU_TRACE_MANIFEST, "
+        "else <cache dir>/trace_manifest.json)",
+    )
+    wu.add_argument(
+        "--no-expand", action="store_true",
+        help="compile only observed signatures (skip the next-bucket "
+        "cap expansion)",
+    )
     return parser, sub
+
+
+def cmd_warmup(manifest: str = "", expand: bool = True) -> dict:
+    """The ``warmup`` verb: replay the trace manifest through AOT
+    compilation on the current backend (scheduler.prewarm.warmup), so a
+    following plane/solver boot — or this process's first schedule pass —
+    pays zero compile cost for covered fleet shapes."""
+    from .scheduler.prewarm import warmup
+
+    return warmup(manifest or None, expand=expand)
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -1187,6 +1214,13 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.command == "completion":
         print(cmd_completion(args.shell))
         return 0
+    if args.command == "warmup":
+        stats = cmd_warmup(args.manifest, expand=not args.no_expand)
+        print(json.dumps(stats))
+        # no manifest yet is a no-op boot optimization, not a failure;
+        # per-record compile failures (stale manifest vs new build) are
+        # reported in the JSON but only a total wipe-out exits nonzero
+        return 1 if (stats["failed"] and not stats["compiled"]) else 0
 
     if args.command == "local-up":
         if args.processes:

@@ -16,6 +16,7 @@ Ref:
 
 from __future__ import annotations
 
+import hashlib
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -100,6 +101,23 @@ class WorkloadRebalancerStatus:
     observed_workloads: list[dict] = field(default_factory=list)
     observed_generation: int = 0
     finish_time: Optional[float] = None
+    # content digest of the spec.workloads that produced this status —
+    # the echo gate's comparison key (see _workloads_digest)
+    observed_spec_digest: str = ""
+
+
+def _workloads_digest(workloads) -> str:
+    """Content identity of ``spec.workloads``. The apiserver auto-bumps
+    generation on spec writes but Store.apply does not, so a writer that
+    edits the list in place hands the reconciler the SAME generation —
+    and with a same-length edit, the same workload count. Only content
+    tells such an edit apart from our own status-apply echo."""
+    h = hashlib.sha256()
+    for t in workloads:
+        h.update(
+            f"{t.api_version}|{t.kind}|{t.namespace}|{t.name}\n".encode()
+        )
+    return h.hexdigest()
 
 
 @dataclass
@@ -139,17 +157,31 @@ class WorkloadRebalancerController:
         rebalancer = self.store.get("WorkloadRebalancer", key)
         if rebalancer is None:
             return DONE
+        spec_digest = _workloads_digest(rebalancer.spec.workloads)
+        # getattr: a checkpoint restore unpickles statuses written by a
+        # pre-digest build (Store.restore bypasses __init__), so the field
+        # can be missing; such a legacy finished status falls back to the
+        # old length gate rather than re-triggering every restored
+        # rebalancer at boot
+        status_digest = getattr(
+            rebalancer.status, "observed_spec_digest", ""
+        )
+        digest_ok = (
+            status_digest == spec_digest
+            if status_digest
+            else len(rebalancer.status.observed_workloads)
+            == len(rebalancer.spec.workloads)
+        )
         if (
             rebalancer.status.observed_generation == rebalancer.meta.generation
             and rebalancer.status.finish_time is not None
             # generation alone is not enough in this store: the apiserver
             # auto-bumps generation on spec writes, Store.apply does not —
-            # an in-place workloads append would slip the gate. The length
-            # check catches growth/shrink without the O(W) content rebuild
-            # the gate exists to avoid; same-length in-place edits should
-            # bump_generation like any spec writer.
-            and len(rebalancer.status.observed_workloads)
-            == len(rebalancer.spec.workloads)
+            # an in-place workloads edit hands us the same generation. The
+            # digest compares CONTENT, so a same-length in-place edit (a
+            # swapped target) re-triggers like any other spec change; the
+            # O(W) hash is noise next to the O(W x B) cascade it gates.
+            and digest_ok
         ):
             # already fully observed at this generation: the reconcile we
             # are seeing is our own status-apply echo. Without this gate a
@@ -235,11 +267,13 @@ class WorkloadRebalancerController:
             rebalancer.status.observed_workloads != observed
             or rebalancer.status.observed_generation != rebalancer.meta.generation
             or rebalancer.status.finish_time != finish_time
+            or status_digest != spec_digest
         )
         if changed:
             rebalancer.status.observed_workloads = observed
             rebalancer.status.observed_generation = rebalancer.meta.generation
             rebalancer.status.finish_time = finish_time
+            rebalancer.status.observed_spec_digest = spec_digest
             self.store.apply(rebalancer)
         return DONE
 

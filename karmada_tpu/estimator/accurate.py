@@ -362,6 +362,11 @@ class EstimatorRegistry:
         import time as _time
 
         names = list(cluster_names)
+        # memo keys carry the closure's name tuple: memoized columns are
+        # POSITIONAL over this estimator's name list, so two coexisting
+        # batch estimators with different orderings (or subsets) of the
+        # same registry must never read each other's columns
+        memo_ns = tuple(names)
 
         def estimate(requests: np.ndarray, replicas: np.ndarray) -> np.ndarray:
             reqs = np.asarray(requests)
@@ -370,7 +375,7 @@ class EstimatorRegistry:
             # intern the batch to unique profiles; answer memo hits without
             # touching the wire, fan out the misses concurrently
             uniq, inv = np.unique(reqs, axis=0, return_inverse=True)
-            cols = [self._memo.get(row.tobytes()) for row in uniq]
+            cols = [self._memo.get((memo_ns, row.tobytes())) for row in uniq]
             miss = [u for u, col in enumerate(cols) if col is None]
             if miss:
                 if self._pool is None:
@@ -414,7 +419,7 @@ class EstimatorRegistry:
                     col = fresh[k]
                     cols[u] = col
                     if complete:
-                        self._memo[uniq[u].tobytes()] = col
+                        self._memo[(memo_ns, uniq[u].tobytes())] = col
                 self.fanout_seconds_total += _time.perf_counter() - t0
             table = np.stack(cols)  # [U, C]
             out[:] = table[inv]

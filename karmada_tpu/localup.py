@@ -189,6 +189,27 @@ def serve_plane_replica(args) -> None:
                 facade.apply(cluster, expected_rv=0)
             except ConflictError:
                 pass  # a peer replica won the create
+    # HA standbys prewarm at boot: a takeover's first scheduling wave is
+    # exactly the cold wave the manifest exists to kill — a standby that
+    # compiles AFTER winning the lease serves its first storm cold.
+    from .scheduler.prewarm import resolve_boot_manifest
+    from .utils.compilecache import MANIFEST_ENV
+
+    manifest_path = resolve_boot_manifest(args.warmup_manifest)
+    # export the resolved path (including an explicit "" opt-out): the
+    # scheduler controller builds its engine lazily and resolves the
+    # manifest from this env var — without it the replica would prewarm
+    # but never seed its trace ledger or record fresh traces back
+    os.environ[MANIFEST_ENV] = manifest_path
+    if manifest_path:
+        from .scheduler.prewarm import warmup
+
+        stats = warmup(manifest_path)
+        print(
+            f"# replica prewarm: {stats['compiled']}/{stats['specs']} "
+            f"traces in {stats['seconds']:.1f}s",
+            file=sys.stderr,
+        )
     cp.runtime.realtime = True
     metrics = MetricsServer(address=addr(args.metrics_address))
     metrics_port = metrics.start()
@@ -318,6 +339,32 @@ def serve_plane(args) -> None:
         cluster.spec.sync_mode = "Pull"
         cp.join_cluster(cluster, remote_agent=True)
 
+    # boot-phase prewarm: replay the trace manifest through AOT compile
+    # BEFORE the first settle, so the plane's first scheduling wave (the
+    # cold wave a restart/HA-failover pays) runs only already-compiled
+    # traces. Only meaningful when the plane runs the in-proc engine —
+    # with a solver sidecar the sidecar prewarms itself (its own
+    # --warmup-manifest).
+    from .scheduler.prewarm import resolve_boot_manifest
+    from .utils.compilecache import MANIFEST_ENV
+
+    manifest_path = resolve_boot_manifest(args.warmup_manifest)
+    # export the resolved path (including an explicit "" opt-out): the
+    # scheduler controller builds its engine lazily and resolves the
+    # manifest from this env var — without it the plane would prewarm but
+    # never seed its trace ledger (first pass still new_trace=True) or
+    # record fresh traces back into the manifest
+    os.environ[MANIFEST_ENV] = manifest_path
+    if manifest_path and not solver:
+        from .scheduler.prewarm import warmup
+
+        stats = warmup(manifest_path)
+        print(
+            f"# plane prewarm: {stats['compiled']}/{stats['specs']} traces "
+            f"in {stats['seconds']:.1f}s from {manifest_path}",
+            file=sys.stderr,
+        )
+
     # remote estimator registrations: NAME=HOST:PORT
     if args.estimator:
         from .estimator.grpc_transport import (
@@ -433,9 +480,14 @@ class LocalUp:
         lease_grace: float = 0.0,
         feature_gates: str = "Failover=true",
         solver_platform: str = "cpu",
+        warmup_manifest: str | None = None,
     ):
         self.lease_grace = lease_grace
         self.feature_gates = feature_gates
+        # trace-manifest path handed to the scheduling-owning child (the
+        # solver sidecar when present, else the plane): that child AOT-
+        # prewarms from it at boot and records fresh traces back into it
+        self.warmup_manifest = warmup_manifest
         self.members = members
         self.pull = pull
         self.with_solver = with_solver
@@ -466,13 +518,18 @@ class LocalUp:
                 # (--backend-timeout -> 'solver backend timeout', rc=3) and
                 # we respawn a FRESH claimant until one lands post-expiry.
                 attempts = 6 if self.solver_platform != "cpu" else 1
+                solver_cmd = [
+                    py, "-m", "karmada_tpu.solver", "--address",
+                    "127.0.0.1:0", "--report-backend",
+                    "--backend-timeout", "90",
+                ]
+                if self.warmup_manifest is not None:
+                    # an explicit "" propagates as the child's opt-out
+                    # (overrides an inherited KARMADA_TPU_TRACE_MANIFEST)
+                    solver_cmd += ["--warmup-manifest", self.warmup_manifest]
                 for attempt in range(attempts):
                     p = self._spawn(
-                        "solver",
-                        [py, "-m", "karmada_tpu.solver", "--address",
-                         "127.0.0.1:0", "--report-backend",
-                         "--backend-timeout", "90"],
-                        platform=self.solver_platform,
+                        "solver", solver_cmd, platform=self.solver_platform,
                     )
                     self.endpoints["solver"] = _scrape_port(p, r"port (\d+)")
                     self.solver_backend = scrape_line(
@@ -528,6 +585,8 @@ class LocalUp:
                 plane_cmd += ["--lease-grace", str(self.lease_grace)]
             if self.feature_gates:
                 plane_cmd += ["--feature-gates", self.feature_gates]
+            if self.warmup_manifest is not None:
+                plane_cmd += ["--warmup-manifest", self.warmup_manifest]
             p = self._spawn("plane", plane_cmd)
             deadline = time.time() + 240
             while time.time() < deadline:
@@ -619,12 +678,22 @@ def main(argv=None) -> None:
                     help="leader-election identity (default plane-<pid>)")
     sv.add_argument("--lease-duration", type=float, default=15.0)
     sv.add_argument("--renew-deadline", type=float, default=10.0)
+    sv.add_argument("--warmup-manifest", default=None,
+                    help="trace-manifest path to AOT-prewarm the in-proc "
+                    "scheduler from before the first settle (default: "
+                    "$KARMADA_TPU_TRACE_MANIFEST; with --solver the "
+                    "sidecar prewarms itself instead)")
 
     up = sub.add_parser("up", help="spawn the full multi-process deployment")
     up.add_argument("--members", type=int, default=2)
     # default applied after parsing: an append action with a non-empty
     # default list would APPEND user values to it (no way to drop pull1)
     up.add_argument("--pull", action="append", default=None)
+    up.add_argument("--warmup-manifest", default=None,
+                    help="trace-manifest path handed to the scheduling-"
+                    "owning child (solver sidecar when present, else the "
+                    "plane) for boot-phase AOT prewarm (default: "
+                    "$KARMADA_TPU_TRACE_MANIFEST)")
 
     args = p.parse_args(argv)
     if args.command == "up" and args.pull is None:
@@ -638,7 +707,10 @@ def main(argv=None) -> None:
                     "store-bus the replicas elect over)")
         serve_plane(args)
     elif args.command == "up":
-        with LocalUp(members=args.members, pull=tuple(args.pull)) as lu:
+        with LocalUp(
+            members=args.members, pull=tuple(args.pull),
+            warmup_manifest=args.warmup_manifest,
+        ) as lu:
             print(json.dumps(lu.endpoints), flush=True)
             try:
                 while all(p.poll() is None for p in lu.procs.values()):

@@ -14,54 +14,17 @@ models should run the solver in its own process (the gRPC sidecar deployment
 shape of SURVEY.md section 2.2) rather than in-process.
 """
 
-import os
-
 import jax
 
 jax.config.update("jax_enable_x64", True)
 
-# Persistent XLA compilation cache: the tunneled TPU backend charges
-# 20-40 s per fresh trace, and the engine's static specializations (chunk
-# counts, kernel variants, entry-buffer sizes) legitimately produce several
-# traces per workload shape. Caching across processes makes bench reruns and
-# control-plane restarts pay compile cost once. Opt out / relocate with
-# JAX_COMPILATION_CACHE_DIR ("" disables).
-_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
-if _cache_dir is None:
-    # repo checkout: cache beside the package; installed package (parent
-    # dir not writable, e.g. site-packages): fall back to the user cache
-    _repo_parent = os.path.dirname(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-    if os.access(_repo_parent, os.W_OK):
-        _cache_dir = os.path.join(_repo_parent, ".jax_cache")
-    else:
-        _cache_dir = os.path.join(
-            os.path.expanduser("~"), ".cache", "karmada_tpu", "jax"
-        )
-if _cache_dir:
-    # partition by platform set: a tunneled accelerator backend compiles on
-    # the REMOTE host and caches CPU AOT artifacts built for that machine's
-    # CPU features — a local CPU process loading them gets machine-feature
-    # mismatch warnings at best and SIGILL at worst (observed killing
-    # localup children mid-suite). Read the CONFIGURED platform list (the
-    # sitecustomize sets it programmatically, callers may too — the env
-    # var alone is not authoritative); every distinct set gets its own
-    # cache root. JAX_COMPILATION_CACHE_DIR overrides skip this.
-    if os.environ.get("JAX_COMPILATION_CACHE_DIR") is None:
-        try:
-            _plat = jax.config.jax_platforms
-        except Exception:  # noqa: BLE001 — knob missing in this jax
-            _plat = None
-        _plat = _plat or os.environ.get("JAX_PLATFORMS") or "default"
-        _cache_dir = os.path.join(
-            _cache_dir, _plat.replace(",", "_") or "default"
-        )
-    try:
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # older jax without the knob: run uncached
-        pass
+# Persistent XLA compilation cache: policy lives in utils.compilecache
+# (one resolution point shared with the prewarm subsystem and the warmup
+# CLI — the manifest must sit beside the cache its records replay into).
+# Opt out / relocate with JAX_COMPILATION_CACHE_DIR ("" disables).
+from ..utils.compilecache import enable as _enable_compile_cache  # noqa: E402
+
+_enable_compile_cache()
 
 from .dispense import (  # noqa: E402,F401
     take_by_weight,

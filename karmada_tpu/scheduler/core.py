@@ -185,9 +185,17 @@ class TensorScheduler:
         custom_filters: Sequence = (),
         mesh=None,
         shard_clusters: bool = False,
+        trace_manifest=None,
     ):
         self.snapshot = snapshot
         self.chunk_size = chunk_size
+        # durable trace ledger (scheduler.prewarm.TraceManifest | path |
+        # None = env default KARMADA_TPU_TRACE_MANIFEST, unset = off).
+        # Resolved once here so every fleet table this engine builds
+        # shares one manifest instance (one dedup set, one file).
+        from .prewarm import resolve_manifest
+
+        self.trace_manifest = resolve_manifest(trace_manifest)
         # optional jax.sharding.Mesh with axes ("b", "c"): the fleet solve
         # shards its row axis over "b" (and the cluster axis over "c" when
         # shard_clusters) via sharding constraints — multi-chip scale-out

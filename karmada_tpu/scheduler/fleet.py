@@ -999,6 +999,12 @@ class FleetTable:
         self._gvk_list: list[str] = []
         self._prof_slot: dict[bytes, int] = {}
         self._profiles: list[np.ndarray] = []
+        # requests-tuple -> profile slot memo over _prof_slot: skips the
+        # per-row dim-vector build (zeros + dim_index loop + tobytes) that
+        # dominates bulk onboarding (a restart's first wave packs EVERY
+        # row). Keyed per snapshot object — dims can change across swaps
+        self._req_slot: dict[tuple, int] = {}
+        self._req_slot_snap = None
         # host staging
         self._st: dict[str, np.ndarray] = {}
         # device
@@ -1063,6 +1069,26 @@ class FleetTable:
         # already-compiled traces.
         self._seen_traces: set = set()
         self.new_trace_last_pass = False
+        # durable ledger (scheduler.prewarm): fresh solve-family traces are
+        # persisted with their full compile inputs so a future process can
+        # AOT-prewarm them before its first pass. Seeding the in-memory
+        # ledger from the manifest is gated on the manifest having been
+        # REPLAYED in this process (prewarm.warmup) — otherwise the first
+        # pass would claim new_trace=False while a compile still runs.
+        from .prewarm import prewarm_on_rebuild
+
+        # the engine resolved its manifest once at construction (including
+        # the env-default fallback); re-resolving None here would resurrect
+        # an inherited KARMADA_TPU_TRACE_MANIFEST after an explicit
+        # trace_manifest="" opt-out
+        self._manifest = getattr(engine, "trace_manifest", None)
+        if self._manifest is not None:
+            # warmed_keys() is empty before replay, and excludes records
+            # whose compile FAILED during replay — those traces would
+            # still compile at first dispatch, so seeding them would fake
+            # a warm pass
+            self._seen_traces |= self._manifest.warmed_keys()
+        prewarm_on_rebuild(self._manifest)
 
     @property
     def shrink_pending(self) -> bool:
@@ -1085,12 +1111,28 @@ class FleetTable:
             f"rows={self.n_rows} cap={self.cap}"
         )
 
-    def _mark_trace(self, *key) -> None:
+    def _mark_trace(self, *key) -> bool:
         """Record a dispatched trace signature; flips the per-pass
-        new-trace flag when the signature is unseen (a compile will run)."""
+        new-trace flag when the signature is unseen (a compile will run).
+        Returns True for a fresh signature so dispatch sites can persist
+        the compile record to the trace manifest."""
         if key not in self._seen_traces:
             self._seen_traces.add(key)
             self.new_trace_last_pass = True
+            return True
+        return False
+
+    def _record_trace(self, kernel: str, key, arrays, **statics) -> None:
+        """Persist a fresh trace's compile inputs (shapes + statics) to
+        the manifest. Meshed dispatches are skipped — a Mesh is not
+        serializable and the multi-chip shape re-warms live. Best-effort:
+        manifest failures must never reach the scheduling path."""
+        if self._manifest is None or statics.get("mesh") is not None:
+            return
+        try:
+            self._manifest.record(kernel, key, arrays, statics)
+        except Exception:  # noqa: BLE001 — durability is best-effort
+            pass
 
     # -- rows --------------------------------------------------------------
 
@@ -1231,22 +1273,32 @@ class FleetTable:
             self._tables_dirty = True
         st["gvk_idx"][row] = gslot
         # request profile slot (pods-dim adjustment applied BEFORE interning,
-        # mirroring _pack_chunk: each replica occupies a pod)
-        vec = np.zeros(len(snap.dims), np.int64)
-        for d, q in problem.requests.items():
-            j = snap.dim_index(d)
-            if j is not None:
-                vec[j] = q
-        pods = snap.dim_index("pods")
-        if pods is not None and problem.replicas > 0:
-            vec[pods] = max(vec[pods], 1)
-        pkey = vec.tobytes()
-        pslot = self._prof_slot.get(pkey)
+        # mirroring _pack_chunk: each replica occupies a pod). The identity
+        # check (not ==) on the memo's snapshot pins the dims mapping the
+        # cached slots were built under AND keeps the object alive, so a
+        # recycled id can never alias a stale entry
+        if self._req_slot_snap is not snap:
+            self._req_slot = {}
+            self._req_slot_snap = snap
+        rkey = (tuple(problem.requests.items()), problem.replicas > 0)
+        pslot = self._req_slot.get(rkey)
         if pslot is None:
-            pslot = len(self._profiles)
-            self._prof_slot[pkey] = pslot
-            self._profiles.append(vec)
-            self._tables_dirty = True
+            vec = np.zeros(len(snap.dims), np.int64)
+            for d, q in problem.requests.items():
+                j = snap.dim_index(d)
+                if j is not None:
+                    vec[j] = q
+            pods = snap.dim_index("pods")
+            if pods is not None and problem.replicas > 0:
+                vec[pods] = max(vec[pods], 1)
+            pkey = vec.tobytes()
+            pslot = self._prof_slot.get(pkey)
+            if pslot is None:
+                pslot = len(self._profiles)
+                self._prof_slot[pkey] = pslot
+                self._profiles.append(vec)
+                self._tables_dirty = True
+            self._req_slot[rkey] = pslot
         st["prof_idx"][row] = pslot
         st["replicas"][row] = problem.replicas
         st["strategy"][row] = compiled.strategy
@@ -1666,7 +1718,20 @@ class FleetTable:
             _chunk, _n_chunks = eff_chunk, n_chunks
 
             def bits_src():
-                self._mark_trace("B", _chunk, _n_chunks, len(_tables))
+                # the signature must carry every shape the trace closes
+                # over: the cp-table capacity (slot growth re-traces), the
+                # rows-buffer length, and the state cap — the old
+                # (chunk, n_chunks)-only key let a slot-table growth mint
+                # a new XLA trace that new_trace_last_pass never reported
+                key = (
+                    "B", _chunk, _n_chunks, _tables[0].shape,
+                    int(_rows.shape[0]), int(_state[0].shape[0]),
+                )
+                if self._mark_trace(*key):
+                    self._record_trace(
+                        "fleet_bits", key, (*_tables, _rows, *_state),
+                        chunk=_chunk, n_chunks=_n_chunks,
+                    )
                 return _fleet_bits(
                     *_tables, _rows, *_state, chunk=_chunk,
                     n_chunks=_n_chunks,
@@ -1772,7 +1837,16 @@ class FleetTable:
         self._e_cap_cur = e_cap
 
         def solve(rows_slice, cap):
-            self._mark_trace(*l_key(cap))
+            if self._mark_trace(*l_key(cap)):
+                self._record_trace(
+                    "fleet_solve", l_key(cap),
+                    (*self._dev_tables, rows_slice, *self._dev_state,
+                     self._resident_entries),
+                    chunk=eff_chunk, n_chunks=n_chunks, k_out=k_out,
+                    k_res=k_res, e_cap=cap, wide=wide, fast=fast,
+                    has_aggregated=has_agg, all_rows=is_all, mesh=mesh,
+                    shard_c=shard_c, pack21=pack21 and byte_wire,
+                )
             return _fleet_solve(
                 *self._dev_tables,
                 rows_slice,
@@ -1859,6 +1933,35 @@ class FleetTable:
             has_cand, is_dup,
         )
 
+    def _e_key(
+        self, chunk: int, n_chunks: int, k_out: int, e_cap: int,
+        byte_wire: bool, pack21: bool,
+    ) -> tuple:
+        """THE ``_fleet_entries`` trace signature, shared by the exact
+        phase-B fetch and the speculative dispatch in ``_solve_dense``.
+        The two sites used to compose the cluster-count element
+        differently (``self._res_dense.shape[1]`` vs the pass-local
+        ``c``), so the same trace could be ledgered under two keys —
+        spuriously flipping ``new_trace_last_pass`` (and double-entering
+        the manifest). Keyed on the resident's OWN shape: that is the
+        array the trace closes over."""
+        return (
+            "E", self._res_dense.shape[0], self._res_dense.shape[1],
+            chunk, n_chunks, k_out, e_cap, byte_wire, pack21,
+        )
+
+    def _mark_entries_trace(
+        self, rows_dev, *, chunk, n_chunks, k_out, e_cap, byte_wire, pack21,
+    ) -> None:
+        """Ledger + manifest entry for a ``_fleet_entries`` dispatch."""
+        key = self._e_key(chunk, n_chunks, k_out, e_cap, byte_wire, pack21)
+        if self._mark_trace(*key):
+            self._record_trace(
+                "fleet_entries", key, (self._res_dense, rows_dev),
+                chunk=chunk, n_chunks=n_chunks, k_out=k_out, e_cap=e_cap,
+                byte_wire=byte_wire, pack21=pack21,
+            )
+
     def _fetch_fold_exact(
         self, rows, counts, *, eff_chunk, k_out, byte_wire, pack21, tmr,
     ) -> int:
@@ -1875,14 +1978,15 @@ class FleetTable:
         rows_b[: len(rows)] = rows
         e_cap = _cap_round(max(e_want, 1))
         t_b = _time.perf_counter()
-        self._mark_trace(
-            "E", self.cap, self._res_dense.shape[1], b_chunk,
-            m_pad_b // b_chunk, k_out, e_cap, byte_wire,
-            pack21 and byte_wire,
+        rows_b_dev = jnp.asarray(rows_b)
+        self._mark_entries_trace(
+            rows_b_dev, chunk=b_chunk, n_chunks=m_pad_b // b_chunk,
+            k_out=k_out, e_cap=e_cap, byte_wire=byte_wire,
+            pack21=pack21 and byte_wire,
         )
         flat2 = _fleet_entries(
             self._res_dense,
-            jnp.asarray(rows_b),
+            rows_b_dev,
             chunk=b_chunk,
             n_chunks=m_pad_b // b_chunk,
             k_out=k_out,
@@ -2017,7 +2121,15 @@ class FleetTable:
         cap_round = _cap_round
         tmr["prep"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        self._mark_trace(*a_key(m_cap, d_cap))
+        if self._mark_trace(*a_key(m_cap, d_cap)):
+            self._record_trace(
+                "fleet_pass", a_key(m_cap, d_cap),
+                (*self._dev_tables, rows_dev, *self._dev_state,
+                 self._res_dense, self._res_meta),
+                chunk=eff_chunk, n_chunks=n_chunks, wide=wide, fast=fast,
+                has_aggregated=has_agg, all_rows=is_all, m_cap=m_cap,
+                d_cap=d_cap, mesh=mesh, shard_c=shard_c,
+            )
         flat, rowbuf, rd, rm = _fleet_pass(
             *self._dev_tables,
             rows_dev,
@@ -2063,9 +2175,10 @@ class FleetTable:
         ):
             spec_cap = cap_round(self._last_total * 9 // 8)
             b_chunk = min(eff_chunk, m_cap)
-            self._mark_trace(
-                "E", self.cap, c, b_chunk, m_cap // b_chunk, k_out,
-                spec_cap, byte_wire, pack21 and byte_wire,
+            self._mark_entries_trace(
+                rowbuf, chunk=b_chunk, n_chunks=m_cap // b_chunk,
+                k_out=k_out, e_cap=spec_cap, byte_wire=byte_wire,
+                pack21=pack21 and byte_wire,
             )
             spec_flat = _fleet_entries(
                 self._res_dense,

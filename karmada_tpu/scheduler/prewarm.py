@@ -1,0 +1,409 @@
+"""Trace-signature manifest + AOT prewarming: kill the cold start.
+
+The fleet engine already ledgers every XLA trace signature it dispatches
+(``FleetTable._mark_trace`` — the ``new_trace_last_pass`` warm-loop
+contract). This module makes that ledger DURABLE and REPLAYABLE:
+
+- ``TraceManifest`` persists, for every fresh trace, the kernel name, the
+  ledger key, the exact input shapes/dtypes, and the static-argument
+  tuple — everything needed to re-lower and re-compile that trace in a
+  process that has never scheduled anything.
+- ``replay()`` walks the manifest and runs each record ONCE on
+  zero-filled dummy inputs — no engine, no real data — which traces,
+  compiles (a persistent-cache hit when a prior process seeded it), and
+  leaves the jit DISPATCH cache hot, so the first real dispatch is a
+  straight cache hit. AOT ``lower().compile()`` alone is not enough: it
+  populates the compile caches but the first dispatch still re-traces
+  and re-loads on the serving path (measured at ~1.5× a steady wave). A
+  record whose kernel rejects zeros falls back to exactly that AOT
+  compile. Everything happens OFF the serving path.
+- ``warmup()`` is the boot-phase entry (the ``karmadactl-tpu warmup``
+  verb, the localup/solver ``--warmup-manifest`` boot stage, and the
+  opt-in fleet-rebuild background thread all land here).
+
+Shape-bucket canonicalization: the engine's static caps are already
+quantized (pow2 chunk/slot caps, quarter-octave entry caps, M/D-quantum
+wire caps), so a fleet of a given size maps to a small, stable signature
+set. ``replay(expand=True)`` additionally compiles the NEXT bucket of
+each tuned cap (entry/meta/delta), so a churn burst that grows a cap
+mid-storm lands on an already-compiled bucket instead of minting a fresh
+compile on the critical path. Expanded specs carry no ledger key — the
+signature genuinely was not observed, so ``new_trace_last_pass`` still
+reports it honestly; only the compile is prepaid.
+
+Restore contract: after ``replay()`` ran in this process, an engine
+constructed with the same manifest seeds its fleet ledger from the
+manifest keys, so its FIRST pass over a covered fleet shape reports
+``new_trace=False`` — warm loops (and HA failovers) skip straight to the
+timed window. Seeding without replay would be a lie (the compile would
+still run at first dispatch), so it is gated on the replay having
+actually happened (``TraceManifest.warmed``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+#: per manifest path, the record canons replay() COMPILED in this
+#: process — the honesty gate for ledger seeding (see module docstring).
+#: Per-record, not per-path: a partial warm (stale record vs new build,
+#: transient backend error) must seed only the keys whose compile
+#: actually succeeded, or the first pass claims new_trace=False while a
+#: compile still runs on the serving path.
+_WARMED: dict[str, set[str]] = {}
+_WARM_LOCK = threading.Lock()
+
+_SCHEMA_VERSION = 1
+
+#: kernels worth persisting: the solve-family traces dominate compile
+#: cost; tiny utility kernels (row scatter, meta gather) stay ledger-only
+_KERNELS = (
+    "fleet_solve",
+    "fleet_pass",
+    "fleet_entries",
+    "fleet_bits",
+)
+
+
+def _jit_registry() -> dict:
+    from . import fleet
+
+    return {
+        "fleet_solve": fleet._fleet_solve,
+        "fleet_pass": fleet._fleet_pass,
+        "fleet_entries": fleet._fleet_entries,
+        "fleet_bits": fleet._fleet_bits,
+    }
+
+
+def _retuple(v):
+    """JSON round-trip inverse: lists back to tuples, recursively (ledger
+    keys and the ``fast`` static are tuples; JSON stores them as lists)."""
+    if isinstance(v, list):
+        return tuple(_retuple(x) for x in v)
+    return v
+
+
+def _canon(record: dict) -> str:
+    """Content identity of a record (dedup key): kernel + shapes +
+    statics. The ledger key is derived from those, so it is excluded —
+    an expanded spec (key=None) must dedup against an observed record
+    with the same compile inputs."""
+    return json.dumps(
+        [record["kernel"], record["in_shapes"], record["statics"]],
+        sort_keys=True,
+    )
+
+
+class TraceManifest:
+    """File-backed ledger of compile-ready trace records.
+
+    One instance per path; safe to share across engines in a process.
+    Recording never raises into the scheduler (best-effort persistence);
+    writes are atomic (tmp + rename) so a crashed writer cannot corrupt
+    the manifest a future boot restores from."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self.records: list[dict] = []
+        self._seen: set[str] = set()
+        self._lock = threading.Lock()
+        self._load()
+
+    @property
+    def warmed(self) -> bool:
+        """True when ``replay()`` completed for this path in this
+        process (possibly a partial warm — see ``warmed_keys``)."""
+        return self.path in _WARMED
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            records = data.get("records", [])
+        except (OSError, ValueError):
+            return
+        for r in records:
+            if r.get("kernel") in _KERNELS and "in_shapes" in r:
+                c = _canon(r)
+                if c not in self._seen:
+                    self._seen.add(c)
+                    self.records.append(r)
+
+    def _save(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        payload = {
+            "version": _SCHEMA_VERSION,
+            "platform": os.environ.get("JAX_PLATFORMS", ""),
+            "records": self.records,
+        }
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=None, separators=(",", ":"))
+        os.replace(tmp, self.path)
+
+    def record(self, kernel: str, key, arrays, statics: dict) -> None:
+        """Persist one fresh trace: ``key`` is the fleet ledger tuple (or
+        None for synthesized bucket specs), ``arrays`` the positional
+        kernel inputs in dispatch order, ``statics`` the static kwargs.
+        No-op for already-known records."""
+        rec = {
+            "kernel": kernel,
+            "key": key if key is None else list(_listify(key)),
+            "in_shapes": [
+                [list(int(d) for d in a.shape), str(a.dtype)]
+                for a in arrays
+            ],
+            "statics": {k: _listify(v) for k, v in statics.items()},
+        }
+        c = _canon(rec)
+        with self._lock:
+            if c in self._seen:
+                return
+            self._seen.add(c)
+            self.records.append(rec)
+            try:
+                self._save()
+            except OSError:
+                pass  # persistence is best-effort; the ledger still holds
+
+    def keys(self) -> set:
+        """The observed ledger keys, as tuples (seeding form)."""
+        return {
+            _retuple(r["key"])
+            for r in self.records
+            if r.get("key") is not None
+        }
+
+    def warmed_keys(self) -> set:
+        """The ledger keys whose records ``replay()`` COMPILED in this
+        process — the only keys an engine may seed its new-trace ledger
+        from. Empty before replay; excludes records whose compile failed
+        (their trace would still run at first dispatch)."""
+        ok = _WARMED.get(self.path)
+        if not ok:
+            return set()
+        return {
+            _retuple(r["key"])
+            for r in self.records
+            if r.get("key") is not None and _canon(r) in ok
+        }
+
+
+def _listify(v):
+    if isinstance(v, tuple):
+        return [_listify(x) for x in v]
+    return v
+
+
+def _statics_from_json(statics: dict) -> dict:
+    """Inverse of record(): lists back to tuples (``fast``), everything
+    else verbatim. ``mesh`` is always None in recorded specs (meshed
+    dispatches are not recorded — a Mesh is not serializable and the
+    multi-chip deployment re-warms live)."""
+    return {k: _retuple(v) for k, v in statics.items()}
+
+
+def expand_records(records: list[dict]) -> list[dict]:
+    """Shape-bucket expansion: for each observed record, synthesize the
+    NEXT bucket of each tuned wire cap so mid-storm cap growth lands on a
+    prepaid compile. Expanded specs have key=None (the signature was
+    never dispatched; the ledger must stay honest)."""
+    from .fleet import M_ROUND, _cap_round, d_round
+
+    out: list[dict] = []
+    for r in records:
+        statics = dict(r["statics"])
+        grown: list[dict] = []
+        if r["kernel"] in ("fleet_solve", "fleet_entries"):
+            e_cap = statics.get("e_cap")
+            if isinstance(e_cap, int):
+                grown.append({**statics, "e_cap": _cap_round(e_cap + 1)})
+        elif r["kernel"] == "fleet_pass":
+            m_cap = statics.get("m_cap")
+            d_cap = statics.get("d_cap", 0)
+            if isinstance(m_cap, int):
+                # the engine's m_round: 4096 floor, then M_ROUND
+                # multiples, clamped to the padded row count (the rows
+                # input, position 5) — rounding the cap's successor lands
+                # on the bucket the engine would actually tune to next
+                # (adding a raw quantum to the 4096 floor does not)
+                n_pad = r["in_shapes"][5][0][0]
+                nxt = (
+                    -(-(m_cap + 1) // M_ROUND) * M_ROUND
+                    if m_cap + 1 > 4096
+                    else 4096
+                )
+                nxt = min(nxt, n_pad)
+                if nxt > m_cap:
+                    grown.append({**statics, "m_cap": nxt})
+            if isinstance(d_cap, int) and d_cap > 0:
+                # same successor-rounding for the delta cap (D_FLOOR,
+                # then D_ROUND multiples)
+                grown.append({**statics, "d_cap": d_round(d_cap + 1)})
+        for st in grown:
+            out.append(
+                {
+                    "kernel": r["kernel"],
+                    "key": None,
+                    "in_shapes": r["in_shapes"],
+                    "statics": st,
+                }
+            )
+    return out
+
+
+def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
+    """AOT-compile every manifest record (plus expanded buckets) on the
+    current backend. Returns stats; per-record failures are counted, not
+    raised — a manifest written by an older build must degrade to a
+    partial warm, never block boot."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    registry = _jit_registry()
+    records = list(manifest.records)
+    specs = records + (expand_records(records) if expand else [])
+    # dedup expanded specs against observed ones
+    seen: set[str] = set()
+    todo = []
+    for r in specs:
+        c = _canon(r)
+        if c not in seen:
+            seen.add(c)
+            todo.append(r)
+    compiled = failed = 0
+    ok_canons: set[str] = set()
+    errors: list[str] = []
+    t0 = time.perf_counter()
+    for r in todo:
+        fn = registry.get(r["kernel"])
+        if fn is None:
+            failed += 1
+            continue
+        try:
+            shapes = [
+                (tuple(shape), np.dtype(dtype))
+                for shape, dtype in r["in_shapes"]
+            ]
+            statics = _statics_from_json(r["statics"])
+            try:
+                # one dummy-data execution: trace + compile (persistent-
+                # cache hit when seeded) + run, leaving the jit dispatch
+                # cache hot — the first REAL dispatch then skips tracing
+                # and cache-loading entirely
+                args = [jnp.zeros(s, d) for s, d in shapes]
+                jax.block_until_ready(fn(*args, **statics))
+                del args
+            except Exception:  # noqa: BLE001 — zeros tripped the kernel
+                # fall back to AOT compile: the caches still fill, only
+                # the first dispatch re-traces (off the compile cliff)
+                fn.lower(
+                    *(jax.ShapeDtypeStruct(s, d) for s, d in shapes),
+                    **statics,
+                ).compile()
+            compiled += 1
+            ok_canons.add(_canon(r))
+        except Exception as e:  # noqa: BLE001 — partial warm beats no boot
+            failed += 1
+            if len(errors) < 5:
+                errors.append(f"{r['kernel']}: {e!r}")
+    stats = {
+        "records": len(records),
+        "specs": len(todo),
+        "compiled": compiled,
+        "failed": failed,
+        "seconds": round(time.perf_counter() - t0, 3),
+    }
+    if errors:
+        stats["errors"] = errors
+    with _WARM_LOCK:
+        _WARMED.setdefault(manifest.path, set()).update(ok_canons)
+    return stats
+
+
+def warmup(
+    manifest_path: Optional[str] = None, *, expand: bool = True
+) -> dict:
+    """Boot-phase prewarm: enable the persistent cache with a zero
+    persistence threshold (every warmed trace must survive the process),
+    load the manifest, and replay it. The entry point behind the
+    ``karmadactl-tpu warmup`` verb and the localup/solver
+    ``--warmup-manifest`` boot stage."""
+    from ..utils import compilecache
+
+    path = manifest_path or compilecache.default_manifest_path()
+    if not path:
+        return {"records": 0, "specs": 0, "compiled": 0, "failed": 0,
+                "seconds": 0.0, "manifest": "", "cache_dir": ""}
+    cache_dir = compilecache.enable(min_compile_secs=0.0)
+    manifest = TraceManifest(path)
+    stats = replay(manifest, expand=expand)
+    stats["manifest"] = manifest.path
+    stats["cache_dir"] = cache_dir
+    return stats
+
+
+def resolve_boot_manifest(flag: Optional[str]) -> str:
+    """The ``--warmup-manifest`` resolution rule shared by the solver
+    sidecar and the localup serve/replica boot phases: a flag left unset
+    (None) falls back to ``$KARMADA_TPU_TRACE_MANIFEST``; an EXPLICIT
+    ``""`` opts out even with the env var set. Returns the manifest path
+    ("" = disabled)."""
+    if flag is not None:
+        return flag
+    from ..utils.compilecache import MANIFEST_ENV
+
+    return os.environ.get(MANIFEST_ENV, "")
+
+
+def resolve_manifest(spec) -> Optional[TraceManifest]:
+    """Normalize an engine's ``trace_manifest`` argument: a TraceManifest
+    passes through, a path string wraps, None falls back to the env
+    default (``KARMADA_TPU_TRACE_MANIFEST``; unset/empty = disabled —
+    engines never write a manifest the operator didn't ask for)."""
+    if isinstance(spec, TraceManifest):
+        return spec
+    if isinstance(spec, str):
+        return TraceManifest(spec) if spec else None
+    if spec is None:
+        from ..utils.compilecache import MANIFEST_ENV
+
+        path = os.environ.get(MANIFEST_ENV, "")
+        return TraceManifest(path) if path else None
+    raise TypeError(f"trace_manifest: expected TraceManifest, str or None, "
+                    f"got {type(spec).__name__}")
+
+
+_REBUILD_WARMED: set[str] = set()
+
+
+def prewarm_on_rebuild(manifest: Optional[TraceManifest]) -> None:
+    """Opt-in background prewarm when a fleet table is (re)built: replay
+    the manifest on a daemon thread so the rebuilt table's upcoming
+    shapes compile OFF the serving path. Enabled by
+    ``KARMADA_TPU_PREWARM_ON_REBUILD=1``; once per manifest per
+    process."""
+    if manifest is None:
+        return
+    if os.environ.get("KARMADA_TPU_PREWARM_ON_REBUILD") not in ("1", "true"):
+        return
+    with _WARM_LOCK:
+        if manifest.path in _REBUILD_WARMED:
+            return
+        _REBUILD_WARMED.add(manifest.path)
+
+    def _bg() -> None:
+        try:
+            replay(manifest)
+        except Exception:  # noqa: BLE001 — warmers never take the plane down
+            pass
+
+    threading.Thread(
+        target=_bg, name="fleet-prewarm", daemon=True
+    ).start()

@@ -29,6 +29,14 @@ def main(argv=None) -> None:
         "for minutes and the stuck claim cannot be cancelled in-process; "
         "fail-fast lets the orchestrator respawn a fresh claimant",
     )
+    p.add_argument(
+        "--warmup-manifest", default=None,
+        help="trace-manifest path: AOT-prewarm the engine's XLA traces "
+        "from it after backend init (off the serving path) and record "
+        "fresh traces back into it, so a sidecar restart's first "
+        "ScoreAndAssign wave runs only already-compiled traces "
+        "(default: $KARMADA_TPU_TRACE_MANIFEST; '' disables)",
+    )
     args = p.parse_args(argv)
 
     def read(path):
@@ -42,8 +50,36 @@ def main(argv=None) -> None:
 
     _signal.signal(_signal.SIGTERM, lambda s, f: sys.exit(0))
 
+    import os
+
+    from ..scheduler.prewarm import resolve_boot_manifest
+    from ..utils.compilecache import MANIFEST_ENV
+
+    # flag absent (None) falls back to the env default; an EXPLICIT
+    # --warmup-manifest '' disables even with the env var set (the
+    # opt-out the help text promises). Exported so an opt-out also sticks
+    # for engines this process builds without an explicit manifest.
+    manifest_path = resolve_boot_manifest(args.warmup_manifest)
+    os.environ[MANIFEST_ENV] = manifest_path
+    if manifest_path:
+        # the sidecar owns the engine (and with it the accelerator's trace
+        # set): its engines record fresh traces into the manifest and —
+        # once the prewarm below ran — seed their new-trace ledger from it
+        from ..scheduler import TensorScheduler
+        from ..scheduler.prewarm import TraceManifest
+
+        manifest = TraceManifest(manifest_path)
+        service = SolverService(
+            engine_factory=lambda snap: TensorScheduler(
+                snap, trace_manifest=manifest
+            )
+        )
+    else:
+        manifest = None
+        service = SolverService()
+
     server = SolverGrpcServer(
-        SolverService(),
+        service,
         args.address,
         server_cert=read(args.server_cert),
         server_key=read(args.server_key),
@@ -86,6 +122,20 @@ def main(argv=None) -> None:
             sys.stdout.flush()
             _os._exit(4)
         print(f"solver backend {platform[0]}", flush=True)
+    if manifest is not None:
+        # prewarm AFTER the port/backend lines the orchestrator scrapes:
+        # compiles run off the serving path (the plane connects and syncs
+        # while this proceeds; the gRPC executor serves concurrently).
+        # warmup() also drops the persistence threshold to 0 so every
+        # warmed trace lands in the persistent cache.
+        from ..scheduler.prewarm import warmup
+
+        stats = warmup(manifest.path)
+        print(
+            f"solver prewarm {stats['compiled']}/{stats['specs']} traces "
+            f"in {stats['seconds']:.1f}s",
+            flush=True,
+        )
     try:
         server.wait()
     except KeyboardInterrupt:

@@ -1,0 +1,295 @@
+"""Compile-lifecycle subsystem: persistent trace manifest + AOT prewarm.
+
+The cold-start contract (ISSUE 1): the fleet engine persists every fresh
+solve-family trace signature (kernel + input shapes + statics) to a
+TraceManifest; ``prewarm.warmup`` replays the manifest through AOT
+compilation in a process that has never scheduled anything; an engine
+restored from a REPLAYED manifest reports ``new_trace=False`` on its
+first pass over a covered fleet shape — including across a real process
+restart (subprocess test below).
+
+Everything runs at toy shapes on the conftest CPU platform, so tier-1
+exercises the whole subsystem without TPU access.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from karmada_tpu.scheduler import (
+    BindingProblem,
+    ClusterSnapshot,
+    TensorScheduler,
+)
+from karmada_tpu.scheduler import prewarm
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    synthetic_fleet,
+)
+from karmada_tpu.utils.quantity import parse_resource_list
+
+C, B = 50, 300
+
+
+def toy_problems(n=B, seed=11):
+    rng = np.random.default_rng(seed)
+    pl = dynamic_weight_placement()
+    profiles = [
+        parse_resource_list(
+            {"cpu": f"{250 * (p + 1)}m", "memory": f"{512 * (p + 1)}Mi"}
+        )
+        for p in range(3)
+    ]
+    return [
+        BindingProblem(
+            key=f"t{i}",
+            placement=pl,
+            replicas=int(rng.integers(1, 40)),
+            requests=profiles[i % 3],
+            gvk="apps/v1/Deployment",
+        )
+        for i in range(n)
+    ]
+
+
+def seed_manifest(path, *, passes=3):
+    """Schedule a toy fleet with manifest recording on; returns the
+    settled engine (its trace set is what the manifest must replay)."""
+    snap = ClusterSnapshot(synthetic_fleet(C, seed=7))
+    problems = toy_problems()
+    eng = TensorScheduler(snap, trace_manifest=str(path))
+    assert eng.trace_manifest is not None
+    for _ in range(passes):
+        eng.schedule(problems)
+    assert eng._fleet is not None, "fleet path did not engage"
+    return eng
+
+
+class TestTraceManifest:
+    def test_records_written_and_deduped(self, tmp_path):
+        path = tmp_path / "manifest.json"
+        seed_manifest(path)
+        data = json.loads(path.read_text())
+        kernels = [r["kernel"] for r in data["records"]]
+        assert kernels, "no trace records persisted"
+        assert set(kernels) <= set(prewarm._KERNELS)
+        # re-loading dedups to the same record set, and every observed
+        # record round-trips its ledger key back to a tuple
+        m = prewarm.TraceManifest(str(path))
+        assert len(m.records) == len(data["records"])
+        for key in m.keys():
+            assert isinstance(key, tuple) and isinstance(key[0], str)
+
+    def test_same_workload_records_once(self, tmp_path):
+        path = tmp_path / "manifest.json"
+        eng = seed_manifest(path)
+        n = len(eng.trace_manifest.records)
+        # more passes over the settled shape add nothing
+        eng.schedule(toy_problems())
+        assert len(eng.trace_manifest.records) == n
+
+    def test_corrupt_manifest_tolerated(self, tmp_path):
+        path = tmp_path / "manifest.json"
+        path.write_text("{not json")
+        m = prewarm.TraceManifest(str(path))
+        assert m.records == []
+        # and replay of an empty manifest is a clean no-op
+        stats = prewarm.replay(m)
+        assert stats["specs"] == 0 and stats["failed"] == 0
+
+    def test_expand_records_next_bucket(self):
+        from karmada_tpu.scheduler.fleet import M_ROUND, _cap_round
+
+        solve = {
+            "kernel": "fleet_solve",
+            "key": ["L", 1024],
+            "in_shapes": [[[64, 4], "int64"]],
+            "statics": {"e_cap": 1024, "chunk": 256},
+        }
+        grown = prewarm.expand_records([solve])
+        assert len(grown) == 1
+        # expanded specs are honest: no ledger key (never dispatched),
+        # and the e_cap landed on the NEXT quantized bucket
+        assert grown[0]["key"] is None
+        assert grown[0]["statics"]["e_cap"] == _cap_round(1025) > 1024
+
+        # fleet_pass grows the changed-meta cap, but only within the
+        # padded row count (an over-bound m_cap is a trace nothing ever
+        # dispatches): with n_pad == m_cap, no meta expansion happens
+        def pass_rec(n_pad):
+            return {
+                "kernel": "fleet_pass",
+                "key": ["A", 7],
+                "in_shapes": [[[4], "int32"]] * 5
+                + [[[n_pad, 8], "int64"]],
+                "statics": {"m_cap": M_ROUND, "d_cap": 0},
+            }
+
+        grown = prewarm.expand_records([pass_rec(4 * M_ROUND)])
+        assert [g["statics"]["m_cap"] for g in grown] == [2 * M_ROUND]
+        assert prewarm.expand_records([pass_rec(M_ROUND)]) == []
+
+        # floor caps expand to the engine's REAL next bucket, not
+        # floor+quantum: m_round's first step is 4096 -> M_ROUND, and
+        # d_round's is D_FLOOR -> D_ROUND (phantom buckets like 36864
+        # would be compiles nothing ever dispatches)
+        from karmada_tpu.scheduler.fleet import D_FLOOR, D_ROUND
+
+        floor = {
+            "kernel": "fleet_pass",
+            "key": ["A", 9],
+            "in_shapes": [[[4], "int32"]] * 5
+            + [[[4 * M_ROUND, 8], "int64"]],
+            "statics": {"m_cap": 4096, "d_cap": D_FLOOR},
+        }
+        caps = {
+            k: g["statics"][k]
+            for g in prewarm.expand_records([floor])
+            for k in ("m_cap", "d_cap")
+            if g["statics"][k] != floor["statics"][k]
+        }
+        assert caps == {"m_cap": M_ROUND, "d_cap": D_ROUND}
+
+
+class TestRestoreContract:
+    def test_round_trip_restored_engine_first_pass_warm(self, tmp_path):
+        path = tmp_path / "manifest.json"
+        seed_manifest(path)
+        # replay in-process (the warmup boot stage), then a FRESH engine
+        # restored from the same manifest must report new_trace=False on
+        # its very first pass — zero compiles on the serving path
+        stats = prewarm.warmup(str(path))
+        assert stats["compiled"] >= stats["records"] > 0
+        assert stats["failed"] == 0
+        snap = ClusterSnapshot(synthetic_fleet(C, seed=7))
+        eng = TensorScheduler(snap, trace_manifest=str(path))
+        eng.schedule(toy_problems())
+        assert eng.last_pass_new_trace is False
+
+    def test_partial_warm_seeds_only_compiled_keys(self, tmp_path):
+        # a record whose compile FAILS during replay (stale manifest vs
+        # new build) must not seed the ledger: its trace would still
+        # compile at first dispatch, so claiming new_trace=False for it
+        # would put a cold compile inside the "warm" window
+        path = tmp_path / "manifest.json"
+        seed_manifest(path)
+        m = prewarm.TraceManifest(str(path))
+        good_keys = m.keys()
+        bogus = {
+            "kernel": "fleet_solve",
+            "key": ["L", "bogus", 999],
+            "in_shapes": [[[3, 3], "int64"]],
+            "statics": {"e_cap": -1, "chunk": 0},
+        }
+        m.records.append(bogus)
+        m._seen.add(prewarm._canon(bogus))
+        stats = prewarm.replay(m, expand=False)
+        assert stats["failed"] >= 1 and stats["compiled"] >= 1
+        warmed = m.warmed_keys()
+        assert ("L", "bogus", 999) not in warmed
+        assert warmed == good_keys
+
+    def test_explicit_opt_out_beats_env(self, tmp_path, monkeypatch):
+        # trace_manifest="" is the documented opt-out; an inherited
+        # KARMADA_TPU_TRACE_MANIFEST must not resurrect recording at the
+        # fleet layer (the engine resolved the opt-out once)
+        env_manifest = tmp_path / "env.json"
+        monkeypatch.setenv("KARMADA_TPU_TRACE_MANIFEST", str(env_manifest))
+        snap = ClusterSnapshot(synthetic_fleet(C, seed=7))
+        eng = TensorScheduler(snap, trace_manifest="")
+        assert eng.trace_manifest is None
+        eng.schedule(toy_problems())
+        assert eng._fleet is not None and eng._fleet._manifest is None
+        assert not env_manifest.exists()
+
+    def test_seeding_gated_on_replay(self, tmp_path):
+        # an engine handed a manifest that was NOT replayed in this
+        # process must not claim a warm first pass: seeding without the
+        # compile would report new_trace=False while the compile still
+        # runs at first dispatch
+        path = tmp_path / "unreplayed.json"
+        seed_manifest(path)
+        snap = ClusterSnapshot(synthetic_fleet(C, seed=7))
+        eng = TensorScheduler(snap, trace_manifest=str(path))
+        eng.schedule(toy_problems())
+        assert eng.last_pass_new_trace is True
+
+    def test_restart_smoke_subprocess(self, tmp_path):
+        """The real restart: process 1 schedules and exits; process 2
+        prewarms from the manifest + persistent cache and must run its
+        first pass with new_trace=False. CPU toy shapes — the tier-1
+        smoke for the whole cold-start path."""
+        manifest = tmp_path / "manifest.json"
+        cache = tmp_path / "cache"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["JAX_COMPILATION_CACHE_DIR"] = str(cache)
+        env["KARMADA_TPU_TRACE_MANIFEST"] = str(manifest)
+        body = (
+            "import json, sys\n"
+            f"sys.path.insert(0, "
+            f"{os.path.dirname(os.path.abspath(__file__))!r})\n"
+            "from test_compile_lifecycle import "
+            "seed_manifest, toy_problems, C\n"
+            "from karmada_tpu.scheduler import "
+            "ClusterSnapshot, TensorScheduler\n"
+            "from karmada_tpu.scheduler.prewarm import warmup\n"
+            "from karmada_tpu.utils.builders import synthetic_fleet\n"
+            "phase = sys.argv[1]\n"
+            "manifest = sys.argv[2]\n"
+            "if phase == 'seed':\n"
+            "    eng = seed_manifest(manifest)\n"
+            "    out = {'records': len(eng.trace_manifest.records)}\n"
+            "else:\n"
+            "    stats = warmup(manifest)\n"
+            "    snap = ClusterSnapshot(synthetic_fleet(C, seed=7))\n"
+            "    eng = TensorScheduler(snap, trace_manifest=manifest)\n"
+            "    eng.schedule(toy_problems())\n"
+            "    out = {'prewarm': stats,\n"
+            "           'new_trace': eng.last_pass_new_trace}\n"
+            "print(json.dumps(out))\n"
+        )
+
+        def run(phase):
+            proc = subprocess.run(
+                [sys.executable, "-c", body, phase, str(manifest)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, timeout=300,
+                cwd=os.path.dirname(os.path.dirname(__file__)),
+            )
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        seeded = run("seed")
+        assert seeded["records"] > 0
+        restored = run("restore")
+        assert restored["prewarm"]["compiled"] > 0
+        assert restored["prewarm"]["failed"] == 0
+        assert restored["new_trace"] is False
+
+
+class TestWarmupCLI:
+    def test_warmup_verb(self, tmp_path, capsys):
+        from karmada_tpu import cli
+
+        path = tmp_path / "manifest.json"
+        seed_manifest(path)
+        rc = cli.main(["warmup", "--manifest", str(path)])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0
+        assert out["compiled"] >= out["records"] > 0
+        assert out["failed"] == 0
+        assert out["manifest"] == str(path)
+
+    def test_warmup_missing_manifest_is_noop(self, tmp_path, capsys):
+        from karmada_tpu import cli
+
+        rc = cli.main(
+            ["warmup", "--manifest", str(tmp_path / "absent.json")]
+        )
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0
+        assert out["specs"] == 0 and out["compiled"] == 0

@@ -191,6 +191,33 @@ class TestAccurateEstimator:
         assert res.clusters.get("m1", 0) <= 3
         assert sum(res.clusters.values()) == 10
 
+    def test_batch_estimator_memo_scoped_to_name_order(self):
+        # two coexisting batch estimators over the SAME registry but
+        # different name orderings: memoized columns are positional, so
+        # a memo keyed only by request bytes would hand the second
+        # estimator the first one's columns transposed
+        clusters = [new_cluster("m1", cpu="1000"), new_cluster("m2", cpu="1000")]
+        snap = ClusterSnapshot(clusters)
+        reg = EstimatorRegistry()
+        for name, cores in (("m1", "3"), ("m2", "8")):
+            node = NodeState(
+                name=f"{name}-n0",
+                allocatable=parse_resource_list(
+                    {"cpu": cores, "memory": "64Gi", "pods": 50}
+                ),
+            )
+            reg.register(AccurateEstimator(name, NodeSnapshot([node], snap.dims)))
+        fwd = reg.make_batch_estimator(["m1", "m2"])
+        rev = reg.make_batch_estimator(["m2", "m1"])
+        req = np.zeros((1, len(snap.dims)), np.int64)
+        req[0, list(snap.dims).index("cpu")] = 1000
+        reps = np.asarray([10])
+        assert fwd(req, reps)[0].tolist() == [3, 8]
+        assert rev(req, reps)[0].tolist() == [8, 3]
+        # and the repeat answers come from each closure's own memo slice
+        assert fwd(req, reps)[0].tolist() == [3, 8]
+        assert rev(req, reps)[0].tolist() == [8, 3]
+
 
 class TestModelEstimatorHostMirror:
     def _model_fleet(self, n=20, seed=3):

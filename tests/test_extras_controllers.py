@@ -189,6 +189,81 @@ class TestWorkloadRebalancer:
         assert rebalancer.status.observed_workloads[0]["result"] == "Successful"
         assert rebalancer.status.finish_time == clock[0]
 
+    def test_same_length_inplace_edit_retriggers(self):
+        # Store.apply does not auto-bump generation, so a writer that
+        # swaps a target IN PLACE (same workload count, same generation)
+        # used to be indistinguishable from our own status echo — the
+        # content digest must re-trigger it
+        clock = [5000.0]
+        cp = ControlPlane(clock=lambda: clock[0])
+        for i in (1, 2):
+            cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+        cp.store.apply(new_deployment("app", replicas=4))
+        cp.store.apply(new_deployment("app2", replicas=4))
+        cp.store.apply(nginx_policy(dynamic_weight_placement()))
+        cp.settle()
+        clock[0] += 10
+        cp.store.apply(
+            WorkloadRebalancer(
+                meta=ObjectMeta(name="rb-edit"),
+                spec=WorkloadRebalancerSpec(
+                    workloads=[ObjectReferenceSelector(kind="Deployment", name="app")]
+                ),
+            )
+        )
+        cp.settle()
+        t_first = clock[0]
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert rb.spec.reschedule_triggered_at == t_first
+        # same-length in-place edit: app -> app2, no generation bump
+        clock[0] += 10
+        reb = cp.store.get("WorkloadRebalancer", "rb-edit")
+        reb.spec.workloads[0] = ObjectReferenceSelector(
+            kind="Deployment", name="app2"
+        )
+        cp.store.apply(reb)
+        cp.settle()
+        rb2 = cp.store.get("ResourceBinding", "default/app2-deployment")
+        assert rb2.spec.reschedule_triggered_at == clock[0]
+        # the echo gate still holds once the edit is observed: more
+        # settles must not re-trigger anything
+        clock[0] += 10
+        cp.settle()
+        rb2 = cp.store.get("ResourceBinding", "default/app2-deployment")
+        assert rb2.spec.reschedule_triggered_at == clock[0] - 10
+
+    def test_legacy_status_without_digest_not_retriggered(self):
+        # a checkpoint written by a pre-digest build unpickles statuses
+        # WITHOUT observed_spec_digest (Store.restore bypasses __init__):
+        # the echo gate must fall back to the old length compare — no
+        # AttributeError, and no boot-time re-trigger of every finished
+        # rebalancer
+        clock = [5000.0]
+        cp = ControlPlane(clock=lambda: clock[0])
+        for i in (1, 2):
+            cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+        cp.store.apply(new_deployment("app", replicas=4))
+        cp.store.apply(nginx_policy(dynamic_weight_placement()))
+        cp.settle()
+        clock[0] += 10
+        cp.store.apply(
+            WorkloadRebalancer(
+                meta=ObjectMeta(name="rb-legacy"),
+                spec=WorkloadRebalancerSpec(
+                    workloads=[ObjectReferenceSelector(kind="Deployment", name="app")]
+                ),
+            )
+        )
+        cp.settle()
+        t_first = clock[0]
+        # simulate the restored legacy object: strip the new field
+        reb = cp.store.get("WorkloadRebalancer", "rb-legacy")
+        del reb.status.observed_spec_digest
+        clock[0] += 10
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        assert rb.spec.reschedule_triggered_at == t_first
+
     def test_ttl_after_finished_cleans_up(self):
         clock = [5000.0]
         cp = ControlPlane(clock=lambda: clock[0])
