@@ -1179,7 +1179,53 @@ def build_parser() -> tuple:
         help="compile only observed signatures (skip the next-bucket "
         "cap expansion)",
     )
+
+    li = sub.add_parser(
+        "lint",
+        help="run graftlint, the repo's AST-based trace-safety & "
+        "concurrency analyzer (GL001 trace safety, GL002 trace-key "
+        "completeness, GL003 env-flag registry, GL004 lock discipline, "
+        "GL005 import hygiene)",
+    )
+    li.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: karmada_tpu tools)",
+    )
+    li.add_argument("--format", choices=("text", "json"), default="text")
+    li.add_argument(
+        "--no-baseline", action="store_true",
+        help="report findings grandfathered in graftlint_baseline.json too",
+    )
     return parser, sub
+
+
+def cmd_lint(
+    paths: Sequence[str] = (), *, fmt: str = "text", baseline: bool = True
+) -> int:
+    """The ``lint`` verb: run the repo's static analyzer
+    (tools/graftlint) over ``paths`` (default: the package + tools).
+    Works from a checkout — the analyzer rides beside the package, not
+    inside it (it is a development gate, not a serving component). The
+    verb DELEGATES to graftlint's own CLI so output shape, exit codes and
+    defaults can never drift between the two surfaces."""
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(repo_root, "tools", "graftlint")):
+        print(
+            "error: graftlint not found — `lint` runs from a repo "
+            "checkout (tools/graftlint/)",
+            file=sys.stderr,
+        )
+        return 2
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from tools.graftlint.__main__ import main as graftlint_main
+
+    argv = list(paths) + ["--root", repo_root, "--format", fmt]
+    if not baseline:
+        argv.append("--no-baseline")
+    return graftlint_main(argv)
 
 
 def cmd_warmup(manifest: str = "", expand: bool = True) -> dict:
@@ -1214,6 +1260,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.command == "completion":
         print(cmd_completion(args.shell))
         return 0
+    if args.command == "lint":
+        return cmd_lint(
+            args.paths, fmt=args.format, baseline=not args.no_baseline
+        )
     if args.command == "warmup":
         stats = cmd_warmup(args.manifest, expand=not args.no_expand)
         print(json.dumps(stats))

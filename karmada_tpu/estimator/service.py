@@ -16,10 +16,13 @@ wire it is on.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import asdict, dataclass, field
 from typing import Callable, Optional
+
+log = logging.getLogger("karmada_tpu")
 
 import numpy as np
 
@@ -145,8 +148,8 @@ def _close(conn) -> None:
     if close is not None:
         try:
             close()
-        except Exception:
-            pass
+        except Exception as exc:  # noqa: BLE001 — teardown is best-effort
+            log.debug("estimator connection close failed: %s", exc)
 
 
 class EstimatorClientPool:
@@ -217,11 +220,22 @@ class EstimatorClientPool:
                         cluster=cluster, resource_request=resource_request, **req_kw
                     ),
                 )
-            except Exception:
+            except Exception as exc:  # noqa: BLE001 — any transport failure
                 # transport failure answers UnauthenticReplica and drops the
                 # cached channel — only if it is still this one, so a late
                 # straggler cannot tear down a re-resolved healthy channel
-                # (client/accurate.go error path + cache eviction)
+                # (client/accurate.go error path + cache eviction). Logged:
+                # a silently-evicted estimator looks identical to a cluster
+                # that genuinely answered -1. Class name only at warning —
+                # grpc error reprs are multi-line and orchestrators scrape
+                # this process's merged stdout/stderr for JSON lines
+                log.warning(
+                    "estimator %s: MaxAvailableReplicas failed (%s); "
+                    "answering UnauthenticReplica and evicting the channel",
+                    cluster, type(exc).__name__,
+                )
+                log.debug("estimator %s failure detail", cluster,
+                          exc_info=exc)
                 self.evict(cluster, conn)
                 return
             results[cluster] = resp.max_replicas

@@ -449,7 +449,11 @@ class ProcessKarmadaOperator:
         inst = self._instance(data)
         proc = _spawn(self._plane_cmd(data))
         inst.procs["plane"] = proc
-        line = _scrape(proc, r"(\{.*\})")
+        # anchor on a JSON object (json.dumps always opens with `{"`):
+        # the child's stderr is merged into the scraped stream, and a
+        # stray log line containing braces (grpc error reprs carry
+        # `{grpc_status:...}`) must not masquerade as the endpoints line
+        line = _scrape(proc, r"(\{\".*\})")
         info = json.loads(line)
         inst.endpoints.update(
             bus=info["bus"], proxy=info["proxy"], metrics=info["metrics"],

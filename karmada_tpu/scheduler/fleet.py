@@ -52,6 +52,8 @@ placements.
 
 from __future__ import annotations
 
+import logging
+
 from functools import partial
 from typing import Optional, Sequence
 
@@ -63,6 +65,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.divide import AGGREGATED, DUPLICATED as S_DUPLICATED, _divide_batch
 from ..ops.estimate import MAX_INT32, merge_estimates
+
+log = logging.getLogger("karmada_tpu")
 
 K_PREV = 32  # max previous-assignment sites on the fast path (small fleets
 # legitimately spread one binding over dozens of clusters; rows beyond this
@@ -1131,8 +1135,17 @@ class FleetTable:
             return
         try:
             self._manifest.record(kernel, key, arrays, statics)
-        except Exception:  # noqa: BLE001 — durability is best-effort
-            pass
+        except Exception as exc:  # noqa: BLE001 — manifest failures must
+            # never abort a scheduling wave (durability is optional, the
+            # placement is not) — but they are LOGGED, never swallowed:
+            # an unrecorded trace costs the NEXT boot a full compile.
+            # Class name only at warning (orchestrators scrape merged
+            # stdout/stderr for JSON lines; reprs can be multi-line)
+            log.warning(
+                "trace manifest record of %s failed (%s); next boot "
+                "re-compiles this trace", kernel, type(exc).__name__,
+            )
+            log.debug("manifest record failure detail", exc_info=exc)
 
     # -- rows --------------------------------------------------------------
 

@@ -43,6 +43,7 @@ actually happened (``TraceManifest.warmed``).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -127,12 +128,16 @@ class TraceManifest:
             records = data.get("records", [])
         except (OSError, ValueError):
             return
-        for r in records:
-            if r.get("kernel") in _KERNELS and "in_shapes" in r:
-                c = _canon(r)
-                if c not in self._seen:
-                    self._seen.add(c)
-                    self.records.append(r)
+        # under the lock like every other records/_seen mutation: _load
+        # also runs via restore-time re-instantiation while engine threads
+        # may hold the same manifest object (one instance per path)
+        with self._lock:
+            for r in records:
+                if r.get("kernel") in _KERNELS and "in_shapes" in r:
+                    c = _canon(r)
+                    if c not in self._seen:
+                        self._seen.add(c)
+                        self.records.append(r)
 
     def _save(self) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -402,7 +407,10 @@ def prewarm_on_rebuild(manifest: Optional[TraceManifest]) -> None:
         try:
             replay(manifest)
         except Exception:  # noqa: BLE001 — warmers never take the plane down
-            pass
+            logging.getLogger("karmada_tpu").exception(
+                "background prewarm of %s failed; serving path will "
+                "compile on first dispatch instead", manifest.path
+            )
 
     threading.Thread(
         target=_bg, name="fleet-prewarm", daemon=True

@@ -103,6 +103,9 @@ class InvertedIndexBackend:
                 self._remove_locked(doc_id)
             self._by_cluster.pop(cluster, None)
 
+    # called-with-lock-held helper (the ``_locked`` suffix contract):
+    # every caller above holds self._lock
+    # graftlint: disable=GL004
     def _remove_locked(self, doc_id: str) -> None:
         doc = self._docs.pop(doc_id, None)
         if doc is None:

@@ -21,7 +21,117 @@ Semantics preserved from the reference:
 from __future__ import annotations
 
 import argparse
+from dataclasses import dataclass
 from typing import Optional, Sequence
+
+# -- environment-flag registry ----------------------------------------------
+#
+# Every KARMADA_TPU_* environment variable any process in this repo reads
+# MUST be declared here (graftlint rule GL003 enforces it) and is rendered
+# into the docs/OPERATIONS.md env table by ``render_env_table()``
+# (tools/docs_from_bench.py regenerates the table and fails loudly on
+# drift). The read sites stay where they are — this registry is the
+# DECLARATION surface, the analogue of the reference's pflag definitions
+# for knobs that configure processes below the flag parser (backend
+# selection, cache policy) or from test/bench drivers.
+
+
+@dataclass(frozen=True)
+class EnvFlag:
+    name: str
+    default: str
+    description: str
+    #: read outside the package tree (test/bench drivers): exempt from
+    #: graftlint's registered-but-never-read staleness check
+    external: bool = False
+
+
+ENV_FLAGS: dict[str, EnvFlag] = {
+    f.name: f
+    for f in (
+        EnvFlag(
+            "KARMADA_TPU_PLATFORM", "",
+            "Authoritative jax platform for a spawned component (the "
+            "tunnel sitecustomize overrides JAX_PLATFORMS programmatically"
+            ", so the env var alone is not enough); set by "
+            "localup.spawn_child, applied by utils.platform."
+            "apply_child_platform at package import.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_TRACE_MANIFEST", "<cache dir>/trace_manifest.json",
+            "Trace-signature manifest path (scheduler.prewarm."
+            "TraceManifest): fleet engines record fresh solve-family "
+            "traces into it and AOT prewarm replays it at boot. Empty "
+            "string disables recording and restoring.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_CACHE_MIN_COMPILE_SECS", "1.0",
+            "Persistent XLA compile-cache threshold (utils.compilecache): "
+            "compiles faster than this are not persisted. Prewarm drops "
+            "it to 0 so every warmed trace survives the process.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_PREWARM_ON_REBUILD", "0",
+            "Set to 1/true to replay the trace manifest on a daemon "
+            "thread whenever a fleet table is (re)built, compiling the "
+            "rebuilt table's upcoming shapes off the serving path.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_DENSE_BUDGET", str(6 << 30),
+            "HBM byte budget for the dense-resident fleet table; tables "
+            "whose dense mirror exceeds it fall back to the "
+            "entry-resident legacy path. Raise on parts with more HBM.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_NO_NATIVE", "0",
+            "Set to 1 to skip building/loading the ctypes native decode "
+            "helpers and always use the numpy fallback path.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_DRYRUN_REAL_DEVICES", "0",
+            "Multichip dryrun escape hatch (__graft_entry__): set to 1 to "
+            "run on the default backend's real devices instead of forcing "
+            "a virtual CPU mesh.",
+            external=True,
+        ),
+        EnvFlag(
+            "KARMADA_TPU_TPU_SOLVER_E2E", "0",
+            "Set to 1 to enable the live-TPU solver-sidecar e2e "
+            "(tests/test_tpu_solver_localup.py); run alone — the "
+            "single-client tunnel grant can linger after an unclean kill.",
+            external=True,
+        ),
+        EnvFlag(
+            "KARMADA_TPU_SOLVER_PLATFORM", "axon,cpu",
+            "Platform handed to the solver sidecar by the TPU e2e — the "
+            "one component allowed to dial the accelerator tunnel.",
+            external=True,
+        ),
+        EnvFlag(
+            "KARMADA_TPU_TPU_E2E_RECORD", "",
+            "Path the TPU solver e2e writes its timing record to "
+            "(TPU_E2E_r*.json); empty disables recording.",
+            external=True,
+        ),
+    )
+}
+
+
+def render_env_table() -> str:
+    """The docs/OPERATIONS.md environment-variable table, generated from
+    ``ENV_FLAGS`` so prose can never drift from the declaration surface
+    (tools/docs_from_bench.py writes it between the envflags markers and
+    fails loudly when the committed table differs)."""
+    lines = [
+        "| variable | default | what it does |",
+        "|---|---|---|",
+    ]
+    for name in sorted(ENV_FLAGS):
+        f = ENV_FLAGS[name]
+        default = f.default if f.default else '""'
+        lines.append(f"| `{name}` | `{default}` | {f.description} |")
+    return "\n".join(lines)
+
 
 #: the in-tree scheduler plugin set (framework/plugins/registry.go:30-39)
 IN_TREE_PLUGINS = (

@@ -1,0 +1,27 @@
+"""GL001 good fixture: static branches, shape reads, traced selects —
+everything the rule must NOT flag. Parsed by graftlint only."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("flag", "cap"))
+def kernel(x, flag: bool, cap: int):
+    if flag:  # OK: static argument — branch resolves at trace time
+        x = x + 1
+    if cap > 4:  # OK: static argument
+        x = x * 2
+    if x.shape[0] > 2:  # OK: shape is static at trace time
+        x = x[:2]
+    if len(x) > 1:  # OK: len(traced) == shape[0], static
+        x = x + 0
+    return jnp.where(x > 0, x, 0)  # OK: traced select, not a host branch
+
+
+def host_helper(x):
+    # OK: not jitted — host code may branch and convert freely
+    if x > 3:
+        return float(x)
+    return 0.0

@@ -1,0 +1,16 @@
+"""GL002 bad fixture: a jitted kernel dispatched with no trace-key
+ledger call in any enclosing function. Parsed by graftlint only."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _toy_kernel(x):
+    return x * 2
+
+
+class Table:
+    def schedule(self, x):
+        # BAD: a fresh trace here is invisible to new_trace_last_pass
+        return _toy_kernel(jnp.asarray(x))

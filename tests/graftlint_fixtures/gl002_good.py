@@ -1,0 +1,29 @@
+"""GL002 good fixture: ledgered dispatch + jit-composed call.
+Parsed by graftlint only."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _toy_kernel(x):
+    return x * 2
+
+
+@jax.jit
+def _outer_kernel(x):
+    # OK: a kernel called inside another jitted kernel traces as ONE
+    # composed program — the outer dispatch site ledgers it
+    return _toy_kernel(x) + 1
+
+
+class Table:
+    def __init__(self):
+        self._seen = set()
+
+    def _mark_trace(self, *key):
+        self._seen.add(key)
+
+    def schedule(self, x):
+        self._mark_trace("T", x.shape)  # OK: signature ledgered
+        return _outer_kernel(jnp.asarray(x))

@@ -1,0 +1,18 @@
+"""GL003 bad fixture: unregistered KARMADA_TPU_* env reads — attribute
+form, module-constant indirection, and the ``from os import`` aliased
+forms. Parsed by graftlint only."""
+
+import os
+from os import environ, getenv as _ge
+
+_INDIRECT = "KARMADA_TPU_ALSO_NOT_REGISTERED"
+
+
+def read():
+    a = os.environ.get("KARMADA_TPU_NOT_REGISTERED", "")  # BAD
+    b = os.getenv(_INDIRECT)  # BAD: resolved through the constant
+    c = os.environ["KARMADA_TPU_NOT_REGISTERED"]  # BAD
+    d = _ge("KARMADA_TPU_ALIASED_GETENV")  # BAD: aliased getenv
+    e = environ.get("KARMADA_TPU_ALIASED_ENVIRON")  # BAD: aliased environ
+    f = environ["KARMADA_TPU_ALIASED_ENVIRON"]  # BAD
+    return a, b, c, d, e, f

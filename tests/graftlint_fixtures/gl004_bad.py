@@ -1,0 +1,20 @@
+"""GL004 bad fixture: attrs guarded by the lock in one method, mutated
+lock-free in another. Parsed by graftlint only."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # OK: construction happens before the object is shared
+        self._items = []
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+            self._items.append(self._n)
+
+    def reset(self):
+        self._n = 0  # BAD: lock-free write of a lock-guarded attr
+        self._items.clear()  # BAD: lock-free in-place mutation

@@ -1,0 +1,27 @@
+"""GL004 good fixture: every mutation under the lock, plus a documented
+single-writer suppression. Parsed by graftlint only."""
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._items = []
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+            self._items.append(self._n)
+
+    def reset(self):
+        with self._lock:  # OK: takes the same lock
+            self._n = 0
+            self._items.clear()
+
+    # single-writer invariant: only the owner thread calls rewind(),
+    # before the worker threads that use bump() are started
+    # graftlint: disable=GL004
+    def rewind(self):
+        self._n = 0

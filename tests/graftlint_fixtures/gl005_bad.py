@@ -1,0 +1,14 @@
+"""GL005 bad fixture: linted with roles {entry, ops} — module-level jax
+in an entry module, scheduler import from the kernel layer.
+Parsed by graftlint only."""
+
+import jax  # BAD: cold-start cost on every CLI boot
+import jax.numpy as jnp  # BAD
+
+from karmada_tpu.scheduler import fleet  # BAD: ops/ -> scheduler/
+
+
+def solve(x):
+    from ..scheduler import core  # BAD: relative scheduler import too
+
+    return jnp.asarray(x), fleet, core, jax

@@ -1,0 +1,12 @@
+"""GL005 good fixture: jax deferred into the function that needs it, no
+scheduler imports. Linted with roles {entry, ops}.
+Parsed by graftlint only."""
+
+import os
+import sys
+
+
+def run():
+    import jax  # OK: deferred — only the verb that needs the backend pays
+
+    return jax.devices(), os, sys

@@ -1,0 +1,3 @@
+"""Repo tooling: doc generation (docs_from_bench) and static analysis
+(graftlint). Not shipped with the karmada_tpu package — run from a
+checkout (``python -m tools.graftlint``, ``python tools/docs_from_bench.py``)."""

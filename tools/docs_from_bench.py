@@ -1,6 +1,7 @@
 """Regenerate the measured-numbers blocks in the docs from a bench record.
 
 Usage: python tools/docs_from_bench.py BENCH_SELF_r05.json
+       python tools/docs_from_bench.py --env-table
 
 Rewrites the text between ``<!-- bench:begin -->`` / ``<!-- bench:end -->``
 markers in docs/OPERATIONS.md and BASELINE.md from the JSON line bench.py
@@ -8,6 +9,13 @@ printed (either the raw line or the driver's ``{"parsed": ...}`` wrapper).
 Round 4 shipped docs claiming ~10 s where the recorded JSON said 71.6 s
 (VERDICT r4 weak #2); with this tool the prose can never drift from the
 record again — regenerate, don't hand-edit.
+
+The same contract covers the environment-variable table: the block between
+``<!-- envflags:begin -->`` / ``<!-- envflags:end -->`` in
+docs/OPERATIONS.md is generated from ``karmada_tpu.utils.flags.ENV_FLAGS``
+(``--env-table`` rewrites it), and EVERY doc-regeneration run fails loudly
+when the committed table has drifted from the registry — the docs half of
+graftlint's GL003 gate.
 """
 
 from __future__ import annotations
@@ -89,19 +97,58 @@ def cold_block(cd: dict) -> str:
     )
 
 
-def rewrite(path: Path, body: str) -> None:
+def rewrite(path: Path, body: str, marker: str = "bench") -> None:
     text = path.read_text()
-    pat = re.compile(
-        r"(<!-- bench:begin[^>]*-->\n).*?(<!-- bench:end -->)", re.S
-    )
+    pat = _marker_re(marker)
     if not pat.search(text):
-        raise SystemExit(f"{path}: no bench markers")
+        raise SystemExit(f"{path}: no {marker} markers")
     text = pat.sub(lambda m: m.group(1) + body + "\n" + m.group(2), text)
     path.write_text(text)
-    print(f"rewrote {path}")
+    print(f"rewrote {path} [{marker}]")
+
+
+def _marker_re(marker: str) -> "re.Pattern":
+    return re.compile(
+        rf"(<!-- {marker}:begin[^>]*-->\n).*?(<!-- {marker}:end -->)", re.S
+    )
+
+
+def env_table() -> str:
+    """The generated env-var table (karmada_tpu.utils.flags is the single
+    source of truth; graftlint GL003 keeps the READ sites honest)."""
+    sys.path.insert(0, str(ROOT))
+    from karmada_tpu.utils.flags import render_env_table
+
+    return (
+        "_Generated from `karmada_tpu/utils/flags.py` ENV_FLAGS by "
+        "`tools/docs_from_bench.py --env-table` — regenerate, don't "
+        "hand-edit._\n\n" + render_env_table()
+    )
+
+
+def check_env_table() -> None:
+    """Fail loudly when the committed OPERATIONS.md env table drifted from
+    the flags registry — runs on EVERY doc regeneration."""
+    path = ROOT / "docs" / "OPERATIONS.md"
+    m = _marker_re("envflags").search(path.read_text())
+    if not m:
+        raise SystemExit(
+            f"{path}: no envflags markers — restore the Environment "
+            "variables section and run "
+            "`python tools/docs_from_bench.py --env-table`"
+        )
+    committed_body = m.group(0).split("-->\n", 1)[1].rsplit("<!--", 1)[0]
+    if committed_body.strip() != env_table().strip():
+        raise SystemExit(
+            f"{path}: env table drifted from karmada_tpu/utils/flags.py "
+            "ENV_FLAGS — run `python tools/docs_from_bench.py --env-table`"
+        )
 
 
 def main() -> None:
+    if sys.argv[1:] == ["--env-table"]:
+        rewrite(ROOT / "docs" / "OPERATIONS.md", env_table(), "envflags")
+        return
     src = Path(sys.argv[1])
     d = json.loads(src.read_text())
     if "parsed" in d:  # the driver's BENCH_r{N}.json wrapper
@@ -118,6 +165,7 @@ def main() -> None:
     )
     rewrite(ROOT / "docs" / "OPERATIONS.md", body)
     rewrite(ROOT / "BASELINE.md", body)
+    check_env_table()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,52 @@
+"""graftlint — AST-based trace-safety & concurrency analyzer for this repo.
+
+Run it:
+
+    python -m tools.graftlint                 # karmada_tpu/ + tools/
+    python -m tools.graftlint path/to/file.py
+    karmadactl-tpu lint --format json
+
+Rules (see rules.py): GL001 trace safety, GL002 trace-key completeness,
+GL003 env-flag registry, GL004 lock discipline, GL005 cold-start import
+hygiene. Suppress per line with ``# graftlint: disable=GL00X`` (same line,
+line above, or the enclosing ``def`` line for GL004), per file with
+``# graftlint: disable-file=GL00X``. Grandfathered findings live in
+``graftlint_baseline.json`` and MUST carry a written justification.
+"""
+
+from . import rules  # noqa: F401 — registers the GL00x analyzers
+from .core import (  # noqa: F401
+    RULES,
+    Config,
+    Finding,
+    Linter,
+    LintResult,
+    default_config,
+    load_baseline,
+    write_baseline,
+)
+
+DEFAULT_TARGETS = ("karmada_tpu", "tools")
+
+
+def run(
+    targets=DEFAULT_TARGETS,
+    *,
+    root=None,
+    baseline="auto",
+    roles_override=None,
+) -> LintResult:
+    """One-call API used by the CLI verb and the tier-1 test.
+
+    ``baseline="auto"`` loads the repo's committed baseline; ``None``
+    disables baselining (fixture tests want raw findings)."""
+    config = default_config(root)
+    linter = Linter(config)
+    baseline_path = None
+    if baseline == "auto":
+        baseline_path = config.root / config.baseline_path
+    elif baseline:
+        baseline_path = config.root / baseline
+    return linter.run(
+        targets, baseline=baseline_path, roles_override=roles_override
+    )

@@ -1,0 +1,68 @@
+"""CLI: ``python -m tools.graftlint [paths ...]`` (see package docstring).
+
+Exit codes: 0 clean (baselined findings allowed), 1 findings or a
+baseline entry without justification, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import DEFAULT_TARGETS, RULES, default_config, run
+from .core import write_baseline
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based trace-safety & concurrency analyzer",
+    )
+    p.add_argument("paths", nargs="*", default=list(DEFAULT_TARGETS),
+                   help="files/directories to lint (default: karmada_tpu "
+                   "tools)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: this checkout)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings too")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to graftlint_baseline.json "
+                   "with EMPTY justifications (the linter refuses them "
+                   "until each is justified)")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rid, r in sorted(RULES.items()):
+            print(f"{rid}  {r.title}")
+        return 0
+
+    if args.write_baseline:
+        # baseline=None: the new baseline must hold EVERY current finding
+        # (a baselined run would drop — and thereby delete — entries that
+        # still match); write_baseline carries existing justifications over
+        raw = run(args.paths or DEFAULT_TARGETS, root=args.root,
+                  baseline=None)
+        config = default_config(args.root)
+        path = config.root / config.baseline_path
+        n = write_baseline(path, raw.findings)
+        print(f"wrote {n} entries to {path} — add a justification to each "
+              "new entry (empty justifications are rejected)")
+        return 0
+
+    result = run(
+        args.paths or DEFAULT_TARGETS,
+        root=args.root,
+        baseline=None if args.no_baseline else "auto",
+    )
+    if args.format == "json":
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        print(result.render_text())
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,484 @@
+"""graftlint core: the visitor framework behind the GL00x analyzers.
+
+A project-native static analyzer in the spirit of Karmada's golangci/vet
+gates: the invariants the hot path lives or dies on (XLA trace discipline,
+trace-key ledgering, env-flag registration, lock discipline, cold-start
+import hygiene) become machine-checked rules that run in tier-1 instead of
+surfacing as perf regressions after the fact.
+
+Pieces:
+
+- ``Finding`` — one diagnostic, with a STABLE identity (rule, path,
+  anchor, detail) so baseline entries survive line-number drift.
+- ``ModuleInfo`` — a parsed file: AST + parent map + role tags (which
+  rules apply where) + suppression comments.
+- ``LintContext`` — cross-module state (the env-flag registry, the
+  module-level constant table GL003 resolves indirect keys through).
+- ``Linter`` — walks files, runs every registered rule, applies inline
+  suppressions (``# graftlint: disable=GL001``) and the committed
+  baseline (``graftlint_baseline.json``), and returns a ``LintResult``.
+
+Rules self-register via the ``@rule`` decorator (see rules.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+#: roles a module can carry; rules declare which roles they act on
+ROLE_JIT = "jit"  # trace-safety scope (ops/, scheduler/, parallel/, refimpl/)
+ROLE_LEDGER = "ledger"  # trace-key ledger scope (scheduler/)
+ROLE_ENTRY = "entry"  # cold-start-sensitive entry module
+ROLE_OPS = "ops"  # kernel layer: must not import the scheduler
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\s]+?)\s*(?:#|$)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``anchor`` (enclosing class.func qualname or a
+    symbol) + ``detail`` (the offending name: env var, attribute, import)
+    form the line-number-independent identity baseline entries match on."""
+
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    col: int
+    message: str
+    anchor: str = ""
+    detail: str = ""
+    #: line of the enclosing def/class — a suppression pragma there (or
+    #: the line above it) silences the finding too (0 = unset)
+    anchor_line: int = 0
+
+    @property
+    def identity(self) -> tuple:
+        return (self.rule, self.path, self.anchor, self.detail)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "anchor": self.anchor,
+            "detail": self.detail,
+        }
+
+
+@dataclass
+class Config:
+    """What the rules need to know about THIS repo's layout."""
+
+    root: Path
+    package: str = "karmada_tpu"
+    env_prefix: str = "KARMADA_TPU_"
+    #: package subdirs whose jitted functions get GL001 trace-safety checks
+    jit_dirs: tuple = ("ops", "scheduler", "parallel", "refimpl", "models",
+                      "estimator")
+    #: package subdirs whose jit-kernel call sites must ledger trace keys
+    ledger_dirs: tuple = ("scheduler",)
+    #: the trace-key ledger helpers (FleetTable._mark_trace family)
+    ledger_helpers: tuple = (
+        "_mark_trace", "_mark_entries_trace", "_record_trace",
+    )
+    #: package-relative entry modules that must not import jax at module
+    #: level (PR 1's cold-start win); every ``*/__main__.py`` is implied
+    entry_modules: tuple = (
+        "__init__.py", "cli.py", "localup.py", "controlplane.py",
+        "bus/agent.py",
+    )
+    flags_module: str = "karmada_tpu/utils/flags.py"
+    docs_env_table: str = "docs/OPERATIONS.md"
+    baseline_path: str = "graftlint_baseline.json"
+
+    def roles_for(self, rel: str) -> set:
+        """Role tags from a repo-relative posix path."""
+        roles: set = set()
+        prefix = self.package + "/"
+        if not rel.startswith(prefix):
+            return roles
+        sub = rel[len(prefix):]
+        top = sub.split("/", 1)[0]
+        if top in self.jit_dirs:
+            roles.add(ROLE_JIT)
+        if top in self.ledger_dirs:
+            roles.add(ROLE_LEDGER)
+        if top == "ops":
+            roles.add(ROLE_OPS)
+        if sub in self.entry_modules or sub.endswith("__main__.py"):
+            roles.add(ROLE_ENTRY)
+        return roles
+
+
+@dataclass
+class ModuleInfo:
+    path: Path
+    rel: str
+    tree: ast.Module
+    lines: list
+    roles: set
+    parents: dict = field(default_factory=dict)
+    suppress_file: set = field(default_factory=set)
+    suppress_line: dict = field(default_factory=dict)  # line -> set(rules)
+
+    @classmethod
+    def parse(cls, path: Path, rel: str, roles: set) -> "ModuleInfo":
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+        mod = cls(
+            path=path, rel=rel, tree=tree,
+            lines=source.splitlines(), roles=roles,
+        )
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                mod.parents[child] = parent
+        for i, line in enumerate(mod.lines, start=1):
+            if "graftlint" not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+            if m.group("scope"):
+                mod.suppress_file |= rules
+            else:
+                mod.suppress_line.setdefault(i, set()).update(rules)
+        return mod
+
+    def suppressed(self, rule: str, *lines: int) -> bool:
+        """A finding is suppressed by a file-level pragma, or a line
+        pragma on the flagged line, the line above it, or any anchor line
+        the rule passed (typically the enclosing ``def``)."""
+        if rule in self.suppress_file or "all" in self.suppress_file:
+            return True
+        for ln in lines:
+            if ln <= 0:
+                continue
+            for candidate in (ln, ln - 1):
+                marked = self.suppress_line.get(candidate, ())
+                if rule in marked or "all" in marked:
+                    return True
+        return False
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted class/function chain enclosing ``node`` (inclusive when
+        node itself is a def/class); "" at module level."""
+        parts: list = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts))
+
+
+class LintContext:
+    """Cross-module state shared by every rule invocation of one run."""
+
+    def __init__(self, config: Config, modules: list):
+        self.config = config
+        self.modules = modules
+        self._env_registry: Optional[dict] = None
+        self._docs_text: Optional[str] = None
+        # module-level NAME = "KARMADA_TPU_..." constants: GL003 resolves
+        # os.environ.get(MANIFEST_ENV) through these. Per-module first
+        # (same-named constants in different modules must not shadow each
+        # other), then a cross-module fallback for imported constants
+        # (from ..utils.compilecache import MANIFEST_ENV) — but only when
+        # the identifier maps to ONE value repo-wide; ambiguous names
+        # stay unresolved rather than misresolve.
+        self._module_constants: dict = {}  # rel -> {name: value}
+        global_values: dict = {}  # name -> set(values)
+        for mod in modules:
+            local: dict = {}
+            for node in mod.tree.body:
+                targets: list = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                    value = node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [node.target]
+                    value = node.value
+                else:
+                    continue
+                if not (
+                    isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)
+                    and value.value.startswith(config.env_prefix)
+                ):
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        local[t.id] = value.value
+                        global_values.setdefault(t.id, set()).add(value.value)
+            self._module_constants[mod.rel] = local
+        self._global_constants = {
+            name: next(iter(values))
+            for name, values in global_values.items()
+            if len(values) == 1
+        }
+
+    def resolve_env_constant(self, mod: "ModuleInfo", ident: str):
+        """The env-var name a bare identifier refers to in ``mod`` (None
+        when unknown or ambiguous across modules)."""
+        local = self._module_constants.get(mod.rel, {})
+        if ident in local:
+            return local[ident]
+        return self._global_constants.get(ident)
+
+    @property
+    def env_registry(self) -> dict:
+        """name -> EnvFlag from utils/flags.py (imported live: the
+        registry IS code, so the linter can never drift from it)."""
+        if self._env_registry is None:
+            import importlib
+            import sys
+
+            root = str(self.config.root)
+            if root not in sys.path:
+                sys.path.insert(0, root)
+            flags = importlib.import_module(
+                self.config.package + ".utils.flags"
+            )
+            self._env_registry = dict(flags.ENV_FLAGS)
+        return self._env_registry
+
+    @property
+    def docs_text(self) -> str:
+        if self._docs_text is None:
+            path = self.config.root / self.config.docs_env_table
+            self._docs_text = path.read_text() if path.exists() else ""
+        return self._docs_text
+
+
+class Rule:
+    id = "GL000"
+    title = ""
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finalize(self, ctx: LintContext) -> Iterator[Finding]:
+        """Cross-module findings emitted after every file was checked."""
+        return iter(())
+
+
+RULES: dict = {}
+
+
+def rule(cls):
+    """Register an analyzer class (decorator)."""
+    RULES[cls.id] = cls()
+    return cls
+
+
+@dataclass
+class LintResult:
+    findings: list  # non-suppressed, non-baselined — these fail the gate
+    baselined: list  # matched a justified baseline entry
+    suppressed_count: int
+    checked_files: int
+    baseline_errors: list  # malformed baseline entries (missing justification)
+    unused_baseline: list  # baseline entries no finding matched
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.baseline_errors
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "checked_files": self.checked_files,
+            "findings": [f.to_json() for f in self.findings],
+            "baselined": [f.to_json() for f in self.baselined],
+            "suppressed": self.suppressed_count,
+            "baseline_errors": self.baseline_errors,
+            "unused_baseline": self.unused_baseline,
+        }
+
+    def render_text(self) -> str:
+        out = [f.render() for f in self.findings]
+        for err in self.baseline_errors:
+            out.append(f"baseline: {err}")
+        for ent in self.unused_baseline:
+            out.append(
+                "baseline: unused entry "
+                f"{ent.get('rule')} {ent.get('path')} "
+                f"anchor={ent.get('anchor', '')!r} — remove it"
+            )
+        tail = (
+            f"{self.checked_files} files: {len(self.findings)} finding(s), "
+            f"{len(self.baselined)} baselined, "
+            f"{self.suppressed_count} suppressed"
+        )
+        out.append(tail)
+        return "\n".join(out)
+
+
+def load_baseline(path: Path) -> tuple:
+    """Returns (entries, errors). An entry without a written justification
+    is an ERROR, not a grandfather: the baseline exists to carry debt
+    with a reason attached, never silently."""
+    if not path.exists():
+        return [], []
+    data = json.loads(path.read_text())
+    entries = data.get("entries", [])
+    errors = []
+    for ent in entries:
+        just = (ent.get("justification") or "").strip()
+        if not just or just.upper().startswith("TODO"):
+            errors.append(
+                f"entry {ent.get('rule')} {ent.get('path')} "
+                f"anchor={ent.get('anchor', '')!r} has no written "
+                "justification — fix the finding or justify it"
+            )
+    return entries, errors
+
+
+def write_baseline(path: Path, findings: Iterable[Finding]) -> int:
+    """Write the baseline for the CURRENT findings, carrying over the
+    hand-written justification of any entry whose identity still matches
+    — regenerating must never destroy a justification someone wrote.
+    New entries get an EMPTY justification; the linter refuses them until
+    a human writes the reason in."""
+    previous, _ = load_baseline(path)
+    carried: dict = {}
+    for e in previous:
+        key = (e.get("rule"), e.get("path"), e.get("anchor", ""),
+               e.get("detail", ""))
+        just = e.get("justification") or ""
+        # several findings can share one identity (two reads of the same
+        # env var in one function); a justified entry must not be
+        # clobbered by an empty duplicate
+        if just or key not in carried:
+            carried[key] = just
+    entries = [
+        {
+            "rule": f.rule,
+            "path": f.path,
+            "anchor": f.anchor,
+            "detail": f.detail,
+            "justification": carried.get(f.identity, ""),
+        }
+        for f in findings
+    ]
+    path.write_text(json.dumps({"version": 1, "entries": entries}, indent=2) + "\n")
+    return len(entries)
+
+
+def iter_py_files(root: Path, targets: Iterable[str]) -> Iterator[Path]:
+    skip_parts = {"__pycache__", ".git", ".jax_cache", "graftlint_fixtures"}
+    for target in targets:
+        p = (root / target) if not Path(target).is_absolute() else Path(target)
+        if p.is_file():
+            if p.suffix == ".py":
+                yield p
+            continue
+        for f in sorted(p.rglob("*.py")):
+            if not skip_parts & set(f.parts):
+                yield f
+
+
+class Linter:
+    def __init__(self, config: Config, rules: Optional[dict] = None):
+        self.config = config
+        self.rules = rules if rules is not None else RULES
+
+    def parse(self, path: Path, roles: Optional[set] = None) -> ModuleInfo:
+        try:
+            rel = path.resolve().relative_to(self.config.root.resolve())
+            rel_s = rel.as_posix()
+        except ValueError:
+            rel_s = path.as_posix()
+        if roles is None:
+            roles = self.config.roles_for(rel_s)
+        return ModuleInfo.parse(path, rel_s, roles)
+
+    def run(
+        self,
+        targets: Iterable[str],
+        *,
+        baseline: Optional[Path] = None,
+        roles_override: Optional[dict] = None,
+    ) -> LintResult:
+        """Lint ``targets`` (files or directories, repo-relative or
+        absolute). ``roles_override`` maps rel-path -> role set, used by
+        the fixture tests to force a role onto an arbitrary file."""
+        modules = []
+        for path in iter_py_files(self.config.root, targets):
+            roles = None
+            if roles_override:
+                try:
+                    rel = path.resolve().relative_to(
+                        self.config.root.resolve()
+                    ).as_posix()
+                except ValueError:
+                    rel = path.as_posix()
+                if rel in roles_override:
+                    roles = set(roles_override[rel])
+            modules.append(self.parse(path, roles))
+        ctx = LintContext(self.config, modules)
+
+        raw: list = []
+        suppressed = 0
+        for mod in modules:
+            for r in self.rules.values():
+                for finding in r.check(mod, ctx):
+                    if mod.suppressed(
+                        finding.rule, finding.line, finding.anchor_line
+                    ):
+                        suppressed += 1
+                    else:
+                        raw.append(finding)
+        for r in self.rules.values():
+            raw.extend(r.finalize(ctx))
+
+        entries, baseline_errors = (
+            load_baseline(baseline) if baseline else ([], [])
+        )
+        by_identity = {
+            (e.get("rule"), e.get("path"), e.get("anchor", ""),
+             e.get("detail", "")): e
+            for e in entries
+        }
+        matched: set = set()
+        findings, baselined = [], []
+        for f in raw:
+            if f.identity in by_identity:
+                matched.add(f.identity)
+                baselined.append(f)
+            else:
+                findings.append(f)
+        unused = [
+            e for key, e in by_identity.items() if key not in matched
+        ]
+        findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        return LintResult(
+            findings=findings,
+            baselined=baselined,
+            suppressed_count=suppressed,
+            checked_files=len(modules),
+            baseline_errors=baseline_errors,
+            unused_baseline=unused,
+        )
+
+
+def default_config(root: Optional[Path] = None) -> Config:
+    if root is None:
+        root = Path(__file__).resolve().parent.parent.parent
+    return Config(root=Path(root))

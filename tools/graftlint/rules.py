@@ -1,0 +1,719 @@
+"""The GL00x analyzers.
+
+| id    | invariant                                                        |
+|-------|------------------------------------------------------------------|
+| GL001 | trace safety: no host control flow / host sync inside jit        |
+| GL002 | trace-key completeness: kernel dispatches ledger their signature |
+| GL003 | env-flag registry: KARMADA_TPU_* reads declared + documented     |
+| GL004 | lock discipline: lock-guarded attrs never mutated lock-free      |
+| GL005 | cold-start import hygiene: no module-level jax in entry modules, |
+|       | no scheduler imports from ops/                                   |
+
+Each rule is a pure-AST pass over one ``ModuleInfo`` (plus cross-module
+``finalize`` hooks); nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .core import (
+    ROLE_ENTRY,
+    ROLE_JIT,
+    ROLE_LEDGER,
+    ROLE_OPS,
+    Finding,
+    LintContext,
+    ModuleInfo,
+    Rule,
+    rule,
+)
+
+# --------------------------------------------------------------------------
+# shared: jit detection
+# --------------------------------------------------------------------------
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` (from jax import jit)."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return isinstance(node.value, ast.Name) and node.value.id == "jax"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _is_partial(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "partial"
+    return isinstance(node, ast.Attribute) and node.attr == "partial"
+
+
+def _static_names(call: ast.Call, func: ast.FunctionDef) -> set:
+    """static_argnames / static_argnums from a jit(...) call, as param
+    names of ``func``."""
+    names: set = set()
+    positional = [a.arg for a in func.args.posonlyargs + func.args.args]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in _str_elements(kw.value):
+                names.add(n)
+        elif kw.arg == "static_argnums":
+            for i in _int_elements(kw.value):
+                if 0 <= i < len(positional):
+                    names.add(positional[i])
+    return names
+
+
+def _str_elements(node: ast.AST) -> list:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _int_elements(node: ast.AST) -> list:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def jitted_functions(mod: ModuleInfo) -> dict:
+    """FunctionDef -> set of static param names, for every function the
+    module jits: ``@jax.jit``, ``@partial(jax.jit, ...)``, and the
+    ``name = jax.jit(fn, ...)`` / ``return jax.jit(fn, ...)`` wrapper
+    forms. Also returns (via ``.aliases``-style second dict) the bound
+    jitted NAMES a call site can refer to."""
+    defs: dict = {}
+    by_name: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                if _is_jax_jit(dec):
+                    defs[node] = set()
+                elif isinstance(dec, ast.Call):
+                    if _is_jax_jit(dec.func):
+                        defs[node] = _static_names(dec, node)
+                    elif (
+                        _is_partial(dec.func)
+                        and dec.args
+                        and _is_jax_jit(dec.args[0])
+                    ):
+                        defs[node] = _static_names(dec, node)
+    jit_names: set = set()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and _is_jax_jit(node.func)):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Name)):
+            continue
+        target = by_name.get(node.args[0].id)
+        if target is not None and not isinstance(
+            target, ast.AsyncFunctionDef
+        ):
+            defs.setdefault(target, set()).update(
+                _static_names(node, target)
+            )
+            jit_names.add(target.name)
+        # the wrapper's bound name is jitted too (schedule_step = jax.jit(f))
+        parent = mod.parents.get(node)
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    jit_names.add(t.id)
+    jit_names |= {f.name for f in defs}
+    return {"defs": defs, "names": jit_names}
+
+
+def _enclosing_functions(mod: ModuleInfo, node: ast.AST) -> list:
+    out = []
+    cur = mod.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(cur)
+        cur = mod.parents.get(cur)
+    return out
+
+
+# --------------------------------------------------------------------------
+# GL001 — trace safety
+# --------------------------------------------------------------------------
+
+#: attribute reads of a traced array that resolve at TRACE time (static)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+#: builtins whose result over a traced array is static (len = shape[0])
+SAFE_CALLS = {"len", "isinstance", "hasattr", "getattr", "type"}
+#: host-conversion builtins that force a device sync inside a trace
+HOST_CONVERSIONS = {"float", "int", "bool", "complex"}
+#: time-module calls that bake a host clock read into the trace
+TIME_CALLS = {"time", "perf_counter", "monotonic", "process_time", "sleep"}
+
+
+def _traced_use(node: ast.AST, traced: set) -> Optional[str]:
+    """First traced-parameter name used as a VALUE in ``node``, ignoring
+    static-at-trace-time reads (``x.shape``, ``len(x)``...)."""
+    if isinstance(node, ast.Name):
+        return node.id if node.id in traced else None
+    if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+        return None
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in SAFE_CALLS:
+            return None
+    for child in ast.iter_child_nodes(node):
+        hit = _traced_use(child, traced)
+        if hit:
+            return hit
+    return None
+
+
+@rule
+class TraceSafety(Rule):
+    id = "GL001"
+    title = "no host control flow or host sync inside jitted functions"
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        if ROLE_JIT not in mod.roles:
+            return
+        info = jitted_functions(mod)
+        for func, statics in info["defs"].items():
+            args = func.args
+            params = {
+                a.arg
+                for a in args.posonlyargs + args.args + args.kwonlyargs
+            }
+            traced = params - statics
+            anchor = mod.qualname(func)
+
+            def emit(node, message, detail):
+                return Finding(
+                    rule=self.id, path=mod.rel, line=node.lineno,
+                    col=node.col_offset + 1, message=message,
+                    anchor=anchor, detail=detail,
+                )
+
+            for node in ast.walk(func):
+                if isinstance(node, (ast.If, ast.While)):
+                    hit = _traced_use(node.test, traced)
+                    if hit:
+                        kind = "if" if isinstance(node, ast.If) else "while"
+                        yield emit(
+                            node,
+                            f"Python `{kind}` on traced value {hit!r} inside "
+                            f"jitted {func.name}() — use jnp.where/lax.cond "
+                            "or make it a static argument",
+                            f"{kind}:{hit}",
+                        )
+                elif isinstance(node, ast.Call):
+                    fn = node.func
+                    if isinstance(fn, ast.Name):
+                        if fn.id in HOST_CONVERSIONS:
+                            hit = next(
+                                filter(None, (
+                                    _traced_use(a, traced) for a in node.args
+                                )), None,
+                            )
+                            if hit:
+                                yield emit(
+                                    node,
+                                    f"host conversion {fn.id}() of traced "
+                                    f"value {hit!r} inside jitted "
+                                    f"{func.name}() — forces a device sync "
+                                    "per call",
+                                    f"{fn.id}:{hit}",
+                                )
+                        elif fn.id == "print":
+                            yield emit(
+                                node,
+                                f"print() inside jitted {func.name}() — "
+                                "runs at TRACE time only (or syncs under "
+                                "debug callbacks); use jax.debug.print",
+                                "print",
+                            )
+                    elif isinstance(fn, ast.Attribute):
+                        if fn.attr in ("item", "tolist") and not node.args:
+                            yield emit(
+                                node,
+                                f".{fn.attr}() inside jitted {func.name}() "
+                                "— host sync on the serving path",
+                                f".{fn.attr}",
+                            )
+                        elif (
+                            fn.attr in TIME_CALLS
+                            and isinstance(fn.value, ast.Name)
+                            and fn.value.id in ("time", "_time")
+                        ):
+                            yield emit(
+                                node,
+                                f"time.{fn.attr}() inside jitted "
+                                f"{func.name}() — the clock read is baked "
+                                "into the trace, not evaluated per call",
+                                f"time.{fn.attr}",
+                            )
+                        elif (
+                            fn.attr in ("getenv",)
+                            and isinstance(fn.value, ast.Name)
+                            and fn.value.id == "os"
+                        ):
+                            yield emit(
+                                node,
+                                f"os.getenv() inside jitted {func.name}() "
+                                "— env reads are trace-time constants; "
+                                "thread the value through a static arg",
+                                "os.getenv",
+                            )
+                elif isinstance(node, ast.Attribute):
+                    if (
+                        node.attr == "environ"
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "os"
+                    ):
+                        yield emit(
+                            node,
+                            f"os.environ read inside jitted {func.name}() "
+                            "— env reads are trace-time constants; thread "
+                            "the value through a static arg",
+                            "os.environ",
+                        )
+
+
+# --------------------------------------------------------------------------
+# GL002 — trace-key completeness
+# --------------------------------------------------------------------------
+
+
+@rule
+class TraceKeyCompleteness(Rule):
+    id = "GL002"
+    title = "jit-kernel dispatch sites must ledger their trace signature"
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        if ROLE_LEDGER not in mod.roles:
+            return
+        info = jitted_functions(mod)
+        kernels = info["names"]
+        if not kernels:
+            return
+        jit_defs = set(info["defs"])
+        helpers = set(ctx.config.ledger_helpers)
+
+        def has_ledger_call(func: ast.AST) -> bool:
+            for node in ast.walk(func):
+                if isinstance(node, ast.Call):
+                    fn = node.func
+                    name = (
+                        fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None
+                    )
+                    if name in helpers:
+                        return True
+            return False
+
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in kernels
+            ):
+                continue
+            enclosing = _enclosing_functions(mod, node)
+            # a kernel called from inside another jitted kernel traces as
+            # ONE composed program — the outer dispatch site ledgers it
+            if any(f in jit_defs for f in enclosing):
+                continue
+            if any(has_ledger_call(f) for f in enclosing):
+                continue
+            anchor = mod.qualname(node.func) or "<module>"
+            yield Finding(
+                rule=self.id, path=mod.rel, line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"jitted kernel {node.func.id}() dispatched without a "
+                    "trace-key ledger call "
+                    f"({'/'.join(sorted(helpers))}) in any enclosing "
+                    "function — a fresh compile here is invisible to "
+                    "new_trace_last_pass and the prewarm manifest"
+                ),
+                anchor=anchor, detail=node.func.id,
+            )
+
+
+# --------------------------------------------------------------------------
+# GL003 — env-flag registry
+# --------------------------------------------------------------------------
+
+
+def _os_aliases(tree: ast.Module) -> tuple:
+    """(getenv aliases, environ aliases) bound by ``from os import ...``
+    — the import style that would otherwise slip past the registry gate."""
+    getenv_names: set = set()
+    environ_names: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            for a in node.names:
+                if a.name == "getenv":
+                    getenv_names.add(a.asname or a.name)
+                elif a.name == "environ":
+                    environ_names.add(a.asname or a.name)
+    return getenv_names, environ_names
+
+
+def _is_environ(node: ast.AST, environ_names: set) -> bool:
+    """``os.environ`` or a ``from os import environ [as e]`` binding."""
+    if isinstance(node, ast.Attribute):
+        return (
+            node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os"
+        )
+    return isinstance(node, ast.Name) and node.id in environ_names
+
+
+def _env_key_node(
+    call_or_sub: ast.AST, getenv_names: set, environ_names: set
+) -> Optional[ast.AST]:
+    """The key expression of an env READ, or None.
+
+    Shapes: ``os.environ[k]``, ``os.environ.get(k, ...)``,
+    ``os.getenv(k, ...)``, and the aliased forms bound by
+    ``from os import getenv/environ [as name]``. Env WRITES/constructions
+    (``os.environ[k] = v`` handled by caller, ``dict(os.environ, K=v)``)
+    are not reads."""
+    node = call_or_sub
+    if isinstance(node, ast.Subscript):
+        if _is_environ(node.value, environ_names):
+            return node.slice
+        return None
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr == "get" and _is_environ(fn.value, environ_names):
+                return node.args[0] if node.args else None
+            if (
+                fn.attr == "getenv"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "os"
+            ):
+                return node.args[0] if node.args else None
+        elif isinstance(fn, ast.Name) and fn.id in getenv_names:
+            return node.args[0] if node.args else None
+    return None
+
+
+@rule
+class EnvFlagRegistry(Rule):
+    id = "GL003"
+    title = "KARMADA_TPU_* env reads must be registered and documented"
+
+    @staticmethod
+    def _reads(ctx: LintContext) -> set:
+        # per-run accumulator lives on the context (rule instances are
+        # process-global singletons; state must not leak across runs)
+        if not hasattr(ctx, "_gl003_reads"):
+            ctx._gl003_reads = set()
+        return ctx._gl003_reads
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        prefix = ctx.config.env_prefix
+        getenv_names, environ_names = _os_aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            key = _env_key_node(node, getenv_names, environ_names)
+            if key is None:
+                continue
+            # a Subscript on the left of an assignment is a WRITE
+            if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, (ast.Store, ast.Del)
+            ):
+                continue
+            name: Optional[str] = None
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                name = key.value
+            elif isinstance(key, ast.Name):
+                name = ctx.resolve_env_constant(mod, key.id)
+            if not name or not name.startswith(prefix):
+                continue
+            self._reads(ctx).add(name)
+            if name not in ctx.env_registry:
+                yield Finding(
+                    rule=self.id, path=mod.rel, line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"env flag {name} read here but not declared in "
+                        f"{ctx.config.flags_module} ENV_FLAGS — register "
+                        "it with a default and description"
+                    ),
+                    anchor=mod.qualname(node), detail=name,
+                )
+
+    def finalize(self, ctx: LintContext) -> Iterator[Finding]:
+        """Registry-side drift, anchored on flags.py: undocumented flags
+        and registered-but-never-read flags (unless declared external —
+        read by tests/bench drivers outside the scanned tree)."""
+        scanned = {m.rel for m in ctx.modules}
+        if ctx.config.flags_module not in scanned:
+            return
+        docs = ctx.docs_text
+        reads = self._reads(ctx)
+        for name, flag in sorted(ctx.env_registry.items()):
+            if name not in docs:
+                yield Finding(
+                    rule=self.id, path=ctx.config.flags_module, line=1,
+                    col=1,
+                    message=(
+                        f"registered env flag {name} is not documented in "
+                        f"{ctx.config.docs_env_table} — regenerate the env "
+                        "table (python tools/docs_from_bench.py --env-table)"
+                    ),
+                    anchor="ENV_FLAGS", detail=f"undocumented:{name}",
+                )
+            if name not in reads and not getattr(flag, "external", False):
+                yield Finding(
+                    rule=self.id, path=ctx.config.flags_module, line=1,
+                    col=1,
+                    message=(
+                        f"registered env flag {name} is never read in the "
+                        "scanned tree — remove it or mark it external=True "
+                        "(read by tests/bench drivers)"
+                    ),
+                    anchor="ENV_FLAGS", detail=f"stale:{name}",
+                )
+
+
+# --------------------------------------------------------------------------
+# GL004 — lock discipline
+# --------------------------------------------------------------------------
+
+#: method calls that mutate the receiver in place
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "update", "pop",
+    "popitem", "popleft", "remove", "discard", "clear", "setdefault",
+}
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _mutated_self_attr(node: ast.AST) -> Optional[str]:
+    """self attr mutated by ``node``: assignment/augassign/del targets
+    (including self.x[...] = v) and in-place mutator calls."""
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            base = t
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _self_attr(base)
+            if attr:
+                return attr
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            base = t
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _self_attr(base)
+            if attr:
+                return attr
+    elif isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATORS:
+            attr = _self_attr(fn.value)
+            if attr:
+                return attr
+    return None
+
+
+@rule
+class LockDiscipline(Rule):
+    id = "GL004"
+    title = "lock-guarded attributes must not be mutated lock-free"
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        for cls in ast.walk(mod.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(mod, cls)
+
+    def _check_class(self, mod: ModuleInfo, cls: ast.ClassDef):
+        # which self attrs ARE locks (threading.Lock/RLock/Condition(...))
+        lock_attrs: set = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                fn = node.value.func
+                factory = (
+                    fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None
+                )
+                if factory in _LOCK_FACTORIES:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            lock_attrs.add(attr)
+        if not lock_attrs:
+            return
+
+        def under_lock(node: ast.AST) -> bool:
+            cur = mod.parents.get(node)
+            while cur is not None and cur is not cls:
+                if isinstance(cur, ast.With):
+                    for item in cur.items:
+                        expr = item.context_expr
+                        # with self._lock: / with self._cond: (Condition
+                        # wraps the same lock)
+                        if isinstance(expr, ast.Call):
+                            expr = expr.func  # e.g. self._lock.acquire? no-op
+                        attr = _self_attr(expr)
+                        if attr in lock_attrs:
+                            return True
+                cur = mod.parents.get(cur)
+            return False
+
+        # mutations: (attr, node, method) — methods are the DIRECT defs;
+        # nested closures attribute to their outermost method
+        mutations = []
+        for method in cls.body:
+            if not isinstance(
+                method, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            for node in ast.walk(method):
+                attr = _mutated_self_attr(node)
+                if attr and attr not in lock_attrs:
+                    mutations.append((attr, node, method))
+
+        guarded = {
+            attr
+            for attr, node, method in mutations
+            if under_lock(node)
+        }
+        for attr, node, method in mutations:
+            if attr not in guarded or under_lock(node):
+                continue
+            # construction happens before the object is shared: __init__
+            # (and __new__) mutations are the single-writer window
+            if method.name in ("__init__", "__new__"):
+                continue
+            yield Finding(
+                rule=self.id, path=mod.rel, line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"self.{attr} is mutated under "
+                    f"{cls.name}'s lock elsewhere but lock-free in "
+                    f"{method.name}() — take the lock, or document the "
+                    "single-writer invariant with "
+                    f"`# graftlint: disable={self.id}`"
+                ),
+                anchor=f"{mod.qualname(cls)}.{method.name}", detail=attr,
+                anchor_line=method.lineno,
+            )
+
+
+# --------------------------------------------------------------------------
+# GL005 — cold-start import hygiene
+# --------------------------------------------------------------------------
+
+
+def _module_level_stmts(tree: ast.Module):
+    """Top-level statements, descending into module-level if/try blocks
+    (conditional imports still run at import time) but not into defs."""
+    stack = list(tree.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.If, ast.Try, ast.With)):
+            for f in ast.iter_child_nodes(node):
+                if not isinstance(
+                    f, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    stack.append(f)
+
+
+@rule
+class ImportHygiene(Rule):
+    id = "GL005"
+    title = "entry modules import jax lazily; ops/ never imports scheduler"
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        if ROLE_ENTRY in mod.roles:
+            for node in _module_level_stmts(mod.tree):
+                bad = None
+                if isinstance(node, ast.Import):
+                    bad = next(
+                        (
+                            a.name for a in node.names
+                            if a.name == "jax" or a.name.startswith("jax.")
+                        ),
+                        None,
+                    )
+                elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                    m = node.module or ""
+                    if m == "jax" or m.startswith("jax."):
+                        bad = m
+                if bad:
+                    yield Finding(
+                        rule=self.id, path=mod.rel, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"module-level `import {bad}` in entry module "
+                            f"{mod.rel} — jax import costs seconds of cold "
+                            "start on every CLI/controlplane boot; defer it "
+                            "into the function that needs it"
+                        ),
+                        anchor="<module>", detail=f"jax:{bad}",
+                    )
+        if ROLE_OPS in mod.roles:
+            pkg = ctx.config.package
+            for node in ast.walk(mod.tree):
+                bad = None
+                if isinstance(node, ast.Import):
+                    bad = next(
+                        (
+                            a.name for a in node.names
+                            if a.name.startswith(pkg + ".scheduler")
+                        ),
+                        None,
+                    )
+                elif isinstance(node, ast.ImportFrom):
+                    m = node.module or ""
+                    if m.startswith(pkg + ".scheduler"):
+                        bad = m
+                    elif node.level >= 1 and (
+                        m == "scheduler" or m.startswith("scheduler.")
+                    ):
+                        bad = "." * node.level + m
+                if bad:
+                    yield Finding(
+                        rule=self.id, path=mod.rel, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"ops/ imports the scheduler ({bad}) — the "
+                            "kernel layer must stay dependency-free of the "
+                            "engine that dispatches it (layering, and the "
+                            "scheduler import pulls the whole fleet engine "
+                            "into every ops consumer's cold start)"
+                        ),
+                        anchor=mod.qualname(node) or "<module>",
+                        detail=f"scheduler:{bad}",
+                    )
