@@ -1182,25 +1182,46 @@ def build_parser() -> tuple:
 
     li = sub.add_parser(
         "lint",
-        help="run graftlint, the repo's AST-based trace-safety & "
-        "concurrency analyzer (GL001 trace safety, GL002 trace-key "
-        "completeness, GL003 env-flag registry, GL004 lock discipline, "
-        "GL005 import hygiene)",
+        help="run graftlint, the repo's two-tier static analyzer: AST "
+        "tier (GL001 trace safety, GL002 trace-key completeness, GL003 "
+        "env-flag registry, GL004 lock discipline, GL005 import hygiene) "
+        "and, with --ir, the jaxpr-level kernel auditor (IR001 dtype "
+        "discipline, IR002 host round-trips, IR003 const capture, IR004 "
+        "trace-manifest fidelity, IR005 donation audit)",
     )
     li.add_argument(
         "paths", nargs="*",
-        help="files/directories to lint (default: karmada_tpu tools)",
+        help="files/directories to lint (default: karmada_tpu tools); "
+        "with --ir, kernel family names to audit (default: all)",
     )
     li.add_argument("--format", choices=("text", "json"), default="text")
     li.add_argument(
         "--no-baseline", action="store_true",
         help="report findings grandfathered in graftlint_baseline.json too",
     )
+    li.add_argument(
+        "--ir", action="store_true",
+        help="run the IR tier: abstractly trace every registered kernel "
+        "entry point on CPU and audit the jaxprs — run before a plane "
+        "rollout (docs/OPERATIONS.md)",
+    )
+    li.add_argument(
+        "--manifest", default=None, metavar="PATH",
+        help="IR tier: also audit a prewarm trace manifest (every record "
+        "must re-trace to its recorded signature)",
+    )
+    li.add_argument(
+        "--changed-only", action="store_true",
+        help="AST tier: lint only files with uncommitted git changes "
+        "(the pre-commit mode, see docs/DEVELOPMENT.md)",
+    )
     return parser, sub
 
 
 def cmd_lint(
-    paths: Sequence[str] = (), *, fmt: str = "text", baseline: bool = True
+    paths: Sequence[str] = (), *, fmt: str = "text", baseline: bool = True,
+    ir: bool = False, manifest: str | None = None,
+    changed_only: bool = False,
 ) -> int:
     """The ``lint`` verb: run the repo's static analyzer
     (tools/graftlint) over ``paths`` (default: the package + tools).
@@ -1225,6 +1246,12 @@ def cmd_lint(
     argv = list(paths) + ["--root", repo_root, "--format", fmt]
     if not baseline:
         argv.append("--no-baseline")
+    if ir:
+        argv.append("--ir")
+    if manifest is not None:
+        argv += ["--manifest", manifest]
+    if changed_only:
+        argv.append("--changed-only")
     return graftlint_main(argv)
 
 
@@ -1236,6 +1263,17 @@ def cmd_warmup(manifest: str = "", expand: bool = True) -> dict:
     from .scheduler.prewarm import warmup
 
     return warmup(manifest or None, expand=expand)
+
+
+def lint_main(argv: Optional[list[str]] = None) -> int:
+    """Console entry for the ``karmada-tpu-lint`` convenience script
+    (pyproject [project.scripts]): ``karmada-tpu-lint --changed-only`` is
+    the pre-commit hook body, ``karmada-tpu-lint --ir`` the pre-rollout
+    audit — both delegate through the ``lint`` verb so the script, the
+    verb and ``python -m tools.graftlint`` cannot drift."""
+    if argv is None:
+        argv = sys.argv[1:]
+    return main(["lint", *argv])
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -1262,7 +1300,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 0
     if args.command == "lint":
         return cmd_lint(
-            args.paths, fmt=args.format, baseline=not args.no_baseline
+            args.paths, fmt=args.format, baseline=not args.no_baseline,
+            ir=args.ir, manifest=args.manifest,
+            changed_only=args.changed_only,
         )
     if args.command == "warmup":
         stats = cmd_warmup(args.manifest, expand=not args.no_expand)

@@ -32,6 +32,24 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# Accumulator dtypes for the dispense/divide integer math, single-sourced
+# so the wide/narrow selection can never drift between kernels (the same
+# ``wide`` static must mean the same arithmetic in take_by_weight,
+# _aggregated_prefix_mask and _divide_one) and pinned as EXPLICIT dtypes:
+# a weak-typed scalar in an accumulator expression would re-promote under
+# jax.config drift, which graftlint IR001 machine-checks against. ACC_WIDE
+# must stay in parity with the numpy reference's accumulator
+# (refimpl/divider_np.py ACC_NP) — identical placements require both
+# sides to agree on the overflow-free integer range (asserted by
+# tests/test_graftlint_ir.py::test_acc_dtype_parity).
+ACC_WIDE = jnp.int64
+ACC_NARROW = jnp.int32
+
+
+def acc_dtype(wide: bool):
+    """The accumulator dtype selected by a kernel's ``wide`` static."""
+    return ACC_WIDE if wide else ACC_NARROW
+
 
 def take_by_weight(
     num: jnp.ndarray,  # int32 scalar: replicas to dispense
@@ -48,7 +66,7 @@ def take_by_weight(
     """
     c = weights.shape[0]
     idx = jnp.arange(c, dtype=jnp.int32)
-    acc = jnp.int64 if wide else jnp.int32
+    acc = acc_dtype(wide)
 
     total = jnp.sum(weights.astype(acc))
     safe_total = jnp.maximum(total, 1)

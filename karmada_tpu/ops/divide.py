@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .dispense import take_by_weight, take_by_weight_fast
+from .dispense import acc_dtype, take_by_weight, take_by_weight_fast
 
 # Strategy codes — shared with refimpl.divider
 DUPLICATED = 0
@@ -71,7 +71,7 @@ def _aggregated_prefix_mask(
     """
     c = weights.shape[0]
     idx = jnp.arange(c, dtype=jnp.int32)
-    acc = jnp.int64 if wide else jnp.int32
+    acc = acc_dtype(wide)
     prev_key = jnp.where(is_prev, 0, 1).astype(jnp.int32)
     if w_bits is not None:
         # packed path (host-proven weights < 2^w_bits): the (prev, -w, idx)
@@ -124,7 +124,7 @@ def _divide_one(
     # indices (requires fast; every non-previous placed cluster is in them
     # when k_top >= num — see take_by_weight_fast)
 ) -> tuple[jnp.ndarray, ...]:
-    acc = jnp.int64 if wide else jnp.int32
+    acc = acc_dtype(wide)
     c = candidates.shape[0]
     prev_cand = jnp.where(candidates, prev, 0)  # buildScheduledClusters
     assigned = jnp.sum(prev_cand)

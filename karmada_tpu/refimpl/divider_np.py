@@ -25,6 +25,15 @@ from .divider import AGGREGATED, DUPLICATED, DYNAMIC_WEIGHT, STATIC_WEIGHT
 
 MAX_INT32 = 2**31 - 1
 
+#: accumulation dtype of the host baseline. MUST stay in parity with the
+#: TPU kernels' wide accumulator (karmada_tpu.ops.dispense.ACC_WIDE) —
+#: identical placements require both sides to agree on the overflow-free
+#: integer range for weight*replica products and availability cumsums.
+#: Declared here (not imported from ops) so this numpy module never pulls
+#: jax; tests/test_graftlint_ir.py::test_acc_dtype_parity asserts the two
+#: constants resolve to the same numpy dtype.
+ACC_NP = np.int64
+
 
 def _dispense_np(
     num: np.ndarray,  # int64[B] replicas to dispense
@@ -44,7 +53,7 @@ def _dispense_np(
     # the bonus goes to the `remain` largest (w, last, -idx) keys; remain
     # <= num <= k_bound, so only the top-k keys per row matter. The triple
     # packs exactly into one int64 via mixed-radix arithmetic.
-    idx = np.arange(c, dtype=np.int64)
+    idx = np.arange(c, dtype=ACC_NP)
     lmax = int(last.max(initial=0)) + 1
     wmax = int(w.max(initial=0))
     assert (wmax + 1) * lmax * c < 2**63, "weights exceed the packed baseline"
@@ -56,11 +65,11 @@ def _dispense_np(
         top_idx = np.broadcast_to(idx[None, :], (b, c))
     top_keys = np.take_along_axis(key, top_idx, axis=1)
     top_sorted = -np.sort(-top_keys, axis=1)  # desc
-    pos = np.clip(remain - 1, 0, k - 1).astype(np.int64)
+    pos = np.clip(remain - 1, 0, k - 1).astype(ACC_NP)
     thr = np.take_along_axis(top_sorted, pos[:, None], axis=1)[:, 0]
     bonus = (key >= thr[:, None]) & (remain > 0)[:, None]
     dispensed = np.where(
-        (total > 0)[:, None], floors + bonus.astype(np.int64), 0
+        (total > 0)[:, None], floors + bonus.astype(ACC_NP), 0
     )
     return init + dispensed
 
@@ -73,7 +82,7 @@ def _aggregated_keep_np(
     """Minimal prefix of (prev desc, avail desc, idx asc) whose cumulative
     availability covers target (assignment.go:146-173 + the resort)."""
     b, c = w.shape
-    idx = np.arange(c, dtype=np.int64)
+    idx = np.arange(c, dtype=ACC_NP)
     prev_key = np.where(is_prev, 0, 1)
     order = np.lexsort((idx[None, :].repeat(b, 0), -w, prev_key), axis=1)
     w_sorted = np.take_along_axis(w, order, axis=1)
@@ -97,10 +106,10 @@ def assign_batch_np(
     (assignment int32[B, C], unschedulable bool[B]). Mirrors
     assignment.go:31-38 dispatch + division_algorithm.go cohorts."""
     b, c = candidates.shape
-    strategy = strategy.astype(np.int64)
-    num = replicas.astype(np.int64)
-    prev = prev.astype(np.int64)
-    avail = np.where(candidates, avail, 0).astype(np.int64)
+    strategy = strategy.astype(ACC_NP)
+    num = replicas.astype(ACC_NP)
+    prev = prev.astype(ACC_NP)
+    avail = np.where(candidates, avail, 0).astype(ACC_NP)
     prev_cand = np.where(candidates, prev, 0)
     assigned = prev_cand.sum(axis=1)
     fresh = fresh.astype(bool)
@@ -135,9 +144,9 @@ def assign_batch_np(
             0,
         )
 
-    sw = np.where(candidates, static_w, 0).astype(np.int64)
+    sw = np.where(candidates, static_w, 0).astype(ACC_NP)
     sw = np.where(
-        (sw.sum(axis=1) > 0)[:, None], sw, candidates.astype(np.int64)
+        (sw.sum(axis=1) > 0)[:, None], sw, candidates.astype(ACC_NP)
     )
     last_static = np.where(candidates, prev, 0)
 

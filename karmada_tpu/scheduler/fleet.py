@@ -771,6 +771,21 @@ def _gather_meta(res_meta, rows):
     ).astype(jnp.uint8).reshape(-1)
 
 
+#: THE solve-family kernel registry: prewarm's manifest replay
+#: (scheduler/prewarm._jit_registry) and the graftlint IR tier's
+#: entry-point registry (tools/graftlint/ir.py) both resolve kernels
+#: through this mapping, so a kernel added here is automatically
+#: replayable at boot and IR-audited in tier-1. prewarm._KERNELS (the
+#: jax-free load-time filter) mirrors these names and is asserted against
+#: this dict at replay time; graftlint IR004 fails on any drift.
+FLEET_KERNELS = {
+    "fleet_solve": _fleet_solve,
+    "fleet_pass": _fleet_pass,
+    "fleet_entries": _fleet_entries,
+    "fleet_bits": _fleet_bits,
+}
+
+
 # --------------------------------------------------------------------------
 # results
 # --------------------------------------------------------------------------

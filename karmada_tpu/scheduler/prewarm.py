@@ -61,7 +61,11 @@ _WARM_LOCK = threading.Lock()
 _SCHEMA_VERSION = 1
 
 #: kernels worth persisting: the solve-family traces dominate compile
-#: cost; tiny utility kernels (row scatter, meta gather) stay ledger-only
+#: cost; tiny utility kernels (row scatter, meta gather) stay ledger-only.
+#: This is the jax-free NAME mirror of fleet.FLEET_KERNELS —
+#: TraceManifest._load filters on it without importing the engine;
+#: _jit_registry asserts the two stay in lockstep (and graftlint IR004
+#: machine-checks it in tier-1).
 _KERNELS = (
     "fleet_solve",
     "fleet_pass",
@@ -73,12 +77,9 @@ _KERNELS = (
 def _jit_registry() -> dict:
     from . import fleet
 
-    return {
-        "fleet_solve": fleet._fleet_solve,
-        "fleet_pass": fleet._fleet_pass,
-        "fleet_entries": fleet._fleet_entries,
-        "fleet_bits": fleet._fleet_bits,
-    }
+    registry = dict(fleet.FLEET_KERNELS)
+    assert set(registry) == set(_KERNELS), (sorted(registry), _KERNELS)
+    return registry
 
 
 def _retuple(v):

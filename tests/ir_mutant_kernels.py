@@ -1,0 +1,37 @@
+"""Intentionally-defective kernels: seeded mutants for the graftlint IR
+tier (tests/test_graftlint_ir.py registers each as a temporary entry
+point and asserts its rule fires — a rule that stops firing fails the
+gate's fixture tests, never silently).
+
+This module lives under tests/ (outside the linted tree) and is only
+imported by the IR tracer at test time.
+"""
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def ir001_weak_promotion(x):  # int32 input promoted to float64
+    return (x.astype(jnp.float64) * 2.0).astype(jnp.int32)
+
+
+def ir002_host_callback(x):  # host round-trip on every dispatch
+    return jax.pure_callback(
+        lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x
+    )
+
+
+_CAPTURED = np.arange(8192, dtype=np.int32)  # 32 KB baked into the trace
+
+
+def ir003_const_capture(x):
+    return x + jnp.asarray(_CAPTURED)[: x.shape[0]]
+
+
+@partial(jax.jit, donate_argnames=("buf",))
+def ir005_dropped_donation(x, buf):  # buf donated, no aliasable output
+    return x + buf.sum()

@@ -100,6 +100,34 @@ class TestTraceManifest:
         stats = prewarm.replay(m)
         assert stats["specs"] == 0 and stats["failed"] == 0
 
+    def test_ir_retrace_round_trip(self, tmp_path):
+        """A recorded manifest entry re-traced by the graftlint IR tier
+        yields a byte-identical shape/static signature across a
+        save/load cycle — the IR004 fidelity contract: replay dedup and
+        ledger seeding key on this canon, so any serialization loss
+        would make prewarm cover less than the serving path."""
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tools.graftlint import ir as graft_ir
+
+        path = tmp_path / "manifest.json"
+        seed_manifest(path)
+        m1 = prewarm.TraceManifest(str(path))
+        assert m1.records
+        canons = []
+        for i, rec in enumerate(m1.records):
+            spec = graft_ir.spec_from_record(rec, f"manifest[{i}]")
+            original, rebuilt = graft_ir.record_canon(rec, spec)
+            assert original == rebuilt, (original, rebuilt)
+            canons.append(rebuilt)
+        # the full cycle: re-save, re-load, re-derive — still identical
+        m1._save()
+        m2 = prewarm.TraceManifest(str(path))
+        assert [
+            graft_ir.record_canon(r, graft_ir.spec_from_record(r, "x"))[1]
+            for r in m2.records
+        ] == canons
+
     def test_expand_records_next_bucket(self):
         from karmada_tpu.scheduler.fleet import M_ROUND, _cap_round
 

@@ -16,6 +16,12 @@ docs/OPERATIONS.md is generated from ``karmada_tpu.utils.flags.ENV_FLAGS``
 (``--env-table`` rewrites it), and EVERY doc-regeneration run fails loudly
 when the committed table has drifted from the registry — the docs half of
 graftlint's GL003 gate.
+
+Same drift-guard pattern for the kernel audit surface: every regeneration
+run also fails loudly when a kernel family exported from
+``karmada_tpu/ops/`` is missing from the graftlint IR entry-point registry
+(``tools/graftlint/ir.py`` ENTRY_POINTS) — a kernel the IR tier cannot see
+is a kernel whose dtype/transfer/capture invariants nothing proves.
 """
 
 from __future__ import annotations
@@ -145,9 +151,30 @@ def check_env_table() -> None:
         )
 
 
+def check_ir_registry() -> None:
+    """Fail loudly when a kernel family exported from karmada_tpu/ops/ is
+    missing from the graftlint IR entry-point registry (or the registry
+    carries a stale entry) — runs on EVERY doc regeneration, same pattern
+    as the env-flag table gate. Pure AST on the ops side and a plain
+    import of the registry module: no jax needed."""
+    sys.path.insert(0, str(ROOT))
+    from tools.graftlint.ir import ops_registry_drift
+
+    unregistered, stale = ops_registry_drift(ROOT)
+    if unregistered or stale:
+        raise SystemExit(
+            "tools/graftlint/ir.py ENTRY_POINTS drifted from the "
+            "karmada_tpu/ops exports — "
+            f"exported but unregistered: {unregistered}, registered but "
+            f"no longer exported: {stale}; register the kernel (with a "
+            "spec builder) or drop the stale entry"
+        )
+
+
 def main() -> None:
     if sys.argv[1:] == ["--env-table"]:
         rewrite(ROOT / "docs" / "OPERATIONS.md", env_table(), "envflags")
+        check_ir_registry()
         return
     src = Path(sys.argv[1])
     d = json.loads(src.read_text())
@@ -166,6 +193,7 @@ def main() -> None:
     rewrite(ROOT / "docs" / "OPERATIONS.md", body)
     rewrite(ROOT / "BASELINE.md", body)
     check_env_table()
+    check_ir_registry()
 
 
 if __name__ == "__main__":

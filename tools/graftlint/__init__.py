@@ -1,21 +1,34 @@
-"""graftlint — AST-based trace-safety & concurrency analyzer for this repo.
+"""graftlint — two-tier static analyzer for this repo.
+
+AST tier (core.py/rules.py): trace-safety & concurrency invariants over
+Python source — pure ``ast``, no jax import, sub-second. IR tier
+(ir.py/irrules.py): jaxpr-level kernel auditor — abstractly traces every
+registered kernel entry point and machine-checks dtype, transfer,
+const-capture, manifest-fidelity and donation invariants in the lowered
+IR, where those bugs actually live.
 
 Run it:
 
-    python -m tools.graftlint                 # karmada_tpu/ + tools/
-    python -m tools.graftlint path/to/file.py
-    karmadactl-tpu lint --format json
+    python -m tools.graftlint                 # AST: karmada_tpu/ + tools/
+    python -m tools.graftlint --changed-only  # AST: pre-commit scope
+    python -m tools.graftlint --ir            # IR: the full kernel grid
+    karmadactl-tpu lint [--ir]                # same, as a CLI verb
 
-Rules (see rules.py): GL001 trace safety, GL002 trace-key completeness,
-GL003 env-flag registry, GL004 lock discipline, GL005 cold-start import
-hygiene. Suppress per line with ``# graftlint: disable=GL00X`` (same line,
-line above, or the enclosing ``def`` line for GL004), per file with
-``# graftlint: disable-file=GL00X``. Grandfathered findings live in
-``graftlint_baseline.json`` and MUST carry a written justification.
+Rules: GL001 trace safety, GL002 trace-key completeness, GL003 env-flag
+registry, GL004 lock discipline, GL005 cold-start import hygiene; IR001
+dtype discipline, IR002 host round-trips, IR003 const capture, IR004
+trace-manifest fidelity, IR005 donation audit. Suppress per line with
+``# graftlint: disable=GL00X`` (same line, line above, or the enclosing
+``def`` line — the only form IR rules honor, anchored at the kernel's
+``def``), per file with ``# graftlint: disable-file=GL00X``.
+Grandfathered findings live in ``graftlint_baseline.json`` and MUST carry
+a written justification; both tiers share that baseline.
 """
 
+from . import irrules  # noqa: F401 — registers the IR00x analyzers
 from . import rules  # noqa: F401 — registers the GL00x analyzers
 from .core import (  # noqa: F401
+    IR_RULES,
     RULES,
     Config,
     Finding,
@@ -50,3 +63,11 @@ def run(
     return linter.run(
         targets, baseline=baseline_path, roles_override=roles_override
     )
+
+
+def run_ir(families=None, **kwargs):
+    """IR-tier one-call API (lazy import: the tracing machinery needs
+    jax; everything else in this package must not)."""
+    from .ir import run_ir as _run_ir
+
+    return _run_ir(families, **kwargs)

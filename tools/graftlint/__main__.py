@@ -1,5 +1,11 @@
 """CLI: ``python -m tools.graftlint [paths ...]`` (see package docstring).
 
+Two tiers behind one surface: the default AST tier (GL00x, pure-ast,
+sub-second — pre-commit material with ``--changed-only``) and the IR tier
+(``--ir``: IR00x, abstractly traces every registered kernel entry point
+under JAX_PLATFORMS=cpu and audits the jaxprs — run it before a rollout
+and in tier-1, see tests/test_graftlint_ir.py).
+
 Exit codes: 0 clean (baselined findings allowed), 1 findings or a
 baseline entry without justification, 2 usage error.
 """
@@ -8,20 +14,58 @@ from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 
 from . import DEFAULT_TARGETS, RULES, default_config, run
-from .core import write_baseline
+from .core import IR_RULES, write_baseline
+
+
+def changed_py_files(root) -> list:
+    """Repo-relative .py files with uncommitted changes (staged, unstaged
+    and untracked) — the pre-commit scope for ``--changed-only``."""
+    def _git(*args):
+        proc = subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr.strip() or "git failed")
+        return [line for line in proc.stdout.splitlines() if line.strip()]
+
+    names = set(_git("diff", "--name-only", "HEAD", "--"))
+    names |= set(_git("ls-files", "--others", "--exclude-standard"))
+    return sorted(
+        n for n in names
+        if n.endswith(".py") and (root / n).exists()
+    )
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="graftlint",
-        description="AST-based trace-safety & concurrency analyzer",
+        description="trace-safety & concurrency analyzer (AST tier) and "
+        "jaxpr-level kernel auditor (--ir)",
     )
-    p.add_argument("paths", nargs="*", default=list(DEFAULT_TARGETS),
+    p.add_argument("paths", nargs="*", default=[],
                    help="files/directories to lint (default: karmada_tpu "
-                   "tools)")
+                   "tools); with --ir, kernel family names to audit "
+                   "(default: the full entry-point registry)")
+    p.add_argument("--paths", dest="extra_paths", action="append",
+                   default=[], metavar="PATH",
+                   help="additional lint targets (repeatable; same as the "
+                   "positionals — scripting convenience)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="AST tier: lint only .py files with uncommitted "
+                   "git changes (staged+unstaged+untracked) — the "
+                   "pre-commit mode, runs in well under a second")
+    p.add_argument("--ir", action="store_true",
+                   help="run the IR tier instead: abstractly trace every "
+                   "registered kernel entry point (jax.make_jaxpr on CPU, "
+                   "no compiles) and audit the jaxprs (IR001-IR005)")
+    p.add_argument("--manifest", default=None, metavar="PATH",
+                   help="IR tier: additionally audit a prewarm trace "
+                   "manifest — every record must re-trace to its recorded "
+                   "signature (IR004)")
     p.add_argument("--root", default=None,
                    help="repo root (default: this checkout)")
     p.add_argument("--format", choices=("text", "json"), default="text")
@@ -30,33 +74,85 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings to graftlint_baseline.json "
                    "with EMPTY justifications (the linter refuses them "
-                   "until each is justified)")
+                   "until each is justified); always runs BOTH tiers — "
+                   "the baseline file is shared")
     p.add_argument("--list-rules", action="store_true")
     args = p.parse_args(argv)
 
     if args.list_rules:
-        for rid, r in sorted(RULES.items()):
+        for rid, r in sorted({**RULES, **IR_RULES}.items()):
             print(f"{rid}  {r.title}")
         return 0
+
+    paths = list(args.paths) + list(args.extra_paths)
+    config = default_config(args.root)
+
+    if args.manifest is not None and not args.manifest:
+        # an empty path is almost always `--manifest "$UNSET_VAR"`: the
+        # operator asked for a manifest audit and would get a silent skip
+        print("error: --manifest requires a non-empty path (is "
+              "KARMADA_TPU_TRACE_MANIFEST set?)", file=sys.stderr)
+        return 2
+
+    if args.changed_only:
+        if args.ir:
+            print("error: --changed-only is an AST-tier mode (the IR tier "
+                  "audits traced kernels, not files)", file=sys.stderr)
+            return 2
+        if args.write_baseline:
+            print("error: --write-baseline needs the FULL lint scope — a "
+                  "baseline regenerated from only the changed files would "
+                  "delete every justified entry outside them",
+                  file=sys.stderr)
+            return 2
+        try:
+            paths = changed_py_files(config.root)
+        except RuntimeError as exc:
+            print(f"error: --changed-only needs a git checkout: {exc}",
+                  file=sys.stderr)
+            return 2
+        if not paths:
+            print("0 changed python files: nothing to lint")
+            return 0
 
     if args.write_baseline:
         # baseline=None: the new baseline must hold EVERY current finding
         # (a baselined run would drop — and thereby delete — entries that
-        # still match); write_baseline carries existing justifications over
-        raw = run(args.paths or DEFAULT_TARGETS, root=args.root,
-                  baseline=None)
-        config = default_config(args.root)
+        # still match); write_baseline carries existing justifications
+        # over. BOTH tiers always run here — the baseline file is shared,
+        # so an AST-only regeneration would delete the IR tier's entries.
+        raw = run(paths or DEFAULT_TARGETS, root=args.root, baseline=None)
+        findings = list(raw.findings)
+        from .ir import run_ir
+
+        findings += run_ir(
+            root=args.root, baseline=None, manifest=args.manifest
+        ).findings
         path = config.root / config.baseline_path
-        n = write_baseline(path, raw.findings)
+        n = write_baseline(path, findings)
         print(f"wrote {n} entries to {path} — add a justification to each "
               "new entry (empty justifications are rejected)")
         return 0
 
-    result = run(
-        args.paths or DEFAULT_TARGETS,
-        root=args.root,
-        baseline=None if args.no_baseline else "auto",
-    )
+    if args.ir:
+        from .ir import run_ir
+
+        try:
+            result = run_ir(
+                paths or None,
+                root=args.root,
+                baseline=None if args.no_baseline else "auto",
+                manifest=args.manifest,
+            )
+        except KeyError as exc:
+            print(f"error: {exc.args[0]}", file=sys.stderr)
+            return 2
+    else:
+        result = run(
+            paths or DEFAULT_TARGETS,
+            root=args.root,
+            baseline=None if args.no_baseline else "auto",
+        )
     if args.format == "json":
         print(json.dumps(result.to_json(), indent=2))
     else:

@@ -267,6 +267,11 @@ class LintContext:
 
 
 class Rule:
+    #: which analyzer tier the rule belongs to: "ast" rules walk parsed
+    #: source modules (GL00x), "ir" rules walk traced kernel jaxprs
+    #: (IR00x, see ir.py/irrules.py) — the registries are separate so
+    #: the AST tier stays jax-free and sub-second
+    kind = "ast"
     id = "GL000"
     title = ""
 
@@ -278,12 +283,15 @@ class Rule:
         return iter(())
 
 
-RULES: dict = {}
+RULES: dict = {}  # AST-tier analyzers (GL00x)
+IR_RULES: dict = {}  # IR-tier analyzers (IR00x)
 
 
 def rule(cls):
-    """Register an analyzer class (decorator)."""
-    RULES[cls.id] = cls()
+    """Register an analyzer class (decorator); the registry is chosen by
+    ``cls.kind`` ("ast" default, "ir" for jaxpr-level analyzers)."""
+    registry = IR_RULES if getattr(cls, "kind", "ast") == "ir" else RULES
+    registry[cls.id] = cls()
     return cls
 
 
@@ -381,6 +389,47 @@ def write_baseline(path: Path, findings: Iterable[Finding]) -> int:
     return len(entries)
 
 
+def apply_baseline(
+    raw: list,
+    *,
+    baseline: Optional[Path],
+    checked_files: int,
+    suppressed: int = 0,
+) -> LintResult:
+    """Split raw findings into gate-failing vs baselined and package the
+    ``LintResult`` — the shared tail of BOTH analyzer tiers (the AST
+    ``Linter`` and the IR auditor), so baseline identity semantics cannot
+    drift between them."""
+    entries, baseline_errors = (
+        load_baseline(baseline) if baseline else ([], [])
+    )
+    by_identity = {
+        (e.get("rule"), e.get("path"), e.get("anchor", ""),
+         e.get("detail", "")): e
+        for e in entries
+    }
+    matched: set = set()
+    findings, baselined = [], []
+    for f in raw:
+        if f.identity in by_identity:
+            matched.add(f.identity)
+            baselined.append(f)
+        else:
+            findings.append(f)
+    unused = [
+        e for key, e in by_identity.items() if key not in matched
+    ]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(
+        findings=findings,
+        baselined=baselined,
+        suppressed_count=suppressed,
+        checked_files=checked_files,
+        baseline_errors=baseline_errors,
+        unused_baseline=unused,
+    )
+
+
 def iter_py_files(root: Path, targets: Iterable[str]) -> Iterator[Path]:
     skip_parts = {"__pycache__", ".git", ".jax_cache", "graftlint_fixtures"}
     for target in targets:
@@ -448,33 +497,9 @@ class Linter:
         for r in self.rules.values():
             raw.extend(r.finalize(ctx))
 
-        entries, baseline_errors = (
-            load_baseline(baseline) if baseline else ([], [])
-        )
-        by_identity = {
-            (e.get("rule"), e.get("path"), e.get("anchor", ""),
-             e.get("detail", "")): e
-            for e in entries
-        }
-        matched: set = set()
-        findings, baselined = [], []
-        for f in raw:
-            if f.identity in by_identity:
-                matched.add(f.identity)
-                baselined.append(f)
-            else:
-                findings.append(f)
-        unused = [
-            e for key, e in by_identity.items() if key not in matched
-        ]
-        findings.sort(key=lambda f: (f.path, f.line, f.rule))
-        return LintResult(
-            findings=findings,
-            baselined=baselined,
-            suppressed_count=suppressed,
-            checked_files=len(modules),
-            baseline_errors=baseline_errors,
-            unused_baseline=unused,
+        return apply_baseline(
+            raw, baseline=baseline, checked_files=len(modules),
+            suppressed=suppressed,
         )
 
 

@@ -1,0 +1,774 @@
+"""graftlint-IR: jaxpr-level kernel auditor.
+
+The AST tier (core.py/rules.py) guards Python-source invariants; the class
+of bugs that actually burns TPU time — silent float64/weak-type promotion,
+host transfers hidden inside a kernel, large arrays closed over into a
+trace so every snapshot recompiles, prewarm-manifest entries drifting from
+what the kernels really trace to — only exists in the lowered IR,
+invisible to any AST pass. This tier discovers every exported kernel entry
+point (the ops/ dispense/divide/estimate/masks families and the scheduler
+fleet kernels), abstractly traces each via ``jax.make_jaxpr`` under
+``JAX_PLATFORMS=cpu`` across a representative bucket grid (the same
+cap/row buckets the prewarm trace manifest records), and machine-checks
+the IR001-IR005 invariants (irrules.py) over the resulting jaxprs.
+
+Run it:
+
+    python -m tools.graftlint --ir                    # full registry
+    python -m tools.graftlint --ir divide_replicas    # one family
+    python -m tools.graftlint --ir --manifest PATH    # + manifest audit
+    karmadactl-tpu lint --ir                          # same, CLI verb
+
+Tracing is ABSTRACT: ``make_jaxpr`` over ``ShapeDtypeStruct``s never
+compiles or executes anything, so the whole grid audits in seconds on any
+backend. Findings share the AST tier's machinery end to end — inline
+``# graftlint: disable=IR00X`` pragmas on the kernel's ``def`` line,
+justified entries in ``graftlint_baseline.json``, ``--format json``.
+
+This module imports jax ONLY inside the tracing functions: importing it
+(for the registry listing, the docs drift gate, ``--list-rules``) stays
+dependency-free like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Optional
+
+from . import irrules  # noqa: F401 — registers the IR00x analyzers
+from .core import (
+    IR_RULES,
+    Config,
+    Finding,
+    LintResult,
+    ModuleInfo,
+    apply_baseline,
+    default_config,
+)
+
+# --------------------------------------------------------------------------
+# entry-point registry
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    """One abstract trace of one entry point: positional input
+    shapes/dtypes (manifest ``in_shapes`` form: dtype as string) plus the
+    static kwargs. ``group`` optionally regroups the flat struct list
+    into the kernel's pytree signature (tuple-valued args)."""
+
+    variant: str
+    in_shapes: tuple  # ((shape tuple, dtype str), ...)
+    statics: dict = field(default_factory=dict)
+    group: Optional[Callable] = None
+
+
+@dataclass(frozen=True)
+class KernelEntry:
+    """One exported kernel family: where it lives, how prewarm knows it,
+    and how to build its representative spec grid. ``make_specs`` is a
+    thunk so the registry itself imports nothing heavy — bucket constants
+    (K_PREV, cap rounding) are read LIVE from the engine at trace time,
+    never mirrored."""
+
+    name: str
+    family: str  # "ops" | "masks" | "scheduler"
+    module: str
+    attr: str
+    path: str  # repo-relative source file (findings anchor here)
+    make_specs: Callable[[], list]
+    manifest_kernel: Optional[str] = None  # name in the prewarm manifest
+
+
+# -- spec builders: the representative bucket grid --------------------------
+#
+# Dimensions are deliberately SMALL (abstract tracing cost is shape-
+# independent, so nothing is gained by production extents) but bucket-
+# SHAPED: pow2 caps, the engine's floor quanta, both wide/narrow and
+# fast/sorted divide variants, byte and word wires — the statics axes are
+# what mint distinct traces in production, so they are what the grid must
+# cover.
+
+_B, _C, _R, _U, _G, _P = 8, 16, 3, 4, 2, 3
+
+
+def _fast_tuples(c: int) -> tuple:
+    """(with_idx, no_idx) packed-dispense static tuples valid for ``c``
+    clusters — the same (w_bits, l_bits, k_top, div_f32, with_idx) shape
+    scheduler.core.kernel_variant emits."""
+    i_bits = max(1, (c - 1).bit_length())
+    l_bits = 8
+    return (
+        (31 - l_bits - i_bits, l_bits, 8, True, True),
+        (31 - l_bits, l_bits, 8, False, False),
+    )
+
+
+def _specs_divide() -> list:
+    fast_idx, fast_noidx = _fast_tuples(_C)
+    row = (
+        ((_B,), "int32"), ((_B,), "int32"), ((_B, _C), "bool"),
+        ((_B, _C), "int32"), ((_B, _C), "int32"), ((_B, _C), "int32"),
+        ((_B,), "bool"),
+    )
+    return [
+        KernelSpec("wide-sorted", row,
+                   {"has_aggregated": True, "wide": True, "fast": None}),
+        KernelSpec("narrow-fast", row,
+                   {"has_aggregated": True, "wide": False,
+                    "fast": fast_idx}),
+        KernelSpec("narrow-fast-noidx", row,
+                   {"has_aggregated": False, "wide": False,
+                    "fast": fast_noidx}),
+    ]
+
+
+def _specs_take_by_weight() -> list:
+    vec = (((), "int32"), ((_C,), "int32"), ((_C,), "int32"),
+           ((_C,), "int32"))
+    return [
+        KernelSpec("wide", vec, {"wide": True}),
+        KernelSpec("narrow", vec, {"wide": False}),
+    ]
+
+
+def _specs_take_by_weight_fast() -> list:
+    fast_idx, fast_noidx = _fast_tuples(_C)
+    vec = (((), "int32"), ((_C,), "int32"), ((_C,), "int32"),
+           ((_C,), "int32"))
+
+    def statics(fast, sites):
+        w_bits, l_bits, k_top, div_f32, with_idx = fast
+        return {"w_bits": w_bits, "l_bits": l_bits, "k_top": k_top,
+                "div_f32": div_f32, "with_idx": with_idx,
+                "return_sites": sites}
+
+    return [
+        KernelSpec("packed-idx", vec, statics(fast_idx, False)),
+        KernelSpec("packed-idx-sites", vec, statics(fast_idx, True)),
+        KernelSpec("packed-noidx", vec, statics(fast_noidx, False)),
+    ]
+
+
+def _specs_take_by_weight_batch() -> list:
+    batch = (((_B,), "int32"), ((_B, _C), "int32"), ((_B, _C), "int32"),
+             ((_B, _C), "int32"))
+    return [
+        KernelSpec("wide", batch, {"wide": True}),
+        KernelSpec("narrow", batch, {"wide": False}),
+    ]
+
+
+def _specs_general_estimate() -> list:
+    return [KernelSpec(
+        "base", (((_C, _R), "int64"), ((_B, _R), "int64")),
+    )]
+
+
+def _specs_general_estimate_interned() -> list:
+    return [KernelSpec(
+        "base",
+        (((_C, _R), "int64"), ((_U, _R), "int64"), ((_B,), "int32")),
+    )]
+
+
+def _specs_gather_profile_rows() -> list:
+    return [KernelSpec("base", (((_U, _C), "int32"), ((_B,), "int32")))]
+
+
+def _group_merge(structs):
+    return structs[0], tuple(structs[1:])
+
+
+def _specs_merge_estimates() -> list:
+    return [KernelSpec(
+        "two-estimators",
+        (((_B,), "int32"), ((_B, _C), "int32"), ((_B, _C), "int32")),
+        group=_group_merge,
+    )]
+
+
+def _specs_masks_contains_all() -> list:
+    return [KernelSpec(
+        "base", (((_C, 2), "uint32"), ((2,), "uint32")),
+    )]
+
+
+def _specs_masks_intersects() -> list:
+    return [KernelSpec(
+        "base", (((_C, 2), "uint32"), ((2,), "uint32")),
+    )]
+
+
+# -- fleet kernels: shapes mirror FleetTable's device layout ----------------
+
+
+def _fleet_dims() -> dict:
+    from karmada_tpu.scheduler.fleet import K_PREV
+
+    c = _C
+    return {
+        "c": c, "w8": (c + 7) // 8, "cap": 256, "chunk": 256,
+        "n_pad": 256, "k_prev": K_PREV,
+    }
+
+
+def _fleet_tables(d: dict) -> list:
+    return [
+        ((_U, 2 * d["w8"]), "uint8"),  # cp_bits
+        ((_U, d["c"]), "int32"),  # cp_static
+        ((_G, d["w8"]), "uint8"),  # gvk_bits
+        ((_P, d["c"]), "int32"),  # prof_table
+        ((d["c"],), "bool"),  # incomplete_en
+    ]
+
+
+def _fleet_state(d: dict) -> list:
+    cap = d["cap"]
+    return (
+        [((cap,), "int32")] * 5  # cp_idx gvk_idx prof_idx replicas strategy
+        + [((cap,), "bool")]  # fresh
+        + [((cap, d["k_prev"]), "int32")] * 2  # prev_sites prev_counts
+    )
+
+
+def _specs_fleet_solve() -> list:
+    from karmada_tpu.scheduler.fleet import _cap_round
+
+    d = _fleet_dims()
+    fast_idx, _ = _fast_tuples(d["c"])
+    k_out = k_res = 8
+    e_cap = _cap_round(1)
+
+    def spec(variant, **statics):
+        base = dict(
+            chunk=d["chunk"], n_chunks=1, k_out=k_out, k_res=k_res,
+            e_cap=e_cap, wide=True, fast=None, has_aggregated=True,
+            all_rows=True, mesh=None, shard_c=False, pack21=True,
+        )
+        base.update(statics)
+        shapes = tuple(
+            _fleet_tables(d) + [((d["n_pad"],), "int32")] + _fleet_state(d)
+            + [((d["cap"], base["k_res"]), "int32")]
+        )
+        return KernelSpec(variant, shapes, base)
+
+    return [
+        spec("wide-allrows"),
+        spec("narrow-fast-partial", wide=False, fast=fast_idx,
+             all_rows=False, pack21=False),
+        spec("next-e-bucket", e_cap=_cap_round(e_cap + 1)),
+    ]
+
+
+def _specs_fleet_pass() -> list:
+    from karmada_tpu.scheduler.fleet import D_FLOOR
+
+    d = _fleet_dims()
+    fast_idx, _ = _fast_tuples(d["c"])
+
+    def spec(variant, **statics):
+        base = dict(
+            chunk=d["chunk"], n_chunks=1, wide=True, fast=None,
+            has_aggregated=True, all_rows=True, m_cap=d["n_pad"],
+            d_cap=0, mesh=None, shard_c=False,
+        )
+        base.update(statics)
+        shapes = tuple(
+            _fleet_tables(d) + [((d["n_pad"],), "int32")] + _fleet_state(d)
+            + [((d["cap"], d["c"]), "uint8"), ((d["cap"],), "int32")]
+        )
+        return KernelSpec(variant, shapes, base)
+
+    return [
+        spec("wide-allrows"),
+        spec("narrow-fast-delta", wide=False, fast=fast_idx,
+             d_cap=D_FLOOR, all_rows=False),
+    ]
+
+
+def _specs_fleet_entries() -> list:
+    from karmada_tpu.scheduler.fleet import _cap_round
+
+    d = _fleet_dims()
+    shapes = (
+        ((d["cap"], d["c"]), "uint8"), ((2048,), "int32"),
+    )
+    base = dict(chunk=256, n_chunks=8, k_out=8, e_cap=_cap_round(1))
+    return [
+        KernelSpec("byte-pack21", shapes,
+                   {**base, "byte_wire": True, "pack21": True}),
+        KernelSpec("word-wire", shapes,
+                   {**base, "byte_wire": False, "pack21": False}),
+    ]
+
+
+def _specs_fleet_bits() -> list:
+    d = _fleet_dims()
+    shapes = tuple(
+        _fleet_tables(d) + [((d["n_pad"],), "int32")] + _fleet_state(d)
+    )
+    return [KernelSpec("base", shapes, {"chunk": d["chunk"], "n_chunks": 1})]
+
+
+def _specs_gather_meta() -> list:
+    d = _fleet_dims()
+    return [KernelSpec(
+        "base", (((d["cap"],), "int32"), ((d["n_pad"],), "int32")),
+    )]
+
+
+def _group_scatter(structs):
+    return tuple(structs[0:8]), structs[8], tuple(structs[9:17])
+
+
+def _specs_scatter_rows() -> list:
+    d = _fleet_dims()
+    state = _fleet_state(d)
+    rows = 16
+    vals = [((rows,) + tuple(s[0][1:]), s[1]) for s in state]
+    return [KernelSpec(
+        "base",
+        tuple(state + [((rows,), "int64")] + vals),
+        group=_group_scatter,
+    )]
+
+
+def _entry(name, family, module, attr, path, make_specs, manifest=None):
+    return KernelEntry(
+        name=name, family=family, module=module, attr=attr, path=path,
+        make_specs=make_specs, manifest_kernel=manifest,
+    )
+
+
+#: THE registry: every exported kernel entry point, AST-light (spec
+#: builders import the engine lazily). The docs drift gate
+#: (tools/docs_from_bench.py check_ir_registry) fails loudly when an
+#: ops/ export is missing here; IR004 fails when a fleet kernel is
+#: missing from any of FLEET_KERNELS / prewarm._KERNELS / this table.
+ENTRY_POINTS: dict = {
+    e.name: e
+    for e in (
+        # ops/ — the dispense/divide/estimate/masks families
+        _entry("divide_replicas", "ops", "karmada_tpu.ops.divide",
+               "divide_replicas", "karmada_tpu/ops/divide.py",
+               _specs_divide),
+        _entry("take_by_weight", "ops", "karmada_tpu.ops.dispense",
+               "take_by_weight", "karmada_tpu/ops/dispense.py",
+               _specs_take_by_weight),
+        _entry("take_by_weight_fast", "ops", "karmada_tpu.ops.dispense",
+               "take_by_weight_fast", "karmada_tpu/ops/dispense.py",
+               _specs_take_by_weight_fast),
+        _entry("take_by_weight_batch", "ops", "karmada_tpu.ops.dispense",
+               "take_by_weight_batch", "karmada_tpu/ops/dispense.py",
+               _specs_take_by_weight_batch),
+        _entry("general_estimate", "ops", "karmada_tpu.ops.estimate",
+               "general_estimate", "karmada_tpu/ops/estimate.py",
+               _specs_general_estimate),
+        _entry("general_estimate_interned", "ops",
+               "karmada_tpu.ops.estimate", "general_estimate_interned",
+               "karmada_tpu/ops/estimate.py",
+               _specs_general_estimate_interned),
+        _entry("gather_profile_rows", "ops", "karmada_tpu.ops.estimate",
+               "gather_profile_rows", "karmada_tpu/ops/estimate.py",
+               _specs_gather_profile_rows),
+        _entry("merge_estimates", "ops", "karmada_tpu.ops.estimate",
+               "merge_estimates", "karmada_tpu/ops/estimate.py",
+               _specs_merge_estimates),
+        _entry("masks.contains_all", "masks", "karmada_tpu.ops.masks",
+               "contains_all", "karmada_tpu/ops/masks.py",
+               _specs_masks_contains_all),
+        _entry("masks.intersects", "masks", "karmada_tpu.ops.masks",
+               "intersects", "karmada_tpu/ops/masks.py",
+               _specs_masks_intersects),
+        # scheduler fleet kernels (manifest-recorded solve family + the
+        # ledger-only utility kernels)
+        _entry("fleet_solve", "scheduler", "karmada_tpu.scheduler.fleet",
+               "_fleet_solve", "karmada_tpu/scheduler/fleet.py",
+               _specs_fleet_solve, manifest="fleet_solve"),
+        _entry("fleet_pass", "scheduler", "karmada_tpu.scheduler.fleet",
+               "_fleet_pass", "karmada_tpu/scheduler/fleet.py",
+               _specs_fleet_pass, manifest="fleet_pass"),
+        _entry("fleet_entries", "scheduler", "karmada_tpu.scheduler.fleet",
+               "_fleet_entries", "karmada_tpu/scheduler/fleet.py",
+               _specs_fleet_entries, manifest="fleet_entries"),
+        _entry("fleet_bits", "scheduler", "karmada_tpu.scheduler.fleet",
+               "_fleet_bits", "karmada_tpu/scheduler/fleet.py",
+               _specs_fleet_bits, manifest="fleet_bits"),
+        _entry("gather_meta", "scheduler", "karmada_tpu.scheduler.fleet",
+               "_gather_meta", "karmada_tpu/scheduler/fleet.py",
+               _specs_gather_meta),
+        _entry("scatter_rows", "scheduler", "karmada_tpu.scheduler.fleet",
+               "_scatter_rows", "karmada_tpu/scheduler/fleet.py",
+               _specs_scatter_rows),
+    )
+}
+
+
+def exported_ops_kernels(root: Path) -> set:
+    """Kernel function names ``karmada_tpu/ops/__init__.py`` re-exports
+    (pure AST: lowercase ``from .submodule import name`` bindings —
+    constants are UPPER and result types CamelCase by repo convention).
+    The docs drift gate compares this against the registry."""
+    tree = ast.parse(
+        (Path(root) / "karmada_tpu" / "ops" / "__init__.py").read_text()
+    )
+    out: set = set()
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.ImportFrom)
+            and node.level == 1
+            and node.module
+        ):
+            continue
+        for a in node.names:
+            name = a.asname or a.name
+            if name.islower() and not name.startswith("_"):
+                out.add(name)
+    return out
+
+
+def ops_registry_drift(root: Optional[Path] = None) -> tuple:
+    """(exported-but-unregistered, registered-but-unexported) kernel
+    names — both must be empty; tools/docs_from_bench.py fails loudly on
+    either (the same drift-guard pattern as the env-flag table)."""
+    config = default_config(root)
+    exported = exported_ops_kernels(config.root)
+    registered = {
+        e.name for e in ENTRY_POINTS.values() if e.family == "ops"
+    }
+    return sorted(exported - registered), sorted(registered - exported)
+
+
+# --------------------------------------------------------------------------
+# tracing
+# --------------------------------------------------------------------------
+
+
+def _import_jax():
+    # the auditor must never grab a TPU: default to CPU before the first
+    # jax import (a caller that already imported jax keeps its platform)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    return jax
+
+
+@dataclass
+class TracedKernel:
+    """One abstract trace: the jaxpr plus the finding anchor."""
+
+    entry: KernelEntry
+    spec: KernelSpec
+    closed_jaxpr: object
+    line: int = 1
+
+    @property
+    def label(self) -> str:
+        return f"{self.entry.name}[{self.spec.variant}]"
+
+    def finding(self, rule_id: str, message: str, detail: str) -> Finding:
+        return Finding(
+            rule=rule_id, path=self.entry.path, line=self.line, col=1,
+            message=message, anchor=self.entry.attr, detail=detail,
+            anchor_line=self.line,
+        )
+
+
+def resolve_kernel(entry: KernelEntry):
+    import importlib
+
+    return getattr(importlib.import_module(entry.module), entry.attr)
+
+
+def trace_spec(entry: KernelEntry, spec: KernelSpec, line: int = 1):
+    """Abstractly trace one spec: no compile, no execution, no data."""
+    jax = _import_jax()
+    import numpy as np
+
+    fn = resolve_kernel(entry)
+    structs = [
+        jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+        for shape, dtype in spec.in_shapes
+    ]
+    args = spec.group(structs) if spec.group else tuple(structs)
+    statics = dict(spec.statics)
+    closed = jax.make_jaxpr(lambda *a: fn(*a, **statics))(*args)
+    return TracedKernel(
+        entry=entry, spec=spec, closed_jaxpr=closed, line=line,
+    )
+
+
+# --------------------------------------------------------------------------
+# manifest fidelity (IR004 inputs)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ManifestResult:
+    index: int
+    kernel: str
+    error: Optional[str] = None
+    reason: str = "ok"
+    traced: Optional[TracedKernel] = None
+
+
+def spec_from_record(record: dict, variant: str) -> KernelSpec:
+    """A manifest record IS a kernel spec: same in_shapes form, statics
+    through prewarm's own JSON inverse (so tuple restoration cannot
+    diverge from what replay() would execute)."""
+    from karmada_tpu.scheduler.prewarm import _statics_from_json
+
+    return KernelSpec(
+        variant=variant,
+        in_shapes=tuple(
+            (tuple(int(d) for d in shape), dtype)
+            for shape, dtype in record["in_shapes"]
+        ),
+        statics=_statics_from_json(record["statics"]),
+    )
+
+
+def record_canon(record: dict, spec: KernelSpec) -> tuple:
+    """(original canon, canon of the spec re-serialized through prewarm's
+    own writers) — byte-identical means the save/load/replay cycle is
+    lossless for this record."""
+    import numpy as np
+
+    from karmada_tpu.scheduler.prewarm import _canon, _listify
+
+    rebuilt = {
+        "kernel": record["kernel"],
+        "in_shapes": [
+            [list(shape), str(np.dtype(dtype))]
+            for shape, dtype in spec.in_shapes
+        ],
+        "statics": {k: _listify(v) for k, v in spec.statics.items()},
+    }
+    return _canon(record), _canon(rebuilt)
+
+
+def check_manifest(path: str, ctx: "IRContext") -> None:
+    """Audit one trace manifest: every record must resolve to a known
+    kernel family, re-trace under its recorded shapes/statics, and
+    round-trip to a byte-identical content signature. Successfully traced
+    records join the IR001/2/3/5 audit set.
+
+    The file is parsed RAW, not through ``prewarm.TraceManifest`` — the
+    loader silently drops unreadable files and records whose kernel is
+    missing from ``_KERNELS``, which is exactly the drift this audit
+    exists to catch (a renamed fleet kernel would make every old record
+    vanish and the audit report clean). An explicitly-audited manifest
+    that is unreadable or empty is itself a finding: the operator asked
+    to prove coverage, and there is none."""
+    import json
+
+    by_manifest = {
+        e.manifest_kernel: e
+        for e in ctx.entries.values()
+        if e.manifest_kernel
+    }
+    try:
+        rel = Path(path).resolve().relative_to(
+            ctx.config.root.resolve()
+        ).as_posix()
+    except ValueError:
+        rel = Path(path).as_posix()
+    ctx.manifest_rel = rel
+    try:
+        data = json.loads(Path(path).read_text())
+        records = data.get("records", [])
+        if not isinstance(records, list):
+            raise ValueError("'records' is not a list")
+    except (OSError, ValueError) as exc:
+        ctx.manifest_results.append(ManifestResult(
+            index=-1, kernel="<manifest>",
+            error=f"manifest unreadable ({exc})", reason="unreadable",
+        ))
+        return
+    if not records:
+        ctx.manifest_results.append(ManifestResult(
+            index=-1, kernel="<manifest>",
+            error=("manifest holds zero records — prewarm would cover "
+                   "nothing; re-record it (run a warm pass with recording "
+                   "on) or drop --manifest"),
+            reason="empty",
+        ))
+        return
+    for i, record in enumerate(records):
+        kernel = (
+            record.get("kernel", "?") if isinstance(record, dict) else "?"
+        )
+        res = ManifestResult(index=i, kernel=str(kernel))
+        ctx.manifest_results.append(res)
+        if not isinstance(record, dict) or not all(
+            k in record for k in ("kernel", "in_shapes", "statics")
+        ):
+            res.error = (
+                "malformed record (kernel/in_shapes/statics required)"
+            )
+            res.reason = "malformed"
+            continue
+        entry = by_manifest.get(kernel)
+        if entry is None:
+            res.error = (
+                "unknown kernel family (not in the IR entry-point registry)"
+            )
+            res.reason = "unknown-kernel"
+            continue
+        try:
+            spec = spec_from_record(record, f"manifest[{i}]")
+            res.traced = trace_spec(entry, spec, ctx.entry_line(entry))
+        except Exception as exc:  # noqa: BLE001 — each record is audited
+            # independently; one stale record must not mask the rest
+            res.error = f"re-trace failed ({exc!r})"
+            res.reason = "trace-failed"
+            continue
+        original, rebuilt = record_canon(record, spec)
+        if original != rebuilt:
+            res.error = (
+                "recorded signature does not round-trip byte-identically "
+                f"({original} != {rebuilt})"
+            )
+            res.reason = "canon-drift"
+            continue
+        ctx.traced.append(res.traced)
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+
+class IRContext:
+    """Cross-rule state of one IR run (the IR analogue of LintContext)."""
+
+    def __init__(self, config: Config, entries: dict):
+        self.config = config
+        self.entries = entries
+        self.traced: list = []
+        self.trace_failures: list = []  # (entry, spec, err-str)
+        self.registry_coverage: Optional[dict] = None
+        self.manifest_rel: str = ""
+        self.manifest_results: list = []
+        self.const_bytes_threshold = irrules.CONST_BYTES_THRESHOLD
+        self._def_lines: dict = {}  # path -> {funcname: lineno}
+        self._modinfos: dict = {}  # path -> Optional[ModuleInfo]
+
+    def entry_line(self, entry: KernelEntry) -> int:
+        lines = self._def_lines.get(entry.path)
+        if lines is None:
+            lines = {}
+            source = self.config.root / entry.path
+            if source.exists():
+                for node in ast.walk(ast.parse(source.read_text())):
+                    if isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        lines.setdefault(node.name, node.lineno)
+            self._def_lines[entry.path] = lines
+        return lines.get(entry.attr, 1)
+
+    def modinfo(self, rel: str) -> Optional[ModuleInfo]:
+        """Parsed module for suppression lookup (None for paths outside
+        the tree, e.g. a manifest file)."""
+        if rel not in self._modinfos:
+            source = self.config.root / rel
+            info = None
+            if source.exists() and source.suffix == ".py":
+                info = ModuleInfo.parse(source, rel, set())
+            self._modinfos[rel] = info
+        return self._modinfos[rel]
+
+
+def _registry_coverage(entries: dict) -> dict:
+    """The three surfaces a fleet kernel must be registered on (IR004)."""
+    from karmada_tpu.scheduler import fleet, prewarm
+
+    return {
+        "fleet": set(fleet.FLEET_KERNELS),
+        "prewarm": set(prewarm._KERNELS),
+        "ir": {
+            e.manifest_kernel
+            for e in entries.values()
+            if e.manifest_kernel
+        },
+    }
+
+
+def run_ir(
+    families=None,
+    *,
+    root=None,
+    baseline="auto",
+    manifest: Optional[str] = None,
+    entries: Optional[dict] = None,
+    const_bytes_threshold: Optional[int] = None,
+) -> LintResult:
+    """One-call API behind ``--ir`` and the tier-1 gate. ``families``
+    filters the registry by entry name (None = everything); ``entries``
+    substitutes the registry wholesale (the seeded-mutant fixtures);
+    ``manifest`` additionally audits a trace-manifest file (IR004)."""
+    config = default_config(root)
+    registry = dict(entries) if entries is not None else dict(ENTRY_POINTS)
+    full_run = entries is None and not families
+    if families:
+        unknown = sorted(set(families) - set(registry))
+        if unknown:
+            raise KeyError(
+                f"unknown kernel families {unknown}; known: "
+                f"{sorted(registry)}"
+            )
+        registry = {name: registry[name] for name in families}
+
+    ctx = IRContext(config, registry)
+    if const_bytes_threshold is not None:
+        ctx.const_bytes_threshold = const_bytes_threshold
+    for entry in registry.values():
+        line = ctx.entry_line(entry)
+        for spec in entry.make_specs():
+            try:
+                ctx.traced.append(trace_spec(entry, spec, line))
+            except Exception as exc:  # noqa: BLE001 — a spec that fails
+                # to trace is ITSELF the IR004 finding, never an abort
+                ctx.trace_failures.append((entry, spec, repr(exc)))
+    if full_run:
+        ctx.registry_coverage = _registry_coverage(registry)
+    if manifest:
+        check_manifest(manifest, ctx)
+
+    raw: list = []
+    suppressed = 0
+    seen: set = set()
+    for r in IR_RULES.values():
+        found: list = []
+        for t in ctx.traced:
+            found.extend(r.check(t, ctx))
+        found.extend(r.finalize(ctx))
+        for f in found:
+            key = (f.identity, f.line)
+            if key in seen:  # variants of one entry repeat one defect
+                continue
+            seen.add(key)
+            mod = ctx.modinfo(f.path)
+            if mod is not None and mod.suppressed(
+                f.rule, f.line, f.anchor_line
+            ):
+                suppressed += 1
+            else:
+                raw.append(f)
+
+    baseline_path = None
+    if baseline == "auto":
+        baseline_path = config.root / config.baseline_path
+    elif baseline:
+        baseline_path = config.root / baseline
+    checked = len(ctx.traced) + len(ctx.trace_failures)
+    return apply_baseline(
+        raw, baseline=baseline_path, checked_files=checked,
+        suppressed=suppressed,
+    )
