@@ -115,6 +115,14 @@ def build_parser():
         help=argparse.SUPPRESS,
     )
     p.add_argument(
+        "--estimator-only", action="store_true",
+        help="run just the estimator-512 wire tier (4 live gRPC server "
+        "processes): full-refresh storm p50 over the batched protocol, "
+        "no-movement refresh p50 over GetGenerations pings, the unary-"
+        "fallback parity run, and per-pass RPC counts — the "
+        "BENCH_ESTIMATOR_r*.json record",
+    )
+    p.add_argument(
         "--config",
         type=int,
         default=5,
@@ -491,6 +499,192 @@ def build_headline_workload(b_total: int, c: int):
         pl_plain=pl_plain, pl_tol=pl_tol, profiles=profiles,
         replicas=replicas, prof_idx=prof_idx, problems=problems,
     )
+
+
+# --------------------------------------------------------------------------
+# estimator-512 wire tier: batched protocol + generation-gated refresh
+# --------------------------------------------------------------------------
+
+
+def run_estimator_tier(args, tier_status=None) -> dict:
+    """Availability from LIVE gRPC accurate estimators: 512 clusters
+    multiplexed across 4 real server processes (python -m
+    karmada_tpu.estimator --spec-file). Three timed shapes:
+
+    - FULL refresh (invalidate(drop=True) per pass): every cluster re-pays
+      the wire, but the batched protocol makes it ONE MaxAvailableReplicas
+      Batch RPC per server process instead of clusters x profiles unary
+      calls.
+    - NO-MOVEMENT refresh (invalidate() per pass): one GetGenerations ping
+      per server proves nothing moved, the memoized profile columns stay
+      valid, and the fan-out never runs — the steady-state staleness check
+      a cluster-status heartbeat triggers.
+    - UNARY FALLBACK (KARMADA_TPU_ESTIMATOR_BATCH=0, full refresh): the
+      mixed-version path — per-profile calls pipelined over each server
+      channel via grpc futures.
+
+    Identity: each cluster's estimator holds one node whose allocatable
+    equals the snapshot's free capacity, so min-merge(general, accurate)
+    == general and placements must match the snapshot-fed engine bit for
+    bit on BOTH protocols. Per-pass RPC counts are recorded to prove the
+    O(servers) steady shape."""
+    import os
+
+    from karmada_tpu.estimator.accurate import BATCH_ENV
+    from karmada_tpu.estimator.fleet import spawn_estimator_fleet
+    from karmada_tpu.scheduler import (
+        BindingProblem,
+        ClusterSnapshot,
+        TensorScheduler,
+    )
+    from karmada_tpu.utils.builders import (
+        dynamic_weight_placement,
+        synthetic_fleet,
+    )
+    from karmada_tpu.utils.quantity import parse_resource_list
+
+    if tier_status is None:
+        tier_status = {}
+    c_e, b_e, n_servers = 512, 10_000, 4
+    e_clusters = synthetic_fleet(c_e, seed=77)
+    e_snap = ClusterSnapshot(e_clusters)
+    e_names = e_snap.names
+    dims = list(e_snap.dims)
+    free = np.maximum(np.asarray(e_snap.available_cap), 0)
+    pl_plain = dynamic_weight_placement()
+    profiles = [
+        parse_resource_list(
+            {"cpu": f"{250 * (p + 1)}m", "memory": f"{512 * (p + 1)}Mi"}
+        )
+        for p in range(8)
+    ]
+    rng_e = np.random.default_rng(17)
+    e_problems = [
+        BindingProblem(
+            key=f"e{i}", placement=pl_plain,
+            replicas=int(rng_e.integers(1, 80)),
+            requests=profiles[int(rng_e.integers(0, 8))],
+            gvk="apps/v1/Deployment",
+        )
+        for i in range(b_e)
+    ]
+    with spawn_estimator_fleet(
+        e_names, free, dims, n_servers=n_servers, index=e_snap.index,
+    ) as fleet:
+        registry = fleet.registry
+        # the deadline must clear a full UNARY fan-out on the bench rig
+        # (the fallback tier re-pays 512 x 8 per-profile RPCs per pass);
+        # the batch path never comes near it
+        batch = registry.make_batch_estimator(e_names, timeout_seconds=60.0)
+        eng_est = TensorScheduler(
+            e_snap, chunk_size=args.chunk, extra_estimators=[batch]
+        )
+        t0 = time.perf_counter()
+        eng_est.schedule(e_problems)
+        print(
+            f"# estimator-512 warm pass: {time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
+        for _ in range(2):
+            eng_est.schedule(e_problems)
+
+        def timed_passes(tag: str, *, drop: bool, reps: int = 3):
+            times, rpcs, res = [], [], None
+            for rep in range(reps):
+                registry.invalidate(drop=drop)
+                c0 = dict(registry.rpc_counts)
+                f0 = registry.fanout_seconds_total
+                t0 = time.perf_counter()
+                res = eng_est.schedule(e_problems)
+                times.append(time.perf_counter() - t0)
+                rpcs.append(
+                    {k: registry.rpc_counts[k] - c0[k] for k in c0}
+                )
+                print(
+                    f"# estimator-512 {tag} pass {rep}: {times[-1]:.3f}s "
+                    f"(wire {registry.fanout_seconds_total - f0:.3f}s, "
+                    f"rpcs {rpcs[-1]})",
+                    file=sys.stderr,
+                )
+            return float(np.median(times)), rpcs[-1], res
+
+        full_p50, rpc_full, e_res = timed_passes("full-refresh", drop=True)
+        refresh_p50, rpc_steady, _ = timed_passes("no-movement", drop=False)
+
+        # unary-fallback parity: the same tier forced onto the per-profile
+        # protocol (old-server shape), pipelined over each channel, plus a
+        # width-1 reference = the reference's blocking-sequential wire
+        # shape measured on THIS rig (r05's 8.28 s came from a larger one)
+        from karmada_tpu.estimator.accurate import WIDTH_ENV
+
+        saved_env = {
+            k: os.environ.get(k) for k in (BATCH_ENV, WIDTH_ENV)
+        }
+        os.environ[BATCH_ENV] = "0"
+        try:
+            fb_p50, rpc_fb, fb_res = timed_passes("fallback", drop=True)
+            os.environ[WIDTH_ENV] = "1"
+            fb_seq, _rpc_seq, _ = timed_passes(
+                "fallback-sequential", drop=True, reps=1
+            )
+        finally:
+            for key, val in saved_env.items():
+                if val is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = val
+
+        n_est = sum(1 for r in e_res if r.success)
+        # identity vs the snapshot-fed engine on the same problems
+        eng_plain = TensorScheduler(e_snap, chunk_size=args.chunk)
+        p_res = eng_plain.schedule(e_problems)
+
+        def identical(res):
+            return sum(
+                1 for a, b_ in zip(res, p_res)
+                if a.success == b_.success
+                and dict(a.clusters) == dict(b_.clusters)
+            )
+
+        ident = identical(e_res)
+        fb_ident = identical(fb_res)
+        print(
+            f"# estimator-512 tier: full-refresh p50 {full_p50:.3f}s, "
+            f"no-movement refresh p50 {refresh_p50:.3f}s, fallback p50 "
+            f"{fb_p50:.3f}s, {n_est}/{b_e} scheduled, identity vs "
+            f"snapshot-fed {ident}/{b_e} (fallback {fb_ident}/{b_e})",
+            file=sys.stderr,
+        )
+        if ident != b_e or fb_ident != b_e:
+            # divergence is a TIER FAILURE, not a footnote: flag it in the
+            # parsed status so the record (and the generated docs' FAILED-
+            # tiers row) can never bury it
+            print(
+                f"# WARNING: estimator-512 divergence: batch "
+                f"{b_e - ident}, fallback {b_e - fb_ident}",
+                file=sys.stderr,
+            )
+            tier_status["estimator-512"] = (
+                f"DIVERGED: batch {b_e - ident}/{b_e}, "
+                f"fallback {b_e - fb_ident}/{b_e} rows"
+            )
+        del eng_est, eng_plain, e_res, p_res, fb_res, e_problems
+        gc.collect()
+        return {
+            "metric": f"estimator512_wire_{b_e // 1000}kx{c_e}",
+            "value": round(full_p50, 4),
+            "unit": "s",
+            "estimator512_p50": round(full_p50, 4),
+            "estimator512_refresh_p50": round(refresh_p50, 4),
+            "estimator512_fallback_p50": round(fb_p50, 4),
+            "estimator512_fallback_seq_s": round(fb_seq, 4),
+            "estimator512_identical": ident == b_e,
+            "estimator512_fallback_identical": fb_ident == b_e,
+            "estimator512_rpc_full": rpc_full,
+            "estimator512_rpc_steady": rpc_steady,
+            "estimator512_rpc_fallback": rpc_fb,
+            "estimator512_n_servers": n_servers,
+        }
 
 
 def run_engine_north_star(args) -> dict:
@@ -896,106 +1090,18 @@ def run_engine_north_star(args) -> dict:
             hetero9k_p50, hetero9k_churn = h9
 
     # ---- live-estimator sub-tier (VERDICT r4 next #5) ---------------------
-    # Availability from LIVE gRPC accurate estimators: 512 clusters
-    # multiplexed across 4 real server processes
-    # (python -m karmada_tpu.estimator --spec-file), concurrent fan-out
-    # under one shared deadline (client/accurate.go:139-162), and per-pass
-    # invalidation so EVERY timed pass pays a full wire refresh of all 512
-    # clusters (the staleness contract: estimates memoize per profile until
-    # member state moves). Identity: each cluster's estimator holds one
-    # node whose allocatable equals the snapshot's free capacity, so
-    # min-merge(general, accurate) == general and placements must match
-    # the snapshot-fed engine bit for bit.
-    def _estimator_tier() -> tuple:
-        from karmada_tpu.estimator.fleet import spawn_estimator_fleet
-        from karmada_tpu.scheduler import ClusterSnapshot as _CS
+    # The batched-wire + generation-gated-refresh tier, shared with
+    # ``--estimator-only`` (run_estimator_tier): full-refresh storm p50
+    # over one batch RPC per server, no-movement refresh p50 over
+    # GetGenerations pings, and the unary-fallback parity run.
+    def _estimator_tier() -> dict:
+        return run_estimator_tier(args, tier_status)
 
-        c_e, b_e, n_servers = 512, 10_000, 4
-        e_clusters = synthetic_fleet(c_e, seed=77)
-        e_snap = _CS(e_clusters)
-        e_names = e_snap.names
-        dims = list(e_snap.dims)
-        free = np.maximum(np.asarray(e_snap.available_cap), 0)
-        with spawn_estimator_fleet(
-            e_names, free, dims, n_servers=n_servers, index=e_snap.index,
-        ) as fleet:
-            registry = fleet.registry
-            batch = registry.make_batch_estimator(
-                e_names, timeout_seconds=10.0
-            )
-            rng_e = np.random.default_rng(17)
-            e_problems = [
-                BindingProblem(
-                    key=f"e{i}", placement=pl_plain,
-                    replicas=int(rng_e.integers(1, 80)),
-                    requests=profiles[int(rng_e.integers(0, 8))],
-                    gvk="apps/v1/Deployment",
-                )
-                for i in range(b_e)
-            ]
-            eng_est = TensorScheduler(
-                e_snap, chunk_size=args.chunk, extra_estimators=[batch]
-            )
-            t0 = time.perf_counter()
-            eng_est.schedule(e_problems)
-            print(
-                f"# estimator-512 warm pass: {time.perf_counter() - t0:.1f}s",
-                file=sys.stderr,
-            )
-            for _ in range(2):
-                eng_est.schedule(e_problems)
-            e_times, refreshes = [], []
-            for rep in range(3):
-                registry.invalidate()  # force a full live refresh this pass
-                f0 = registry.fanout_seconds_total
-                t0 = time.perf_counter()
-                e_res = eng_est.schedule(e_problems)
-                e_times.append(time.perf_counter() - t0)
-                refreshes.append(registry.fanout_seconds_total - f0)
-                print(
-                    f"# estimator-512 pass {rep}: {e_times[-1]:.3f}s "
-                    f"(live refresh {refreshes[-1]:.3f}s)",
-                    file=sys.stderr,
-                )
-            est_p50 = float(np.median(e_times))
-            refresh_p50 = float(np.median(refreshes))
-            n_est = sum(1 for r in e_res if r.success)
-            # identity vs the snapshot-fed engine on the same problems
-            eng_plain = TensorScheduler(e_snap, chunk_size=args.chunk)
-            p_res = eng_plain.schedule(e_problems)
-            ident = sum(
-                1 for a, b_ in zip(e_res, p_res)
-                if a.success == b_.success
-                and dict(a.clusters) == dict(b_.clusters)
-            )
-            print(
-                f"# estimator-512 tier: p50 {est_p50:.3f}s, live refresh "
-                f"p50 {refresh_p50:.3f}s, {n_est}/{b_e} scheduled, "
-                f"identity vs snapshot-fed {ident}/{b_e}",
-                file=sys.stderr,
-            )
-            if ident != b_e:
-                # divergence is a TIER FAILURE, not a footnote: flag it in
-                # the parsed status so the record (and the generated docs'
-                # FAILED-tiers row) can never bury it
-                print(
-                    f"# WARNING: estimator-512 divergence: {b_e - ident}",
-                    file=sys.stderr,
-                )
-                tier_status["estimator-512"] = (
-                    f"DIVERGED: {b_e - ident}/{b_e} rows"
-                )
-            del eng_est, eng_plain, e_res, p_res, e_problems
-            gc.collect()
-            return est_p50, refresh_p50, ident == b_e
-
-    est512_p50 = est512_refresh = est512_ident = None
+    est512 = None
     ran_est512 = False
     if not args.hetero and not args.no_verify and b_total == 100_000:
         ran_est512 = True
-        e5 = _subtier("estimator-512", _estimator_tier, None)
-        if e5 is not None:
-            est512_p50, est512_refresh, est512_ident = e5
+        est512 = _subtier("estimator-512", _estimator_tier, None)
 
     # ---- 1M x 5k scale tier (first-class, VERDICT r3 item 9) --------------
     # Ten times the headline bindings through the same engine: steady +
@@ -1291,9 +1397,9 @@ def run_engine_north_star(args) -> dict:
         out["hetero9000_p50"] = _r(hetero9k_p50)
         out["hetero9k_churn_p50"] = _r(hetero9k_churn)
     if ran_est512:
-        out["estimator512_p50"] = _r(est512_p50)
-        out["estimator512_refresh_p50"] = _r(est512_refresh)
-        out["estimator512_identical"] = est512_ident
+        for key, val in (est512 or {}).items():
+            if key.startswith("estimator512_"):
+                out[key] = val
     if ran_wp:
         out["whole_plane_bindings_s"] = (
             round(whole_plane, 1) if whole_plane is not None else None
@@ -1845,6 +1951,13 @@ def main():
         return
     if args.cold_start:
         print(json.dumps(run_cold_start(args)))
+        return
+    if args.estimator_only:
+        tier_status: dict = {}
+        record = run_estimator_tier(args, tier_status)
+        if tier_status:
+            record["tiers"] = tier_status
+        print(json.dumps(record))
         return
     if args.config != 5:
         print(json.dumps(run_engine_config(args.config)))

@@ -338,13 +338,27 @@ class ControlPlane:
     def _refresh_estimators(self) -> None:
         if not self._accurate_enabled:
             return
+        import numpy as np
+
         snap_dims = ["cpu", "memory", "pods", "ephemeral-storage"]
         for name in self.members.names():
             member = self.members.get(name)
             est = self.estimators.get(name)
             if member is None or est is None:
                 continue
-            est.snapshot = NodeSnapshot(member.nodes, snap_dims)
+            new = NodeSnapshot(member.nodes, snap_dims)
+            old = est.snapshot
+            # generation gate (EstimatorRegistry delta refresh): a fresh
+            # NodeSnapshot always stamps a NEW generation, so carry the old
+            # one forward when the packed capacities provably did not move
+            # — the memoized estimates stay valid and the registry's
+            # refresh pass skips this cluster. The packed array is a copy
+            # made at build time, so comparing old vs new detects drift
+            # even though both snapshots reference the same NodeState
+            # objects.
+            if old is not None and np.array_equal(old.available, new.available):
+                new.generation = old.generation
+            est.snapshot = new
             est.unschedulable = member.count_unschedulable(self.clock())
 
     # -- driving -----------------------------------------------------------

@@ -12,7 +12,7 @@ from __future__ import annotations
 import argparse
 import sys
 
-from .accurate import AccurateEstimator, NodeSnapshot, NodeState
+from .accurate import AccurateEstimator, NodeCache, NodeState
 from .grpc_transport import EstimatorGrpcServer
 from .service import EstimatorService
 
@@ -47,14 +47,18 @@ def main(argv=None) -> None:
         with open(args.spec_file) as f:
             spec: dict = json.load(f)
         dims = sorted({d for caps in spec.values() for d in caps})
+        # NodeCache (not NodeSnapshot): the long-lived server's snapshot
+        # generation stays pinned between member events, so the scheduler
+        # side's GetGenerations ping can prove "nothing moved" and skip the
+        # profile fan-out entirely (the generation-gated refresh contract)
         services = {
             name: EstimatorService(
                 AccurateEstimator(
                     name,
-                    NodeSnapshot(
+                    NodeCache(
+                        dims,
                         [NodeState(name=f"{name}-node-0",
                                    allocatable=dict(caps))],
-                        dims,
                     ),
                 )
             )
@@ -83,7 +87,7 @@ def main(argv=None) -> None:
             )
             for i in range(args.nodes)
         ]
-        est = AccurateEstimator(args.cluster, NodeSnapshot(nodes, DIMS))
+        est = AccurateEstimator(args.cluster, NodeCache(DIMS, nodes))
         server = EstimatorGrpcServer(EstimatorService(est), args.address)
         port = server.start()
         # the parent process scrapes this line to learn the bound port

@@ -16,6 +16,7 @@ karmada_tpu.estimator.service).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Mapping, Optional, Sequence
 
@@ -27,6 +28,44 @@ from .. import ops  # noqa: F401  — enables x64 before the int64 kernel traces
 from ..api.work import ReplicaRequirements
 
 UNAUTHENTIC = -1
+
+#: kill-switch for the batched wire protocol (utils.flags ENV_FLAGS): 0
+#: forces every connection onto the per-profile unary fallback — the
+#: mixed-version escape hatch and the bench's fallback-parity tier
+BATCH_ENV = "KARMADA_TPU_ESTIMATOR_BATCH"
+#: seconds a generation confirmation stays trusted across invalidate();
+#: 0 re-pings the servers on every invalidated pass
+PING_ENV = "KARMADA_TPU_ESTIMATOR_PING_SECONDS"
+#: in-flight unary RPCs per server channel on the pipelined fallback path
+WIDTH_ENV = "KARMADA_TPU_ESTIMATOR_FALLBACK_WIDTH"
+
+
+def batch_enabled() -> bool:
+    return os.environ.get(BATCH_ENV, "1").lower() not in ("0", "false", "")
+
+
+def ping_trust_seconds() -> float:
+    try:
+        return float(os.environ.get(PING_ENV, "0") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def fallback_width() -> int:
+    try:
+        width = int(os.environ.get(WIDTH_ENV, "4") or 4)
+    except ValueError:
+        width = 4
+    return max(1, width)
+
+
+def conn_supports_batch(conn) -> Optional[bool]:
+    """Per-connection negotiation state: None = not yet probed, False =
+    server answered UNIMPLEMENTED (probed once; a reconnect builds a fresh
+    connection and re-probes). The env kill-switch overrides."""
+    if not batch_enabled():
+        return False
+    return getattr(conn, "supports_batch", None)
 
 
 @dataclass
@@ -41,6 +80,18 @@ class NodeState:
     num_pods: int = 0
 
 
+#: NodeSnapshot generation source: every instance gets a fresh, monotonic
+#: generation so a snapshot SWAP (the informer-refresh idiom — build a new
+#: NodeSnapshot, assign est.snapshot) always reads as movement to the
+#: generation gate. Owners that can prove content equality may carry the
+#: old generation forward (controlplane._refresh_estimators does). Offset
+#: far above any NodeCache event count so the two generation spaces can
+#: never collide for one cluster across a cache<->snapshot swap.
+import itertools as _itertools
+
+_SNAPSHOT_GEN = _itertools.count(1 << 32)
+
+
 class NodeSnapshot:
     """Packed node arrays for one cluster (ref: the lifted kube-scheduler
     NodeInfo snapshot, pkg/util/lifted/scheduler/cache)."""
@@ -48,6 +99,7 @@ class NodeSnapshot:
     def __init__(self, nodes: Sequence[NodeState], dims: Sequence[str]):
         self.nodes = list(nodes)
         self.dims = list(dims)
+        self.generation = next(_SNAPSHOT_GEN)
         n, r = len(nodes), len(dims)
         self.available = np.zeros((n, r), np.int64)
         pods_dim = self.dims.index("pods") if "pods" in self.dims else None
@@ -158,22 +210,42 @@ class NodeCache:
         return [n for n in self.nodes if n is not None]
 
 
-@jax.jit
-def _node_sum_estimate(
-    node_avail: jnp.ndarray,  # int64[N, R]
-    node_ok: jnp.ndarray,  # bool[B, N] affinity/toleration prefilter
-    requests: jnp.ndarray,  # int64[B, R]
-) -> jnp.ndarray:
-    avail = jnp.maximum(node_avail, 0)
-    r_dims = requests.shape[-1]
-    per_node = jnp.full((requests.shape[0], avail.shape[0]), jnp.int64(2**62))
-    for r in range(r_dims):
+def _node_sum_kernel(xp, node_avail, node_ok, requests):
+    """node-sum estimate over an array module: min over requested dims of
+    floor(avail / request) per node, summed over prefilter-passing nodes,
+    int32-clamped. ONE body serves both array modules — jit for real
+    batches, plain numpy for SMALL problems, where an estimator server
+    answering one unary request (or one cluster's profile rows over a
+    handful of nodes) pays more in jit dispatch than the whole estimate
+    costs in numpy (~3 ms versus ~50 us per call, which IS the server's
+    unary throughput ceiling on small members). Pure int math, so the two
+    instantiations are bit-identical by construction (asserted in
+    tests/test_estimators.py)."""
+    avail = xp.maximum(node_avail, 0)
+    per_node = xp.full(
+        (requests.shape[0], avail.shape[0]), xp.int64(2**62)
+    )
+    for r in range(requests.shape[-1]):
         req_r = requests[:, r][:, None]
-        ratio = avail[None, :, r] // jnp.maximum(req_r, 1)
-        per_node = jnp.where(req_r > 0, jnp.minimum(per_node, ratio), per_node)
-    per_node = jnp.where(per_node >= 2**62, 0, per_node)  # no requested dims
-    total = jnp.sum(jnp.where(node_ok, per_node, 0), axis=1)
-    return jnp.minimum(total, jnp.int64(2**31 - 1)).astype(jnp.int32)
+        ratio = avail[None, :, r] // xp.maximum(req_r, 1)
+        per_node = xp.where(req_r > 0, xp.minimum(per_node, ratio), per_node)
+    per_node = xp.where(per_node >= 2**62, 0, per_node)  # no requested dims
+    total = xp.sum(xp.where(node_ok, per_node, 0), axis=1)
+    return xp.minimum(total, xp.int64(2**31 - 1)).astype(xp.int32)
+
+
+def _node_sum_estimate_np(node_avail, node_ok, requests):
+    return _node_sum_kernel(np, node_avail, node_ok, requests)
+
+
+@jax.jit
+def _node_sum_estimate(node_avail, node_ok, requests):
+    return _node_sum_kernel(jnp, node_avail, node_ok, requests)
+
+
+#: below this B x N footprint the numpy mirror beats the jit kernel's
+#: dispatch overhead (same crossover idea as the engine's host_small path)
+_NP_ESTIMATE_CELLS = 1 << 14
 
 
 class ResourceQuotaPlugin:
@@ -274,14 +346,19 @@ class AccurateEstimator:
         node_ok = np.broadcast_to(
             self._node_prefilter(requirements)[None, :], (len(req), n)
         )
-        out = np.asarray(
-            _node_sum_estimate(
+        if len(req) * n <= _NP_ESTIMATE_CELLS:
+            out = _node_sum_estimate_np(
                 # trim to the row count: a NodeCache over-allocates
-                jnp.asarray(self.snapshot.available[:n]),
-                jnp.asarray(node_ok),
-                jnp.asarray(req),
+                np.asarray(self.snapshot.available[:n]), node_ok, req
             )
-        )
+        else:
+            out = np.asarray(
+                _node_sum_estimate(
+                    jnp.asarray(self.snapshot.available[:n]),
+                    jnp.asarray(node_ok),
+                    jnp.asarray(req),
+                )
+            )
         # quota plugin caps the node-sum estimate (server/estimate.go:98-101,
         # RunEstimateReplicasPlugins min-merge), feature-gated
         from ..utils.features import RESOURCE_QUOTA_ESTIMATE, feature_gate
@@ -304,41 +381,91 @@ class AccurateEstimator:
 
 class EstimatorRegistry:
     """Scheduler-side estimator fan-out (ref: client/accurate.go:33-68 — the
-    per-cluster connection cache + concurrent fan-out)."""
+    per-cluster connection cache + concurrent fan-out), batch-native and
+    delta-aware.
+
+    Estimates memoize per (cluster, unique request profile) and are GATED
+    by the owning estimator's snapshot generation: ``invalidate()`` marks
+    every cluster unconfirmed, and the next pass re-confirms them with one
+    GetGenerations ping per SERVER connection — only clusters whose
+    generation actually advanced re-pay the profile fan-out, and the
+    fan-out itself is one MaxAvailableReplicasBatch per server instead of
+    clusters x profiles unary calls. Old servers (UNIMPLEMENTED) keep the
+    reference shape: full per-cluster re-query on every invalidation,
+    pipelined over the channel."""
 
     def __init__(self) -> None:
         self._by_cluster: dict[str, AccurateEstimator] = {}
         self._pool = None
-        # wall seconds spent in live estimator fan-outs (memo misses) since
-        # construction — benches diff this across passes to report the
-        # snapshot-refresh latency of estimator-backed availability
+        # wall seconds spent in live estimator traffic (generation pings +
+        # memo-miss fan-outs) since construction — benches diff this across
+        # passes to report the snapshot-refresh latency of estimator-backed
+        # availability
         self.fanout_seconds_total = 0.0
-        self._memo: dict[tuple, np.ndarray] = {}
+        # memoized answers, one scalar per (cluster, profile bytes); the
+        # profile key is positional over the engine snapshot's dims, so one
+        # registry serves one dims universe at a time (as before)
+        self._memo: dict[tuple[str, bytes], int] = {}
+        # last generation each cluster's memo entries were computed at
+        self._gen: dict[str, int] = {}
+        # clusters whose memo is trusted this epoch -> monotonic confirm
+        # time (the PING_ENV trust window keys off it)
+        self._confirmed: dict[str, float] = {}
+        # live RPCs issued since construction, by kind — benches diff this
+        # per pass to prove the O(servers) steady-pass shape
+        self.rpc_counts: dict[str, int] = {"batch": 0, "unary": 0, "ping": 0}
+        # memo-content version: bumped whenever an entry is written or
+        # dropped. confirm_token() folds it into the token the scheduler's
+        # batch-identity fast path compares — equal tokens prove the
+        # estimator contribution to a replayed batch is unchanged
+        self._epoch = 0
 
     def register(self, est: AccurateEstimator) -> None:
         self._by_cluster[est.cluster_name] = est
-        # memoized columns are positional over a batch estimator's name
-        # list; any membership change invalidates them (a stale shorter
-        # column would shape-mismatch a rebuilt, longer fan-out)
-        self._memo.clear()
+        # a (re)registered estimator invalidates exactly its own cluster's
+        # memo — columns are keyed by name, so other members keep theirs
+        self._drop_cluster(est.cluster_name)
 
     def deregister(self, cluster_name: str) -> None:
         self._by_cluster.pop(cluster_name, None)
-        self._memo.clear()
+        self._drop_cluster(cluster_name)
+
+    def _drop_cluster(self, name: str) -> None:
+        self._gen.pop(name, None)
+        self._confirmed.pop(name, None)
+        self._epoch += 1
+        for key in [k for k in self._memo if k[0] == name]:
+            del self._memo[key]
 
     def get(self, cluster_name: str) -> Optional[AccurateEstimator]:
         return self._by_cluster.get(cluster_name)
 
-    def invalidate(self) -> None:
-        """Drop memoized estimates. Staleness contract: an estimate is a
-        point-in-time answer memoized per unique request profile until the
+    def invalidate(self, drop: bool = False) -> None:
+        """Mark memoized estimates stale. Staleness contract: an estimate
+        is a point-in-time answer memoized per (cluster, profile) until the
         owner observes member state change (cluster status heartbeat /
-        snapshot swap) and invalidates — the informer-cache granularity the
-        reference's general estimator gets for free, applied to the gRPC
-        accurate path. Without invalidation a long steady storm re-uses
-        the first pass's fan-out; after it, the next pass re-queries every
-        cluster live."""
-        self._memo.clear()
+        snapshot swap) and invalidates. The default is GENERATION-GATED:
+        memo entries survive, and the next pass re-confirms each cluster's
+        snapshot generation (one ping per server) — a no-movement refresh
+        never touches the profile fan-out. ``drop=True`` is the hard form
+        (membership changes, tests, benches): forget everything and re-pay
+        the full fan-out next pass."""
+        if drop:
+            self._memo.clear()
+            self._gen.clear()
+            self._confirmed.clear()
+            self._epoch += 1
+            return
+        trust = ping_trust_seconds()
+        if trust <= 0:
+            self._confirmed.clear()
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        self._confirmed = {
+            c: t for c, t in self._confirmed.items() if now - t < trust
+        }
 
     def make_batch_estimator(
         self,
@@ -352,77 +479,500 @@ class EstimatorRegistry:
         estimator serves the cluster.
 
         Fan-out is CONCURRENT under one shared deadline
-        (client/accurate.go:139-162): each cluster's per-profile queries
-        run on a worker pool; a cluster missing the deadline answers
+        (client/accurate.go:139-162), grouped by server connection: one
+        batch RPC per server covers every hosted cluster's misses; clusters
+        on fallback (unary) connections fan out per cluster with pipelined
+        per-profile calls. A cluster missing the deadline answers
         UnauthenticReplica (-1) for this pass, so the min-merge ignores it
         instead of blocking scheduling — its late result is discarded,
-        never applied to a later pass."""
-        from concurrent.futures import ThreadPoolExecutor
-        from concurrent.futures import wait as _fwait
-        import time as _time
-
+        never applied to a later pass, and (per-column completeness) it
+        never blocks memoization of the clusters that did answer."""
         names = list(cluster_names)
-        # memo keys carry the closure's name tuple: memoized columns are
-        # POSITIONAL over this estimator's name list, so two coexisting
-        # batch estimators with different orderings (or subsets) of the
-        # same registry must never read each other's columns
-        memo_ns = tuple(names)
+        # registered clusters the LAST estimate pass answered -1 for
+        # (unconfirmed or cells missing): such a pass is degraded and must
+        # never be replayed by the scheduler's batch-identity fast path —
+        # the cluster may become confirmable right after (its server
+        # recovers), at which point a replayed pass would pin the
+        # transient -1 forever while a real pass would answer from memo
+        unanswered: set = set()
 
         def estimate(requests: np.ndarray, replicas: np.ndarray) -> np.ndarray:
             reqs = np.asarray(requests)
-            b = len(reqs)
-            out = np.full((b, len(names)), UNAUTHENTIC, np.int32)
-            # intern the batch to unique profiles; answer memo hits without
-            # touching the wire, fan out the misses concurrently
-            uniq, inv = np.unique(reqs, axis=0, return_inverse=True)
-            cols = [self._memo.get((memo_ns, row.tobytes())) for row in uniq]
-            miss = [u for u, col in enumerate(cols) if col is None]
-            if miss:
-                if self._pool is None:
-                    self._pool = ThreadPoolExecutor(max_workers)
-                t0 = _time.perf_counter()
-                miss_reqs = uniq[miss]
-                futs = {}
+            reps = np.asarray(replicas)
+            out = np.full((len(reqs), len(names)), UNAUTHENTIC, np.int32)
+            # zero-replica rows (the engine's power-of-two PAD rows, plus
+            # real scale-to-zero bindings) never need a live answer — the
+            # min-merge ignores -1 and the divider assigns 0 regardless, so
+            # their profiles must not force a wire wave of their own
+            live = reps > 0
+            if not live.any():
+                return out
+            uniq, inv = np.unique(reqs[live], axis=0, return_inverse=True)
+            prof_keys = [row.tobytes() for row in uniq]
+            self._refresh(names, uniq, prof_keys, max_workers, timeout_seconds)
+            table = np.full((len(uniq), len(names)), UNAUTHENTIC, np.int32)
+            memo = self._memo
+            unanswered.clear()
+            for ci, name in enumerate(names):
                 # clusters with no registered estimator answer -1
-                # STRUCTURALLY (deterministic) and don't block memoization;
-                # a TIMED-OUT or errored cluster answers -1 for this pass
-                # only — memoizing a transient failure would pin the
-                # snapshot-only fallback until the next invalidation
-                complete = True
-                for ci, name in enumerate(names):
-                    est = self._by_cluster.get(name)
-                    if est is None:
-                        continue
-                    futs[
-                        self._pool.submit(
-                            est.max_available_replicas, None, miss_reqs
-                        )
-                    ] = ci
-                done, not_done = _fwait(futs, timeout=timeout_seconds)
-                fresh = np.full(
-                    (len(miss), len(names)), UNAUTHENTIC, np.int32
-                )
-                for f in done:
-                    try:
-                        vals = np.asarray(f.result(), np.int32)
-                        fresh[:, futs[f]] = vals
-                        if (vals < 0).any():
-                            # the remote adapter reports its own per-RPC
-                            # wire failures as -1 rows — same transient
-                            complete = False
-                    except Exception:  # noqa: BLE001 — wire failure = -1
-                        complete = False
-                for f in not_done:
-                    f.cancel()
-                    complete = False
-                for k, u in enumerate(miss):
-                    col = fresh[k]
-                    cols[u] = col
-                    if complete:
-                        self._memo[(memo_ns, uniq[u].tobytes())] = col
-                self.fanout_seconds_total += _time.perf_counter() - t0
-            table = np.stack(cols)  # [U, C]
-            out[:] = table[inv]
+                # STRUCTURALLY (deterministic); unconfirmed clusters answer
+                # -1 for this pass only
+                if name not in self._confirmed:
+                    if name in self._by_cluster:
+                        unanswered.add(name)
+                    continue
+                for u, key in enumerate(prof_keys):
+                    val = memo.get((name, key))
+                    if val is not None:
+                        table[u, ci] = val
+                    else:
+                        unanswered.add(name)
+            out[live] = table[inv]
             return out
 
+        def refresh_token():
+            # the scheduler's batch-identity fast path probes this before
+            # replaying a storm pass: it confirms generations (O(servers)
+            # pings) and returns an unchanged token iff no memo content
+            # moved AND the last pass answered every registered cluster —
+            # a degraded pass (transient -1 cells) is never replayable
+            token = self.confirm_token(
+                names, max_workers=max_workers,
+                timeout_seconds=timeout_seconds,
+            )
+            if token is None or unanswered:
+                return None
+            return token
+
+        estimate.refresh_token = refresh_token
         return estimate
+
+    # -- live refresh machinery (ping + grouped fan-out) -------------------
+
+    def _refresh(
+        self,
+        names: Sequence[str],
+        uniq: np.ndarray,
+        prof_keys: Sequence[bytes],
+        max_workers: int,
+        timeout_seconds: Optional[float],
+    ) -> None:
+        """Bring every (cluster, profile) memo cell either up to date or
+        provably unanswerable for this pass. Mutates memo/generation state
+        only on the calling thread — pool tasks just return data."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        deadline = (
+            None if timeout_seconds is None else t0 + timeout_seconds
+        )
+
+        def remaining() -> Optional[float]:
+            if deadline is None:
+                return None
+            return max(deadline - _time.perf_counter(), 0.0)
+
+        # steps A+B: confirm generations (local reads + one ping per
+        # server connection)
+        touched_wire = self._confirm_generations(
+            names, prof_keys, max_workers, remaining
+        )
+
+        # ---- step C: fetch — clusters with any unmemoized profile, grouped
+        # by batch-capable connection; the rest fan out per cluster
+        fetch: list = []  # (name, est, conn | None)
+        for name in names:
+            est = self._by_cluster.get(name)
+            if est is None:
+                continue
+            if name in self._confirmed and all(
+                (name, k) in self._memo for k in prof_keys
+            ):
+                continue
+            fetch.append((name, est, getattr(est, "conn", None)))
+        if fetch:
+            touched_wire = True
+            self._fetch(fetch, uniq, prof_keys, max_workers, remaining)
+        if touched_wire:
+            self.fanout_seconds_total += _time.perf_counter() - t0
+
+    def _confirm_generations(
+        self,
+        names: Sequence[str],
+        prof_keys: Optional[Sequence[bytes]],
+        max_workers: int,
+        remaining,
+    ) -> bool:
+        """Confirm every unconfirmed cluster's snapshot generation: local
+        estimators by a direct read, remote ones with one GetGenerations
+        ping per server connection. A cluster whose generation moved drops
+        its memo (the fetch step re-queries it). When ``prof_keys`` is
+        given, remote clusters with ANY unmemoized profile skip the ping —
+        the fetch returns their generation anyway; ``prof_keys=None``
+        (confirm_token) pings every unconfirmed remote. Returns True when
+        any wire traffic happened."""
+        from concurrent.futures import wait as _fwait
+
+        from .service import UnsupportedMethodError
+
+        # ---- step A: local estimators confirm by direct generation read
+        remote_unconfirmed: list = []  # (name, est, conn)
+        for name in names:
+            if name in self._confirmed:
+                continue
+            est = self._by_cluster.get(name)
+            if est is None:
+                continue
+            conn = getattr(est, "conn", None)
+            if conn is None:
+                gen = int(getattr(est.snapshot, "generation", 0))
+                if self._gen.get(name) != gen:
+                    self._drop_cluster(name)
+                    self._gen[name] = gen
+                self._confirm(name)
+                continue
+            remote_unconfirmed.append((name, est, conn))
+
+        # ---- step B: generation pings, one per server connection
+        ping_groups: dict[int, tuple] = {}
+        for name, est, conn in remote_unconfirmed:
+            if prof_keys is not None and not all(
+                (name, k) in self._memo for k in prof_keys
+            ):
+                continue
+            if conn_supports_batch(conn) is False:
+                # old server: no generations to ask for — re-pay the
+                # fan-out for this cluster (the reference's shape)
+                self._drop_cluster(name)
+                continue
+            key = id(conn)
+            if key not in ping_groups:
+                ping_groups[key] = (conn, [])
+            ping_groups[key][1].append(name)
+        if not ping_groups:
+            return False
+        from .service import GetGenerationsRequest
+
+        pool = self._ensure_pool(max_workers)
+
+        def ping(conn, members):
+            return conn.call(
+                "GetGenerations", GetGenerationsRequest(clusters=members)
+            )
+
+        futs = {}
+        for conn, members in ping_groups.values():
+            self.rpc_counts["ping"] += 1
+            futs[pool.submit(ping, conn, list(members))] = (conn, members)
+        done, not_done = _fwait(futs, timeout=remaining())
+        for f in not_done:
+            f.cancel()  # members stay unconfirmed: -1 this pass
+        for f in done:
+            conn, members = futs[f]
+            try:
+                resp = f.result()
+            except UnsupportedMethodError:
+                conn.supports_batch = False
+                for name in members:
+                    self._drop_cluster(name)  # refetch on the unary path
+                continue
+            except Exception:  # noqa: BLE001 — server unreachable:
+                # members stay unconfirmed (and answer -1) this pass;
+                # the memo survives, so a later ping that finds the
+                # generation unchanged revalidates it without a refetch
+                continue
+            for name in members:
+                gen = resp.generations.get(name)
+                if gen is not None and self._gen.get(name) == gen:
+                    self._confirm(name)
+                else:
+                    self._drop_cluster(name)  # moved (or unknown)
+        return True
+
+    def confirm_token(
+        self,
+        cluster_names: Sequence[str],
+        *,
+        max_workers: int = 64,
+        timeout_seconds: Optional[float] = None,
+    ):
+        """Prove the estimator contribution to a scheduling batch is
+        unchanged, as cheaply as the protocol allows: confirm every
+        registered cluster's snapshot generation (O(servers) pings; zero
+        wire when everything is already confirmed) and return an opaque
+        token that is EQUAL to a previous token iff no memo content
+        changed in between. Returns None when any registered cluster could
+        not be confirmed (old server, unreachable, or never fetched) — the
+        caller must run the full estimate path, which retries those
+        clusters. The scheduler's batch-identity fast path compares tokens
+        to replay a storm pass without re-solving it."""
+        import time as _time
+
+        names = list(cluster_names)
+        t0 = _time.perf_counter()
+        deadline = (
+            None if timeout_seconds is None else t0 + timeout_seconds
+        )
+
+        def remaining() -> Optional[float]:
+            if deadline is None:
+                return None
+            return max(deadline - _time.perf_counter(), 0.0)
+
+        touched = self._confirm_generations(names, None, max_workers, remaining)
+        if touched:
+            self.fanout_seconds_total += _time.perf_counter() - t0
+        if all(
+            name in self._confirmed
+            for name in names
+            if name in self._by_cluster
+        ):
+            return (self._epoch,)
+        return None
+
+    def _confirm(self, name: str) -> None:
+        import time as _time
+
+        self._confirmed[name] = _time.monotonic()
+
+    def _ensure_pool(self, max_workers: int):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(max_workers)
+        return self._pool
+
+    def _fetch(self, fetch, uniq, prof_keys, max_workers, remaining) -> None:
+        """One batch RPC per batch-capable server connection; per-CHANNEL
+        pipelined unary tasks for fallback servers; per-cluster tasks for
+        local estimators. Results merge on the calling thread: a cluster
+        that answered memoizes regardless of what happened to any other
+        cluster (per-column completeness). Only the profile columns some
+        fetched cluster is actually missing go over the wire — a pass whose
+        only novelty is one new profile ships one row, not the matrix."""
+        from concurrent.futures import wait as _fwait
+
+        from .service import UnsupportedMethodError
+
+        pool = self._ensure_pool(max_workers)
+        # an unconfirmed cluster cannot trust ANY memo entry (its
+        # generation is unknown), so it needs the full matrix; confirmed
+        # clusters only their missing columns
+        miss_idx: set = set()
+        for name, _est, _conn in fetch:
+            if name not in self._confirmed:
+                miss_idx = set(range(len(prof_keys)))
+                break
+            miss_idx.update(
+                u
+                for u, k in enumerate(prof_keys)
+                if (name, k) not in self._memo
+            )
+        order = sorted(miss_idx)
+        sub_uniq = np.asarray(uniq)[order]
+        sub_keys = [prof_keys[u] for u in order]
+        rows = [[int(v) for v in row] for row in sub_uniq]
+
+        batch_groups: dict[int, tuple] = {}  # id(conn) -> (conn, members)
+        unary_groups: dict[int, tuple] = {}  # id(conn) -> (conn, members)
+        locals_: list = []  # (name, est) — no connection (in-proc direct)
+        retry: list = []  # members re-routed after a mid-pass UNIMPLEMENTED
+
+        def route(name, est, conn):
+            if conn is not None and conn_supports_batch(conn) is not False:
+                batch_groups.setdefault(id(conn), (conn, []))[1].append(
+                    (name, est)
+                )
+            elif conn is not None and hasattr(conn, "call_future"):
+                unary_groups.setdefault(id(conn), (conn, []))[1].append(
+                    (name, est)
+                )
+            else:
+                locals_.append((name, est))
+
+        for name, est, conn in fetch:
+            route(name, est, conn)
+
+        def fetch_batch(conn, members):
+            from .service import MaxAvailableReplicasBatchRequest
+
+            dims = list(members[0][1].dims_provider())
+            return conn.call(
+                "MaxAvailableReplicasBatch",
+                MaxAvailableReplicasBatchRequest(
+                    clusters=[name for name, _ in members],
+                    dims=dims,
+                    rows=rows,
+                ),
+            )
+
+        def fetch_unary_channel(conn, members):
+            """The pipelined fallback: ONE task per server channel slides a
+            bounded window of per-profile calls over it (grpc futures) —
+            latency hides without flooding the connection's HTTP/2 stream
+            limit the way a task per cluster would."""
+            from collections import deque
+
+            from .service import MaxAvailableReplicasRequest
+
+            width = fallback_width()
+            out = {
+                name: np.full(len(rows), UNAUTHENTIC, np.int32)
+                for name, _ in members
+            }
+
+            def resolve(entry):
+                name, u, fut = entry
+                try:
+                    out[name][u] = fut.result().max_replicas
+                except Exception:  # noqa: BLE001 — per-RPC failure = -1
+                    pass
+
+            inflight: deque = deque()
+            for name, est in members:
+                dims = list(est.dims_provider())
+                for u, row in enumerate(sub_uniq):
+                    req = MaxAvailableReplicasRequest(
+                        cluster=name,
+                        resource_request={
+                            d: int(q) for d, q in zip(dims, row) if q > 0
+                        },
+                    )
+                    if len(inflight) >= width:
+                        resolve(inflight.popleft())
+                    try:
+                        inflight.append(
+                            (name, u,
+                             conn.call_future("MaxAvailableReplicas", req))
+                        )
+                    except Exception:  # noqa: BLE001 — submit failure = -1
+                        pass
+            while inflight:
+                resolve(inflight.popleft())
+            return out
+
+        def fetch_single(name, est):
+            conn = getattr(est, "conn", None)
+            if conn is not None and hasattr(est, "query_profiles"):
+                dims = list(est.dims_provider())
+                return est.query_profiles(dims, sub_uniq)
+            # local estimator: generation read BEFORE computing so a
+            # concurrent member event makes the answer look stale (see
+            # EstimatorService.max_available_replicas_batch)
+            gen = int(getattr(est.snapshot, "generation", 0))
+            return (
+                np.asarray(
+                    est.max_available_replicas(None, sub_uniq), np.int32
+                ),
+                gen,
+            )
+
+        def merge_vals(name, vals, gen) -> None:
+            if np.asarray(vals).min(initial=0) < 0:
+                # the adapter reports per-RPC wire failures as -1 rows —
+                # transient, never memoized (a pinned -1 would shadow the
+                # member until the next hard invalidation)
+                return
+            self._memoize(name, sub_keys, vals, gen)
+
+        futs = {}
+        for conn, members in batch_groups.values():
+            self.rpc_counts["batch"] += 1
+            futs[pool.submit(fetch_batch, conn, members)] = (
+                "batch", (conn, members),
+            )
+        for conn, members in unary_groups.values():
+            self.rpc_counts["unary"] += len(members) * len(rows)
+            futs[pool.submit(fetch_unary_channel, conn, members)] = (
+                "unary", (conn, members),
+            )
+        for name, est in locals_:
+            if getattr(est, "conn", None) is not None:
+                self.rpc_counts["unary"] += len(rows)
+            futs[pool.submit(fetch_single, name, est)] = ("single", name)
+        done, not_done = _fwait(futs, timeout=remaining())
+        for f in not_done:
+            # a straggler answers -1 this pass only (it stays unconfirmed
+            # and unmemoized) — per-column completeness: it cannot block
+            # the clusters that DID answer from memoizing
+            f.cancel()
+        for f in done:
+            kind, meta = futs[f]
+            try:
+                result = f.result()
+            except UnsupportedMethodError:
+                if kind == "batch":
+                    # negotiated mid-pass: pin the fallback on the
+                    # connection (the gRPC conn already did; the in-proc
+                    # seam needs it set here) and re-fan these clusters
+                    # over the unary path — once per connection lifetime
+                    conn, members = meta
+                    conn.supports_batch = False
+                    retry.append((conn, members))
+                continue
+            except Exception:  # noqa: BLE001 — wire failure = -1 this pass
+                continue
+            if kind == "batch":
+                _conn, members = meta
+                answered = {res.cluster: res for res in result.results}
+                for name, _est in members:
+                    res = answered.get(name)
+                    if res is None:
+                        continue  # unhosted: structural -1, never memoized
+                    self._memoize(
+                        name, sub_keys, res.max_replicas, res.generation
+                    )
+            elif kind == "unary":
+                for name, vals in result.items():
+                    merge_vals(name, vals, None)
+            else:
+                vals, gen = result
+                merge_vals(meta, vals, gen)
+        if retry:
+            futs = {}
+            for conn, members in retry:
+                if hasattr(conn, "call_future"):
+                    self.rpc_counts["unary"] += len(members) * len(rows)
+                    futs[pool.submit(fetch_unary_channel, conn, members)] = (
+                        "unary", (conn, members),
+                    )
+                else:
+                    for name, est in members:
+                        self.rpc_counts["unary"] += len(rows)
+                        futs[pool.submit(fetch_single, name, est)] = (
+                            "single", name,
+                        )
+            done, not_done = _fwait(futs, timeout=remaining())
+            for f in not_done:
+                f.cancel()
+            for f in done:
+                kind, meta = futs[f]
+                try:
+                    result = f.result()
+                except Exception:  # noqa: BLE001
+                    continue
+                if kind == "unary":
+                    for name, vals in result.items():
+                        merge_vals(name, vals, None)
+                else:
+                    vals, gen = result
+                    merge_vals(meta, vals, gen)
+
+    def _memoize(self, name, prof_keys, values, gen) -> None:
+        if gen is not None and self._gen.get(name) not in (None, int(gen)):
+            # the server's snapshot moved between our last fetch and this
+            # partial one: entries OUTSIDE this response are at the old
+            # generation — drop them so they re-fetch instead of serving
+            # stale values next to fresh ones
+            self._drop_cluster(name)
+        self._epoch += 1
+        for key, val in zip(prof_keys, values):
+            self._memo[(name, key)] = int(val)
+        if gen is not None:
+            self._gen[name] = int(gen)
+        else:
+            # fallback server: no generation protocol — entries stay valid
+            # until the next invalidate() epoch, then re-fetch (the
+            # reference's full-refresh shape)
+            self._gen.pop(name, None)
+        self._confirm(name)

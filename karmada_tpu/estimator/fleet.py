@@ -76,6 +76,11 @@ def spawn_estimator_fleet(
 
     fleet = EstimatorFleet(registry=EstimatorRegistry())
     try:
+        if index is None:
+            # one name->row map up front: names.index(name) inside the
+            # spec comprehension is O(n) per lookup — an O(n^2 x dims)
+            # spec build at 512+ clusters
+            index = {name: i for i, name in enumerate(names)}
         shard = (len(names) + n_servers - 1) // n_servers
         for s in range(n_servers):
             names_s = names[s * shard:(s + 1) * shard]
@@ -83,12 +88,7 @@ def spawn_estimator_fleet(
                 continue
             spec = {
                 name: {
-                    d: int(
-                        free_caps[
-                            index[name] if index is not None
-                            else names.index(name)
-                        ][r]
-                    )
+                    d: int(free_caps[index[name]][r])
                     for r, d in enumerate(dims)
                 }
                 for name in names_s

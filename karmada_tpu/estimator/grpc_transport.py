@@ -21,13 +21,20 @@ from typing import Optional
 
 import grpc
 
+from .proto import estimator_batch_pb2 as bpb
 from .proto import estimator_pb2 as pb
 from .service import (
+    ClusterBatchResult,
     EstimatorService,
+    GetGenerationsRequest,
+    GetGenerationsResponse,
+    MaxAvailableReplicasBatchRequest,
+    MaxAvailableReplicasBatchResponse,
     MaxAvailableReplicasRequest,
     MaxAvailableReplicasResponse,
     UnschedulableReplicasRequest,
     UnschedulableReplicasResponse,
+    UnsupportedMethodError,
 )
 
 SERVICE_NAME = "karmada_tpu.estimator.Estimator"
@@ -98,6 +105,81 @@ def _pb_to_unsched(msg: pb.UnschedulableReplicasRequest) -> UnschedulableReplica
     )
 
 
+def _batch_to_pb(
+    req: MaxAvailableReplicasBatchRequest,
+) -> "bpb.MaxAvailableReplicasBatchRequest":
+    msg = bpb.MaxAvailableReplicasBatchRequest(
+        clusters=list(req.clusters), dims=list(req.dims)
+    )
+    for row in req.rows:
+        msg.rows.add().values.extend(int(v) for v in row)
+    return msg
+
+
+def _pb_to_batch(
+    msg: "bpb.MaxAvailableReplicasBatchRequest",
+) -> MaxAvailableReplicasBatchRequest:
+    return MaxAvailableReplicasBatchRequest(
+        clusters=list(msg.clusters),
+        dims=list(msg.dims),
+        rows=[list(row.values) for row in msg.rows],
+    )
+
+
+def _batch_resp_to_pb(
+    resp: MaxAvailableReplicasBatchResponse,
+) -> "bpb.MaxAvailableReplicasBatchResponse":
+    msg = bpb.MaxAvailableReplicasBatchResponse()
+    for res in resp.results:
+        out = msg.results.add()
+        out.cluster = res.cluster
+        out.max_replicas.extend(int(v) for v in res.max_replicas)
+        out.generation = int(res.generation)
+    return msg
+
+
+def _pb_to_batch_resp(
+    msg: "bpb.MaxAvailableReplicasBatchResponse",
+) -> MaxAvailableReplicasBatchResponse:
+    return MaxAvailableReplicasBatchResponse(
+        results=[
+            ClusterBatchResult(
+                cluster=res.cluster,
+                max_replicas=list(res.max_replicas),
+                generation=res.generation,
+            )
+            for res in msg.results
+        ]
+    )
+
+
+def _gens_to_pb(req: GetGenerationsRequest) -> "bpb.GetGenerationsRequest":
+    return bpb.GetGenerationsRequest(clusters=list(req.clusters))
+
+
+def _pb_to_gens(msg: "bpb.GetGenerationsRequest") -> GetGenerationsRequest:
+    return GetGenerationsRequest(clusters=list(msg.clusters))
+
+
+def _gens_resp_to_pb(
+    resp: GetGenerationsResponse,
+) -> "bpb.GetGenerationsResponse":
+    msg = bpb.GetGenerationsResponse()
+    for cluster, gen in resp.generations.items():
+        entry = msg.generations.add()
+        entry.cluster = cluster
+        entry.generation = int(gen)
+    return msg
+
+
+def _pb_to_gens_resp(
+    msg: "bpb.GetGenerationsResponse",
+) -> GetGenerationsResponse:
+    return GetGenerationsResponse(
+        generations={e.cluster: e.generation for e in msg.generations}
+    )
+
+
 class EstimatorGrpcServer:
     """Serves one cluster's ``EstimatorService`` over gRPC, optionally mTLS
     (ref: server/server.go:171-173; grpcconnection/config.go ServerConfig)."""
@@ -111,6 +193,7 @@ class EstimatorGrpcServer:
         server_key: Optional[bytes] = None,
         client_ca: Optional[bytes] = None,
         max_workers: int = 8,
+        enable_batch: bool = True,
     ):
         self._service = service
         # SO_REUSEPORT off: a port conflict must surface at bind time, not
@@ -130,6 +213,19 @@ class EstimatorGrpcServer:
                 unschedulable_replicas=resp.unschedulable_replicas
             )
 
+        def max_available_batch(
+            request: "bpb.MaxAvailableReplicasBatchRequest", context
+        ):
+            resp = self._service.max_available_replicas_batch(
+                _pb_to_batch(request)
+            )
+            return _batch_resp_to_pb(resp)
+
+        def get_generations(request: "bpb.GetGenerationsRequest", context):
+            return _gens_resp_to_pb(
+                self._service.get_generations(_pb_to_gens(request))
+            )
+
         handlers = {
             "MaxAvailableReplicas": grpc.unary_unary_rpc_method_handler(
                 max_available,
@@ -142,6 +238,29 @@ class EstimatorGrpcServer:
                 response_serializer=pb.UnschedulableReplicasResponse.SerializeToString,
             ),
         }
+        # the batched protocol + generation pings ship together; a service
+        # object without the methods (or enable_batch=False — the old-server
+        # shape, used by the mixed-version tests) leaves them unregistered
+        # so clients get UNIMPLEMENTED and negotiate the unary fallback
+        if enable_batch and hasattr(service, "max_available_replicas_batch"):
+            handlers["MaxAvailableReplicasBatch"] = (
+                grpc.unary_unary_rpc_method_handler(
+                    max_available_batch,
+                    request_deserializer=(
+                        bpb.MaxAvailableReplicasBatchRequest.FromString
+                    ),
+                    response_serializer=(
+                        bpb.MaxAvailableReplicasBatchResponse.SerializeToString
+                    ),
+                )
+            )
+            handlers["GetGenerations"] = grpc.unary_unary_rpc_method_handler(
+                get_generations,
+                request_deserializer=bpb.GetGenerationsRequest.FromString,
+                response_serializer=(
+                    bpb.GetGenerationsResponse.SerializeToString
+                ),
+            )
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
         )
@@ -216,6 +335,32 @@ class GrpcEstimatorConnection:
             request_serializer=pb.UnschedulableReplicasRequest.SerializeToString,
             response_deserializer=pb.UnschedulableReplicasResponse.FromString,
         )
+        self._batch = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/MaxAvailableReplicasBatch",
+            request_serializer=(
+                bpb.MaxAvailableReplicasBatchRequest.SerializeToString
+            ),
+            response_deserializer=(
+                bpb.MaxAvailableReplicasBatchResponse.FromString
+            ),
+        )
+        self._generations = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/GetGenerations",
+            request_serializer=bpb.GetGenerationsRequest.SerializeToString,
+            response_deserializer=bpb.GetGenerationsResponse.FromString,
+        )
+        # batched-protocol negotiation: None until the first batch/ping
+        # call, then pinned for this connection's lifetime — an evicted
+        # connection is rebuilt from the resolver, so a server upgrade is
+        # picked up on reconnect (re-probe on reconnect)
+        self.supports_batch: Optional[bool] = None
+
+    def _unimplemented(self, method: str, exc) -> UnsupportedMethodError:
+        # UNIMPLEMENTED = an old server build without the batched protocol:
+        # remember the negotiation on THIS connection and let the caller
+        # fall back to per-profile unary (any other failure propagates)
+        self.supports_batch = False
+        return UnsupportedMethodError(method)
 
     def call(self, method: str, request):
         if method == "MaxAvailableReplicas":
@@ -226,7 +371,38 @@ class GrpcEstimatorConnection:
             return UnschedulableReplicasResponse(
                 unschedulable_replicas=resp.unschedulable_replicas
             )
+        if method == "MaxAvailableReplicasBatch":
+            try:
+                resp = self._batch(_batch_to_pb(request), timeout=self.timeout)
+            except grpc.RpcError as exc:
+                if exc.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    raise self._unimplemented(method, exc) from exc
+                raise
+            self.supports_batch = True
+            return _pb_to_batch_resp(resp)
+        if method == "GetGenerations":
+            try:
+                resp = self._generations(
+                    _gens_to_pb(request), timeout=self.timeout
+                )
+            except grpc.RpcError as exc:
+                if exc.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    raise self._unimplemented(method, exc) from exc
+                raise
+            self.supports_batch = True
+            return _pb_to_gens_resp(resp)
         raise ValueError(f"unknown method {method}")
+
+    def call_future(self, method: str, request):
+        """Pipelined seam for the unary fallback: returns a grpc future so
+        a client can keep N per-profile calls in flight on one channel
+        instead of blocking sequentially. Resolve with ``future.result()``;
+        the response is the raw pb message (use ``.max_replicas``)."""
+        if method == "MaxAvailableReplicas":
+            return self._max_available.future(
+                _req_to_pb(request), timeout=self.timeout
+            )
+        raise ValueError(f"no future seam for method {method}")
 
     def close(self) -> None:
         self._channel.close()
@@ -246,9 +422,12 @@ class RemoteAccurateEstimator:
     (per-member deployment; ref client/accurate.go SchedulerEstimator).
 
     ``max_available_replicas`` interns the request batch to its unique
-    profiles and issues one MaxAvailableReplicas RPC per profile — the
-    reference queries per binding; batching per profile is the same answer
-    at orders fewer round-trips. Unreachable estimators answer -1
+    profiles and issues ONE MaxAvailableReplicasBatch RPC carrying the
+    whole matrix — the reference queries per binding; one batched call is
+    the same answer at orders fewer round-trips. Old servers answer
+    UNIMPLEMENTED and the connection negotiates the per-profile unary
+    fallback, PIPELINED over the channel (``call_future``) instead of
+    blocking sequentially. Unreachable estimators answer -1
     (UnauthenticReplica, client/interface.go:30) so the min-merge ignores
     them instead of blocking scheduling."""
 
@@ -260,6 +439,79 @@ class RemoteAccurateEstimator:
         self.dims_provider = dims_provider  # () -> list[str] snapshot dims
         self.unschedulable: dict[str, int] = {}
         self._np = _np
+
+    def query_profiles(self, dims, uniq):
+        """int32[U] answers for unique profile rows over ``dims``, plus the
+        server's snapshot generation (None when the fallback path answered
+        — old servers have no generation to report)."""
+        from .accurate import UNAUTHENTIC, conn_supports_batch
+
+        np_ = self._np
+        if conn_supports_batch(self.conn) is not False:
+            try:
+                resp = self.conn.call(
+                    "MaxAvailableReplicasBatch",
+                    MaxAvailableReplicasBatchRequest(
+                        clusters=[self.cluster_name],
+                        dims=list(dims),
+                        rows=[[int(v) for v in row] for row in uniq],
+                    ),
+                )
+                for res in resp.results:
+                    if res.cluster == self.cluster_name:
+                        return (
+                            np_.asarray(res.max_replicas, np_.int32),
+                            int(res.generation),
+                        )
+                # server answered but does not host this cluster
+                return np_.full(len(uniq), UNAUTHENTIC, np_.int32), None
+            except UnsupportedMethodError:
+                pass  # negotiated on the conn: fall through to unary
+            except Exception:  # noqa: BLE001 — wire failure = no answer
+                return np_.full(len(uniq), UNAUTHENTIC, np_.int32), None
+        return self._query_profiles_unary(dims, uniq), None
+
+    def _query_profiles_unary(self, dims, uniq):
+        """Per-profile unary fallback, pipelined: keep up to
+        ``fallback_width()`` calls in flight on the channel. In-proc
+        connections (no ``call_future`` seam) just loop — there is no wire
+        latency to hide."""
+        from .accurate import UNAUTHENTIC, fallback_width
+
+        np_ = self._np
+        out = np_.empty(len(uniq), np_.int32)
+        reqs = [
+            MaxAvailableReplicasRequest(
+                cluster=self.cluster_name,
+                resource_request={
+                    d: int(q) for d, q in zip(dims, row) if q > 0
+                },
+            )
+            for row in uniq
+        ]
+        submit = getattr(self.conn, "call_future", None)
+        if submit is None:
+            for u, req in enumerate(reqs):
+                try:
+                    resp = self.conn.call("MaxAvailableReplicas", req)
+                    out[u] = resp.max_replicas
+                except Exception:  # noqa: BLE001
+                    out[u] = UNAUTHENTIC
+            return out
+        width = fallback_width()
+        for start in range(0, len(reqs), width):
+            window = []
+            for u in range(start, min(start + width, len(reqs))):
+                try:
+                    window.append((u, submit("MaxAvailableReplicas", reqs[u])))
+                except Exception:  # noqa: BLE001 — submit failure = -1
+                    out[u] = UNAUTHENTIC
+            for u, fut in window:
+                try:
+                    out[u] = fut.result().max_replicas
+                except Exception:  # noqa: BLE001
+                    out[u] = UNAUTHENTIC
+        return out
 
     def max_available_replicas(self, requirements, requests_batch=None):
         np_ = self._np
@@ -278,19 +530,7 @@ class RemoteAccurateEstimator:
         dims = list(self.dims_provider())
         batch = np_.asarray(requests_batch, np_.int64)
         uniq, inv = np_.unique(batch, axis=0, return_inverse=True)
-        per_prof = np_.empty(len(uniq), np_.int32)
-        for u, row in enumerate(uniq):
-            req = {d: int(q) for d, q in zip(dims, row) if q > 0}
-            try:
-                resp = self.conn.call(
-                    "MaxAvailableReplicas",
-                    MaxAvailableReplicasRequest(
-                        cluster=self.cluster_name, resource_request=req
-                    ),
-                )
-                per_prof[u] = resp.max_replicas
-            except Exception:  # noqa: BLE001
-                per_prof[u] = -1
+        per_prof, _gen = self.query_profiles(dims, uniq)
         return per_prof[inv]
 
     def get_unschedulable_replicas(self, namespace: str, name: str) -> int:

@@ -1,1 +1,1 @@
-from . import estimator_pb2  # noqa: F401
+from . import estimator_batch_pb2, estimator_pb2  # noqa: F401

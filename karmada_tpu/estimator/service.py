@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from dataclasses import asdict, dataclass, field
 from typing import Callable, Optional
 
@@ -63,6 +62,49 @@ class UnschedulableReplicasResponse:
     unschedulable_replicas: int = 0
 
 
+# -- batched protocol + generation pings (estimator_batch.proto) ------------
+
+
+@dataclass
+class MaxAvailableReplicasBatchRequest:
+    """One RPC per SERVER per pass: the whole unique-profile matrix for
+    every cluster the server hosts (empty ``clusters`` = all hosted).
+    ``rows`` are positional over ``dims``; the server projects them onto
+    its own dim order by name."""
+
+    clusters: list[str] = field(default_factory=list)
+    dims: list[str] = field(default_factory=list)
+    rows: list = field(default_factory=list)  # U x len(dims) ints
+
+
+@dataclass
+class ClusterBatchResult:
+    cluster: str = ""
+    max_replicas: list[int] = field(default_factory=list)  # one per row
+    generation: int = 0  # snapshot generation the answers were computed at
+
+
+@dataclass
+class MaxAvailableReplicasBatchResponse:
+    results: list[ClusterBatchResult] = field(default_factory=list)
+
+
+@dataclass
+class GetGenerationsRequest:
+    clusters: list[str] = field(default_factory=list)  # empty = all hosted
+
+
+@dataclass
+class GetGenerationsResponse:
+    generations: dict[str, int] = field(default_factory=dict)
+
+
+class UnsupportedMethodError(RuntimeError):
+    """The server does not speak this method (an old estimator build):
+    gRPC UNIMPLEMENTED translated at the transport seam so in-proc and
+    wire connections negotiate the fallback identically."""
+
+
 class EstimatorService:
     """Server side: wraps one cluster's AccurateEstimator behind the service
     contract (ref: server/server.go:194-225)."""
@@ -100,6 +142,56 @@ class EstimatorService:
             unschedulable_replicas=self.estimator.get_unschedulable_replicas(key)
         )
 
+    def generation(self) -> int:
+        """Monotonic snapshot generation: NodeCache bumps it on every
+        upsert_node/add_pod/remove_* event; a static NodeSnapshot pins it
+        (no events means the estimate can never go stale)."""
+        return int(getattr(self.estimator.snapshot, "generation", 0))
+
+    def max_available_replicas_batch(
+        self, req: MaxAvailableReplicasBatchRequest
+    ) -> MaxAvailableReplicasBatchResponse:
+        """Answer the whole unique-profile matrix from ONE vectorized
+        estimator call — the [B, N] kernel the unary wire path throws away.
+        The generation is read BEFORE computing: a member event landing
+        mid-computation must make the answer look stale (re-queried next
+        pass), never fresh."""
+        name = self.estimator.cluster_name
+        if req.clusters and name not in req.clusters:
+            return MaxAvailableReplicasBatchResponse()
+        gen = self.generation()
+        dims = self.estimator.snapshot.dims
+        u = len(req.rows)
+        mat = np.zeros((u, len(dims)), np.int64)
+        # project caller dims onto ours by name: unknown caller dims drop,
+        # our dims absent from the caller's list read 0 — exactly the unary
+        # path's resource_request.get(d, 0)
+        for j_src, d in enumerate(req.dims):
+            if d in dims:
+                mat[:, dims.index(d)] = [row[j_src] for row in req.rows]
+        out = (
+            self.estimator.max_available_replicas(None, mat)
+            if u
+            else np.zeros(0, np.int32)
+        )
+        return MaxAvailableReplicasBatchResponse(
+            results=[
+                ClusterBatchResult(
+                    cluster=name,
+                    max_replicas=[int(v) for v in out],
+                    generation=gen,
+                )
+            ]
+        )
+
+    def get_generations(
+        self, req: GetGenerationsRequest
+    ) -> GetGenerationsResponse:
+        name = self.estimator.cluster_name
+        if req.clusters and name not in req.clusters:
+            return GetGenerationsResponse()
+        return GetGenerationsResponse(generations={name: self.generation()})
+
 
 class MultiClusterEstimatorService:
     """One server PROCESS hosting many clusters' estimators, routed by
@@ -127,6 +219,37 @@ class MultiClusterEstimatorService:
             raise KeyError(f"no estimator for cluster {req.cluster!r}")
         return svc.get_unschedulable_replicas(req)
 
+    def max_available_replicas_batch(
+        self, req: MaxAvailableReplicasBatchRequest
+    ) -> MaxAvailableReplicasBatchResponse:
+        """One RPC answers every hosted cluster's unique-profile vector —
+        the O(servers) pass shape. A requested-but-unhosted cluster is
+        simply absent from the response (the caller answers
+        UnauthenticReplica for it, matching the unary path's KeyError)."""
+        wanted = req.clusters or sorted(self._services)
+        results: list[ClusterBatchResult] = []
+        for name in wanted:
+            svc = self._services.get(name)
+            if svc is None:
+                continue
+            sub = MaxAvailableReplicasBatchRequest(
+                clusters=[name], dims=req.dims, rows=req.rows
+            )
+            results.extend(svc.max_available_replicas_batch(sub).results)
+        return MaxAvailableReplicasBatchResponse(results=results)
+
+    def get_generations(
+        self, req: GetGenerationsRequest
+    ) -> GetGenerationsResponse:
+        wanted = req.clusters or sorted(self._services)
+        return GetGenerationsResponse(
+            generations={
+                name: self._services[name].generation()
+                for name in wanted
+                if name in self._services
+            }
+        )
+
 
 class EstimatorConnection:
     """One cluster's channel. ``call`` is the transport seam."""
@@ -140,6 +263,18 @@ class EstimatorConnection:
             return self._service.max_available_replicas(request)
         if method == "GetUnschedulableReplicas":
             return self._service.get_unschedulable_replicas(request)
+        if method == "MaxAvailableReplicasBatch":
+            handler = getattr(
+                self._service, "max_available_replicas_batch", None
+            )
+            if handler is None:  # an old service build: negotiate fallback
+                raise UnsupportedMethodError(method)
+            return handler(request)
+        if method == "GetGenerations":
+            handler = getattr(self._service, "get_generations", None)
+            if handler is None:
+                raise UnsupportedMethodError(method)
+            return handler(request)
         raise ValueError(f"unknown method {method}")
 
 
@@ -162,11 +297,21 @@ class EstimatorClientPool:
         self,
         resolver: Callable[[str], Optional[EstimatorService]],
         timeout_seconds: float = 3.0,
+        max_workers: int = 32,
     ):
         self.resolver = resolver
         self.timeout = timeout_seconds
         self._conns: dict[str, EstimatorConnection] = {}
         self._lock = threading.Lock()
+        # bounded shared executor for the fan-out: a raw Thread per cluster
+        # per query (the previous shape) costs a ~8 MiB stack + spawn each
+        # at thousands of members; the executor spawns lazily up to the
+        # bound and reuses threads across passes
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._executor = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="estimator-fanout"
+        )
 
     def connection(self, cluster: str) -> Optional[EstimatorConnection]:
         with self._lock:
@@ -205,9 +350,9 @@ class EstimatorClientPool:
         """Concurrent fan-out with one shared deadline
         (client/accurate.go:139-162). Clusters without a connection answer
         UnauthenticReplica (-1)."""
+        from concurrent.futures import wait as _fwait
+
         results: dict[str, int] = {c: UNAUTHENTIC for c in clusters}
-        deadline = time.time() + self.timeout
-        threads = []
 
         def one(cluster: str) -> None:
             conn = self.connection(cluster)
@@ -240,12 +385,10 @@ class EstimatorClientPool:
                 return
             results[cluster] = resp.max_replicas
 
-        for c in clusters:
-            t = threading.Thread(target=one, args=(c,), daemon=True)
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join(max(deadline - time.time(), 0.0))
-        # snapshot: stragglers past the deadline keep writing to ``results``;
-        # the caller's view must be frozen at the deadline
+        futs = [self._executor.submit(one, c) for c in clusters]
+        # one shared deadline for the whole fan-out; stragglers keep running
+        # on the executor (their conn.call carries its own timeout, so they
+        # drain) and keep writing to ``results`` — the caller's view must be
+        # frozen at the deadline, hence the snapshot
+        _fwait(futs, timeout=self.timeout)
         return dict(results)

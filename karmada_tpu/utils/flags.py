@@ -88,6 +88,29 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "helpers and always use the numpy fallback path.",
         ),
         EnvFlag(
+            "KARMADA_TPU_ESTIMATOR_BATCH", "1",
+            "Batched estimator wire protocol (estimator.accurate): set to "
+            "0 to force every connection onto the per-profile unary "
+            "fallback — the mixed-version escape hatch; servers that "
+            "answer UNIMPLEMENTED negotiate the fallback per connection "
+            "automatically.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_ESTIMATOR_PING_SECONDS", "0",
+            "Seconds a cluster's snapshot-generation confirmation stays "
+            "trusted across EstimatorRegistry.invalidate(); 0 re-pings "
+            "the estimator servers (one GetGenerations per server) on "
+            "every invalidated pass.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_ESTIMATOR_FALLBACK_WIDTH", "4",
+            "In-flight MaxAvailableReplicas calls per server CHANNEL when "
+            "the unary fallback is negotiated: the per-profile queries "
+            "pipeline over each channel via grpc futures (bounded, so the "
+            "HTTP/2 stream limit is never flooded) instead of blocking "
+            "sequentially per cluster. 1 disables pipelining.",
+        ),
+        EnvFlag(
             "KARMADA_TPU_DRYRUN_REAL_DEVICES", "0",
             "Multichip dryrun escape hatch (__graft_entry__): set to 1 to "
             "run on the default backend's real devices instead of forcing "

@@ -1,0 +1,514 @@
+"""Batched estimator wire protocol + generation-gated delta refresh.
+
+The reference fans out one MaxAvailableReplicas RPC per (cluster, query)
+under a shared deadline (client/accurate.go:139-162); the batched protocol
+collapses a scheduling pass to one MaxAvailableReplicasBatch per SERVER and
+gates refreshes on each cluster's snapshot generation (GetGenerations), so
+a no-movement refresh never re-pays the profile fan-out. Old servers
+(UNIMPLEMENTED) negotiate the per-profile unary fallback per connection —
+pipelined, with placements byte-identical to the batch path.
+
+Servers here are real gRPC servers (EstimatorGrpcServer) hosted in-process
+so the tests can mutate the member NodeCaches directly and watch the
+generation gate react.
+"""
+
+import numpy as np
+import pytest
+
+from karmada_tpu.estimator.accurate import (
+    AccurateEstimator,
+    EstimatorRegistry,
+    NodeCache,
+    NodeState,
+)
+from karmada_tpu.estimator.grpc_transport import (
+    EstimatorGrpcServer,
+    GrpcEstimatorConnection,
+    RemoteAccurateEstimator,
+)
+from karmada_tpu.estimator.service import (
+    EstimatorService,
+    GetGenerationsRequest,
+    MaxAvailableReplicasBatchRequest,
+    MaxAvailableReplicasRequest,
+    MultiClusterEstimatorService,
+    UnsupportedMethodError,
+)
+
+DIMS = ["cpu", "memory", "pods"]
+
+
+def make_member_caches(names, cpu_step=4000):
+    return {
+        name: NodeCache(
+            DIMS,
+            [
+                NodeState(
+                    name=f"{name}-n0",
+                    allocatable={
+                        "cpu": cpu_step * (i + 1),
+                        "memory": 1 << 32,
+                        "pods": 110,
+                    },
+                )
+            ],
+        )
+        for i, name in enumerate(names)
+    }
+
+
+@pytest.fixture()
+def wired_fleet():
+    """Two real gRPC server processes' worth of clusters, hosted in-proc:
+    server 1 hosts a+b, server 2 hosts c+d. Yields (caches, conns,
+    registry, names)."""
+    names = ["a", "b", "c", "d"]
+    caches = make_member_caches(names)
+    services = {
+        n: EstimatorService(AccurateEstimator(n, caches[n])) for n in names
+    }
+    servers, conns = [], []
+    registry = EstimatorRegistry()
+    try:
+        for hosted in (names[:2], names[2:]):
+            srv = EstimatorGrpcServer(
+                MultiClusterEstimatorService(
+                    {n: services[n] for n in hosted}
+                )
+            )
+            port = srv.start()
+            servers.append(srv)
+            conn = GrpcEstimatorConnection(
+                "multi", f"127.0.0.1:{port}", timeout_seconds=5.0
+            )
+            conns.append(conn)
+            for n in hosted:
+                registry.register(
+                    RemoteAccurateEstimator(n, conn, lambda: list(DIMS))
+                )
+        yield caches, conns, registry, names
+    finally:
+        for conn in conns:
+            conn.close()
+        for srv in servers:
+            srv.stop()
+
+
+def reqs_matrix(cpus):
+    out = np.zeros((len(cpus), len(DIMS)), np.int64)
+    out[:, 0] = cpus
+    return out
+
+
+class TestBatchWire:
+    def test_batch_rpc_matches_unary(self, wired_fleet):
+        """One batch RPC answers every hosted cluster; values equal the
+        per-profile unary protocol's bit for bit."""
+        caches, conns, registry, names = wired_fleet
+        conn = conns[0]
+        rows = [[1000, 0, 0], [2500, 0, 0], [500, 1 << 30, 0]]
+        resp = conn.call(
+            "MaxAvailableReplicasBatch",
+            MaxAvailableReplicasBatchRequest(
+                clusters=[], dims=DIMS, rows=rows
+            ),
+        )
+        got = {r.cluster: list(r.max_replicas) for r in resp.results}
+        assert sorted(got) == ["a", "b"]
+        for cluster, vec in got.items():
+            for row, expect in zip(rows, vec):
+                unary = conn.call(
+                    "MaxAvailableReplicas",
+                    MaxAvailableReplicasRequest(
+                        cluster=cluster,
+                        resource_request={
+                            d: int(v) for d, v in zip(DIMS, row) if v
+                        },
+                    ),
+                )
+                assert unary.max_replicas == expect
+        assert conn.supports_batch is True
+
+    def test_generations_ping(self, wired_fleet):
+        caches, conns, registry, names = wired_fleet
+        resp = conns[1].call("GetGenerations", GetGenerationsRequest())
+        assert sorted(resp.generations) == ["c", "d"]
+        g0 = resp.generations["c"]
+        caches["c"].add_pod("c-n0", {"cpu": 100})
+        resp = conns[1].call(
+            "GetGenerations", GetGenerationsRequest(clusters=["c"])
+        )
+        assert resp.generations == {"c": g0 + 1}
+
+    def test_registry_one_rpc_per_server_and_delta_refresh(
+        self, wired_fleet
+    ):
+        """The steady-pass RPC shape the bench asserts: first pass = one
+        batch per server; a no-movement refresh = one ping per server and
+        NO profile fan-out; movement re-queries exactly the changed
+        clusters."""
+        caches, conns, registry, names = wired_fleet
+        est = registry.make_batch_estimator(names, timeout_seconds=5.0)
+        reqs = reqs_matrix([1000, 2000, 500])
+        reps = np.asarray([5, 5, 5])
+
+        out = est(reqs, reps)
+        assert dict(registry.rpc_counts) == {"batch": 2, "unary": 0, "ping": 0}
+        assert (out >= 0).all()
+
+        # steady repeat: pure memo, zero wire traffic
+        out2 = est(reqs, reps)
+        assert dict(registry.rpc_counts) == {"batch": 2, "unary": 0, "ping": 0}
+        assert (out2 == out).all()
+
+        # no-movement refresh: one ping per server, memo survives
+        registry.invalidate()
+        out3 = est(reqs, reps)
+        assert dict(registry.rpc_counts) == {"batch": 2, "unary": 0, "ping": 2}
+        assert (out3 == out).all()
+
+        # one member moves: its server re-queried (ping + batch), the
+        # other server answers from its pinged-valid memo
+        caches["b"].add_pod("b-n0", {"cpu": 1000})
+        registry.invalidate()
+        out4 = est(reqs, reps)
+        assert dict(registry.rpc_counts) == {"batch": 3, "unary": 0, "ping": 4}
+        b_col = names.index("b")
+        assert out4[0, b_col] == out[0, b_col] - 1  # 1000m less free cpu
+        others = [i for i in range(len(names)) if i != b_col]
+        assert (out4[:, others] == out[:, others]).all()
+
+    def test_hard_invalidate_refans_everything(self, wired_fleet):
+        caches, conns, registry, names = wired_fleet
+        est = registry.make_batch_estimator(names, timeout_seconds=5.0)
+        reqs = reqs_matrix([1000])
+        est(reqs, np.asarray([5]))
+        registry.invalidate(drop=True)
+        est(reqs, np.asarray([5]))
+        assert registry.rpc_counts["batch"] == 4  # 2 servers x 2 full passes
+        assert registry.rpc_counts["ping"] == 0
+
+
+class TestMixedVersionFallback:
+    @pytest.fixture()
+    def old_and_new(self):
+        """The same member state behind a batch-capable server AND an old
+        server with the batch handler deliberately unregistered."""
+        names = ["a", "b", "c"]
+        caches = make_member_caches(names)
+        services = {
+            n: EstimatorService(AccurateEstimator(n, caches[n]))
+            for n in names
+        }
+        new_srv = EstimatorGrpcServer(MultiClusterEstimatorService(services))
+        old_srv = EstimatorGrpcServer(
+            MultiClusterEstimatorService(services), enable_batch=False
+        )
+        try:
+            yield names, new_srv.start(), old_srv.start()
+        finally:
+            new_srv.stop()
+            old_srv.stop()
+
+    def _registry(self, names, port):
+        registry = EstimatorRegistry()
+        conn = GrpcEstimatorConnection(
+            "multi", f"127.0.0.1:{port}", timeout_seconds=5.0
+        )
+        for n in names:
+            registry.register(
+                RemoteAccurateEstimator(n, conn, lambda: list(DIMS))
+            )
+        return registry, conn
+
+    def test_fallback_negotiation_and_parity(self, old_and_new):
+        names, new_port, old_port = old_and_new
+        reqs = reqs_matrix([1000, 2500, 700])
+        reps = np.asarray([9, 9, 9])
+
+        reg_new, conn_new = self._registry(names, new_port)
+        reg_old, conn_old = self._registry(names, old_port)
+        try:
+            batch_out = reg_new.make_batch_estimator(
+                names, timeout_seconds=5.0
+            )(reqs, reps)
+            fallback_out = reg_old.make_batch_estimator(
+                names, timeout_seconds=5.0
+            )(reqs, reps)
+            # byte-identical placably: the min-merge sees the same matrix
+            assert (batch_out == fallback_out).all()
+            assert batch_out.dtype == fallback_out.dtype
+            assert conn_old.supports_batch is False
+            assert conn_new.supports_batch is True
+            # the fallback actually fanned out per profile
+            assert reg_old.rpc_counts["unary"] == 3 * len(names)
+            # old servers cannot delta-gate: an invalidated pass re-pays
+            # the unary fan-out (no ping protocol to ask)
+            reg_old.invalidate()
+            fallback_out2 = reg_old.make_batch_estimator(
+                names, timeout_seconds=5.0
+            )(reqs, reps)
+            assert (fallback_out2 == fallback_out).all()
+            assert reg_old.rpc_counts["unary"] == 2 * 3 * len(names)
+            assert reg_old.rpc_counts["ping"] == 0
+        finally:
+            conn_new.close()
+            conn_old.close()
+
+    def test_unsupported_method_error_over_wire(self, old_and_new):
+        names, _new_port, old_port = old_and_new
+        conn = GrpcEstimatorConnection(
+            "multi", f"127.0.0.1:{old_port}", timeout_seconds=5.0
+        )
+        try:
+            with pytest.raises(UnsupportedMethodError):
+                conn.call(
+                    "MaxAvailableReplicasBatch",
+                    MaxAvailableReplicasBatchRequest(
+                        clusters=[], dims=DIMS, rows=[[1000, 0, 0]]
+                    ),
+                )
+            assert conn.supports_batch is False
+        finally:
+            conn.close()
+
+    def test_reprobe_after_reconnect(self, old_and_new):
+        """Negotiation is per CONNECTION: after an evict/reconnect lands on
+        an upgraded server, the fresh connection probes batch again."""
+        names, new_port, old_port = old_and_new
+        reqs = reqs_matrix([1000])
+        reps = np.asarray([5])
+
+        registry, conn_old = self._registry(names, old_port)
+        try:
+            est = registry.make_batch_estimator(names, timeout_seconds=5.0)
+            est(reqs, reps)
+            assert conn_old.supports_batch is False
+            assert registry.rpc_counts["batch"] == 1  # the probe
+            # reconnect: the server was upgraded (same members, batch on)
+            conn_new = GrpcEstimatorConnection(
+                "multi", f"127.0.0.1:{new_port}", timeout_seconds=5.0
+            )
+            for n in names:
+                registry.register(
+                    RemoteAccurateEstimator(n, conn_new, lambda: list(DIMS))
+                )
+            try:
+                est(reqs, reps)
+                assert conn_new.supports_batch is True
+                assert registry.rpc_counts["batch"] == 2
+                # and the batch path serves refreshes from generations now
+                registry.invalidate()
+                est(reqs, reps)
+                assert registry.rpc_counts["ping"] == 1
+                assert registry.rpc_counts["batch"] == 2
+            finally:
+                conn_new.close()
+        finally:
+            conn_old.close()
+
+    def test_env_kill_switch_forces_unary(self, old_and_new, monkeypatch):
+        names, new_port, _old_port = old_and_new
+        monkeypatch.setenv("KARMADA_TPU_ESTIMATOR_BATCH", "0")
+        registry, conn = self._registry(names, new_port)
+        try:
+            est = registry.make_batch_estimator(names, timeout_seconds=5.0)
+            out = est(reqs_matrix([1000, 2000]), np.asarray([5, 5]))
+            assert (out >= 0).all()
+            assert registry.rpc_counts["batch"] == 0
+            assert registry.rpc_counts["unary"] == 2 * len(names)
+        finally:
+            conn.close()
+
+
+class TestPerColumnCompleteness:
+    def test_straggler_does_not_block_healthy_memoization(self):
+        """One dead server must not force the healthy clusters to re-pay
+        the fan-out next pass (the old whole-matrix `complete` gate did)."""
+        names = ["live1", "live2", "dead"]
+        caches = make_member_caches(names[:2])
+        services = {
+            n: EstimatorService(AccurateEstimator(n, caches[n]))
+            for n in names[:2]
+        }
+        srv = EstimatorGrpcServer(MultiClusterEstimatorService(services))
+        port = srv.start()
+        conn = GrpcEstimatorConnection(
+            "multi", f"127.0.0.1:{port}", timeout_seconds=5.0
+        )
+        dead_conn = GrpcEstimatorConnection(
+            "dead", "127.0.0.1:1", timeout_seconds=0.5
+        )
+        registry = EstimatorRegistry()
+        try:
+            for n in names[:2]:
+                registry.register(
+                    RemoteAccurateEstimator(n, conn, lambda: list(DIMS))
+                )
+            registry.register(
+                RemoteAccurateEstimator("dead", dead_conn, lambda: list(DIMS))
+            )
+            est = registry.make_batch_estimator(names, timeout_seconds=5.0)
+            reqs = reqs_matrix([1000, 2000])
+            out = est(reqs, np.asarray([5, 5]))
+            assert (out[:, :2] >= 0).all()
+            assert (out[:, 2] == -1).all()
+            batches_first = registry.rpc_counts["batch"]
+
+            # healthy columns answered from memo; only the straggler is
+            # re-attempted
+            out2 = est(reqs, np.asarray([5, 5]))
+            assert (out2 == out).all()
+            assert (
+                registry.rpc_counts["batch"] == batches_first + 1
+            ), "only the dead server's group should re-fan"
+        finally:
+            conn.close()
+            dead_conn.close()
+            srv.stop()
+
+
+class TestDegradedPassNeverReplayed:
+    class FlakyConn:
+        """In-proc transport seam with a kill switch: while ``down``, every
+        call fails like an unreachable server."""
+
+        def __init__(self, service):
+            from karmada_tpu.estimator.service import EstimatorConnection
+
+            self._inner = EstimatorConnection("multi", service)
+            self.down = False
+
+        def call(self, method, request):
+            if self.down:
+                raise ConnectionError("server unreachable")
+            return self._inner.call(method, request)
+
+    def test_recovered_cluster_invalidates_replay_token(self):
+        """The arming race: a pass degraded by a transiently-down server
+        must never become replayable just because the server recovers in
+        time for the post-pass confirmation ping — refresh_token has to
+        answer None until a full pass re-answers the cluster."""
+        caches = make_member_caches(["a"])
+        svc = MultiClusterEstimatorService(
+            {"a": EstimatorService(AccurateEstimator("a", caches["a"]))}
+        )
+        conn = self.FlakyConn(svc)
+        registry = EstimatorRegistry()
+        registry.register(RemoteAccurateEstimator("a", conn, lambda: DIMS))
+        est = registry.make_batch_estimator(["a"], timeout_seconds=2.0)
+        reqs = reqs_matrix([1000])
+        reps = np.asarray([5])
+
+        # healthy pass: memoized, confirmed, replayable
+        out1 = est(reqs, reps)
+        assert (out1 >= 0).all()
+        token1 = est.refresh_token()
+        assert token1 is not None
+
+        # server drops; the invalidated pass cannot confirm -> -1
+        registry.invalidate()
+        conn.down = True
+        out2 = est(reqs, reps)
+        assert (out2 == -1).all()
+        # server recovers JUST in time for the confirmation probe: the
+        # generation still matches, so confirm_token could confirm — but
+        # the degraded pass must not be replayable
+        conn.down = False
+        assert est.refresh_token() is None
+
+        # the next full pass answers from the still-valid memo and
+        # becomes replayable again
+        out3 = est(reqs, reps)
+        assert (out3 == out1).all()
+        assert est.refresh_token() is not None
+
+
+class TestSchedulerParity:
+    def test_batch_and_fallback_placements_identical(self):
+        """End to end through TensorScheduler: estimator-backed placements
+        are identical between the batched protocol and the unary fallback,
+        and identical to the snapshot-fed engine (min-merge degeneracy:
+        each cluster's single node holds exactly the snapshot's free
+        capacity)."""
+        from karmada_tpu.scheduler import (
+            BindingProblem,
+            ClusterSnapshot,
+            TensorScheduler,
+        )
+        from karmada_tpu.utils.builders import (
+            dynamic_weight_placement,
+            synthetic_fleet,
+        )
+        from karmada_tpu.utils.quantity import parse_resource_list
+
+        snap = ClusterSnapshot(synthetic_fleet(8, seed=77))
+        dims = list(snap.dims)
+        free = np.maximum(np.asarray(snap.available_cap), 0)
+        services = {}
+        for i, name in enumerate(snap.names):
+            node = NodeState(
+                name=f"{name}-n0",
+                allocatable={d: int(free[i][r]) for r, d in enumerate(dims)},
+            )
+            services[name] = EstimatorService(
+                AccurateEstimator(name, NodeCache(dims, [node]))
+            )
+        srv = EstimatorGrpcServer(MultiClusterEstimatorService(services))
+        old_srv = EstimatorGrpcServer(
+            MultiClusterEstimatorService(services), enable_batch=False
+        )
+        port, old_port = srv.start(), old_srv.start()
+
+        rng = np.random.default_rng(3)
+        pl = dynamic_weight_placement()
+        profiles = [
+            parse_resource_list(
+                {"cpu": f"{250 * (p + 1)}m", "memory": f"{512 * (p + 1)}Mi"}
+            )
+            for p in range(4)
+        ]
+        problems = [
+            BindingProblem(
+                key=f"e{i}", placement=pl,
+                replicas=int(rng.integers(1, 40)),
+                requests=profiles[int(rng.integers(0, 4))],
+                gvk="apps/v1/Deployment",
+            )
+            for i in range(96)
+        ]
+
+        def run(target_port):
+            registry = EstimatorRegistry()
+            conn = GrpcEstimatorConnection(
+                "multi", f"127.0.0.1:{target_port}", timeout_seconds=5.0
+            )
+            try:
+                for name in snap.names:
+                    registry.register(
+                        RemoteAccurateEstimator(
+                            name, conn, lambda: list(dims)
+                        )
+                    )
+                batch = registry.make_batch_estimator(
+                    snap.names, timeout_seconds=5.0
+                )
+                eng = TensorScheduler(snap, extra_estimators=[batch])
+                return eng.schedule(problems), registry
+            finally:
+                conn.close()
+
+        try:
+            res_batch, reg_batch = run(port)
+            res_fallback, reg_fallback = run(old_port)
+            assert reg_batch.rpc_counts["batch"] >= 1
+            assert reg_batch.rpc_counts["unary"] == 0
+            assert reg_fallback.rpc_counts["unary"] > 0
+            plain = TensorScheduler(snap).schedule(problems)
+            for a, b, c in zip(res_batch, res_fallback, plain):
+                assert a.success == b.success == c.success
+                assert dict(a.clusters) == dict(b.clusters) == dict(c.clusters)
+        finally:
+            srv.stop()
+            old_srv.stop()

@@ -218,6 +218,40 @@ class TestAccurateEstimator:
         assert fwd(req, reps)[0].tolist() == [3, 8]
         assert rev(req, reps)[0].tolist() == [8, 3]
 
+    def test_numpy_kernel_mirrors_jit_kernel(self):
+        # the small-problem numpy mirror (the estimator server's unary
+        # fast path) must be bit-identical to the jit kernel — same floor
+        # division, no-requested-dims zeroing, prefilter and int32 clamp
+        from karmada_tpu.estimator.accurate import (
+            _node_sum_estimate,
+            _node_sum_estimate_np,
+        )
+
+        rng = np.random.default_rng(11)
+        for b, n, r in ((1, 1, 4), (8, 3, 4), (5, 17, 2), (3, 2, 1)):
+            avail = rng.integers(-5, 10_000, (n, r)).astype(np.int64)
+            ok = rng.random((b, n)) < 0.8
+            reqs = rng.integers(0, 7, (b, r)).astype(np.int64) * 100
+            reqs[0, :] = 0  # a row with no requested dims answers 0
+            jit_out = np.asarray(
+                _node_sum_estimate(
+                    jnp.asarray(avail), jnp.asarray(ok), jnp.asarray(reqs)
+                )
+            )
+            np_out = _node_sum_estimate_np(avail, ok, reqs)
+            assert jit_out.dtype == np_out.dtype
+            assert (jit_out == np_out).all()
+        # huge availability with a tiny request exercises the int32 clamp
+        avail = np.full((2, 1), 2**40, np.int64)
+        reqs = np.asarray([[1]], np.int64)
+        ok = np.ones((1, 2), bool)
+        assert _node_sum_estimate_np(avail, ok, reqs).tolist() == [2**31 - 1]
+        assert np.asarray(
+            _node_sum_estimate(
+                jnp.asarray(avail), jnp.asarray(ok), jnp.asarray(reqs)
+            )
+        ).tolist() == [2**31 - 1]
+
 
 class TestModelEstimatorHostMirror:
     def _model_fleet(self, n=20, seed=3):

@@ -54,7 +54,7 @@ def block(d: dict) -> str:
         f"| hetero 9000 slot-eviction churn p50 (10% unique rotation/pass) |"
         f" {fmt(d.get('hetero9k_churn_p50'))} |",
         f"| live-gRPC estimator tier (512 clusters, 4 server processes) "
-        f"storm p50 | {fmt(d.get('estimator512_p50'))} (full wire refresh "
+        f"storm p50 | {fmt(d.get('estimator512_p50'))} (refresh "
         f"{fmt(d.get('estimator512_refresh_p50'))}, placements "
         + {True: "identical", False: "DIVERGED", None: "n/a"}[
             d.get("estimator512_identical")
@@ -101,6 +101,56 @@ def cold_block(cd: dict) -> str:
             f"new_trace={'False' if warm is False else warm}) |",
         ]
     )
+
+
+def estimator_block(ed: dict) -> str:
+    """Rows for a ``bench.py --estimator-only`` record (the batched
+    estimator wire tier): full-refresh storm over one batch RPC per
+    server, generation-ping no-movement refresh, and the unary-fallback
+    parity run with its width-1 (blocking sequential) reference."""
+    scale = ed.get("metric", "").removeprefix("estimator512_wire_")
+
+    def rpcs(key):
+        d = ed.get(key) or {}
+        parts = [
+            f"{d.get(k, 0)} {k}" for k in ("batch", "unary", "ping")
+            if d.get(k)
+        ]
+        return " + ".join(parts) if parts else "0"
+
+    ident = {True: "identical", False: "DIVERGED", None: "n/a"}
+    return "\n".join(
+        [
+            f"| estimator wire {scale}: full-refresh storm p50 (batched "
+            f"protocol) | {fmt(ed.get('estimator512_p50'))} (RPCs/pass: "
+            f"{rpcs('estimator512_rpc_full')}; placements "
+            f"{ident[ed.get('estimator512_identical')]} vs snapshot-fed) |",
+            f"| estimator wire {scale}: no-movement refresh pass "
+            f"(generation pings only) | "
+            f"{fmt(ed.get('estimator512_refresh_p50'))} (RPCs/pass: "
+            f"{rpcs('estimator512_rpc_steady')}) |",
+            f"| estimator wire {scale}: unary-fallback full refresh "
+            f"(mixed-version path, pipelined) | "
+            f"{fmt(ed.get('estimator512_fallback_p50'))} (RPCs/pass: "
+            f"{rpcs('estimator512_rpc_fallback')}; placements "
+            f"{ident[ed.get('estimator512_fallback_identical')]}; "
+            f"blocking-sequential reference "
+            f"{fmt(ed.get('estimator512_fallback_seq_s'))}) |",
+        ]
+    )
+
+
+def extra_block(src: Path) -> str:
+    """Dispatch an extra record file by its metric prefix."""
+    d = json.loads(src.read_text())
+    if "parsed" in d:
+        d = d["parsed"]
+    metric = d.get("metric", "")
+    if metric.startswith("cold_start"):
+        return cold_block(d)
+    if metric.startswith("estimator512_wire"):
+        return estimator_block(d)
+    raise SystemExit(f"{src}: unrecognized bench record metric {metric!r}")
 
 
 def rewrite(path: Path, body: str, marker: str = "bench") -> None:
@@ -182,10 +232,11 @@ def main() -> None:
         d = d["parsed"]
     names = src.name
     body = block(d)
-    if len(sys.argv) > 2:  # optional bench.py --cold-start record
-        cold_src = Path(sys.argv[2])
-        body += "\n" + cold_block(json.loads(cold_src.read_text()))
-        names += f" {cold_src.name}"
+    # optional extra records: bench.py --cold-start / --estimator-only
+    for extra in sys.argv[2:]:
+        extra_src = Path(extra)
+        body += "\n" + extra_block(extra_src)
+        names += f" {extra_src.name}"
     body = (
         f"_Generated by `tools/docs_from_bench.py {names}` — regenerate, "
         f"don't hand-edit._\n\n" + body

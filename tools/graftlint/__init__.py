@@ -48,11 +48,14 @@ def run(
     root=None,
     baseline="auto",
     roles_override=None,
+    full_scope=True,
 ) -> LintResult:
     """One-call API used by the CLI verb and the tier-1 test.
 
     ``baseline="auto"`` loads the repo's committed baseline; ``None``
-    disables baselining (fixture tests want raw findings)."""
+    disables baselining (fixture tests want raw findings).
+    ``full_scope=False`` marks a partial scan (--changed-only / explicit
+    --paths): whole-tree negative checks (GL003 staleness) are skipped."""
     config = default_config(root)
     linter = Linter(config)
     baseline_path = None
@@ -61,7 +64,8 @@ def run(
     elif baseline:
         baseline_path = config.root / baseline
     return linter.run(
-        targets, baseline=baseline_path, roles_override=roles_override
+        targets, baseline=baseline_path, roles_override=roles_override,
+        full_scope=full_scope,
     )
 
 

@@ -152,6 +152,9 @@ def main(argv=None) -> int:
             paths or DEFAULT_TARGETS,
             root=args.root,
             baseline=None if args.no_baseline else "auto",
+            # an explicit path list (or the git-changed set) is a partial
+            # scan: whole-tree negative checks must not fire from it
+            full_scope=not paths,
         )
     if args.format == "json":
         print(json.dumps(result.to_json(), indent=2))

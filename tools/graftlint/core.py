@@ -464,10 +464,15 @@ class Linter:
         *,
         baseline: Optional[Path] = None,
         roles_override: Optional[dict] = None,
+        full_scope: bool = True,
     ) -> LintResult:
         """Lint ``targets`` (files or directories, repo-relative or
         absolute). ``roles_override`` maps rel-path -> role set, used by
-        the fixture tests to force a role onto an arbitrary file."""
+        the fixture tests to force a role onto an arbitrary file.
+        ``full_scope=False`` marks a partial scan (--changed-only /
+        explicit --paths): whole-tree negative claims like GL003's
+        registered-but-never-read staleness check are skipped — a scoped
+        run cannot prove "never read"."""
         modules = []
         for path in iter_py_files(self.config.root, targets):
             roles = None
@@ -482,6 +487,7 @@ class Linter:
                     roles = set(roles_override[rel])
             modules.append(self.parse(path, roles))
         ctx = LintContext(self.config, modules)
+        ctx.full_scope = full_scope
 
         raw: list = []
         suppressed = 0

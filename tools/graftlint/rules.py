@@ -477,6 +477,10 @@ class EnvFlagRegistry(Rule):
                     ),
                     anchor="ENV_FLAGS", detail=f"undocumented:{name}",
                 )
+            if not getattr(ctx, "full_scope", True):
+                # a scoped run (--changed-only / --paths) cannot prove
+                # "never read" — the read sites are outside the scan
+                continue
             if name not in reads and not getattr(flag, "external", False):
                 yield Finding(
                     rule=self.id, path=ctx.config.flags_module, line=1,
