@@ -29,11 +29,18 @@ def main(argv=None) -> None:
         "--checkpoint-interval", type=float, default=0.0,
         help="seconds between periodic store checkpoints (0 = only on exit)",
     )
+    p.add_argument(
+        "--metrics-port", default=None,
+        help="serve /metrics + /healthz + /debug/traces on this port or HOST:PORT "
+        "(0 = ephemeral, reported in the startup JSON line; default: "
+        "$KARMADA_TPU_METRICS_PORT, empty = disabled)",
+    )
     args = p.parse_args(argv)
 
     import os
 
     from ..utils import Store
+    from ..utils.metrics import serve_process_metrics
     from ..webhook import default_admission_chain
     from .service import StoreBusServer
 
@@ -45,9 +52,13 @@ def main(argv=None) -> None:
         restored = store.restore(args.state_file)
         print(f"# restored {restored} objects from {args.state_file}",
               file=sys.stderr)
+    metrics = serve_process_metrics(args.metrics_port)
     bus = StoreBusServer(store, args.address)
     port = bus.start()
-    print(json.dumps({"bus": port}), flush=True)
+    endpoints = {"bus": port}
+    if metrics is not None:
+        endpoints["metrics"] = metrics.port
+    print(json.dumps(endpoints), flush=True)
 
     stop = [False]
 
@@ -75,6 +86,8 @@ def main(argv=None) -> None:
             saved = store.checkpoint(args.state_file)
             print(f"# checkpointed {saved} objects to {args.state_file}",
                   file=sys.stderr)
+        if metrics is not None:
+            metrics.stop()
         bus.stop()
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
 from concurrent import futures
 from typing import Optional
 
@@ -28,6 +29,12 @@ import grpc
 from ..api.core import Resource
 from ..utils import Store
 from ..utils.codec import from_jsonable, to_jsonable
+from ..utils.metrics import (
+    bus_event_age_seconds,
+    bus_events,
+    bus_queue_depth,
+    bus_subscribers,
+)
 from ..utils.store import ConflictError, Event as StoreEvent
 from .proto import storebus_pb2 as pb
 
@@ -126,6 +133,7 @@ class StoreBusServer:
             # list() snapshots each kind
             with self._lock:
                 self._subscribers.append((q, kinds, dead))
+                bus_subscribers.set(len(self._subscribers))
             if request.replay:
                 for kind in sorted(self.store.kinds()):
                     if kinds and kind not in kinds:
@@ -144,9 +152,16 @@ class StoreBusServer:
             try:
                 while context.is_active() and not dead[0]:
                     try:
-                        ev = q.get(timeout=0.5)
+                        queued_at, ev = q.get(timeout=0.5)
                     except queue.Empty:
                         continue
+                    # queue AGE: how long the event sat behind this
+                    # subscriber's backlog before the stream drained it —
+                    # the per-subscriber half of the backpressure signal
+                    # (depth is sampled at fan-out)
+                    bus_event_age_seconds.observe(
+                        time.monotonic() - queued_at
+                    )
                     yield ev
                 # dead: fall through — closing the stream forces the client
                 # to reconnect and re-list, healing the dropped-event gap
@@ -155,6 +170,7 @@ class StoreBusServer:
                     self._subscribers = [
                         s for s in self._subscribers if s[0] is not q
                     ]
+                    bus_subscribers.set(len(self._subscribers))
 
         def apply(request: pb.ApplyRequest, context):
             try:
@@ -238,13 +254,22 @@ class StoreBusServer:
             resource_version=getattr(event.obj.meta, "resource_version", 0),
             object_json=encode_object(event.obj),
         )
+        now = time.monotonic()
+        depth = 0
+        dropped = 0
         for q, _, dead in subs:
             try:
-                q.put_nowait(msg)
+                q.put_nowait((now, msg))
+                depth = max(depth, q.qsize())
             except queue.Full:
                 # slow subscriber: close its stream so it reconnects and
                 # re-lists — silently dropping would leave it stale forever
                 dead[0] = True
+                dropped += 1
+        bus_events.inc(len(subs) - dropped, result="delivered")
+        if dropped:
+            bus_events.inc(dropped, result="dropped")
+        bus_queue_depth.set(depth)
 
     def start(self) -> int:
         self._server.start()

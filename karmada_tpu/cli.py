@@ -1180,11 +1180,35 @@ def build_parser() -> tuple:
         "cap expansion)",
     )
 
+    tr = sub.add_parser(
+        "trace",
+        help="wave-trace operations: `trace dump --metrics HOST:PORT` "
+        "fetches /debug/traces from a running process (plane, solver, "
+        "estimator, bus — any MetricsServer) and prints the span ring + "
+        "per-wave phase summaries as JSON",
+    )
+    tr.add_argument("action", choices=("dump",))
+    tr.add_argument(
+        "--metrics", default="",
+        help="HOST:PORT of the target process's metrics endpoint; "
+        "without it the CURRENT process's in-proc tracer dumps (useful "
+        "under an embedded plane)",
+    )
+    tr.add_argument(
+        "--wave", type=int, default=None,
+        help="restrict the span dump to one wave id",
+    )
+    tr.add_argument(
+        "--summary", action="store_true",
+        help="print only the per-wave phase summaries",
+    )
+
     li = sub.add_parser(
         "lint",
         help="run graftlint, the repo's two-tier static analyzer: AST "
         "tier (GL001 trace safety, GL002 trace-key completeness, GL003 "
-        "env-flag registry, GL004 lock discipline, GL005 import hygiene) "
+        "env-flag registry, GL004 lock discipline, GL005 import hygiene, "
+        "GL006 metric naming) "
         "and, with --ir, the jaxpr-level kernel auditor (IR001 dtype "
         "discipline, IR002 host round-trips, IR003 const capture, IR004 "
         "trace-manifest fidelity, IR005 donation audit)",
@@ -1255,6 +1279,33 @@ def cmd_lint(
     return graftlint_main(argv)
 
 
+def cmd_trace_dump(
+    metrics: str = "", wave: Optional[int] = None, summary: bool = False
+) -> dict:
+    """The ``trace dump`` verb: the wave-trace ring + per-wave phase
+    summaries, either from a remote process's ``/debug/traces`` endpoint
+    (``metrics="host:port"``) or this process's in-proc tracer. The
+    per-phase summary is the same shape the observability bench records
+    (BENCH_OBS_r*.json), so a dumped wave reads against the docs table."""
+    if metrics:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://{metrics}/debug/traces", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+    else:
+        from .utils.tracing import tracer
+
+        doc = {"waves": tracer.wave_summaries(), "spans": tracer.dump()}
+    if wave is not None:
+        doc["spans"] = [s for s in doc["spans"] if s.get("wave") == wave]
+        doc["waves"] = [w for w in doc["waves"] if w.get("wave") == wave]
+    if summary:
+        doc.pop("spans", None)
+    return doc
+
+
 def cmd_warmup(manifest: str = "", expand: bool = True) -> dict:
     """The ``warmup`` verb: replay the trace manifest through AOT
     compilation on the current backend (scheduler.prewarm.warmup), so a
@@ -1304,6 +1355,16 @@ def main(argv: Optional[list[str]] = None) -> int:
             ir=args.ir, manifest=args.manifest,
             changed_only=args.changed_only,
         )
+    if args.command == "trace":
+        try:
+            doc = cmd_trace_dump(
+                args.metrics, wave=args.wave, summary=args.summary
+            )
+        except Exception as exc:  # unreachable endpoint, bad JSON
+            print(json.dumps({"error": str(exc)}))
+            return 1
+        print(json.dumps(doc, indent=2))
+        return 0
     if args.command == "warmup":
         stats = cmd_warmup(args.manifest, expand=not args.no_expand)
         print(json.dumps(stats))

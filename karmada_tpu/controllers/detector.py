@@ -23,6 +23,7 @@ from ..api.core import ObjectMeta
 from ..interpreter import ResourceInterpreter
 from ..utils import DONE, Runtime, Store
 from ..utils.features import POLICY_PREEMPTION, feature_gate
+from ..utils.tracing import tracer
 from .overridemanager import resource_matches_selector
 
 # claim labels (ref: policy permanent-ID labels, claim.go)
@@ -77,6 +78,13 @@ class ResourceDetector:
     # -- events ------------------------------------------------------------
 
     def _on_template_event(self, event) -> None:
+        # a user-driven template event is the canonical start of a wave:
+        # stamp the monotonic wave id HERE so the whole downstream chain
+        # (policy match -> binding -> scheduler pass -> work render ->
+        # status) records its spans under one tree (utils.tracing). A
+        # burst of events shares the open wave; the wave closes when the
+        # plane settles.
+        tracer.ensure_wave("detector")
         self._by_karmada.discard(event.key)  # a user change always syncs
         self._user_pending.add(event.key)
         self.worker.enqueue(event.key)

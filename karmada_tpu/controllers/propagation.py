@@ -33,6 +33,7 @@ from ..api.work import (
 from ..api.policy import DIVIDED
 from ..interpreter import ResourceInterpreter
 from ..utils import DONE, REQUEUE, Runtime, Store
+from ..utils.metrics import works_rendered
 from ..utils.member import (
     ConflictError,
     MemberClientRegistry,
@@ -414,6 +415,10 @@ class BindingController:
             conflict_resolution=rb.spec.conflict_resolution,
         )
         self.store.apply(work)
+        # only SEMANTIC creates/updates count (the signature gate above
+        # returned on no-ops): this is the work-render throughput the
+        # whole-plane storm tier measures (ROADMAP item 3)
+        works_rendered.inc()
 
     def _cleanup_works(self, binding_key: str, keep_clusters: set[str]) -> None:
         for work in self.work_index.works_for(binding_key):

@@ -175,7 +175,12 @@ class SchedulerController:
         storm becomes chunked kernel batches instead of 100k single-item
         engine invocations (the batch axis is the whole point of the
         tensor scheduler)."""
-        from ..utils.metrics import e2e_scheduling_duration, schedule_attempts
+        from ..utils.metrics import (
+            e2e_scheduling_duration,
+            schedule_attempts,
+            scheduler_pass_seconds,
+        )
+        from ..utils.tracing import tracer
 
         out: dict = {}
         todo: list[tuple] = []  # (kind_key, rb, problem, fresh)
@@ -193,8 +198,14 @@ class SchedulerController:
         if not todo:
             return out
         start = time.perf_counter()
-        engine = self._get_engine()
-        results = engine.schedule([p for _, _, p, _ in todo])
+        # one engine pass = one scheduler.pass span; the fleet/kernel
+        # spans (pack/dispatch/device/fetch) nest under it, so a storm
+        # wave's solve time decomposes without per-binding bookkeeping
+        with tracer.span("scheduler.pass") as sp:
+            engine = self._get_engine()
+            results = engine.schedule([p for _, _, p, _ in todo])
+            sp.attrs["bindings"] = len(todo)
+        scheduler_pass_seconds.observe(sp.duration)
         per_item = (time.perf_counter() - start) / len(todo)
         # leadership check at the write barrier: a batched engine pass can
         # outlast a lease (first-compile stalls), and the heartbeat seam

@@ -35,6 +35,12 @@ def main(argv=None) -> None:
         "hundreds of members). Each cluster gets one node whose "
         "allocatable IS the given free capacity.",
     )
+    p.add_argument(
+        "--metrics-port", default=None,
+        help="serve /metrics + /healthz + /debug/traces on this port or HOST:PORT "
+        "(0 = ephemeral, printed as 'metrics listening on port N'; "
+        "default: $KARMADA_TPU_METRICS_PORT, empty = disabled)",
+    )
     args = p.parse_args(argv)
     if bool(args.cluster) == bool(args.spec_file):
         p.error("exactly one of --cluster / --spec-file is required")
@@ -92,11 +98,21 @@ def main(argv=None) -> None:
         port = server.start()
         # the parent process scrapes this line to learn the bound port
         print(f"estimator {args.cluster} listening on port {port}", flush=True)
+
+    from ..utils.metrics import serve_process_metrics
+
+    # AFTER the gRPC port line: orchestrators scrape the FIRST
+    # "port (\\d+)" match, which must stay the serving port
+    metrics = serve_process_metrics(args.metrics_port)
+    if metrics is not None:
+        print(f"metrics listening on port {metrics.port}", flush=True)
     try:
         server._server.wait_for_termination()
     except KeyboardInterrupt:
         pass
     finally:
+        if metrics is not None:
+            metrics.stop()
         server.stop()
 
 

@@ -420,6 +420,16 @@ class EstimatorRegistry:
         # estimator contribution to a replayed batch is unchanged
         self._epoch = 0
 
+    def _count_rpc(self, kind: str, n: int = 1) -> None:
+        """One choke point for wire accounting: the per-registry
+        ``rpc_counts`` dict (benches diff it per pass) AND the process
+        metric family (karmada_tpu_estimator_rpcs_total) move together so
+        the two surfaces can never disagree."""
+        from ..utils.metrics import estimator_rpcs
+
+        self.rpc_counts[kind] += n
+        estimator_rpcs.inc(n, kind=kind)
+
     def register(self, est: AccurateEstimator) -> None:
         self._by_cluster[est.cluster_name] = est
         # a (re)registered estimator invalidates exactly its own cluster's
@@ -562,6 +572,12 @@ class EstimatorRegistry:
         only on the calling thread — pool tasks just return data."""
         import time as _time
 
+        from ..utils.metrics import (
+            estimator_delta_requeries,
+            estimator_refresh_seconds,
+        )
+        from ..utils.tracing import tracer
+
         t0 = _time.perf_counter()
         deadline = (
             None if timeout_seconds is None else t0 + timeout_seconds
@@ -572,29 +588,37 @@ class EstimatorRegistry:
                 return None
             return max(deadline - _time.perf_counter(), 0.0)
 
-        # steps A+B: confirm generations (local reads + one ping per
-        # server connection)
-        touched_wire = self._confirm_generations(
-            names, prof_keys, max_workers, remaining
-        )
+        with tracer.span("estimator.refresh") as sp:
+            # steps A+B: confirm generations (local reads + one ping per
+            # server connection)
+            touched_wire = self._confirm_generations(
+                names, prof_keys, max_workers, remaining
+            )
 
-        # ---- step C: fetch — clusters with any unmemoized profile, grouped
-        # by batch-capable connection; the rest fan out per cluster
-        fetch: list = []  # (name, est, conn | None)
-        for name in names:
-            est = self._by_cluster.get(name)
-            if est is None:
-                continue
-            if name in self._confirmed and all(
-                (name, k) in self._memo for k in prof_keys
-            ):
-                continue
-            fetch.append((name, est, getattr(est, "conn", None)))
-        if fetch:
-            touched_wire = True
-            self._fetch(fetch, uniq, prof_keys, max_workers, remaining)
+            # ---- step C: fetch — clusters with any unmemoized profile,
+            # grouped by batch-capable connection; the rest per cluster
+            fetch: list = []  # (name, est, conn | None)
+            for name in names:
+                est = self._by_cluster.get(name)
+                if est is None:
+                    continue
+                if name in self._confirmed and all(
+                    (name, k) in self._memo for k in prof_keys
+                ):
+                    continue
+                fetch.append((name, est, getattr(est, "conn", None)))
+            sp.attrs["requeried_clusters"] = len(fetch)
+            if fetch:
+                touched_wire = True
+                # the delta half of the generation-gated refresh: only
+                # clusters whose generation moved (or never fetched)
+                # re-pay the fan-out — this counter is that cardinality
+                estimator_delta_requeries.inc(len(fetch))
+                self._fetch(fetch, uniq, prof_keys, max_workers, remaining)
         if touched_wire:
-            self.fanout_seconds_total += _time.perf_counter() - t0
+            elapsed = _time.perf_counter() - t0
+            self.fanout_seconds_total += elapsed
+            estimator_refresh_seconds.observe(elapsed)
 
     def _confirm_generations(
         self,
@@ -662,7 +686,7 @@ class EstimatorRegistry:
 
         futs = {}
         for conn, members in ping_groups.values():
-            self.rpc_counts["ping"] += 1
+            self._count_rpc("ping")
             futs[pool.submit(ping, conn, list(members))] = (conn, members)
         done, not_done = _fwait(futs, timeout=remaining())
         for f in not_done:
@@ -877,18 +901,18 @@ class EstimatorRegistry:
 
         futs = {}
         for conn, members in batch_groups.values():
-            self.rpc_counts["batch"] += 1
+            self._count_rpc("batch")
             futs[pool.submit(fetch_batch, conn, members)] = (
                 "batch", (conn, members),
             )
         for conn, members in unary_groups.values():
-            self.rpc_counts["unary"] += len(members) * len(rows)
+            self._count_rpc("unary", len(members) * len(rows))
             futs[pool.submit(fetch_unary_channel, conn, members)] = (
                 "unary", (conn, members),
             )
         for name, est in locals_:
             if getattr(est, "conn", None) is not None:
-                self.rpc_counts["unary"] += len(rows)
+                self._count_rpc("unary", len(rows))
             futs[pool.submit(fetch_single, name, est)] = ("single", name)
         done, not_done = _fwait(futs, timeout=remaining())
         for f in not_done:
@@ -932,13 +956,13 @@ class EstimatorRegistry:
             futs = {}
             for conn, members in retry:
                 if hasattr(conn, "call_future"):
-                    self.rpc_counts["unary"] += len(members) * len(rows)
+                    self._count_rpc("unary", len(members) * len(rows))
                     futs[pool.submit(fetch_unary_channel, conn, members)] = (
                         "unary", (conn, members),
                     )
                 else:
                     for name, est in members:
-                        self.rpc_counts["unary"] += len(rows)
+                        self._count_rpc("unary", len(rows))
                         futs[pool.submit(fetch_single, name, est)] = (
                             "single", name,
                         )

@@ -203,11 +203,18 @@ class EstimatorGrpcServer:
             options=[("grpc.so_reuseport", 0)],
         )
 
+        # served-RPC accounting at the wire choke point (covers the single-
+        # and multi-cluster services alike): the estimator PROCESS's
+        # /metrics answers with this family (ISSUE 6 c)
+        from ..utils.metrics import estimator_server_requests
+
         def max_available(request: pb.MaxAvailableReplicasRequest, context):
+            estimator_server_requests.inc(method="MaxAvailableReplicas")
             resp = self._service.max_available_replicas(_pb_to_req(request))
             return pb.MaxAvailableReplicasResponse(max_replicas=resp.max_replicas)
 
         def unschedulable(request: pb.UnschedulableReplicasRequest, context):
+            estimator_server_requests.inc(method="GetUnschedulableReplicas")
             resp = self._service.get_unschedulable_replicas(_pb_to_unsched(request))
             return pb.UnschedulableReplicasResponse(
                 unschedulable_replicas=resp.unschedulable_replicas
@@ -216,12 +223,14 @@ class EstimatorGrpcServer:
         def max_available_batch(
             request: "bpb.MaxAvailableReplicasBatchRequest", context
         ):
+            estimator_server_requests.inc(method="MaxAvailableReplicasBatch")
             resp = self._service.max_available_replicas_batch(
                 _pb_to_batch(request)
             )
             return _batch_resp_to_pb(resp)
 
         def get_generations(request: "bpb.GetGenerationsRequest", context):
+            estimator_server_requests.inc(method="GetGenerations")
             return _gens_resp_to_pb(
                 self._service.get_generations(_pb_to_gens(request))
             )

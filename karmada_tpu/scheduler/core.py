@@ -446,6 +446,20 @@ class TensorScheduler:
                 and (cp.strategy == _DUP or p.replicas <= _MRF)
             ]
             self.last_breakdown["eligible"] = _time.perf_counter() - t0
+            # the host prologue (placement compile + spread selection +
+            # eligibility partition) is the wave tree's "pack" phase —
+            # recorded as one span so a storm's pass decomposes into
+            # pack / solve(dispatch/device/fetch) under scheduler.pass
+            from ..utils.tracing import tracer as _tracer
+
+            _tracer.record(
+                "scheduler.pack",
+                sum(
+                    self.last_breakdown.get(k, 0.0)
+                    for k in ("compile", "select", "eligible")
+                ),
+                rows=len(problems),
+            )
             if len(fast_idx) >= self.fleet_threshold:
                 from .fleet import FleetTable
 
@@ -680,6 +694,16 @@ class TensorScheduler:
         return np.minimum(avail, int(_MI)).astype(np.int32)
 
     def _schedule_host(
+        self,
+        problems: Sequence[BindingProblem],
+        compiled: list[CompiledPlacement],
+    ) -> list[ScheduleResult]:
+        from ..utils.tracing import tracer
+
+        with tracer.span("scheduler.host", rows=len(problems)):
+            return self._schedule_host_rounds(problems, compiled)
+
+    def _schedule_host_rounds(
         self,
         problems: Sequence[BindingProblem],
         compiled: list[CompiledPlacement],

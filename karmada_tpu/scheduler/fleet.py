@@ -68,6 +68,17 @@ from ..ops.estimate import MAX_INT32, merge_estimates
 
 log = logging.getLogger("karmada_tpu")
 
+#: trace-key prefix -> kernel family, for the per-bucket compile counter
+#: (karmada_tpu_kernel_compiles_total) every _mark_trace feeds
+_TRACE_KERNELS = {
+    "L": "fleet_solve",
+    "A": "fleet_pass",
+    "E": "fleet_entries",
+    "B": "fleet_bits",
+    "S": "state_scatter",
+    "G": "meta_gather",
+}
+
 K_PREV = 32  # max previous-assignment sites on the fast path (small fleets
 # legitimately spread one binding over dozens of clusters; rows beyond this
 # take the general host path)
@@ -1134,10 +1145,23 @@ class FleetTable:
         """Record a dispatched trace signature; flips the per-pass
         new-trace flag when the signature is unseen (a compile will run).
         Returns True for a fresh signature so dispatch sites can persist
-        the compile record to the trace manifest."""
+        the compile record to the trace manifest. Every fresh signature
+        also feeds the per-bucket compile counter — the metric face of
+        the compile-lifecycle subsystem (manifest-seeded signatures never
+        pass through here, so prewarmed traces don't count as serving-
+        path compiles)."""
         if key not in self._seen_traces:
             self._seen_traces.add(key)
             self.new_trace_last_pass = True
+            from ..utils.metrics import kernel_compiles
+
+            bucket = "x".join(
+                str(v) for v in key[1:] if isinstance(v, (int, bool))
+            )[:64]
+            kernel_compiles.inc(
+                kernel=_TRACE_KERNELS.get(key[0], str(key[0])),
+                bucket=bucket,
+            )
             return True
         return False
 
@@ -1655,6 +1679,70 @@ class FleetTable:
     # -- scheduling --------------------------------------------------------
 
     def schedule(self, problems: Sequence, compiled: Sequence) -> list:
+        """One fleet pass, wrapped in a ``scheduler.solve`` wave span with
+        per-phase kernel child spans (host pack / dispatch / fenced device
+        execute / fetch+fold) emitted from the pass breakdown — the
+        device/host attribution surface of ISSUE 6 (b)."""
+        from ..utils.tracing import tracer
+
+        with tracer.span("scheduler.solve") as sp:
+            res = self._schedule_pass(problems, compiled)
+            sp.attrs["rows"] = len(problems)
+            self._emit_phase_spans()
+        return res
+
+    #: breakdown keys that are pure host work outside the dispatch/fetch
+    #: windows (pack, delta scatter, result decode)
+    _HOST_PHASE_KEYS = ("upsert", "sync", "prep", "post")
+
+    def _emit_phase_spans(self) -> None:
+        """Kernel phase spans + karmada_tpu_kernel_phase_seconds from the
+        last pass's breakdown. Components are DISJOINT: ``fetch`` is the
+        whole post-device window (wire transfer + decode + entry folds —
+        its internal dispatch_b/fetch_b/delta_fold live inside it), and
+        the fenced ``device`` window carries the compile attribution flag
+        when this pass minted a fresh XLA trace."""
+        from ..utils.metrics import kernel_phase_seconds
+        from ..utils.tracing import tracer
+
+        tmr = self.last_breakdown
+        host = sum(tmr.get(k, 0.0) for k in self._HOST_PHASE_KEYS)
+        # compile attribution: a synchronous backend compiles INSIDE the
+        # dispatch call, an async tunnel behind it (surfacing at the
+        # device fence) — on a fresh-trace pass both windows carry the
+        # flag, so the summary's compile_s covers either backend
+        fresh = bool(self.new_trace_last_pass)
+        phases = [
+            ("kernel.host", host, "host", {}),
+            (
+                "kernel.dispatch",
+                tmr.get("dispatch", 0.0),
+                "host",
+                {"compile": fresh} if fresh else {},
+            ),
+            (
+                "kernel.device",
+                tmr.get("device", 0.0),
+                "device",
+                {"compile": fresh},
+            ),
+            (
+                "kernel.fetch",
+                tmr.get("fetch", 0.0),
+                "host",
+                {
+                    "fetch_mb": tmr.get("fetch_mb", 0.0),
+                    "changed_rows": tmr.get("changed_rows", 0.0),
+                },
+            ),
+        ]
+        for name, seconds, kind, attrs in phases:
+            if seconds <= 0.0:
+                continue
+            tracer.record(name, seconds, kind=kind, **attrs)
+            kernel_phase_seconds.observe(seconds, phase=name.split(".")[1])
+
+    def _schedule_pass(self, problems: Sequence, compiled: Sequence) -> list:
         import time as _time
 
         tmr: dict[str, float] = {}
@@ -1914,6 +2002,14 @@ class FleetTable:
         t0 = _time.perf_counter()
         flat, resident = solve(rows_dev, e_cap)
         tmr["dispatch"] = _time.perf_counter() - t0
+        # device fence at the span boundary: block_until_ready splits the
+        # on-device execute (plus compile, when this pass minted a fresh
+        # trace) from the host-side transfer+decode that follows — the
+        # fetch would block on the same event anyway, so the fence costs
+        # nothing and buys the device/host attribution
+        t0 = _time.perf_counter()
+        flat.block_until_ready()
+        tmr["device"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
         raw = np.asarray(flat)
         fetched_bytes = raw.nbytes
@@ -2219,6 +2315,13 @@ class FleetTable:
                 pack21=pack21 and byte_wire,
             )
         tmr["dispatch"] = _time.perf_counter() - t0
+        # device fence (see _solve_legacy): splits phase A's on-device
+        # execute (+compile on a fresh trace) from the wire/decode window.
+        # The speculative B keeps running behind it — the fence waits on
+        # A's output only, so the B-overlaps-A's-decode flow is preserved.
+        t0 = _time.perf_counter()
+        flat.block_until_ready()
+        tmr["device"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
         # NOTE (measured, round 4): fusing A's wire with the speculative
         # B's into one device-side concat + single fetch LOSES to two

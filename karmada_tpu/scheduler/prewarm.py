@@ -328,6 +328,15 @@ def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
     }
     if errors:
         stats["errors"] = errors
+    # compile-lifecycle metric hook (ISSUE 6 b): off-serving-path prewarm
+    # compiles show on /metrics beside the serving-path compile counter,
+    # so an operator can see a boot's compile bill vs the storm's
+    from ..utils.metrics import kernel_prewarmed
+
+    if compiled:
+        kernel_prewarmed.inc(compiled, result="compiled")
+    if failed:
+        kernel_prewarmed.inc(failed, result="failed")
     with _WARM_LOCK:
         _WARMED.setdefault(manifest.path, set()).update(ok_canons)
     return stats

@@ -37,6 +37,12 @@ def main(argv=None) -> None:
         "ScoreAndAssign wave runs only already-compiled traces "
         "(default: $KARMADA_TPU_TRACE_MANIFEST; '' disables)",
     )
+    p.add_argument(
+        "--metrics-port", default=None,
+        help="serve /metrics + /healthz + /debug/traces on this port or HOST:PORT "
+        "(0 = ephemeral, printed as 'metrics listening on port N'; "
+        "default: $KARMADA_TPU_METRICS_PORT, empty = disabled)",
+    )
     args = p.parse_args(argv)
 
     def read(path):
@@ -88,6 +94,14 @@ def main(argv=None) -> None:
     port = server.start()
     # the parent process scrapes this line to learn the bound port
     print(f"solver listening on port {port}", flush=True)
+    from ..utils.metrics import serve_process_metrics
+
+    # AFTER the gRPC port line (orchestrators scrape the first
+    # "port (\d+)" match) and BEFORE the backend probe/prewarm: the
+    # endpoint answers while the accelerator claim is still settling
+    metrics = serve_process_metrics(args.metrics_port)
+    if metrics is not None:
+        print(f"metrics listening on port {metrics.port}", flush=True)
     if args.report_backend:
         import os as _os
         import threading

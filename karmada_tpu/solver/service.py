@@ -258,7 +258,12 @@ class SolverGrpcServer:
                      ("grpc.max_send_message_length", 256 << 20)],
         )
 
+        # served-RPC accounting: the sidecar PROCESS's /metrics answers
+        # with this family (ISSUE 6 c)
+        from ..utils.metrics import solver_requests
+
         def sync(request: pb.SyncClustersRequest, context):
+            solver_requests.inc(method="SyncClusters")
             version = self._service.sync_clusters(
                 [state_to_cluster(m) for m in request.clusters],
                 request.snapshot_version,
@@ -266,6 +271,7 @@ class SolverGrpcServer:
             return pb.SyncClustersResponse(snapshot_version=version)
 
         def score(request: pb.ScoreAndAssignRequest, context):
+            solver_requests.inc(method="ScoreAndAssign")
             try:
                 return self._service.score_and_assign(request)
             except StaleSnapshotError as e:

@@ -111,6 +111,15 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "sequentially per cluster. 1 disables pipelining.",
         ),
         EnvFlag(
+            "KARMADA_TPU_METRICS_PORT", "",
+            "Default /metrics + /healthz (+ /debug/traces) port (or "
+            "HOST:PORT — loopback unless a host is given) for the "
+            "standalone process entrypoints (solver sidecar, estimator "
+            "servers, store bus) when --metrics-port is not given "
+            "(utils.metrics.serve_process_metrics). Empty disables the "
+            "endpoint; 0 binds an ephemeral port (printed at startup).",
+        ),
+        EnvFlag(
             "KARMADA_TPU_DRYRUN_REAL_DEVICES", "0",
             "Multichip dryrun escape hatch (__graft_entry__): set to 1 to "
             "run on the default backend's real devices instead of forcing "

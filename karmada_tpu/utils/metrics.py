@@ -1,10 +1,26 @@
-"""Observability: counters/histograms with a Prometheus-style registry.
+"""Observability: counters/gauges/histograms with a Prometheus registry.
 
 Ref: pkg/scheduler/metrics/metrics.go:61-115 (schedule_attempts_total,
 e2e_scheduling_duration_seconds, scheduling_algorithm_duration_seconds
 {schedule_step=Filter|Score|Select|AssignReplicas}, per-plugin timers) and
 pkg/metrics (controller metrics). Text exposition follows the Prometheus
-format so a scraper can consume ``render()`` directly.
+format so a scraper can consume ``render()`` directly: ``# HELP`` before
+``# TYPE``, cumulative histogram buckets, label values escaped per the
+text-format rules.
+
+Every long-running process (plane, solver sidecar, estimator servers, the
+store bus) serves this registry at ``/metrics`` (+ ``/healthz`` and the
+``/debug/traces`` wave-trace dump) through ``MetricsServer``; the shared
+``--metrics-port`` flag semantics live in ``serve_process_metrics``.
+
+Thread-safety contract: ``inc()``/``set()``/``observe()`` mutate under the
+per-metric lock, and every READ path (``value()``, ``summary()``, both
+``render()`` paths) snapshots the sample dicts under that same lock before
+iterating — a scrape racing a storm of observes must never see a bucket
+list mid-update or die on a dict that grew mid-iteration. (This is the
+GL004 invariant stated in code rather than carried by a single-writer
+pragma: there IS no single writer here, so the lock is load-bearing on
+both sides.)
 """
 
 from __future__ import annotations
@@ -19,9 +35,41 @@ _DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
 )
 
+#: end-to-end bucket set for whole-wave / settle-pass latencies: a 1M-tier
+#: settle pass legitimately runs 14-15 s and a cold wave minutes — with the
+#: default buckets every such observation landed in +Inf and the histogram
+#: said nothing (ISSUE 6 satellite). Scrapers still get sub-second
+#: resolution at the fast end.
+E2E_BUCKETS = (
+    0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 15.0, 30.0, 60.0,
+    120.0, 300.0,
+)
+
 
 def _label_key(labels: dict[str, str]) -> tuple:
     return tuple(sorted(labels.items()))
+
+
+def _escape_label_value(value) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote and
+    newline must be escaped inside the quoted label value."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _label_str(key: tuple) -> str:
+    return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
+
+
+def _help_line(name: str, help_: str) -> str:
+    # HELP text escaping: backslash and newline (the text format's rules
+    # for HELP differ from label values — no quote escaping)
+    escaped = help_.replace("\\", "\\\\").replace("\n", "\\n")
+    return f"# HELP {name} {escaped}"
 
 
 class Counter:
@@ -36,12 +84,57 @@ class Counter:
             self._values[_label_key(labels)] += amount
 
     def value(self, **labels) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def samples(self) -> dict[tuple, float]:
+        """Label-set -> value snapshot (bench records enumerate these)."""
+        with self._lock:
+            return dict(self._values)
 
     def render(self) -> Iterable[str]:
+        if self.help:
+            yield _help_line(self.name, self.help)
         yield f"# TYPE {self.name} counter"
-        for key, v in sorted(self._values.items()):
-            label_s = ",".join(f'{k}="{val}"' for k, val in key)
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            label_s = _label_str(key)
+            yield f"{self.name}{{{label_s}}} {v}" if label_s else f"{self.name} {v}"
+
+
+class Gauge:
+    """A settable sample (queue depth, subscriber count). Same lock
+    contract as Counter: set/add mutate and every read snapshots under the
+    lock."""
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def add(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            key = _label_key(labels)
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> Iterable[str]:
+        if self.help:
+            yield _help_line(self.name, self.help)
+        yield f"# TYPE {self.name} gauge"
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            label_s = _label_str(key)
             yield f"{self.name}{{{label_s}}} {v}" if label_s else f"{self.name} {v}"
 
 
@@ -75,30 +168,39 @@ class Histogram:
 
     def summary(self, **labels) -> Optional[dict]:
         key = _label_key(labels)
-        if key not in self._totals:
-            return None
-        return {
-            "count": self._totals[key],
-            "sum": self._sums[key],
-            "avg": self._sums[key] / max(self._totals[key], 1),
-        }
+        with self._lock:
+            if key not in self._totals:
+                return None
+            total = self._totals[key]
+            s = self._sums[key]
+        return {"count": total, "sum": s, "avg": s / max(total, 1)}
 
     def render(self) -> Iterable[str]:
+        if self.help:
+            yield _help_line(self.name, self.help)
         yield f"# TYPE {self.name} histogram"
-        for key in sorted(self._totals):
-            label_s = ",".join(f'{k}="{v}"' for k, v in key)
+        with self._lock:
+            # consistent snapshot of all three sample dicts: counts lists
+            # are copied so a concurrent observe cannot mutate a row
+            # mid-render (the totals/sums pair for a key stays coherent
+            # because both are written under this same lock)
+            keys = sorted(self._totals)
+            counts_snap = {k: list(self._counts[k]) for k in keys}
+            sums_snap = {k: self._sums[k] for k in keys}
+            totals_snap = {k: self._totals[k] for k in keys}
+        for key in keys:
+            label_s = _label_str(key)
             prefix = f"{self.name}_bucket{{{label_s}" if label_s else f"{self.name}_bucket{{"
-            counts = self._counts[key]  # already cumulative (observe adds to
+            counts = counts_snap[key]  # already cumulative (observe adds to
             # every bucket whose bound covers the value)
-            for i, bound in enumerate(self.buckets):
-                sep = "," if label_s else ""
-                yield f'{prefix}{sep}le="{bound}"}} {counts[i]}'
             sep = "," if label_s else ""
-            yield f'{prefix}{sep}le="+Inf"}} {self._totals[key]}'
+            for i, bound in enumerate(self.buckets):
+                yield f'{prefix}{sep}le="{bound}"}} {counts[i]}'
+            yield f'{prefix}{sep}le="+Inf"}} {totals_snap[key]}'
             base = f"{self.name}_sum{{{label_s}}}" if label_s else f"{self.name}_sum"
-            yield f"{base} {self._sums[key]}"
+            yield f"{base} {sums_snap[key]}"
             base = f"{self.name}_count{{{label_s}}}" if label_s else f"{self.name}_count"
-            yield f"{base} {self._totals[key]}"
+            yield f"{base} {totals_snap[key]}"
 
 
 class Registry:
@@ -110,10 +212,22 @@ class Registry:
         self._metrics.append(c)
         return c
 
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        g = Gauge(name, help_)
+        self._metrics.append(g)
+        return g
+
     def histogram(self, name: str, help_: str = "", buckets=_DEFAULT_BUCKETS) -> Histogram:
         h = Histogram(name, help_, buckets)
         self._metrics.append(h)
         return h
+
+    def families(self) -> list:
+        """(name, type, help) per registered metric — the docs metric
+        table and its drift guard (tools/docs_from_bench.py) read this."""
+        return [
+            (m.name, type(m).__name__.lower(), m.help) for m in self._metrics
+        ]
 
     def render(self) -> str:
         lines: list[str] = []
@@ -132,6 +246,7 @@ schedule_attempts = registry.counter(
 e2e_scheduling_duration = registry.histogram(
     "karmada_scheduler_e2e_scheduling_duration_seconds",
     "end-to-end schedule latency",
+    buckets=E2E_BUCKETS,
 )
 scheduling_algorithm_duration = registry.histogram(
     "karmada_scheduler_scheduling_algorithm_duration_seconds",
@@ -142,13 +257,117 @@ queue_incoming_bindings = registry.counter(
     "queue pressure by event",
 )
 
+# -- plane-wide families (ISSUE 6) ------------------------------------------
+#
+# Defined centrally so EVERY process that imports utils.metrics exposes the
+# full family set on /metrics (a family with no samples still renders its
+# HELP/TYPE header — scrapers and the docs drift guard see the complete
+# catalogue regardless of which subsystem ran yet).
+
+scheduler_pass_seconds = registry.histogram(
+    "karmada_tpu_scheduler_pass_seconds",
+    "one engine pass over a queued binding batch (batched drain of the "
+    "scheduler worker)",
+    buckets=E2E_BUCKETS,
+)
+settle_seconds = registry.histogram(
+    "karmada_tpu_settle_seconds",
+    "one run_until_settled drain of the whole controller fleet (a storm "
+    "wave is one settle)",
+    buckets=E2E_BUCKETS,
+)
+kernel_compiles = registry.counter(
+    "karmada_tpu_kernel_compiles_total",
+    "fresh XLA trace signatures dispatched by the fleet engine, by kernel "
+    "family (each is one compile, on or off the serving path)",
+)
+kernel_prewarmed = registry.counter(
+    "karmada_tpu_kernel_prewarmed_total",
+    "trace-manifest records AOT-compiled by prewarm (off the serving "
+    "path), by outcome",
+)
+kernel_phase_seconds = registry.histogram(
+    "karmada_tpu_kernel_phase_seconds",
+    "fleet kernel hot-path wall time split by phase: host (pack/upsert/"
+    "sync/decode), dispatch, device (fenced execute, compile included "
+    "when the pass minted a fresh trace), fetch",
+)
+estimator_rpcs = registry.counter(
+    "karmada_tpu_estimator_rpcs_total",
+    "scheduler-side estimator wire traffic by kind (batch matrix RPCs, "
+    "per-profile unary fallback calls, generation pings)",
+)
+estimator_delta_requeries = registry.counter(
+    "karmada_tpu_estimator_delta_requery_total",
+    "clusters whose availability was re-fetched after a generation "
+    "movement (the delta half of the generation-gated refresh)",
+)
+estimator_refresh_seconds = registry.histogram(
+    "karmada_tpu_estimator_refresh_seconds",
+    "wall time of one registry refresh (pings + grouped fan-out)",
+)
+estimator_server_requests = registry.counter(
+    "karmada_tpu_estimator_server_requests_total",
+    "estimator-server RPCs served, by method",
+)
+solver_requests = registry.counter(
+    "karmada_tpu_solver_requests_total",
+    "solver-sidecar RPCs served, by method",
+)
+bus_events = registry.counter(
+    "karmada_tpu_bus_events_total",
+    "store-bus watch events fanned out to subscribers (dropped = a slow "
+    "subscriber's stream was closed for re-list)",
+)
+bus_subscribers = registry.gauge(
+    "karmada_tpu_bus_subscribers",
+    "live store-bus watch subscribers",
+)
+bus_queue_depth = registry.gauge(
+    "karmada_tpu_bus_queue_depth",
+    "deepest subscriber queue at the last fan-out (backpressure signal)",
+)
+bus_event_age_seconds = registry.histogram(
+    "karmada_tpu_bus_event_age_seconds",
+    "time a watch event waited in a subscriber queue before the stream "
+    "picked it up",
+)
+works_rendered = registry.counter(
+    "karmada_tpu_controller_works_rendered_total",
+    "Work objects created or updated by the binding controller (the "
+    "work-render throughput ROADMAP item 3 optimizes)",
+)
+worker_reconciles = registry.counter(
+    "karmada_tpu_worker_reconciles_total",
+    "reconciles drained, by worker queue",
+)
+worker_queue_depth = registry.gauge(
+    "karmada_tpu_worker_queue_depth",
+    "keys still queued per worker after its last drain",
+)
+
+
+def render_families_table() -> str:
+    """The docs/OPERATIONS.md metric-families table, generated from the
+    live registry so prose can never drift from the exposition
+    (tools/docs_from_bench.py writes it between the metricfamilies
+    markers and fails loudly on drift — the env-table pattern)."""
+    lines = [
+        "| family | type | what it measures |",
+        "|---|---|---|",
+    ]
+    for name, type_, help_ in sorted(registry.families()):
+        lines.append(f"| `{name}` | {type_} | {help_} |")
+    return "\n".join(lines)
+
 
 class MetricsServer:
     """Prometheus text exposition over HTTP: every reference binary serves
     /metrics on --metrics-bind-address (cmd/scheduler/app/options/
     options.go:148); this is that endpoint for the TPU-native processes.
     Also answers /healthz (the readiness probe the reference wires via
-    healthz.InstallHandler)."""
+    healthz.InstallHandler) and /debug/traces (the wave-trace ring as
+    JSON — utils.tracing.tracer.dump())."""
 
     def __init__(
         self,
@@ -174,6 +393,18 @@ class MetricsServer:
                 elif self.path == "/healthz":
                     body = b"ok\n"
                     ctype = "text/plain"
+                elif self.path.startswith("/debug/traces"):
+                    import json
+
+                    from .tracing import tracer
+
+                    body = json.dumps(
+                        {
+                            "waves": tracer.wave_summaries(),
+                            "spans": tracer.dump(),
+                        }
+                    ).encode()
+                    ctype = "application/json"
                 else:
                     self.send_response(404)
                     self.send_header("Content-Length", "0")
@@ -200,3 +431,29 @@ class MetricsServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+
+
+def serve_process_metrics(port: Optional[str]) -> Optional[MetricsServer]:
+    """THE shared ``--metrics-port`` semantics for the standalone process
+    entrypoints (solver sidecar, estimator servers, store bus; the plane
+    has its own --metrics-address): flag value wins, an absent flag falls
+    back to $KARMADA_TPU_METRICS_PORT, and an empty value means disabled.
+    The value is a port (``0`` = ephemeral, loopback bind) or
+    ``HOST:PORT`` (``0.0.0.0:9090`` for an off-host scraper — loopback
+    stays the DEFAULT so an operator opts in to exposure explicitly).
+    Returns the STARTED server (caller prints/exports ``server.port``)
+    or None when disabled."""
+    import os
+
+    if port is None:
+        port = os.environ.get("KARMADA_TPU_METRICS_PORT", "")
+    port = str(port).strip()
+    if port == "":
+        return None
+    host = "127.0.0.1"
+    if ":" in port:
+        host, _, port = port.rpartition(":")
+        host = host or "127.0.0.1"
+    server = MetricsServer(address=(host, int(port)))
+    server.start()
+    return server

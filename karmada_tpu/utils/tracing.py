@@ -1,14 +1,34 @@
-"""Slow-operation tracing + event recording.
+"""Slow-operation tracing, wave-scoped span tracing, event recording.
 
 Ref: k8s.io/utils/trace usage (estimator server/estimate.go:37-54 logs
 "Estimating" traces over 100ms) and the EventRecorder pattern
 (scheduler.go:921-967 — events recorded on both binding and template).
+
+The wave tracer (ISSUE 6 tentpole) is the plane-wide form of utiltrace:
+a monotonic WAVE id is stamped when new work enters the plane (the
+detector's template events, or any settle that finds work queued), and
+every instrumented region — controller drains, scheduler passes, fleet
+kernel phases, estimator refreshes — records a ``Span`` carrying that
+wave id plus a parent span id, so one storm wave reconstructs as a single
+tree attributing pack/solve/dispatch/render/status time. Spans live in a
+bounded ring (deque), are exported as JSON by ``MetricsServer``'s
+``/debug/traces`` endpoint and ``karmadactl-tpu trace dump``, and are
+summarized per-phase by ``wave_summary`` (the bench observability tier's
+record format).
+
+Thread-safety: the completed-span ring, wave bookkeeping and summaries
+mutate/read under one lock; the OPEN-span parent chain is thread-local
+(each thread nests its own spans — a span never migrates threads).
 """
 
 from __future__ import annotations
 
+import itertools
 import logging
+import threading
 import time
+from collections import deque
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -51,6 +71,237 @@ class Trace:
         return msg
 
 
+# --------------------------------------------------------------------------
+# wave-scoped span tracing
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Span:
+    """One timed region of one wave. ``attrs`` may be filled while the
+    span is open (the fleet path stamps device/compile attribution onto
+    its kernel spans); everything is frozen into the ring at close."""
+
+    name: str
+    wave: int
+    span_id: int
+    parent_id: Optional[int]
+    start: float  # perf_counter
+    wall: float  # time.time at open (absolute anchor for exports)
+    end: Optional[float] = None
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return (self.end if self.end is not None else time.perf_counter()) - self.start
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "wave": self.wave,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start": round(self.start, 6),
+            "wall": round(self.wall, 6),
+            "duration_s": round(self.duration, 6),
+            "attrs": dict(self.attrs),
+        }
+
+
+class WaveTracer:
+    """Ring-buffered, thread-safe, nestable span recorder keyed by wave.
+
+    Wave lifecycle: ``ensure_wave(reason)`` opens a wave if none is open
+    (the detector stamps one per user-event burst; ``run_until_settled``
+    stamps one for any other work source) and ``end_wave()`` closes it
+    when the plane reaches quiescence — so one storm, however triggered,
+    is one wave id across every controller it touches."""
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._wave_seq = itertools.count(1)
+        self._span_seq = itertools.count(1)
+        self._local = threading.local()
+        self.current_wave = 0
+        self._wave_open = False
+        self._wave_reason = ""
+        self._wave_started = 0.0
+
+    # -- waves -------------------------------------------------------------
+
+    # called-with-lock-held helper (the *_locked naming convention):
+    # begin_wave/ensure_wave hold self._lock around it
+    def _begin_wave_locked(self, reason: str) -> int:  # graftlint: disable=GL004
+        self.current_wave = next(self._wave_seq)
+        self._wave_open = True
+        self._wave_reason = reason
+        self._wave_started = time.perf_counter()
+        return self.current_wave
+
+    def begin_wave(self, reason: str = "") -> int:
+        with self._lock:
+            return self._begin_wave_locked(reason)
+
+    def ensure_wave(self, reason: str = "") -> int:
+        # ONE critical section for check-and-open: two threads racing
+        # (detector event on the bus watch thread vs the serve loop's
+        # settle) must agree on a single wave id for one burst
+        with self._lock:
+            if self._wave_open:
+                return self.current_wave
+            return self._begin_wave_locked(reason)
+
+    def end_wave(self) -> None:
+        with self._lock:
+            self._wave_open = False
+
+    # -- spans -------------------------------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = []
+            self._local.stack = stack
+        return stack
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Record a span under the current wave, nested under this
+        thread's innermost open span. Yields the ``Span`` so callers can
+        stamp attrs (``kind="device"``, ``compile=True``) mid-flight."""
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        sp = Span(
+            name=name,
+            wave=self.current_wave,
+            span_id=next(self._span_seq),
+            parent_id=parent,
+            start=time.perf_counter(),
+            wall=time.time(),
+            attrs=dict(attrs),
+        )
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.end = time.perf_counter()
+            stack.pop()
+            # a span the caller marked _discard never reaches the ring
+            # (speculative spans around drains that turned out empty)
+            if not sp.attrs.pop("_discard", False):
+                with self._lock:
+                    self._spans.append(sp)
+
+    def record(self, name: str, duration: float, **attrs) -> Span:
+        """Append an already-measured region as a COMPLETED span (ending
+        now), nested under this thread's innermost open span — for code
+        that times its phases with perf_counter deltas (the fleet pass
+        breakdown) rather than nesting context managers."""
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        now = time.perf_counter()
+        sp = Span(
+            name=name,
+            wave=self.current_wave,
+            span_id=next(self._span_seq),
+            parent_id=parent,
+            start=now - duration,
+            wall=time.time() - duration,
+            end=now,
+            attrs=dict(attrs),
+        )
+        with self._lock:
+            self._spans.append(sp)
+        return sp
+
+    # -- export ------------------------------------------------------------
+
+    def dump(self, wave: Optional[int] = None) -> list[dict]:
+        with self._lock:
+            spans = list(self._spans)
+        if wave is not None:
+            spans = [s for s in spans if s.wave == wave]
+        return [s.to_json() for s in spans]
+
+    def waves(self) -> list[int]:
+        with self._lock:
+            return sorted({s.wave for s in self._spans})
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._wave_open = False
+
+    def wave_summary(self, wave: Optional[int] = None) -> dict:
+        """Per-phase attribution of one wave (default: the latest one
+        with spans): ``total_s`` sums the wave's ROOT spans (parentless —
+        the settle drains), ``phases`` maps span name -> summed SELF time
+        (duration minus direct children), and ``coverage`` is attributed/
+        total (1.0 by construction unless spans fell off the ring). The
+        bench observability tier compares ``total_s`` against the
+        externally measured wave wall clock for the >=95% criterion."""
+        with self._lock:
+            spans = list(self._spans)
+        if wave is None:
+            wave = max((s.wave for s in spans), default=0)
+        spans = [s for s in spans if s.wave == wave and s.end is not None]
+        by_id = {s.span_id: s for s in spans}
+        child_time: dict[int, float] = {}
+        for s in spans:
+            if s.parent_id is not None and s.parent_id in by_id:
+                child_time[s.parent_id] = (
+                    child_time.get(s.parent_id, 0.0) + s.duration
+                )
+        roots = [
+            s for s in spans
+            if s.parent_id is None or s.parent_id not in by_id
+        ]
+        total = sum(s.duration for s in roots)
+        phases: dict[str, float] = {}
+        counts: dict[str, int] = {}
+        device = compile_s = 0.0
+        for s in spans:
+            self_time = max(s.duration - child_time.get(s.span_id, 0.0), 0.0)
+            phases[s.name] = phases.get(s.name, 0.0) + self_time
+            counts[s.name] = counts.get(s.name, 0) + 1
+            if s.attrs.get("kind") == "device":
+                device += s.duration
+            # compile attribution is a FLAG, not a kind: a synchronous
+            # backend compiles inside the dispatch window, an async
+            # tunnel inside the device fence — the fleet marks both spans
+            # of a fresh-trace pass, so compile_s upper-bounds the
+            # compile-bearing time on either backend
+            if s.attrs.get("compile"):
+                compile_s += s.duration
+        attributed = sum(phases.values())
+        return {
+            "wave": wave,
+            "total_s": round(total, 6),
+            "coverage": round(attributed / total, 4) if total else 0.0,
+            "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+            "span_counts": dict(sorted(counts.items())),
+            "device_s": round(device, 6),
+            "compile_s": round(compile_s, 6),
+            "host_s": round(max(attributed - device, 0.0), 6),
+            "spans": len(spans),
+        }
+
+    def wave_summaries(self, last: int = 8) -> list[dict]:
+        return [self.wave_summary(w) for w in self.waves()[-last:]]
+
+
+#: the process-wide tracer (one ring per process, like the metrics
+#: registry; MetricsServer and the CLI dump read THIS instance)
+tracer = WaveTracer()
+
+
+# --------------------------------------------------------------------------
+# events
+# --------------------------------------------------------------------------
+
+
 @dataclass
 class Event:
     object_ref: str  # "<kind>/<key>"
@@ -61,19 +312,31 @@ class Event:
 
 
 class EventRecorder:
-    """In-memory event sink (kube EventRecorder seam). Bounded ring."""
+    """In-memory event sink (kube EventRecorder seam). Bounded ring —
+    ``deque(maxlen=...)`` so append-at-capacity is O(1) and atomic, with
+    a lock over append/snapshot: the shared global ``recorder`` is written
+    by every controller thread and read by status surfaces concurrently."""
 
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
-        self.events: list[Event] = []
+        self._events: deque[Event] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    @property
+    def events(self) -> list[Event]:
+        """Snapshot (consumers iterate/filter freely; the historical
+        attribute was a mutable list — a snapshot keeps that read
+        contract race-free)."""
+        with self._lock:
+            return list(self._events)
 
     def event(self, object_ref: str, type_: str, reason: str, message: str) -> None:
-        self.events.append(Event(object_ref, type_, reason, message))
-        if len(self.events) > self.capacity:
-            self.events = self.events[-self.capacity :]
+        with self._lock:
+            self._events.append(Event(object_ref, type_, reason, message))
 
     def for_object(self, object_ref: str) -> list[Event]:
-        return [e for e in self.events if e.object_ref == object_ref]
+        with self._lock:
+            return [e for e in self._events if e.object_ref == object_ref]
 
 
 # shared recorder (cmd binaries each had one; in-proc a single sink suffices)

@@ -286,23 +286,66 @@ class Runtime:
         caller invoking this repeatedly, as a real deployment's main loop
         does. ``heartbeat`` (if set) is invoked every HEARTBEAT_EVERY items
         so long drains cannot starve time-critical duties; a False return
-        aborts the drain (remaining keys stay queued for the next call)."""
+        aborts the drain (remaining keys stay queued for the next call).
+
+        Wave tracing: a settle with queued work is the unit the wave tree
+        hangs off — a ``settle`` root span wraps the drain, one
+        ``controller.<worker>`` child span per contiguous worker drain
+        (NOT per key: a 100k-binding storm is a handful of spans, not
+        100k), and the wave closes at quiescence so the next trigger
+        starts a fresh wave. Per-worker drain counts feed the
+        karmada_tpu_worker_* metric families once per drain — never per
+        key, the drain loop is the storm hot path."""
         if tick:
             self.tick()
+        if self.pending() == 0:
+            due = self.next_due()
+            if due is None or due > 0:
+                return 0  # quiescent (no queued keys, no due-parked keys)
+        from .metrics import settle_seconds, worker_queue_depth, worker_reconciles
+        from .tracing import tracer
+
+        tracer.ensure_wave("settle")
         steps = 0
         next_beat = self.HEARTBEAT_EVERY
-        while steps < max_steps:
-            progressed = False
-            for w in self.workers:
-                while w.process_one():
+        aborted = False
+        with tracer.span("settle") as root:
+            while steps < max_steps and not aborted:
+                progressed = False
+                for w in self.workers:
+                    drained = 0
+                    # the whole drain — including its FIRST item — runs
+                    # inside the controller span; an idle poll discards
+                    # the span so quiescent workers leave no trace
+                    with tracer.span(f"controller.{w.name}") as sp:
+                        while (
+                            steps < max_steps
+                            and not aborted
+                            and w.process_one()
+                        ):
+                            steps += 1
+                            drained += 1
+                            if (
+                                self.heartbeat is not None
+                                and steps >= next_beat
+                            ):
+                                next_beat = steps + self.HEARTBEAT_EVERY
+                                if self.heartbeat() is False:
+                                    aborted = True
+                        sp.attrs["items"] = drained
+                        if not drained:
+                            sp.attrs["_discard"] = True
+                    if not drained:
+                        continue
                     progressed = True
-                    steps += 1
-                    if steps >= max_steps:
-                        return steps
-                    if self.heartbeat is not None and steps >= next_beat:
-                        next_beat = steps + self.HEARTBEAT_EVERY
-                        if self.heartbeat() is False:
-                            return steps
-            if not progressed:
-                break
+                    worker_reconciles.inc(drained, worker=w.name)
+                    worker_queue_depth.set(len(w), worker=w.name)
+                    if aborted or steps >= max_steps:
+                        break
+                if not progressed:
+                    break
+            root.attrs["steps"] = steps
+        settle_seconds.observe(root.duration)
+        if self.pending() == 0:
+            tracer.end_wave()
         return steps

@@ -1,0 +1,22 @@
+"""GL006 bad fixture: unprefixed + duplicate metric family names."""
+
+
+class _Registry:
+    def counter(self, name, help_=""):
+        return name
+
+    def gauge(self, name, help_=""):
+        return name
+
+    def histogram(self, name, help_="", buckets=()):
+        return name
+
+
+registry = _Registry()
+
+# BAD: no karmada_tpu_/karmada_scheduler_ prefix
+requests_total = registry.counter("requests_total", "bare name")
+
+# BAD: same family registered twice (counter then histogram)
+dup_a = registry.counter("karmada_tpu_dup_total", "first registration")
+dup_b = registry.histogram("karmada_tpu_dup_total", "second registration")

@@ -1,0 +1,35 @@
+"""GL006 good fixture: prefixed, unique family names; non-registry
+receivers with a ``counter``-shaped method stay exempt."""
+
+import collections
+
+
+class _Registry:
+    def counter(self, name, help_=""):
+        return name
+
+    def gauge(self, name, help_=""):
+        return name
+
+    def histogram(self, name, help_="", buckets=()):
+        return name
+
+
+registry = _Registry()
+
+ok_counter = registry.counter("karmada_tpu_fixture_ok_total", "prefixed")
+ok_gauge = registry.gauge("karmada_scheduler_fixture_depth", "prefixed")
+ok_hist = registry.histogram("karmada_tpu_fixture_seconds", "prefixed")
+
+# not a registry receiver: collections.Counter / arbitrary APIs with a
+# same-named method are out of scope
+retries = collections.Counter()
+
+
+class _Api:
+    def counter(self, label):
+        return label
+
+
+api = _Api()
+unrelated = api.counter("not_a_metric_family")

@@ -36,6 +36,7 @@ FIXTURE_ROLES = {
     "GL003": set(),
     "GL004": set(),
     "GL005": {gl_core.ROLE_ENTRY, gl_core.ROLE_OPS},
+    "GL006": set(),
 }
 
 
@@ -108,6 +109,26 @@ def test_gl001_catches_each_pattern():
     assert "time.time" in details
     assert ".item" in details
     assert "os.environ" in details
+
+
+def test_gl006_catches_each_pattern():
+    findings = lint_fixture("gl006_bad.py", FIXTURE_ROLES["GL006"])
+    details = {f.detail for f in findings}
+    assert "requests_total" in details, "unprefixed family not flagged"
+    assert "dup:karmada_tpu_dup_total" in details, (
+        "duplicate family registration not flagged"
+    )
+
+
+def test_gl006_registry_families_unique_and_prefixed():
+    """The live registry is GL006's ground truth: every family defined in
+    the package must satisfy the rule the linter enforces statically."""
+    from karmada_tpu.utils.metrics import registry
+
+    names = [name for name, _type, _help in registry.families()]
+    assert len(names) == len(set(names)), "duplicate family in registry"
+    for name in names:
+        assert name.startswith(("karmada_tpu_", "karmada_scheduler_")), name
 
 
 def test_gl003_resolves_constant_keys():

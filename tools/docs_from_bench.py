@@ -140,6 +140,41 @@ def estimator_block(ed: dict) -> str:
     )
 
 
+def obs_block(od: dict) -> str:
+    """Rows for a ``bench.py --observability`` record (the wave-trace
+    attribution tier): coverage of the measured wall clock, the kernel
+    compile/device/host split, and the heaviest wave phases."""
+    scale = od.get("metric", "").removeprefix("observability_wave_")
+    cov = od.get("coverage_vs_wall", 0.0)
+    phases = od.get("phases", {}) or {}
+    top = sorted(phases.items(), key=lambda kv: -kv[1])[:5]
+    top_s = ", ".join(f"{k} {v:.2f}s" for k, v in top)
+    compiles = od.get("kernel_compiles", {}) or {}
+    comp_s = (
+        ", ".join(f"{k} x{int(v)}" for k, v in sorted(compiles.items()))
+        or "none"
+    )
+    return "\n".join(
+        [
+            f"| observability {scale}: storm wave wall / span coverage | "
+            f"{fmt(od.get('value'))} wall, {cov * 100:.1f}% attributed to "
+            f"named spans ({od.get('bindings_s', 0):,.0f} bindings/s, "
+            f"{od.get('works', 0):,} works) |",
+            f"| observability {scale}: kernel span split | "
+            f"host(pack/decode) {phases.get('kernel.host', 0.0):.2f}s, "
+            f"dispatch {phases.get('kernel.dispatch', 0.0):.2f}s (sync "
+            f"backends execute inside it), device-fence "
+            f"{phases.get('kernel.device', 0.0):.2f}s, fetch "
+            f"{phases.get('kernel.fetch', 0.0):.2f}s; compile-bearing "
+            f"{od.get('compile_s', 0.0):.2f}s |",
+            f"| observability {scale}: heaviest wave phases (self time) | "
+            f"{top_s} |",
+            f"| observability {scale}: serving-path kernel compiles "
+            f"(whole run) | {comp_s} |",
+        ]
+    )
+
+
 def extra_block(src: Path) -> str:
     """Dispatch an extra record file by its metric prefix."""
     d = json.loads(src.read_text())
@@ -150,6 +185,8 @@ def extra_block(src: Path) -> str:
         return cold_block(d)
     if metric.startswith("estimator512_wire"):
         return estimator_block(d)
+    if metric.startswith("observability_wave"):
+        return obs_block(d)
     raise SystemExit(f"{src}: unrecognized bench record metric {metric!r}")
 
 
@@ -201,6 +238,42 @@ def check_env_table() -> None:
         )
 
 
+def metrics_table() -> str:
+    """The generated metric-families table (karmada_tpu.utils.metrics
+    ``registry`` is the single source of truth; graftlint GL006 keeps the
+    names prefixed and unique)."""
+    sys.path.insert(0, str(ROOT))
+    from karmada_tpu.utils.metrics import render_families_table
+
+    return (
+        "_Generated from the `karmada_tpu/utils/metrics.py` registry by "
+        "`tools/docs_from_bench.py --metrics-table` — regenerate, don't "
+        "hand-edit._\n\n" + render_families_table()
+    )
+
+
+def check_metrics_table() -> None:
+    """Fail loudly when the committed OPERATIONS.md metric-families table
+    drifted from the live registry (a family the table misses is a family
+    operators won't know to scrape) — runs on EVERY doc regeneration,
+    same pattern as the env-flag gate."""
+    path = ROOT / "docs" / "OPERATIONS.md"
+    m = _marker_re("metricfamilies").search(path.read_text())
+    if not m:
+        raise SystemExit(
+            f"{path}: no metricfamilies markers — restore the "
+            "Observability metric-families section and run "
+            "`python tools/docs_from_bench.py --metrics-table`"
+        )
+    committed_body = m.group(0).split("-->\n", 1)[1].rsplit("<!--", 1)[0]
+    if committed_body.strip() != metrics_table().strip():
+        raise SystemExit(
+            f"{path}: metric-families table drifted from "
+            "karmada_tpu/utils/metrics.py registry — run "
+            "`python tools/docs_from_bench.py --metrics-table`"
+        )
+
+
 def check_ir_registry() -> None:
     """Fail loudly when a kernel family exported from karmada_tpu/ops/ is
     missing from the graftlint IR entry-point registry (or the registry
@@ -224,6 +297,15 @@ def check_ir_registry() -> None:
 def main() -> None:
     if sys.argv[1:] == ["--env-table"]:
         rewrite(ROOT / "docs" / "OPERATIONS.md", env_table(), "envflags")
+        check_metrics_table()
+        check_ir_registry()
+        return
+    if sys.argv[1:] == ["--metrics-table"]:
+        rewrite(
+            ROOT / "docs" / "OPERATIONS.md", metrics_table(),
+            "metricfamilies",
+        )
+        check_env_table()
         check_ir_registry()
         return
     src = Path(sys.argv[1])
@@ -244,6 +326,7 @@ def main() -> None:
     rewrite(ROOT / "docs" / "OPERATIONS.md", body)
     rewrite(ROOT / "BASELINE.md", body)
     check_env_table()
+    check_metrics_table()
     check_ir_registry()
 
 

@@ -15,7 +15,8 @@ Run it:
     karmadactl-tpu lint [--ir]                # same, as a CLI verb
 
 Rules: GL001 trace safety, GL002 trace-key completeness, GL003 env-flag
-registry, GL004 lock discipline, GL005 cold-start import hygiene; IR001
+registry, GL004 lock discipline, GL005 cold-start import hygiene, GL006
+metric naming & uniqueness; IR001
 dtype discipline, IR002 host round-trips, IR003 const capture, IR004
 trace-manifest fidelity, IR005 donation audit. Suppress per line with
 ``# graftlint: disable=GL00X`` (same line, line above, or the enclosing

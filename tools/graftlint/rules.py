@@ -8,6 +8,8 @@
 | GL004 | lock discipline: lock-guarded attrs never mutated lock-free      |
 | GL005 | cold-start import hygiene: no module-level jax in entry modules, |
 |       | no scheduler imports from ops/                                   |
+| GL006 | metric naming: registry.counter/gauge/histogram names must carry |
+|       | the karmada_tpu_/karmada_scheduler_ prefix and be unique         |
 
 Each rule is a pure-AST pass over one ``ModuleInfo`` (plus cross-module
 ``finalize`` hooks); nothing here imports jax.
@@ -632,6 +634,95 @@ class LockDiscipline(Rule):
                 anchor=f"{mod.qualname(cls)}.{method.name}", detail=attr,
                 anchor_line=method.lineno,
             )
+
+
+# --------------------------------------------------------------------------
+# GL006 — metric naming & uniqueness
+# --------------------------------------------------------------------------
+
+#: registry factory methods whose first argument is a metric family name
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+#: allowed metric-name prefixes: the project family and the reference's
+#: scheduler names carried over verbatim (metrics.go:61-115)
+_METRIC_PREFIXES = ("karmada_tpu_", "karmada_scheduler_")
+
+
+@rule
+class MetricNaming(Rule):
+    id = "GL006"
+    title = (
+        "metric families must be karmada_tpu_*/karmada_scheduler_* and "
+        "unique across the import graph"
+    )
+
+    @staticmethod
+    def _defined(ctx: LintContext) -> dict:
+        # name -> [(rel, line, anchor)], accumulated per run on the
+        # context (rule instances are process-global singletons)
+        if not hasattr(ctx, "_gl006_defined"):
+            ctx._gl006_defined = {}
+        return ctx._gl006_defined
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METRIC_FACTORIES
+            ):
+                continue
+            if not (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                continue
+            # restrict to Registry-shaped receivers (``registry.counter``,
+            # ``reg.histogram``, ``self.registry.gauge``) so unrelated
+            # APIs with a str-first ``counter(...)`` method don't trip
+            recv = node.func.value
+            recv_name = (
+                recv.id if isinstance(recv, ast.Name)
+                else recv.attr if isinstance(recv, ast.Attribute)
+                else None
+            )
+            if recv_name is None or "reg" not in recv_name.lower():
+                continue
+            name = node.args[0].value
+            anchor = mod.qualname(node) or "<module>"
+            self._defined(ctx).setdefault(name, []).append(
+                (mod.rel, node.lineno, anchor)
+            )
+            if not name.startswith(_METRIC_PREFIXES):
+                yield Finding(
+                    rule=self.id, path=mod.rel, line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"metric family {name!r} does not carry a "
+                        f"{'/'.join(_METRIC_PREFIXES)} prefix — scrapers "
+                        "aggregate fleets by prefix, and an unprefixed "
+                        "name collides with other exporters on the node"
+                    ),
+                    anchor=anchor, detail=name,
+                )
+
+    def finalize(self, ctx: LintContext) -> Iterator[Finding]:
+        """Cross-module uniqueness: the same family name registered twice
+        double-renders on /metrics (scrapers reject the exposition)."""
+        for name, sites in sorted(self._defined(ctx).items()):
+            if len(sites) < 2:
+                continue
+            first = f"{sites[0][0]}:{sites[0][1]}"
+            for rel, line, anchor in sites[1:]:
+                yield Finding(
+                    rule=self.id, path=rel, line=line, col=1,
+                    message=(
+                        f"metric family {name!r} is already registered at "
+                        f"{first} — duplicate registration double-renders "
+                        "the family in the exposition"
+                    ),
+                    anchor=anchor, detail=f"dup:{name}",
+                )
 
 
 # --------------------------------------------------------------------------
