@@ -127,6 +127,23 @@ def build_parser():
         "measured wall clock — the BENCH_OBS_r*.json record",
     )
     p.add_argument(
+        "--chaos", action="store_true",
+        help="run the chaos-failover tier (default 20k bindings x 512 "
+        "clusters; --bindings/--clusters override): a whole-plane storm "
+        "with ordered ClusterAffinities placements and live gRPC "
+        "estimator servers, then a seeded fault-injection wave killing "
+        "--chaos-kill clusters and SIGSTOP-partitioning one estimator "
+        "server mid-wave; records time-to-stable-placement, displaced-"
+        "binding count, batched-solve count, breaker transitions, and "
+        "verifies the recovered placements against the numpy ordered-"
+        "failover oracle replaying the same event log — the "
+        "BENCH_CHAOS_r*.json record",
+    )
+    p.add_argument("--chaos-kill", type=int, default=8,
+                   help="clusters killed by the chaos wave (K)")
+    p.add_argument("--chaos-seed", type=int, default=1,
+                   help="fault-injection seed (the replay key)")
+    p.add_argument(
         "--estimator-only", action="store_true",
         help="run just the estimator-512 wire tier (4 live gRPC server "
         "processes): full-refresh storm p50 over the batched protocol, "
@@ -1753,6 +1770,374 @@ def run_cold_start(args) -> dict:
 # --------------------------------------------------------------------------
 
 
+def run_chaos(args) -> dict:
+    """ISSUE 7 acceptance tier: the failure half of the plane at storm
+    scale. A 20k x 512 whole-plane fleet under an ordered-failover policy
+    (ClusterAffinities [primary, fallback]) with availability served by
+    LIVE gRPC estimator servers; a seeded chaos wave flips K member
+    clusters NotReady (cluster.health fault point -> the real
+    condition->taint->NoExecute-eviction machinery) and SIGSTOP-partitions
+    one estimator server mid-wave. Records time-to-stable-placement, the
+    displaced-binding count against the batched-solve count (failover must
+    reschedule in O(chunks) solves, not O(bindings)), the estimator
+    breaker's open->half-open->closed recovery, and verifies the final
+    placements bit-for-bit against the numpy per-binding oracle
+    (refimpl.failover_np.replay_failover) consuming the same fault-event
+    log."""
+    import signal
+
+    from karmada_tpu import cli as _cli
+    from karmada_tpu.api import (
+        PropagationPolicy,
+        PropagationSpec,
+        ResourceSelector,
+    )
+    from karmada_tpu.api.core import ObjectMeta
+    from karmada_tpu.api.policy import ClusterAffinityTerm, LabelSelector
+    from karmada_tpu.controllers.extras import (
+        ObjectReferenceSelector,
+        WorkloadRebalancer,
+        WorkloadRebalancerSpec,
+    )
+    from karmada_tpu.estimator.fleet import spawn_estimator_fleet
+    from karmada_tpu.refimpl.failover_np import replay_failover
+    from karmada_tpu.scheduler import ClusterSnapshot
+    from karmada_tpu.scheduler.snapshot import compile_placement
+    from karmada_tpu.utils import backoff, faultinject
+    from karmada_tpu.utils.builders import (
+        dynamic_weight_placement,
+        new_cluster,
+        new_deployment,
+    )
+    from karmada_tpu.utils.features import FAILOVER, feature_gate
+    from karmada_tpu.utils.metrics import circuit_state, degraded_passes
+
+    n, c, kill_k, seed = args.bindings, args.clusters, args.chaos_kill, args.chaos_seed
+    n_servers = 4
+    n_fallback = max(c // 8, kill_k + 2)
+
+    def group_term(g):
+        return ClusterAffinityTerm(
+            affinity_name=f"grp-{g}",
+            label_selector=LabelSelector(match_labels={"group": g}),
+        )
+
+    from karmada_tpu.estimator.accurate import NodeState
+    from karmada_tpu.utils.member import MemberCluster
+    from karmada_tpu.utils.quantity import parse_resource_list
+
+    feature_gate.set(FAILOVER, True)
+    clock = [10_000.0]
+    cp = _cli.cmd_init(clock=lambda: clock[0])
+    for i in range(c):
+        group = "fallback" if i >= c - n_fallback else "primary"
+        name = f"ch{i:04d}"
+        caps = {
+            "cpu": f"{2000 + 8 * (i % 37)}", "memory": "4000Gi",
+            "pods": 10_000,
+        }
+        # members carry REAL node state (one node = the cluster's caps):
+        # the status controller derives genuine resource summaries from
+        # it, so availability is capacity math (not the no-summary
+        # sentinel clamp) and the estimator servers mirror it exactly —
+        # the oracle-identity precondition
+        member = MemberCluster(name)
+        member.nodes = [
+            NodeState(
+                name=f"{name}-n0", allocatable=parse_resource_list(caps)
+            )
+        ]
+        cp.join_cluster(
+            new_cluster(name, labels={"group": group}, **caps), member
+        )
+    cp.settle()
+
+    # live estimator fleet over the SAME capacities the snapshot carries
+    # (min-merge(general, accurate) == general, so placements stay
+    # oracle-checkable); ISSUE 4's invariant keeps degraded passes
+    # un-replayable while a server is partitioned
+    snap0 = ClusterSnapshot(sorted(
+        cp.store.list("Cluster"), key=lambda cl: cl.name
+    ))
+    free = np.maximum(np.asarray(snap0.available_cap), 0)
+    dims = list(snap0.dims)
+    t0 = time.perf_counter()
+    fleet_ctx = spawn_estimator_fleet(
+        snap0.names, free, dims, n_servers=n_servers, index=snap0.index,
+        timeout_seconds=3.0,
+    )
+    fleet = fleet_ctx.__enter__()
+    record: dict = {}
+    try:
+        cp.scheduler.estimator_registry = fleet.registry
+        cp.scheduler.extra_estimators = [
+            fleet.registry.make_batch_estimator(
+                snap0.names, timeout_seconds=5.0
+            )
+        ]
+        print(
+            f"# chaos build: {c} clusters, {n_servers} estimator server "
+            f"processes in {time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+        )
+
+        t0 = time.perf_counter()
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="chaos-policy", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=dynamic_weight_placement(
+                    cluster_affinities=[
+                        group_term("primary"), group_term("fallback"),
+                    ]
+                ),
+            ),
+        ))
+        profiles = [
+            {"cpu": f"{250 * (p + 1)}m", "memory": f"{512 * (p + 1)}Mi"}
+            for p in range(8)
+        ]
+        for i in range(n):
+            prof = profiles[i % 8]
+            cp.store.apply(new_deployment(
+                f"ch{i}", replicas=(i % 8) + 1, cpu=prof["cpu"],
+                memory=prof["memory"],
+            ))
+        print(f"# chaos workload build: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+        t0 = time.perf_counter()
+        cp.settle()
+        print(f"# chaos cold wave: {time.perf_counter() - t0:.1f}s",
+              file=sys.stderr)
+
+        def storm_wave(tag: str) -> float:
+            clock[0] += 60
+            cp.store.apply(WorkloadRebalancer(
+                meta=ObjectMeta(name=f"chaos-storm-{tag}"),
+                spec=WorkloadRebalancerSpec(workloads=[
+                    ObjectReferenceSelector(kind="Deployment", name=f"ch{i}")
+                    for i in range(n)
+                ]),
+            ))
+            t0 = time.perf_counter()
+            cp.settle()
+            return time.perf_counter() - t0
+
+        prev_w = None
+        for wi in range(3):
+            w = storm_wave(f"warm{wi}")
+            print(f"# chaos warm{wi} wave: {w:.1f}s", file=sys.stderr)
+            if prev_w is not None and w > prev_w * 0.7:
+                break
+            prev_w = w
+
+        # ---- steady reference (fault injection DISARMED: the injection
+        # points are live in every hot path below, armed-off)
+        steady = [storm_wave(f"steady{k}") for k in range(3)]
+        steady_p50 = float(np.median(steady))
+        print(f"# chaos steady storm p50 (disarmed): {steady_p50:.2f}s",
+              file=sys.stderr)
+
+        # ---- record pre-kill placements + pick the kill set from the
+        # clusters actually carrying placements (seeded, replayable)
+        before: dict[str, dict[str, int]] = {}
+        for i in range(n):
+            rb = cp.store.get("ResourceBinding", f"default/ch{i}-deployment")
+            before[rb.meta.namespaced_name] = {
+                tc.name: tc.replicas for tc in rb.spec.clusters
+            }
+        placed_primary = sorted({
+            name for placed in before.values() for name in placed
+        })
+        primary_names = {
+            cl.name for cl in cp.store.list("Cluster")
+            if cl.meta.labels.get("group") == "primary"
+        }
+        candidates = [p for p in placed_primary if p in primary_names]
+        rng = np.random.default_rng(seed)
+        kill = sorted(
+            rng.choice(candidates, size=min(kill_k, len(candidates)),
+                       replace=False).tolist()
+        )
+        spec = ";".join(f"cluster.health=down,match={k}" for k in kill)
+        est_conn = fleet.conns[0]
+        stopped_proc = fleet.procs[0]
+        est_channel = f"estimator@{est_conn.target}"
+
+        # ---- the chaos wave: arm the seeded kills and partition
+        # estimator server 0, then settle. The next heartbeat (the tick
+        # at the head of the settle) flips the K members NotReady INSIDE
+        # the wave; taints -> NoExecute evictions -> the cluster event
+        # re-gates the whole 20k grid, and the displaced rows reschedule
+        # through the ranked ordered-failover path as batched solves —
+        # all while one estimator server is black-holed (its clusters
+        # answer -1, the pass is degraded-not-stalled, and its breaker
+        # opens). Time-to-stable-placement is this settle's wall clock.
+        d0 = degraded_passes.value(channel="estimator")
+        inj = faultinject.arm(spec, seed=seed)
+        stopped_proc.send_signal(signal.SIGSTOP)
+        solves0 = cp.scheduler._engine.solve_batches
+        clock[0] += 60
+        t0 = time.perf_counter()
+        cp.settle()
+        time_to_stable = time.perf_counter() - t0
+        solves_wave = cp.scheduler._engine.solve_batches - solves0
+        degraded_wave = degraded_passes.value(channel="estimator") - d0
+        print(
+            f"# chaos wave: stable in {time_to_stable:.1f}s, "
+            f"{solves_wave} batched solves, degraded estimator "
+            f"passes={degraded_wave}",
+            file=sys.stderr,
+        )
+
+        # ---- verify: every binding against the per-binding numpy oracle
+        # replaying the same event log
+        after: dict[str, dict[str, int]] = {}
+        displaced = 0
+        killed_set = set(kill)
+        for i in range(n):
+            rb = cp.store.get("ResourceBinding", f"default/ch{i}-deployment")
+            key = rb.meta.namespaced_name
+            after[key] = {tc.name: tc.replicas for tc in rb.spec.clusters}
+            if killed_set & set(before[key]):
+                displaced += 1
+        engine = cp.scheduler._engine
+        esnap = engine.snapshot
+        pl = cp.store.get(
+            "PropagationPolicy", "default/chaos-policy"
+        ).spec.placement
+        cpl = compile_placement(pl, esnap)
+        term_masks = np.stack([m for _, m in cpl.terms])
+        base = cpl.taint_ok & cpl.spread_field_ok
+        # per-profile availability rows (general == merged: the estimator
+        # mirrors the snapshot, and -1 never survives the min-merge)
+        pods_dim = esnap.dim_index("pods")
+        avail_rows = {}
+        from karmada_tpu.utils.quantity import parse_resource_list
+
+        for p, prof in enumerate(profiles):
+            reqs = np.zeros((1, len(esnap.dims)), np.int64)
+            for d, q in parse_resource_list(prof).items():
+                di = esnap.dim_index(d)
+                if di is not None:
+                    reqs[0, di] = q
+            if pods_dim is not None:
+                reqs[0, pods_dim] = 1
+            avail_rows[p] = engine._availability_np(
+                reqs, np.asarray([8], np.int32)
+            )[0]
+        keys = list(before)
+        want = replay_failover(
+            inj.log,
+            esnap.names,
+            before,
+            {k: term_masks for k in keys},
+            {k: base for k in keys},
+            {k: cpl.strategy for k in keys},
+            {k: (i % 8) + 1 for i, k in enumerate(keys)},
+            {k: cpl.static_weights for k in keys},
+            {k: avail_rows[i % 8] for i, k in enumerate(keys)},
+        )
+        mismatches = [
+            k for k in keys if want[k] != after[k]
+        ]
+        oracle_identical = not mismatches
+        print(
+            f"# chaos oracle: {len(keys) - len(mismatches)}/{len(keys)} "
+            f"placements identical, {displaced} displaced by "
+            f"{len(kill)} killed clusters",
+            file=sys.stderr,
+        )
+        if mismatches:
+            k = mismatches[0]
+            print(
+                f"# chaos oracle FIRST MISMATCH {k}: want {want[k]} "
+                f"got {after[k]} (before {before[k]})",
+                file=sys.stderr,
+            )
+
+        # ---- degraded storms with the server STILL partitioned: the
+        # breaker crosses its threshold and opens — a breaker-open pass
+        # answers -1 with zero wire cost and is observable on the
+        # karmada_tpu_circuit_state gauge
+        degraded_storm_s = [storm_wave(f"degraded{k}") for k in range(2)]
+        breaker_open = est_conn.breaker.state == backoff.OPEN or (
+            circuit_state.value(channel=est_channel) == backoff.OPEN
+        )
+        print(
+            f"# chaos degraded storms (server partitioned): "
+            f"{', '.join(f'{s:.1f}s' for s in degraded_storm_s)}; "
+            f"estimator breaker open={breaker_open}",
+            file=sys.stderr,
+        )
+
+        # ---- recovery: un-partition the estimator server; the breaker
+        # must close half-open -> closed without operator action
+        stopped_proc.send_signal(signal.SIGCONT)
+        faultinject.disarm()
+        import grpc as _grpc
+
+        try:
+            _grpc.channel_ready_future(est_conn._channel).result(timeout=30)
+        except Exception:  # noqa: BLE001 — recovery probe below decides
+            pass
+        recovered = False
+        storm = 0.0
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            clock[0] += 60
+            fleet.registry.invalidate(drop=True)
+            storm = storm_wave("recover")
+            if est_conn.breaker.state == backoff.CLOSED:
+                recovered = True
+                break
+            time.sleep(0.5)
+        print(
+            f"# chaos recovery: breaker "
+            f"{'closed' if recovered else 'STILL OPEN'} after server "
+            f"resume (last recover wave {storm:.1f}s)",
+            file=sys.stderr,
+        )
+
+        record = {
+            "metric": f"chaos_storm_{n // 1000}kx{c}",
+            "value": round(time_to_stable, 4),
+            "unit": "s",
+            # the acceptance slot: oracle-identical fraction (1.0 passes)
+            "vs_baseline": round(
+                (len(keys) - len(mismatches)) / max(len(keys), 1), 6
+            ),
+            "time_to_stable_s": round(time_to_stable, 4),
+            "steady_p50_disarmed_s": round(steady_p50, 4),
+            "killed_clusters": kill,
+            "est_server_partitioned": est_conn.target,
+            "displaced_bindings": displaced,
+            "degraded_storm_s": [round(s, 4) for s in degraded_storm_s],
+            "solves_failover_wave": int(solves_wave),
+            "oracle_identical": oracle_identical,
+            "oracle_mismatches": len(mismatches),
+            "breaker_open_observed": bool(breaker_open),
+            "breaker_recovered_closed": bool(recovered),
+            "degraded_estimator_passes": int(
+                degraded_passes.value(channel="estimator") - d0
+            ),
+            "replay_events": len(inj.log),
+            "chaos_seed": seed,
+        }
+    finally:
+        feature_gate.set(FAILOVER, False)
+        faultinject.disarm()
+        try:
+            fleet_ctx.__exit__(None, None, None)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+    del cp
+    gc.collect()
+    return record
+
+
 def run_observability(args) -> dict:
     """ISSUE 6 acceptance tier: one whole-plane storm wave (detector ->
     scheduler -> binding -> works) with the wave tracer on. The record
@@ -2095,9 +2480,11 @@ def main():
     args = build_parser().parse_args()
     # per-tier default scale (see build_parser): explicit flags always win
     if args.bindings is None:
-        args.bindings = 20_000 if args.observability else 100_000
+        args.bindings = (
+            20_000 if (args.observability or args.chaos) else 100_000
+        )
     if args.clusters is None:
-        args.clusters = 512 if args.observability else 5_000
+        args.clusters = 512 if (args.observability or args.chaos) else 5_000
     if args.cpu:
         import jax
 
@@ -2110,6 +2497,9 @@ def main():
         return
     if args.observability:
         print(json.dumps(run_observability(args)))
+        return
+    if args.chaos:
+        print(json.dumps(run_chaos(args)))
         return
     if args.estimator_only:
         tier_status: dict = {}

@@ -203,6 +203,12 @@ def main(argv=None) -> None:
         help="lease holder identity (default: <cluster>-<pid>)",
     )
     args = p.parse_args(argv)
+    # chaos: arm deterministic fault injection from the environment — the
+    # agent's bus channel (StoreReplica Apply/Delete/Watch) carries the
+    # bus.rpc/bus.watch injection points
+    from ..utils.faultinject import arm_from_env
+
+    arm_from_env()
     agent_main(
         args.target,
         args.cluster,

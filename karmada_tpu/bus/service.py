@@ -294,6 +294,7 @@ class StoreReplica:
         root_ca: Optional[bytes] = None,
         client_cert: Optional[bytes] = None,
         client_key: Optional[bytes] = None,
+        timeout_seconds: float = 10.0,
     ):
         if (client_cert or client_key) and not (root_ca and client_cert and client_key):
             raise ValueError(
@@ -329,6 +330,26 @@ class StoreReplica:
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # unified channel resilience (utils.backoff): write-through RPCs
+        # carry ONE overall deadline budget with decorrelated-jitter
+        # retries; consecutive transport failures open the breaker so a
+        # dead bus fast-fails writers (backpressure — the worker queue
+        # parks the key) instead of stacking full timeouts
+        from ..utils.backoff import default_breaker, default_policy
+
+        self.timeout = timeout_seconds
+        # short reset window: the bus is the replica's lifeline and the
+        # half-open probe costs one RPC — a restarted bus must re-admit
+        # writers within ~a second, not a scrape interval
+        self.breaker = default_breaker(f"bus@{target}", reset_default=1.0)
+        # env-derived and constant for this replica's lifetime: built once
+        # (the write-through path runs per mirrored store write)
+        self._policy = default_policy(
+            attempt_timeout=timeout_seconds / 2, max_attempts=3
+        )
+        self._policy_once = default_policy(
+            attempt_timeout=timeout_seconds, max_attempts=1
+        )
 
     # -- replication -------------------------------------------------------
 
@@ -337,8 +358,23 @@ class StoreReplica:
         self._thread.start()
 
     def _run(self) -> None:
+        import random
+
+        from ..utils.backoff import BackoffPolicy
+        from ..utils.faultinject import apply_fault, fault_point
+
+        # reconnect schedule: decorrelated jitter, but capped LOW — the
+        # watch stream is how an agent finds out about the whole world,
+        # so the de-stampeding must not cost seconds of staleness after
+        # a bus restart (the old fixed loop re-listed every 200 ms)
+        policy = BackoffPolicy(base=0.05, cap=0.5)
+        rng = random.Random()
+        sleeps = policy.sleeps(rng)
         while not self._stop.is_set():
             try:
+                apply_fault(
+                    fault_point("bus.watch", "Watch"), "bus.watch", "Watch"
+                )
                 stream = self._watch(
                     pb.WatchRequest(kinds=list(self.kinds), replay=True)
                 )
@@ -348,13 +384,17 @@ class StoreReplica:
                     if ev.type == "Bookmark":
                         # replay fully consumed: NOW the mirror is synced
                         self._synced.set()
+                        # healthy stream: reset the reconnect schedule
+                        sleeps = policy.sleeps(rng)
                         continue
                     self._apply_event(ev)
             except grpc.RpcError:
                 if self._stop.is_set():
                     return
                 self._synced.clear()
-                self._stop.wait(0.2)  # reconnect backoff, then re-list
+                # decorrelated-jitter reconnect (was a fixed 200 ms loop:
+                # a partitioned bus saw every replica re-list in lockstep)
+                self._stop.wait(next(sleeps))
 
     def _apply_event(self, ev: pb.Event) -> None:
         if ev.type == "Deleted":
@@ -381,15 +421,46 @@ class StoreReplica:
 
     # -- write-through -----------------------------------------------------
 
+    def _resilient(self, method: str, stub, req, *, retry: bool = True):
+        """One write-through RPC under the unified policy: overall
+        deadline budget = ``self.timeout``, decorrelated-jitter retries on
+        transport errors only (admission rejections come back in the
+        response body and never retry), breaker fast-fail when the bus is
+        down — THE backpressure signal: the caller's worker queue parks
+        the key instead of this thread stacking timeouts. ``retry=False``
+        is for conditional writes: retrying one after a commit-then-
+        timeout would surface the caller's OWN committed write as a false
+        ConflictError, so those get one bounded attempt."""
+        from ..utils.backoff import Deadline, call_with_resilience
+        from ..utils.faultinject import apply_fault, fault_point
+
+        def attempt(timeout: float):
+            apply_fault(
+                fault_point("bus.rpc", method), "bus.rpc", method
+            )
+            return stub(req, timeout=timeout)
+
+        return call_with_resilience(
+            attempt,
+            channel="bus",
+            policy=self._policy if retry else self._policy_once,
+            breaker=self.breaker,
+            deadline=Deadline(self.timeout),
+            retryable=(grpc.RpcError,),
+        )
+
     def apply(self, obj, *, expected_rv=None) -> int:
         kind = type(obj).KIND if hasattr(type(obj), "KIND") else "Resource"
-        resp = self._apply(
+        resp = self._resilient(
+            "Apply",
+            self._apply,
             pb.ApplyRequest(
                 kind=kind,
                 object_json=encode_object(obj),
                 conditional=expected_rv is not None,
                 expected_rv=expected_rv or 0,
-            )
+            ),
+            retry=expected_rv is None,
         )
         if resp.error:
             if resp.conflict:
@@ -398,7 +469,11 @@ class StoreReplica:
         return resp.resource_version
 
     def delete(self, kind: str, key: str, force: bool = False) -> bool:
-        resp = self._delete(pb.DeleteRequest(kind=kind, key=key, force=force))
+        resp = self._resilient(
+            "Delete",
+            self._delete,
+            pb.DeleteRequest(kind=kind, key=key, force=force),
+        )
         if resp.error:
             raise RuntimeError(resp.error)
         return resp.deleted

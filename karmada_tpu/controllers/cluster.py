@@ -78,7 +78,15 @@ class ClusterStatusController:
             self.collect(cluster)
 
     def collect(self, cluster: Cluster) -> None:
+        from ..utils.faultinject import fault_point
+
         member = self.members.get(cluster.name)
+        # chaos seam (ISSUE 7): an armed `cluster.health=down` rule flips
+        # this member NotReady for the firing judgement — the same
+        # condition->taint->NoExecute-eviction machinery a real outage
+        # drives, replayable from the fault seed
+        rule = fault_point("cluster.health", cluster.name)
+        forced_down = rule is not None and rule.action == "down"
         if cluster.spec.sync_mode == "Pull":
             # the plane cannot probe Pull members; Ready is lease freshness
             # ALONE (monitorClusterHealth over the agent-renewed Lease) — a
@@ -87,14 +95,17 @@ class ClusterStatusController:
             ready = (
                 lease is not None
                 and self.clock() - lease.renew_time < self.lease_grace
+                and not forced_down
             )
             reason = "AgentLeaseRenewed" if ready else "AgentLeaseExpired"
         else:
-            ready = member is not None and member.reachable
+            ready = member is not None and member.reachable and not forced_down
             reason = "ClusterReady" if ready else "ClusterNotReachable"
         # status collection still needs a live client regardless of how
         # Ready was judged
-        reachable = member is not None and member.reachable
+        reachable = (
+            member is not None and member.reachable and not forced_down
+        )
         changed = set_condition(
             cluster.status.conditions,
             Condition(type="Ready", status=ready, reason=reason),

@@ -23,6 +23,24 @@ from ..utils import DONE, Runtime, Store
 DEFAULT_SCHEDULER = "default-scheduler"
 
 
+def _is_transport_error(exc: Exception) -> bool:
+    """Solver-channel failures that trigger the in-proc fallback (grpc is
+    imported lazily so in-proc-only deployments never pay for it)."""
+    from ..utils.backoff import CircuitBreakerOpen, DeadlineExceeded
+    from ..utils.faultinject import FaultError
+
+    if isinstance(
+        exc, (CircuitBreakerOpen, DeadlineExceeded, FaultError,
+              ConnectionError, TimeoutError)
+    ):
+        return True
+    try:
+        import grpc
+    except ImportError:  # pragma: no cover — grpc ships in the image
+        return False
+    return isinstance(exc, grpc.RpcError)
+
+
 class SchedulerController:
     def __init__(
         self,
@@ -114,6 +132,13 @@ class SchedulerController:
                 self.solver.sync_clusters(self._sorted_clusters())
                 self._solver_synced = True
             return self.solver
+        return self._inproc_engine()
+
+    def _inproc_engine(self):
+        """The snapshot-backed in-process engine — the default when no
+        sidecar is configured, and the degraded-mode fallback when the
+        sidecar channel is down (its breaker open or the RPC failing):
+        scheduling never stalls on a dead solver."""
         if self._snapshot is None:
             clusters = self._sorted_clusters()
             snap = ClusterSnapshot(clusters)
@@ -202,8 +227,29 @@ class SchedulerController:
         # spans (pack/dispatch/device/fetch) nest under it, so a storm
         # wave's solve time decomposes without per-binding bookkeeping
         with tracer.span("scheduler.pass") as sp:
-            engine = self._get_engine()
-            results = engine.schedule([p for _, _, p, _ in todo])
+            problems = [p for _, _, p, _ in todo]
+            try:
+                engine = self._get_engine()
+                results = engine.schedule(problems)
+            except Exception as exc:  # noqa: BLE001 — transport triage below
+                if self.solver is None or not _is_transport_error(exc):
+                    raise
+                # degraded mode (unified-resilience contract): a broken
+                # solver sidecar fails over to the in-proc engine for this
+                # pass — the breaker's half-open probe re-admits the
+                # sidecar without operator action, and _solver_synced
+                # stays False so recovery re-pushes the snapshot first
+                from ..utils.metrics import degraded_passes
+
+                degraded_passes.inc(channel="solver")
+                self._solver_synced = False
+                sp.attrs["degraded"] = "solver-fallback"
+                print(
+                    "# scheduler: solver sidecar unavailable "
+                    f"({type(exc).__name__}); in-proc solve for this pass",
+                    flush=True,
+                )
+                results = self._inproc_engine().schedule(problems)
             sp.attrs["bindings"] = len(todo)
         scheduler_pass_seconds.observe(sp.duration)
         per_item = (time.perf_counter() - start) / len(todo)
@@ -221,7 +267,7 @@ class SchedulerController:
             return out
         changed_rbs = []
         for (kind_key, rb, _, fresh), result in zip(todo, results):
-            if self._write_back(rb, result):
+            if self._write_back(rb, result, fresh):
                 changed_rbs.append(rb)
             e2e_scheduling_duration.observe(per_item)
             schedule_attempts.inc(
@@ -270,11 +316,27 @@ class SchedulerController:
             fresh=fresh,
         )
 
-    def _write_back(self, rb: ResourceBinding, result) -> bool:
+    def _write_back(self, rb: ResourceBinding, result, fresh: bool = False) -> bool:
         """Mutate ``rb`` from the schedule result; returns whether it
         changed (the batch caller owns the store write)."""
         before = [(tc.name, tc.replicas) for tc in rb.spec.clusters]
         changed = rb.status.scheduler_observed_generation != rb.meta.generation
+        if result.success and fresh and (
+            rb.status.last_scheduled_time is None
+            or (
+                rb.spec.reschedule_triggered_at is not None
+                and rb.spec.reschedule_triggered_at
+                > rb.status.last_scheduled_time
+            )
+        ):
+            # consume the served Fresh trigger even when the result is
+            # unchanged (scheduler.go patches lastScheduledTime on every
+            # successful run): a lingering trigger re-marks every later
+            # pass Fresh, so e.g. an eviction-displaced binding would
+            # re-DIVIDE from scratch instead of scale-up-rescheduling
+            # with its surviving placements credited
+            rb.status.last_scheduled_time = self.clock()
+            changed = True
         if result.success:
             if rb.spec.replicas > 0:
                 rb.spec.clusters = [

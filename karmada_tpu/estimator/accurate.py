@@ -62,10 +62,23 @@ def fallback_width() -> int:
 def conn_supports_batch(conn) -> Optional[bool]:
     """Per-connection negotiation state: None = not yet probed, False =
     server answered UNIMPLEMENTED (probed once; a reconnect builds a fresh
-    connection and re-probes). The env kill-switch overrides."""
+    connection and re-probes — a wire failure also resets the pin to None
+    so a server that dies and returns mid-pass re-negotiates). The env
+    kill-switch overrides."""
     if not batch_enabled():
         return False
     return getattr(conn, "supports_batch", None)
+
+
+def conn_breaker_engaged(conn) -> bool:
+    """Is the connection's circuit breaker currently rejecting calls?
+    Routing layers consult this BEFORE submitting fan-out work so a
+    breaker-open server answers UnauthenticReplica immediately instead of
+    burning the executor (and the pass deadline) on a doomed RPC. The
+    check is non-consuming — the half-open probe that heals the breaker
+    is taken by the transport's own call path, never by routing."""
+    br = getattr(conn, "breaker", None)
+    return br is not None and br.engaged()
 
 
 @dataclass
@@ -538,6 +551,13 @@ class EstimatorRegistry:
                     else:
                         unanswered.add(name)
             out[live] = table[inv]
+            if unanswered:
+                # degraded pass: at least one registered cluster answered
+                # -1 transiently. Observable (the counter) and never
+                # replayable (refresh_token below answers None).
+                from ..utils.metrics import degraded_passes
+
+                degraded_passes.inc(channel="estimator")
             return out
 
         def refresh_token():
@@ -660,6 +680,12 @@ class EstimatorRegistry:
         # ---- step B: generation pings, one per server connection
         ping_groups: dict[int, tuple] = {}
         for name, est, conn in remote_unconfirmed:
+            if conn_breaker_engaged(conn):
+                # breaker-open server: stay unconfirmed (-1 this pass)
+                # WITHOUT submitting the doomed ping; the memo survives,
+                # so the half-open probe that heals the channel
+                # revalidates it without a refetch
+                continue
             if prof_keys is not None and not all(
                 (name, k) in self._memo for k in prof_keys
             ):
@@ -803,6 +829,11 @@ class EstimatorRegistry:
         retry: list = []  # members re-routed after a mid-pass UNIMPLEMENTED
 
         def route(name, est, conn):
+            if conn is not None and conn_breaker_engaged(conn):
+                # breaker-open server: the cluster answers -1 for this
+                # pass with ZERO executor/wire cost (stays unconfirmed,
+                # so the pass is degraded and never replayable)
+                return
             if conn is not None and conn_supports_batch(conn) is not False:
                 batch_groups.setdefault(id(conn), (conn, []))[1].append(
                     (name, est)

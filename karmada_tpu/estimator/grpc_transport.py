@@ -359,10 +359,20 @@ class GrpcEstimatorConnection:
             response_deserializer=bpb.GetGenerationsResponse.FromString,
         )
         # batched-protocol negotiation: None until the first batch/ping
-        # call, then pinned for this connection's lifetime — an evicted
-        # connection is rebuilt from the resolver, so a server upgrade is
-        # picked up on reconnect (re-probe on reconnect)
+        # call, then pinned until the channel proves unhealthy — a WIRE
+        # failure resets it to None so the transparently-reconnected
+        # channel re-probes before reuse (the returning server may be a
+        # different build), and an evicted connection is rebuilt from the
+        # resolver with the same effect
         self.supports_batch: Optional[bool] = None
+        # unified channel resilience (utils.backoff): consecutive wire
+        # failures open the breaker; the registry's fan-out consults
+        # ``breaker.engaged()`` BEFORE submitting, so a dead server
+        # answers UnauthenticReplica immediately instead of burning the
+        # executor (and the pass deadline) on a doomed RPC
+        from ..utils.backoff import default_breaker
+
+        self.breaker = default_breaker(f"estimator@{target}")
 
     def _unimplemented(self, method: str, exc) -> UnsupportedMethodError:
         # UNIMPLEMENTED = an old server build without the batched protocol:
@@ -372,6 +382,41 @@ class GrpcEstimatorConnection:
         return UnsupportedMethodError(method)
 
     def call(self, method: str, request):
+        from ..utils.backoff import CircuitBreakerOpen
+        from ..utils.faultinject import apply_fault, fault_point
+
+        if not self.breaker.allow():
+            raise CircuitBreakerOpen(
+                f"estimator {self.target} breaker is open"
+            )
+        ok = False
+        try:
+            apply_fault(
+                fault_point("estimator.rpc", f"{method}:{self.cluster}"),
+                "estimator.rpc", f"{method}:{self.cluster}",
+                channel=self._channel,
+            )
+            resp = self._call(method, request)
+            ok = True
+            return resp
+        except UnsupportedMethodError:
+            # the server ANSWERED (an old build negotiating the fallback):
+            # the channel itself is healthy
+            ok = True
+            raise
+        except grpc.RpcError:
+            # a wire failure invalidates the pinned batch negotiation —
+            # the channel reconnects transparently underneath, and the
+            # server that comes back may be a different build, so the
+            # next batch/ping call must RE-PROBE instead of trusting a
+            # dead server's answer
+            self.supports_batch = None
+            raise
+        finally:
+            (self.breaker.record_success if ok
+             else self.breaker.record_failure)()
+
+    def _call(self, method: str, request):
         if method == "MaxAvailableReplicas":
             resp = self._max_available(_req_to_pb(request), timeout=self.timeout)
             return MaxAvailableReplicasResponse(max_replicas=resp.max_replicas)
@@ -408,9 +453,34 @@ class GrpcEstimatorConnection:
         instead of blocking sequentially. Resolve with ``future.result()``;
         the response is the raw pb message (use ``.max_replicas``)."""
         if method == "MaxAvailableReplicas":
-            return self._max_available.future(
+            from ..utils.backoff import CircuitBreakerOpen
+            from ..utils.faultinject import apply_fault, fault_point
+
+            # non-consuming breaker gate (engaged(), not allow()): futures
+            # resolve off-thread, so outcomes feed the breaker via a done
+            # callback rather than the probe-slot protocol
+            if self.breaker.engaged():
+                raise CircuitBreakerOpen(
+                    f"estimator {self.target} breaker is open"
+                )
+            apply_fault(
+                fault_point(
+                    "estimator.rpc", f"{method}:{self.cluster}:future"
+                ),
+                "estimator.rpc", f"{method}:{self.cluster}",
+                channel=self._channel,
+            )
+            fut = self._max_available.future(
                 _req_to_pb(request), timeout=self.timeout
             )
+            fut.add_done_callback(
+                lambda f: (
+                    self.breaker.record_failure()
+                    if (not f.cancelled() and f.exception() is not None)
+                    else self.breaker.record_success()
+                )
+            )
+            return fut
         raise ValueError(f"no future seam for method {method}")
 
     def close(self) -> None:

@@ -358,6 +358,13 @@ class EstimatorClientPool:
             conn = self.connection(cluster)
             if conn is None:
                 return
+            from .accurate import conn_breaker_engaged
+
+            if conn_breaker_engaged(conn):
+                # breaker-open server: answer UnauthenticReplica NOW
+                # instead of burning the fan-out on a doomed RPC (the
+                # transport's own half-open probe heals the breaker)
+                return
             try:
                 resp = conn.call(
                     "MaxAvailableReplicas",

@@ -696,6 +696,11 @@ def main(argv=None) -> None:
                     "$KARMADA_TPU_TRACE_MANIFEST)")
 
     args = p.parse_args(argv)
+    # chaos: arm deterministic fault injection from the environment
+    # (KARMADA_TPU_FAULT_SPEC; disarmed when empty — zero overhead)
+    from .utils.faultinject import arm_from_env
+
+    arm_from_env()
     if args.command == "up" and args.pull is None:
         args.pull = ["pull1"]
     if args.command == "serve":

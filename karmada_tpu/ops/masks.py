@@ -73,6 +73,77 @@ def intersects(bits, other) -> np.ndarray:
     return ((bits & other) != 0).any(axis=-1)
 
 
+def affinity_group_rank(term_masks: np.ndarray) -> np.ndarray:
+    """int32[..., C] ordered-failover rank tensor: for each cluster, the
+    index of the FIRST affinity term (ClusterAffinities fallback group)
+    whose mask contains it, ``T`` where none does (scheduler.go:533-596's
+    group order as data instead of control flow). ``term_masks``:
+    bool[..., T, C]."""
+    t = term_masks.shape[-2]
+    idx = np.where(
+        term_masks,
+        np.arange(t, dtype=np.int32).reshape((t, 1)),
+        np.int32(t),
+    )
+    return idx.min(axis=-2)
+
+
+def first_fit_group(
+    cand_tc: np.ndarray,  # bool[B, T, C] per-term candidate sets
+    term_len: np.ndarray,  # int32[B] live terms per row (<= T)
+    avail: np.ndarray,  # int64[B, C] merged estimator availability
+    replicas: np.ndarray,  # int64[B]
+    prev: np.ndarray,  # int64[B, C] previous placements
+    dynamic: np.ndarray,  # bool[B] divided dynamic-family strategy
+    fresh: np.ndarray,  # bool[B] reschedule-triggered
+) -> tuple[np.ndarray, np.ndarray]:
+    """Batched ordered-failover group selection: each row's FIRST term
+    whose candidate set both exists and passes the divider's
+    schedulability predicate — the exact cohort math of
+    ``refimpl.divider_np.assign_batch_np`` (fresh credits prev, scale-down
+    weighs FULL prev, scale-up targets the shortfall, steady no-ops), so
+    selecting group t here and then solving once is placement-identical
+    to solving groups 0..t in sequence and keeping the first success.
+
+    Returns ``(rank int32[B], fit bool[B])``; rows where NO group fits get
+    their LAST live term (its solve produces the failure the per-round
+    loop would have reported). The T axis is a short host loop (T = max
+    ClusterAffinities length, almost always <= 4) over fully-batched
+    [B, C] reductions — O(B*T*C) adds, no [B, T, C] integer temporaries.
+    """
+    b, t, c = cand_tc.shape
+    num = replicas.astype(np.int64)
+    prev_full_sum = prev.sum(axis=1)
+    avail_sum = np.empty((b, t), np.int64)
+    prev_sum = np.empty((b, t), np.int64)
+    cand_any = cand_tc.any(axis=2)
+    for ti in range(t):
+        ct = cand_tc[:, ti, :]
+        avail_sum[:, ti] = np.where(ct, avail, 0).sum(axis=1)
+        prev_sum[:, ti] = np.where(ct, prev, 0).sum(axis=1)
+    dyn = dynamic[:, None]
+    fr = fresh[:, None]
+    num_col = num[:, None]
+    scale_down = dyn & ~fr & (prev_sum > num_col)
+    scale_up = dyn & ~fr & (prev_sum < num_col)
+    steady = dyn & ~fr & (prev_sum == num_col)
+    target = np.where(scale_up, num_col - prev_sum, num_col)
+    w_sum = np.where(
+        fr,
+        avail_sum + prev_sum,
+        np.where(scale_down, prev_full_sum[:, None], avail_sum),
+    )
+    unsched = dyn & ~steady & (w_sum < target)
+    live = np.arange(t, dtype=np.int32)[None, :] < term_len[:, None]
+    fit_t = cand_any & ~unsched & live
+    fit = fit_t.any(axis=1)
+    # first-fitting-group extraction = affinity_group_rank over the fit
+    # matrix viewed as [B, T, 1] (same first-true-index primitive)
+    rank = affinity_group_rank(fit_t[:, :, None])[:, 0]
+    last = np.maximum(term_len - 1, 0).astype(np.int32)
+    return np.where(fit, rank, last).astype(np.int32), fit
+
+
 def label_pair(key: str, value: str) -> str:
     return f"{key}={value}"
 

@@ -247,6 +247,10 @@ class TensorScheduler:
         # binding key -> (row fingerprint, derived cp | None): skips the
         # packing+selection stage for unchanged spread rows in steady storms
         self._derived_rows: dict = {}
+        # batched solves dispatched (host chunks + fleet passes): the
+        # chaos bench reads this to prove a failover wave reschedules its
+        # displaced bindings in O(chunks) solves, not O(bindings)
+        self.solve_batches = 0
         # request-profile bytes -> availability row [C] (per snapshot gen)
         self._sel_profile_rows: dict = {}
         self._sel_profile_gen = -1
@@ -407,6 +411,7 @@ class TensorScheduler:
                     "compile": _time.perf_counter() - t0
                 }
                 fp, fc = self._batch_cache
+                self.solve_batches += 1
                 res = self._fleet.schedule(fp, fc)
                 self.last_breakdown.update(self._fleet.last_breakdown)
                 return res
@@ -477,6 +482,7 @@ class TensorScheduler:
                     self._fleet = FleetTable(self)
                 fp = [problems[i] for i in fast_idx]
                 fc = [compiled[i] for i in fast_idx]
+                self.solve_batches += 1
                 fast_res = self._fleet.schedule(fp, fc)
                 self.last_breakdown.update(self._fleet.last_breakdown)
                 if len(fast_idx) == len(problems):
@@ -708,6 +714,66 @@ class TensorScheduler:
         problems: Sequence[BindingProblem],
         compiled: list[CompiledPlacement],
     ) -> list[ScheduleResult]:
+        """Ordered ClusterAffinities dispatch. Multi-term batches take the
+        TENSORIZED first-fit path: the per-binding ranked affinity-group
+        selection (ops.masks.first_fit_group) picks every row's group in
+        one vectorized pass and the whole batch solves ONCE — a failover
+        wave rescheduling thousands of displaced bindings costs one
+        batched solve per chunk, not T sequential rounds. Multi-term rows
+        that ALSO carry spread constraints keep the per-round loop (their
+        per-term group search is a host search, and the combination is
+        rare); single-term batches keep the plain one-round path."""
+        max_terms = max((len(cp.terms) for cp in compiled), default=1)
+        if max_terms > 1:
+            legacy_idx = [
+                i
+                for i, cp in enumerate(compiled)
+                if len(cp.terms) > 1 and cp.spread_constraints
+            ]
+            if not legacy_idx:
+                return self._schedule_ranked(problems, compiled)
+            legacy = set(legacy_idx)
+            ranked_idx = [i for i in range(len(problems)) if i not in legacy]
+            results: list = [None] * len(problems)
+            for res_i, res in zip(
+                ranked_idx,
+                self._schedule_ranked(
+                    [problems[i] for i in ranked_idx],
+                    [compiled[i] for i in ranked_idx],
+                ),
+            ):
+                results[res_i] = res
+            for res_i, res in zip(
+                legacy_idx,
+                self._schedule_round_loop(
+                    [problems[i] for i in legacy_idx],
+                    [compiled[i] for i in legacy_idx],
+                ),
+            ):
+                results[res_i] = res
+            return results
+        return self._schedule_round_loop(problems, compiled)
+
+    def _schedule_ranked(
+        self,
+        problems: Sequence[BindingProblem],
+        compiled: list[CompiledPlacement],
+    ) -> list[ScheduleResult]:
+        out: list[ScheduleResult] = []
+        for start in range(0, len(problems), self.chunk_size):
+            out.extend(
+                self._schedule_chunk_ranked(
+                    list(problems[start : start + self.chunk_size]),
+                    compiled[start : start + self.chunk_size],
+                )
+            )
+        return out
+
+    def _schedule_round_loop(
+        self,
+        problems: Sequence[BindingProblem],
+        compiled: list[CompiledPlacement],
+    ) -> list[ScheduleResult]:
         results: list[Optional[ScheduleResult]] = [None] * len(problems)
         max_terms = max((len(cp.terms) for cp in compiled), default=1)
 
@@ -763,6 +829,7 @@ class TensorScheduler:
         problems: list[BindingProblem],
         compiled: list[CompiledPlacement],
         term_round: int,
+        with_affinity: bool = True,
     ):
         """Vectorized packing: per-binding work is O(sparse entries); the
         O(B x C) mask algebra happens once per *unique* placement/GVK and is
@@ -843,7 +910,7 @@ class TensorScheduler:
         # --- mask composition (api_enablement.go / taint_toleration.go
         # leniency for already-placed clusters) -----------------------------
         feasible = np.ones((b, c), bool)
-        if "ClusterAffinity" not in disabled:
+        if with_affinity and "ClusterAffinity" not in disabled:
             feasible &= aff_pl[cp_idx]
         if "SpreadConstraint" not in disabled:
             feasible &= spread_pl[cp_idx]
@@ -1021,6 +1088,7 @@ class TensorScheduler:
             lmax = int(prev.max(initial=0)) + 1
             host_small = (wmax + 1) * lmax * snap.num_clusters < 2**63
         with algo_timer.time(schedule_step="AssignReplicas"):
+            self.solve_batches += 1
             if host_small:
                 from ..refimpl.divider_np import assign_batch_np
 
@@ -1037,6 +1105,133 @@ class TensorScheduler:
                 unschedulable = np.asarray(res.unschedulable)
         return self._unpack(problems, compiled, term_round, candidates,
                             assignment, unschedulable)
+
+    def _schedule_chunk_ranked(
+        self,
+        problems: list[BindingProblem],
+        compiled: list[CompiledPlacement],
+    ) -> list[ScheduleResult]:
+        """One chunk of the tensorized ordered-failover path: pack every
+        term's mask as a [B, T, C] candidate tensor, pick each row's first
+        fitting affinity group in one vectorized selection
+        (ops.masks.first_fit_group — the divider's exact schedulability
+        predicate), then solve the WHOLE chunk once against the selected
+        masks. T ordered fallback groups cost T batched [B, C] reductions
+        plus one solve, instead of up to T sequential solves."""
+        from ..ops import masks as mops
+        from ..ops.divide import AGGREGATED as S_AGG, DYNAMIC_WEIGHT as S_DYN
+        from ..utils.metrics import scheduling_algorithm_duration as algo_timer
+
+        snap = self.snapshot
+        with algo_timer.time(schedule_step="Filter"):
+            base, strategy, replicas, static_w, requests, prev, fresh = (
+                self._pack_chunk(problems, compiled, 0, with_affinity=False)
+            )
+            b = len(problems)
+            padded = 1
+            while padded < b:
+                padded *= 2
+            padded = min(padded, self.chunk_size)
+            if padded > b:
+                pad = padded - b
+                base = np.pad(base, ((0, pad), (0, 0)))
+                strategy = np.pad(strategy, (0, pad))
+                replicas = np.pad(replicas, (0, pad))
+                static_w = np.pad(static_w, ((0, pad), (0, 0)))
+                requests = np.pad(requests, ((0, pad), (0, 0)))
+                prev = np.pad(prev, ((0, pad), (0, 0)))
+                fresh = np.pad(fresh, (0, pad))
+            # stacked per-placement term tensors (the ranked affinity-
+            # group surface): bool[U, Tmax, C] + live-term counts
+            cp_slot: dict[int, int] = {}
+            unique_cps: list[CompiledPlacement] = []
+            cp_idx = np.zeros(padded, np.int32)
+            for i, cp in enumerate(compiled):
+                slot = cp_slot.get(id(cp))
+                if slot is None:
+                    slot = len(unique_cps)
+                    cp_slot[id(cp)] = slot
+                    unique_cps.append(cp)
+                cp_idx[i] = slot
+            tmax = max(len(cp.terms) for cp in unique_cps)
+            c = snap.num_clusters
+            term_stack = np.zeros((len(unique_cps), tmax, c), bool)
+            term_len_u = np.ones(len(unique_cps), np.int32)
+            for u, cp in enumerate(unique_cps):
+                term_len_u[u] = len(cp.terms)
+                for t, (_name, mask) in enumerate(cp.terms):
+                    term_stack[u, t] = mask
+            disabled = self.disabled_plugins
+            if "ClusterAffinity" in disabled:
+                term_stack[:] = True
+
+        host_small = (
+            padded * snap.num_clusters <= 1 << 16
+            and not self.extra_estimators
+        )
+        with algo_timer.time(schedule_step="Score"):
+            avail = (
+                self._availability_np(requests, replicas)
+                if host_small
+                else self._availability(requests, replicas)
+            )
+
+        with algo_timer.time(schedule_step="Select"):
+            avail_np = np.asarray(avail)
+            cand_tc = base[:, None, :] & term_stack[cp_idx]
+            rank, _fit = mops.first_fit_group(
+                cand_tc,
+                term_len_u[cp_idx],
+                avail_np.astype(np.int64),
+                replicas.astype(np.int64),
+                prev.astype(np.int64),
+                (strategy == S_DYN) | (strategy == S_AGG),
+                fresh.astype(bool),
+            )
+            feasible = np.take_along_axis(
+                cand_tc, rank[:, None, None].astype(np.intp), axis=1
+            )[:, 0, :]
+            # spread selection still narrows single-term spread rows
+            # (multi-term spread rows never reach this path)
+            candidates = self._select_for_chunk(
+                problems, compiled, feasible, avail, prev
+            )
+
+        if host_small:
+            wmax = int(
+                max(
+                    int(avail_np.max(initial=0)) + int(prev.max(initial=0)),
+                    int(static_w.max(initial=0)),
+                    0,
+                )
+            )
+            lmax = int(prev.max(initial=0)) + 1
+            host_small = (wmax + 1) * lmax * snap.num_clusters < 2**63
+        with algo_timer.time(schedule_step="AssignReplicas"):
+            self.solve_batches += 1
+            if host_small:
+                from ..refimpl.divider_np import assign_batch_np
+
+                assignment, unschedulable = assign_batch_np(
+                    strategy, replicas, candidates, static_w,
+                    avail_np, prev, fresh,
+                )
+            else:
+                res = self._assign(
+                    strategy, replicas, candidates, static_w, avail,
+                    prev, fresh,
+                )
+                assignment = np.asarray(res.assignment)
+                unschedulable = np.asarray(res.unschedulable)
+        return self._unpack(problems, compiled, rank, candidates,
+                            assignment, unschedulable)
+
+    def _select_for_chunk(self, problems, compiled, feasible, avail, prev):
+        from .spread import select_clusters_batch
+
+        return select_clusters_batch(
+            self.snapshot, problems, compiled, 0, feasible, avail, prev
+        )
 
     def _assign(self, strategy, replicas, candidates, static_w, avail, prev, fresh):
         from ..ops.divide import AGGREGATED
@@ -1079,8 +1274,10 @@ class TensorScheduler:
         boundaries = np.searchsorted(rows, np.arange(1, b))
         per_row = np.split(cols, boundaries)
         out = []
+        per_row_term = isinstance(term_round, np.ndarray)
         for i, p in enumerate(problems):
-            term_idx = min(term_round, len(compiled[i].terms) - 1)
+            tr = int(term_round[i]) if per_row_term else term_round
+            term_idx = min(tr, len(compiled[i].terms) - 1)
             term_name = compiled[i].terms[term_idx][0]
             if not has_candidates[i]:
                 out.append(

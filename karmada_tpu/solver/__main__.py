@@ -44,6 +44,11 @@ def main(argv=None) -> None:
         "default: $KARMADA_TPU_METRICS_PORT, empty = disabled)",
     )
     args = p.parse_args(argv)
+    # chaos: arm deterministic fault injection from the environment
+    # (KARMADA_TPU_FAULT_SPEC; disarmed when empty — zero overhead)
+    from ..utils.faultinject import arm_from_env
+
+    arm_from_env()
 
     def read(path):
         return open(path, "rb").read() if path else None

@@ -16,6 +16,8 @@ from typing import Optional, Sequence
 import grpc
 
 from ..scheduler import BindingProblem
+from ..utils.backoff import CircuitBreakerOpen, Deadline, default_breaker
+from ..utils.faultinject import apply_fault, fault_point
 from .proto import solver_pb2 as pb
 from .service import SERVICE_NAME, cluster_to_state, encode_problems
 
@@ -65,6 +67,11 @@ class RemoteSolver:
         self.timeout = timeout_seconds
         self._version = 0
         self._cluster_source = cluster_source
+        # unified channel resilience (utils.backoff): the breaker marks
+        # this sidecar degraded after consecutive transport failures so
+        # the scheduler's in-proc fallback engages without burning a
+        # doomed RPC per pass; half-open re-probes heal it automatically
+        self.breaker = default_breaker(f"solver@{target}")
         self._sync = self._channel.unary_unary(
             f"/{SERVICE_NAME}/SyncClusters",
             request_serializer=pb.SyncClustersRequest.SerializeToString,
@@ -78,34 +85,91 @@ class RemoteSolver:
 
     # -- snapshot channel --------------------------------------------------
 
-    def sync_clusters(self, clusters, *, timeout: Optional[float] = None) -> int:
+    def sync_clusters(
+        self,
+        clusters,
+        *,
+        timeout: Optional[float] = None,
+        check_breaker: bool = True,
+    ) -> int:
+        """``check_breaker=False`` is for the re-sync inside ``schedule``:
+        that caller already holds the breaker's admission (possibly the
+        single half-open probe slot) and owns the outcome record."""
+        if check_breaker and not self.breaker.allow():
+            raise CircuitBreakerOpen(
+                f"solver {self._channel!r} breaker is open"
+            )
         self._version += 1
         req = pb.SyncClustersRequest(snapshot_version=self._version)
         for cl in clusters:
             req.clusters.append(cluster_to_state(cl))
-        resp = self._sync(
-            req, timeout=self.timeout if timeout is None else timeout
-        )
+        ok = False
+        try:
+            apply_fault(
+                fault_point("solver.rpc", "SyncClusters"),
+                "solver.rpc", "SyncClusters", channel=self._channel,
+            )
+            resp = self._sync(
+                req, timeout=self.timeout if timeout is None else timeout
+            )
+            ok = True
+        finally:
+            # every admitted call records its outcome: a half-open probe
+            # slot taken but never resolved would wedge the breaker. The
+            # ungated form records nothing — the owning schedule() call
+            # does.
+            if check_breaker:
+                (self.breaker.record_success if ok
+                 else self.breaker.record_failure)()
         return resp.snapshot_version
 
     # -- engine seam -------------------------------------------------------
 
     def schedule(self, problems: Sequence[BindingProblem]) -> list:
+        """Score the batch under ONE overall deadline budget: the re-sync-
+        then-retry path (FAILED_PRECONDITION after a solver restart) used
+        to stack ``self.timeout`` up to three times (score, sync, retry);
+        every RPC now carries the REMAINING budget, so a dead or black-
+        holed solver fails the whole call within 1x ``self.timeout`` —
+        the standby-sync discipline HASolver already had, generalized."""
+        if not self.breaker.allow():
+            raise CircuitBreakerOpen(
+                f"solver {self._channel!r} breaker is open"
+            )
+        deadline = Deadline(self.timeout)
         req = encode_problems(problems)
         req.snapshot_version = self._version
+        ok = False
         try:
-            resp = self._score(req, timeout=self.timeout)
-        except grpc.RpcError as e:
-            if (
-                e.code() == grpc.StatusCode.FAILED_PRECONDITION
-                and self._cluster_source is not None
-            ):
-                # solver restarted or missed a sync: push state and retry once
-                self.sync_clusters(self._cluster_source())
-                req.snapshot_version = self._version
-                resp = self._score(req, timeout=self.timeout)
-            else:
-                raise
+            apply_fault(
+                fault_point("solver.rpc", "ScoreAndAssign"),
+                "solver.rpc", "ScoreAndAssign", channel=self._channel,
+            )
+            try:
+                resp = self._score(req, timeout=deadline.attempt_timeout())
+            except grpc.RpcError as e:
+                if (
+                    e.code() == grpc.StatusCode.FAILED_PRECONDITION
+                    and self._cluster_source is not None
+                ):
+                    # solver restarted or missed a sync: push state and
+                    # retry once, both on the REMAINING budget (this call
+                    # holds the breaker admission, so the sync is ungated)
+                    self.sync_clusters(
+                        self._cluster_source(),
+                        timeout=deadline.attempt_timeout(),
+                        check_breaker=False,
+                    )
+                    req.snapshot_version = self._version
+                    resp = self._score(
+                        req, timeout=deadline.attempt_timeout()
+                    )
+                else:
+                    raise
+            ok = True
+        finally:
+            (self.breaker.record_success if ok
+             else self.breaker.record_failure)()
         return [
             RemoteScheduleResult(
                 key=m.key,
@@ -183,7 +247,9 @@ class HASolver:
                         else self.STANDBY_SYNC_TIMEOUT
                     ),
                 )
-            except grpc.RpcError as e:  # standby down: its re-sync heals it
+            except (grpc.RpcError, CircuitBreakerOpen) as e:
+                # standby down (or breaker-open, costing zero RPC): its
+                # FAILED_PRECONDITION re-sync heals it later
                 errs[i] = e
 
         # concurrent fan-out: N black-holed standbys cost ONE standby
@@ -205,7 +271,8 @@ class HASolver:
                 res = self._solvers[idx].schedule(problems)
                 self._active = idx
                 return res
-            except grpc.RpcError as e:
+            except (grpc.RpcError, CircuitBreakerOpen) as e:
+                # a breaker-open backend is skipped without burning an RPC
                 last_err = e
         assert last_err is not None
         raise last_err

@@ -120,6 +120,43 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "endpoint; 0 binds an ephemeral port (printed at startup).",
         ),
         EnvFlag(
+            "KARMADA_TPU_FAULT_SPEC", "",
+            "Deterministic fault-injection spec (utils.faultinject): "
+            "semicolon-separated `point=action[,rate=][,count=][,after=]"
+            "[,match=][,delay=]` rules armed at process boot by the "
+            "entrypoints (localup serve, solver sidecar, estimator "
+            "__main__, bus agent). Empty (the default) leaves injection "
+            "disarmed — one `is None` check per injection point, zero "
+            "overhead. Actions: error/drop/delay/sever/down.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_FAULT_SEED", "0",
+            "Seed for the fault-injection firing decisions: rules with "
+            "rate < 1 derive every decision from blake2b(seed, point, "
+            "invocation index), so a chaos run replays bit-identically "
+            "from (spec, seed) and the fired-event log doubles as the "
+            "numpy oracle's replay script.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_BACKOFF_BASE", "0.05",
+            "First decorrelated-jitter retry sleep (seconds) of the "
+            "unified channel policy (utils.backoff.default_policy); "
+            "every retried RPC on the solver/estimator/bus channels "
+            "sleeps within [base, 3x previous], capped.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_BACKOFF_CAP", "2.0",
+            "Cap (seconds) on one decorrelated-jitter retry sleep of the "
+            "unified channel policy.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_BREAKER_RESET_SECONDS", "5.0",
+            "Seconds an open circuit breaker waits before admitting the "
+            "single half-open probe; the probe's success closes the "
+            "breaker without operator action (karmada_tpu_circuit_state "
+            "tracks the transitions).",
+        ),
+        EnvFlag(
             "KARMADA_TPU_DRYRUN_REAL_DEVICES", "0",
             "Multichip dryrun escape hatch (__graft_entry__): set to 1 to "
             "run on the default backend's real devices instead of forcing "

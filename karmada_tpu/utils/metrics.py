@@ -345,6 +345,24 @@ worker_queue_depth = registry.gauge(
     "karmada_tpu_worker_queue_depth",
     "keys still queued per worker after its last drain",
 )
+circuit_state = registry.gauge(
+    "karmada_tpu_circuit_state",
+    "per-channel circuit-breaker state (0 closed, 1 open, 2 half-open) — "
+    "the unified resilience policy of utils.backoff; an open estimator/"
+    "solver/bus breaker marks every pass it shadows as degraded",
+)
+channel_retries = registry.counter(
+    "karmada_tpu_channel_retries_total",
+    "RPC attempts retried under the unified backoff policy, by channel "
+    "(each is one decorrelated-jitter sleep inside one deadline budget)",
+)
+degraded_passes = registry.counter(
+    "karmada_tpu_degraded_passes_total",
+    "passes served on a channel's degraded path, by channel: solver = "
+    "in-proc fallback solve, estimator = at least one registered cluster "
+    "answered UnauthenticReplica (such a pass never arms batch-identity "
+    "replay)",
+)
 
 
 def render_families_table() -> str:

@@ -1,0 +1,42 @@
+"""GL007 bad fixture: unary stubs and urlopen called without timeouts."""
+
+import urllib.request
+
+
+class _Chan:
+    def unary_unary(self, path, **kw):
+        return lambda req, timeout=None: req
+
+
+channel = _Chan()
+
+# module-level stub binding
+score = channel.unary_unary("/svc/Score")
+
+
+class Client:
+    def __init__(self, channel):
+        self._sync = channel.unary_unary("/svc/Sync")
+        self._score = channel.unary_unary("/svc/Score")
+
+    def call(self, req):
+        # BAD: direct stub call with no timeout
+        return self._sync(req)
+
+    def call_future(self, req):
+        # BAD: future form with no timeout
+        return self._score.future(req)
+
+    def ok(self, req):
+        return self._score(req, timeout=3.0)
+
+
+def module_call(req):
+    # BAD: module-level stub called unbounded
+    return score(req)
+
+
+def fetch(url):
+    # BAD: urlopen with no timeout
+    with urllib.request.urlopen(url) as resp:
+        return resp.read()

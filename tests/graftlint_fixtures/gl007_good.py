@@ -1,0 +1,53 @@
+"""GL007 good fixture: every unary call bounded; streams and pass-by-value
+stubs exempt."""
+
+import urllib.request
+from urllib.request import urlopen
+
+
+class _Chan:
+    def unary_unary(self, path, **kw):
+        return lambda req, timeout=None: req
+
+    def unary_stream(self, path, **kw):
+        return lambda req: iter(())
+
+
+channel = _Chan()
+
+
+class Client:
+    def __init__(self, channel):
+        self._sync = channel.unary_unary("/svc/Sync")
+        self._score = channel.unary_unary("/svc/Score")
+        # watch streams are deliberately open-ended (bounded by their
+        # reconnect loop), not unbounded unary RPCs
+        self._watch = channel.unary_stream("/svc/Watch")
+
+    def call(self, req, deadline):
+        return self._sync(req, timeout=deadline)
+
+    def call_future(self, req):
+        return self._score.future(req, timeout=2.5)
+
+    def watch(self, req):
+        return self._watch(req)
+
+    def resilient(self, req):
+        # stub passed by VALUE into a wrapper that owns the deadline —
+        # the wrapper's own call carries timeout=
+        return _retry(self._score, req)
+
+
+def _retry(stub, req):
+    return stub(req, timeout=1.0)
+
+
+def fetch(url):
+    with urllib.request.urlopen(url, timeout=5.0) as resp:
+        return resp.read()
+
+
+def fetch2(url):
+    with urlopen(url, timeout=5.0) as resp:
+        return resp.read()

@@ -512,3 +512,159 @@ class TestSchedulerParity:
         finally:
             srv.stop()
             old_srv.stop()
+
+
+class TestChannelResilience:
+    """ISSUE 7: the estimator channel under the unified resilience policy —
+    wire failures reset the batch negotiation (re-probe before reuse), a
+    breaker-open server answers -1 with zero executor/wire cost, and the
+    breaker recovers half-open -> closed without operator action."""
+
+    def _one_server_registry(self, name="a", reset="0.3"):
+        import os
+
+        os.environ["KARMADA_TPU_BREAKER_RESET_SECONDS"] = reset
+        try:
+            caches = make_member_caches([name])
+            svc = MultiClusterEstimatorService(
+                {name: EstimatorService(AccurateEstimator(name, caches[name]))}
+            )
+            srv = EstimatorGrpcServer(svc, "127.0.0.1:0")
+            port = srv.start()
+            conn = GrpcEstimatorConnection(
+                name, f"127.0.0.1:{port}", timeout_seconds=2.0
+            )
+            registry = EstimatorRegistry()
+            registry.register(
+                RemoteAccurateEstimator(name, conn, lambda: list(DIMS))
+            )
+        finally:
+            del os.environ["KARMADA_TPU_BREAKER_RESET_SECONDS"]
+        return caches, svc, srv, port, conn, registry
+
+    def test_wire_failure_resets_batch_negotiation(self):
+        """A server that dies and returns mid-pass must re-probe the batch
+        protocol before reuse: the returning build may be OLDER (no batch
+        handler), and a pinned supports_batch=True would ship it batch
+        RPCs forever."""
+        caches, svc, srv, port, conn, registry = self._one_server_registry()
+        try:
+            est = registry.make_batch_estimator(["a"], timeout_seconds=2.0)
+            out = est(reqs_matrix([1000]), np.asarray([5]))
+            assert (out >= 0).all()
+            assert conn.supports_batch is True
+
+            srv.stop(0)
+            registry.invalidate(drop=True)
+            out = est(reqs_matrix([1000]), np.asarray([5]))
+            assert (out == -1).all()
+            # the wire failure reset the pin: next use re-negotiates
+            assert conn.supports_batch is None
+
+            # the server returns AS AN OLD BUILD on the same port
+            old_srv = EstimatorGrpcServer(
+                svc, f"127.0.0.1:{port}", enable_batch=False
+            )
+            old_srv.start()
+            try:
+                import grpc as _grpc
+
+                _grpc.channel_ready_future(conn._channel).result(timeout=10)
+                conn.breaker.record_success()  # heal: recovery is below
+                registry.invalidate(drop=True)
+                out = est(reqs_matrix([1000]), np.asarray([5]))
+                assert (out >= 0).all()
+                assert conn.supports_batch is False  # unary negotiated
+                assert registry.rpc_counts["unary"] > 0
+            finally:
+                old_srv.stop(0)
+        finally:
+            try:
+                srv.stop(0)
+            except Exception:
+                pass
+            conn.close()
+
+    def test_breaker_open_answers_unauthentic_with_zero_wire_cost(self):
+        from karmada_tpu.utils import backoff
+        from karmada_tpu.utils.metrics import circuit_state
+
+        caches, svc, srv, port, conn, registry = self._one_server_registry(
+            reset="30"
+        )
+        try:
+            est = registry.make_batch_estimator(["a"], timeout_seconds=2.0)
+            out = est(reqs_matrix([1000]), np.asarray([5]))
+            assert (out >= 0).all()
+
+            srv.stop(0)
+            # burn passes until the breaker opens (each degraded pass
+            # costs a ping and/or fetch attempt)
+            for _ in range(4):
+                registry.invalidate(drop=True)
+                est(reqs_matrix([1000]), np.asarray([5]))
+                if conn.breaker.state == backoff.OPEN:
+                    break
+            assert conn.breaker.state == backoff.OPEN
+            assert (
+                circuit_state.value(channel=f"estimator@127.0.0.1:{port}")
+                == backoff.OPEN
+            )
+            # breaker-open pass: -1 immediately, ZERO new wire traffic
+            before = dict(registry.rpc_counts)
+            registry.invalidate(drop=True)
+            out = est(reqs_matrix([1000]), np.asarray([5]))
+            assert (out == -1).all()
+            assert dict(registry.rpc_counts) == before
+            # degraded and never replayable
+            assert est.refresh_token() is None
+        finally:
+            conn.close()
+
+    def test_breaker_recovers_half_open_to_closed_without_operator(self):
+        import time as _time
+
+        from karmada_tpu.utils import backoff
+        from karmada_tpu.utils.metrics import circuit_state
+
+        caches, svc, srv, port, conn, registry = self._one_server_registry(
+            reset="0.3"
+        )
+        try:
+            est = registry.make_batch_estimator(["a"], timeout_seconds=2.0)
+            out1 = est(reqs_matrix([1000]), np.asarray([5]))
+            assert (out1 >= 0).all()
+
+            srv.stop(0)
+            for _ in range(4):
+                registry.invalidate(drop=True)
+                est(reqs_matrix([1000]), np.asarray([5]))
+                if conn.breaker.state == backoff.OPEN:
+                    break
+            assert conn.breaker.state == backoff.OPEN
+
+            # server returns on the same port; after the reset window the
+            # next pass IS the half-open probe and closes the breaker —
+            # no operator action, no registry surgery
+            srv2 = EstimatorGrpcServer(svc, f"127.0.0.1:{port}")
+            srv2.start()
+            try:
+                import grpc as _grpc
+
+                _grpc.channel_ready_future(conn._channel).result(timeout=10)
+                _time.sleep(0.35)  # past the breaker reset window
+                registry.invalidate(drop=True)
+                out2 = est(reqs_matrix([1000]), np.asarray([5]))
+                assert (out2 == out1).all()
+                assert conn.breaker.state == backoff.CLOSED
+                assert (
+                    circuit_state.value(
+                        channel=f"estimator@127.0.0.1:{port}"
+                    )
+                    == backoff.CLOSED
+                )
+                assert est.refresh_token() is not None
+            finally:
+                srv2.stop(0)
+        finally:
+            conn.close()

@@ -1,0 +1,580 @@
+"""Chaos-hardened failover plane (ISSUE 7): deterministic fault injection,
+tensorized ordered failover against the per-binding numpy oracle, graceful-
+eviction deadline edges, and per-channel degraded modes.
+
+Layers under test:
+- utils.faultinject: seeded determinism, the cluster.health injection
+  point driving the SAME condition->taint->NoExecute-eviction machinery a
+  real outage does, and the fired-event log as a replay script.
+- ops.masks.affinity_group_rank / first_fit_group +
+  TensorScheduler._schedule_chunk_ranked: ordered ClusterAffinities
+  fallback as ONE batched solve, placement-identical to
+  refimpl.failover_np's per-binding retry-loop oracle.
+- controllers.failover.GracefulEvictionController deadline edges and
+  ApplicationFailoverController state preservation across a double
+  reschedule.
+- degraded modes: a dead solver sidecar fails over to the in-proc engine
+  (observable via karmada_tpu_degraded_passes_total).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api import (
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+)
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.api.policy import (
+    ApplicationFailoverBehavior,
+    ClusterAffinityTerm,
+    FailoverBehavior,
+    LabelSelector,
+)
+from karmada_tpu.api.work import (
+    AggregatedStatusItem,
+    GracefulEvictionTask,
+    ResourceBinding,
+    TargetCluster,
+)
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.ops import masks as mops
+from karmada_tpu.refimpl.failover_np import replay_failover, solve_one_ordered
+from karmada_tpu.scheduler import (
+    BindingProblem,
+    ClusterSnapshot,
+    TensorScheduler,
+)
+from karmada_tpu.scheduler.snapshot import compile_placement
+from karmada_tpu.utils import faultinject
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    new_cluster,
+    new_deployment,
+)
+from karmada_tpu.utils.features import (
+    FAILOVER,
+    STATEFUL_FAILOVER_INJECTION,
+    feature_gate,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    yield
+    faultinject.disarm()
+
+
+def group_term(group: str) -> ClusterAffinityTerm:
+    return ClusterAffinityTerm(
+        affinity_name=f"grp-{group}",
+        label_selector=LabelSelector(match_labels={"group": group}),
+    )
+
+
+def ordered_policy(name="chaos-policy", ns="default"):
+    return PropagationPolicy(
+        meta=ObjectMeta(name=name, namespace=ns),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=dynamic_weight_placement(
+                cluster_affinities=[
+                    group_term("primary"), group_term("fallback"),
+                ]
+            ),
+        ),
+    )
+
+
+# --------------------------------------------------------------------------
+# deterministic fault injection
+# --------------------------------------------------------------------------
+
+
+class TestFaultInjection:
+    def test_disarmed_is_none_and_allocation_free(self):
+        assert faultinject.fault_point("estimator.rpc", "x") is None
+        assert faultinject.injector() is None
+
+    def test_seeded_decisions_replay_bit_identically(self):
+        spec = "estimator.rpc=error,rate=0.4,count=50"
+        logs = []
+        for _ in range(2):
+            inj = faultinject.arm(spec, seed=1234)
+            for i in range(200):
+                inj.fire("estimator.rpc", f"call{i}")
+            logs.append([(e.seq, e.point, e.key) for e in inj.log])
+        assert logs[0] == logs[1]
+        assert 0 < len(logs[0]) <= 50
+        # a different seed produces a different firing pattern
+        inj = faultinject.arm(spec, seed=99)
+        for i in range(200):
+            inj.fire("estimator.rpc", f"call{i}")
+        assert [(e.seq, e.point, e.key) for e in inj.log] != logs[0]
+
+    def test_match_count_after_and_actions(self):
+        inj = faultinject.arm(
+            "solver.rpc=drop,match=Score,count=2;"
+            "cluster.health=down,match=member2;"
+            "bus.rpc=delay,delay=0.001,after=1"
+        )
+        assert inj.fire("solver.rpc", "SyncClusters") is None
+        assert inj.fire("solver.rpc", "ScoreAndAssign").action == "drop"
+        assert inj.fire("solver.rpc", "ScoreAndAssign").action == "drop"
+        assert inj.fire("solver.rpc", "ScoreAndAssign") is None  # count=2
+        assert inj.fire("cluster.health", "member1") is None
+        assert inj.fire("cluster.health", "member2").action == "down"
+        assert inj.fire("bus.rpc", "Apply") is None  # after=1
+        assert inj.fire("bus.rpc", "Apply").action == "delay"
+
+    def test_injected_error_is_grpc_shaped(self):
+        import grpc
+
+        err = faultinject.injected_error("solver.rpc", "Score")
+        assert isinstance(err, faultinject.FaultError)
+        assert isinstance(err, grpc.RpcError)
+        assert err.code() == grpc.StatusCode.UNAVAILABLE
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError):
+            faultinject.parse_spec("estimator.rpc=explode")
+        with pytest.raises(ValueError):
+            faultinject.parse_spec("estimator.rpc=error,bogus=1")
+
+
+# --------------------------------------------------------------------------
+# tensorized ordered failover vs the per-binding oracle
+# --------------------------------------------------------------------------
+
+
+def make_grouped_snapshot(n_primary=3, n_fallback=3, primary_cpu="4",
+                          fallback_cpu="4000"):
+    clusters = [
+        new_cluster(f"p{i}", cpu=primary_cpu, memory="400Gi",
+                    labels={"group": "primary"})
+        for i in range(n_primary)
+    ] + [
+        new_cluster(f"f{i}", cpu=fallback_cpu, memory="4000Gi",
+                    labels={"group": "fallback"})
+        for i in range(n_fallback)
+    ]
+    clusters.sort(key=lambda c: c.name)
+    return ClusterSnapshot(clusters)
+
+
+class TestRankedOrderedFailover:
+    def test_affinity_group_rank(self):
+        terms = np.array(
+            [[True, False, True], [False, True, True]], bool
+        )  # T=2, C=3
+        rank = mops.affinity_group_rank(terms)
+        assert rank.tolist() == [0, 1, 0]
+        assert mops.affinity_group_rank(np.zeros((2, 3), bool)).tolist() == [
+            2, 2, 2,
+        ]
+
+    def test_batch_matches_per_binding_oracle(self):
+        """Randomized multi-term batch through the engine's ranked path ==
+        the refimpl per-binding ordered retry loop (which re-derives fit
+        by RUNNING the divider per group, sharing no selection code)."""
+        rng = np.random.default_rng(7)
+        snap = make_grouped_snapshot(4, 4, primary_cpu="8", fallback_cpu="64")
+        pl = dynamic_weight_placement(
+            cluster_affinities=[group_term("primary"), group_term("fallback")]
+        )
+        problems = []
+        for i in range(240):
+            reps = int(rng.integers(1, 30))
+            prev = {}
+            if i % 3 == 0:  # some rows carry previous placements
+                prev = {f"p{int(rng.integers(0, 4))}": max(1, reps // 2)}
+            problems.append(
+                BindingProblem(
+                    key=f"b{i}",
+                    placement=pl,
+                    replicas=reps,
+                    requests={"cpu": 1000},
+                    gvk="apps/v1/Deployment",
+                    prev=prev,
+                    fresh=bool(i % 5 == 0),
+                )
+            )
+        eng = TensorScheduler(snap)
+        res = eng.schedule(problems)
+        solves_before = eng.solve_batches
+        assert solves_before >= 1
+
+        cp = compile_placement(pl, snap)
+        term_masks = np.stack([m for _, m in cp.terms])
+        c = snap.num_clusters
+        for p, r in zip(problems, res):
+            reqs = np.zeros((1, len(snap.dims)), np.int64)
+            reqs[0, snap.dim_index("cpu")] = 1000
+            reqs[0, snap.dim_index("pods")] = 1
+            avail = eng._availability_np(
+                reqs, np.asarray([p.replicas], np.int32)
+            )[0]
+            prev_row = np.zeros(c, np.int32)
+            for n, v in p.prev.items():
+                prev_row[snap.index[n]] = v
+            base = cp.taint_ok & cp.spread_field_ok
+            a, ti, err = solve_one_ordered(
+                term_masks, base, cp.strategy, p.replicas,
+                cp.static_weights, avail, prev_row, p.fresh,
+            )
+            want = (
+                {}
+                if a is None
+                else {
+                    snap.names[j]: int(a[j]) for j in np.flatnonzero(a > 0)
+                }
+            )
+            assert r.clusters == want, (p.key, r.clusters, want, r.error, err)
+            if a is not None:
+                assert r.affinity_name == cp.terms[ti][0]
+
+    def test_fallback_engaged_only_when_primary_cannot_fit(self):
+        snap = make_grouped_snapshot(2, 2, primary_cpu="4", fallback_cpu="400")
+        pl = dynamic_weight_placement(
+            cluster_affinities=[group_term("primary"), group_term("fallback")]
+        )
+        eng = TensorScheduler(snap)
+        small, big = (
+            BindingProblem(key="small", placement=pl, replicas=2,
+                           requests={"cpu": 1000}, gvk="apps/v1/Deployment"),
+            BindingProblem(key="big", placement=pl, replicas=100,
+                           requests={"cpu": 1000}, gvk="apps/v1/Deployment"),
+        )
+        res = {r.key: r for r in eng.schedule([small, big])}
+        assert set(res["small"].clusters) <= {"p0", "p1"}
+        assert res["small"].affinity_name == "grp-primary"
+        assert set(res["big"].clusters) <= {"f0", "f1"}
+        assert res["big"].affinity_name == "grp-fallback"
+
+    def test_displaced_wave_is_one_batched_solve(self):
+        """A failover wave (evicted rows, multi-term placements) must ride
+        ONE batched solve per chunk — not a solve per binding."""
+        snap = make_grouped_snapshot(3, 3, primary_cpu="64",
+                                     fallback_cpu="64")
+        pl = dynamic_weight_placement(
+            cluster_affinities=[group_term("primary"), group_term("fallback")]
+        )
+        problems = [
+            BindingProblem(
+                key=f"d{i}", placement=pl, replicas=4,
+                requests={"cpu": 1000}, gvk="apps/v1/Deployment",
+                prev={"p1": 2}, evict_clusters=("p0",),
+            )
+            for i in range(500)
+        ]
+        eng = TensorScheduler(snap)
+        res = eng.schedule(problems)
+        assert eng.solve_batches == 1  # 500 displaced rows, one chunk solve
+        for r in res:
+            assert r.success
+            assert "p0" not in r.clusters  # evicted cluster masked out
+
+    def test_multi_term_with_spread_keeps_round_loop(self):
+        """Multi-term + spread constraints is the partition the ranked
+        path must NOT claim: selection there is a per-term group search."""
+        from karmada_tpu.api.policy import SpreadConstraint
+
+        clusters = [
+            new_cluster(f"s{i}", cpu="64", memory="400Gi",
+                        labels={"group": "primary"}, region=f"r{i % 2}")
+            for i in range(4)
+        ]
+        snap = ClusterSnapshot(sorted(clusters, key=lambda c: c.name))
+        pl = dynamic_weight_placement(
+            cluster_affinities=[group_term("primary"), group_term("fallback")],
+            spread_constraints=[
+                SpreadConstraint(
+                    spread_by_field="region", min_groups=2, max_groups=2
+                )
+            ],
+        )
+        problems = [
+            BindingProblem(key=f"sp{i}", placement=pl, replicas=4,
+                           requests={"cpu": 1000}, gvk="apps/v1/Deployment")
+            for i in range(8)
+        ]
+        res = TensorScheduler(snap).schedule(problems)
+        for r in res:
+            assert r.success, r.error
+            assert len({snap.clusters[snap.index[n]].spec.region
+                        for n in r.clusters}) == 2
+
+
+# --------------------------------------------------------------------------
+# graceful-eviction deadline edges (ISSUE 7 satellite)
+# --------------------------------------------------------------------------
+
+
+class TestGracefulEvictionEdges:
+    def _plane(self, clock, timeout=50.0):
+        cp = ControlPlane(clock=lambda: clock[0], eviction_timeout=timeout)
+        return cp
+
+    def test_task_past_grace_purged_even_with_pending_replacement(self):
+        """A task whose grace window expired is dropped even though the
+        replacement cluster never reported Healthy (evictiontask.go
+        timeout arm beats the health arm)."""
+        feature_gate.set(FAILOVER, True)
+        clock = [1000.0]
+        try:
+            cp = self._plane(clock, timeout=50.0)
+            rb = ResourceBinding(meta=ObjectMeta(name="app", namespace="default"))
+            rb.spec.replicas = 2
+            rb.spec.clusters = [TargetCluster(name="m2", replicas=2)]
+            rb.spec.graceful_eviction_tasks = [
+                GracefulEvictionTask(
+                    from_cluster="m1", replicas=2, reason="test",
+                    creation_timestamp=clock[0],
+                )
+            ]
+            # replacement m2 is still Pending: applied=False, no health
+            rb.status.aggregated_status = [
+                AggregatedStatusItem(cluster_name="m2", applied=False,
+                                     health="Unknown")
+            ]
+            cp.store.apply(rb)
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/app")
+            assert rb.spec.graceful_eviction_tasks  # within grace: kept
+            clock[0] += 51.0  # default timeout exceeded
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/app")
+            assert not rb.spec.graceful_eviction_tasks
+        finally:
+            feature_gate.set(FAILOVER, False)
+
+    def test_per_task_grace_overrides_controller_timeout(self):
+        feature_gate.set(FAILOVER, True)
+        clock = [500.0]
+        try:
+            cp = self._plane(clock, timeout=600.0)
+            rb = ResourceBinding(meta=ObjectMeta(name="fast", namespace="default"))
+            rb.spec.replicas = 1
+            rb.spec.clusters = [TargetCluster(name="m2", replicas=1)]
+            rb.spec.graceful_eviction_tasks = [
+                GracefulEvictionTask(
+                    from_cluster="m1", replicas=1, reason="test",
+                    grace_period_seconds=5,
+                    creation_timestamp=clock[0],
+                )
+            ]
+            cp.store.apply(rb)
+            clock[0] += 6.0  # past the TASK grace, far within controller's
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/fast")
+            assert not rb.spec.graceful_eviction_tasks
+        finally:
+            feature_gate.set(FAILOVER, False)
+
+    def test_preserve_state_survives_double_reschedule(self):
+        """StatefulFailoverInjection: a binding that fails over TWICE
+        during one eviction window keeps each hop's preserved state on its
+        own task (the first task's labels must not be clobbered by the
+        second eviction)."""
+        feature_gate.set(FAILOVER, True)
+        feature_gate.set(STATEFUL_FAILOVER_INJECTION, True)
+        clock = [2000.0]
+        try:
+            cp = self._plane(clock)
+            rb = ResourceBinding(meta=ObjectMeta(name="stateful", namespace="default"))
+            rb.spec.replicas = 2
+            rb.spec.scheduler_name = "nobody"  # keep the scheduler out
+            rb.spec.failover = FailoverBehavior(
+                application=ApplicationFailoverBehavior(
+                    decision_conditions_toleration_seconds=10,
+                    state_preservation={"phase": ".phase"},
+                )
+            )
+            rb.spec.clusters = [TargetCluster(name="m1", replicas=2)]
+            rb.status.aggregated_status = [
+                AggregatedStatusItem(
+                    cluster_name="m1", applied=True, health="Unhealthy",
+                    status={"phase": "hop1"},
+                )
+            ]
+            cp.store.apply(rb)
+            cp.settle()
+            clock[0] += 11.0
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/stateful")
+            tasks = {t.from_cluster: t for t in rb.spec.graceful_eviction_tasks}
+            assert tasks["m1"].preserved_label_state == {"phase": "hop1"}
+
+            # rescheduled onto m2, which then ALSO degrades mid-eviction
+            rb.spec.clusters = [TargetCluster(name="m2", replicas=2)]
+            rb.status.aggregated_status = [
+                AggregatedStatusItem(
+                    cluster_name="m2", applied=True, health="Unhealthy",
+                    status={"phase": "hop2"},
+                )
+            ]
+            cp.store.apply(rb)
+            cp.settle()
+            clock[0] += 11.0
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/stateful")
+            tasks = {t.from_cluster: t for t in rb.spec.graceful_eviction_tasks}
+            assert set(tasks) == {"m1", "m2"}
+            assert tasks["m1"].preserved_label_state == {"phase": "hop1"}
+            assert tasks["m2"].preserved_label_state == {"phase": "hop2"}
+        finally:
+            feature_gate.set(STATEFUL_FAILOVER_INJECTION, False)
+            feature_gate.set(FAILOVER, False)
+
+
+# --------------------------------------------------------------------------
+# chaos e2e: seeded cluster kill -> ordered failover -> oracle parity
+# --------------------------------------------------------------------------
+
+
+class TestChaosPlane:
+    def _grouped_plane(self, clock):
+        cp = ControlPlane(clock=lambda: clock[0])
+        for i in range(1, 3):
+            cp.join_cluster(
+                new_cluster(f"member{i}", cpu="100", memory="200Gi",
+                            labels={"group": "primary"})
+            )
+        for i in range(3, 5):
+            cp.join_cluster(
+                new_cluster(f"member{i}", cpu="100", memory="200Gi",
+                            labels={"group": "fallback"})
+            )
+        cp.settle()
+        return cp
+
+    def test_seeded_cluster_kill_replays_to_oracle_placements(self):
+        feature_gate.set(FAILOVER, True)
+        clock = [3000.0]
+        try:
+            cp = self._grouped_plane(clock)
+            cp.store.apply(new_deployment("web", replicas=8))
+            cp.store.apply(ordered_policy())
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/web-deployment")
+            before = {tc.name: tc.replicas for tc in rb.spec.clusters}
+            assert set(before) <= {"member1", "member2"}
+            assert sum(before.values()) == 8
+
+            # arm the seeded kill: member2 flips NotReady at the next
+            # heartbeat — the exact mid-wave failure the chaos bench fires
+            inj = faultinject.arm("cluster.health=down,match=member2", seed=3)
+            clock[0] += 60
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/web-deployment")
+            after = {tc.name: tc.replicas for tc in rb.spec.clusters}
+            assert "member2" not in after
+            assert sum(after.values()) == 8
+            # ordered fallback honored: the surviving primary serves first
+            assert rb.status.scheduler_observed_affinity_name == "grp-primary"
+
+            # oracle replay from (event log, pre-kill placements, final
+            # availability): placements must match bit-for-bit
+            engine = cp.scheduler._engine
+            snap = engine.snapshot
+            pl = ordered_policy().spec.placement
+            cp_compiled = compile_placement(pl, snap)
+            reqs = np.zeros((1, len(snap.dims)), np.int64)
+            pods = snap.dim_index("pods")
+            if pods is not None:
+                reqs[0, pods] = 1
+            avail = engine._availability_np(
+                reqs, np.asarray([8], np.int32)
+            )[0]
+            key = "default/web-deployment"
+            want = replay_failover(
+                inj.log,
+                snap.names,
+                {key: before},
+                {key: np.stack([m for _, m in cp_compiled.terms])},
+                {key: cp_compiled.taint_ok & cp_compiled.spread_field_ok},
+                {key: cp_compiled.strategy},
+                {key: 8},
+                {key: cp_compiled.static_weights},
+                {key: avail},
+            )
+            assert want[key] == after
+        finally:
+            feature_gate.set(FAILOVER, False)
+
+    def test_primary_wipeout_falls_back_in_group_order(self):
+        feature_gate.set(FAILOVER, True)
+        clock = [4000.0]
+        try:
+            cp = self._grouped_plane(clock)
+            cp.store.apply(new_deployment("web", replicas=6))
+            cp.store.apply(ordered_policy())
+            cp.settle()
+            faultinject.arm(
+                "cluster.health=down,match=member1;"
+                "cluster.health=down,match=member2",
+                seed=11,
+            )
+            clock[0] += 60
+            cp.settle()
+            rb = cp.store.get("ResourceBinding", "default/web-deployment")
+            after = {tc.name: tc.replicas for tc in rb.spec.clusters}
+            assert set(after) <= {"member3", "member4"}
+            assert sum(after.values()) == 6
+            assert rb.status.scheduler_observed_affinity_name == "grp-fallback"
+            # recovery: disarm, members heal, primary group takes back over
+            # on the next reschedule trigger
+            faultinject.disarm()
+            clock[0] += 60
+            cp.settle()
+            cluster2 = cp.store.get("Cluster", "member2")
+            assert not any(
+                t.effect == "NoExecute" for t in cluster2.spec.taints
+            )
+        finally:
+            feature_gate.set(FAILOVER, False)
+
+
+# --------------------------------------------------------------------------
+# degraded mode: solver sidecar down -> in-proc fallback
+# --------------------------------------------------------------------------
+
+
+class TestSolverDegradedMode:
+    def test_dead_sidecar_falls_back_to_inproc_solve(self):
+        from karmada_tpu.solver.client import RemoteSolver
+        from karmada_tpu.utils.metrics import degraded_passes
+
+        solver = RemoteSolver("127.0.0.1:1", timeout_seconds=1.0)
+        before = degraded_passes.value(channel="solver")
+        cp = ControlPlane(solver=solver)
+        for i in (1, 2):
+            cp.join_cluster(
+                new_cluster(f"member{i}", cpu="100", memory="200Gi")
+            )
+        cp.settle()
+        cp.store.apply(new_deployment("app", replicas=4))
+        cp.store.apply(
+            PropagationPolicy(
+                meta=ObjectMeta(name="p", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[
+                        ResourceSelector(
+                            api_version="apps/v1", kind="Deployment"
+                        )
+                    ],
+                    placement=dynamic_weight_placement(),
+                ),
+            )
+        )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/app-deployment")
+        placed = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        assert sum(placed.values()) == 4  # scheduled despite the dead sidecar
+        assert degraded_passes.value(channel="solver") > before
+        solver.close()

@@ -37,6 +37,7 @@ FIXTURE_ROLES = {
     "GL004": set(),
     "GL005": {gl_core.ROLE_ENTRY, gl_core.ROLE_OPS},
     "GL006": set(),
+    "GL007": set(),
 }
 
 

@@ -230,3 +230,78 @@ class TestHASolver:
                 except Exception:
                     pass
             ha.close()
+
+
+class TestDeadlineBudget:
+    """ISSUE 7 satellite: the re-sync-then-retry path used to stack
+    ``self.timeout`` up to three times (score, sync, retry). One overall
+    deadline budget now threads through the whole schedule() call."""
+
+    def test_stalled_resync_path_fails_within_one_budget(self):
+        """THE old stacking shape: the first score answers
+        FAILED_PRECONDITION instantly (solver restarted, missed the sync),
+        the re-sync succeeds but SLOWLY (0.8x the budget), and the retried
+        score black-holes. The old code gave the retry a fresh full
+        ``self.timeout`` on top of the sync's — ~1.8x total; the deadline
+        budget bounds the whole call to ~1x."""
+        import threading
+
+        import grpc
+
+        svc = SolverService()
+        stall = threading.Event()
+
+        real_sync = svc.sync_clusters
+        real_score = svc.score_and_assign
+
+        def slow_sync(clusters, version):
+            time.sleep(1.2)  # succeeds, but eats most of the 1.5s budget
+            return real_sync(clusters, version)
+
+        def stalling_score(request):
+            if svc.snapshot_version == request.snapshot_version:
+                stall.wait(timeout=30.0)  # the RETRY black-holes
+            return real_score(request)
+
+        svc.sync_clusters = slow_sync
+        svc.score_and_assign = stalling_score
+        srv = SolverGrpcServer(svc, "127.0.0.1:0")
+        port = srv.start()
+        clusters = synthetic_fleet(6, seed=3)
+        solver = RemoteSolver(
+            f"127.0.0.1:{port}",
+            timeout_seconds=1.5,
+            cluster_source=lambda: clusters,
+        )
+        try:
+            problems = _problems(clusters, n=4, seed=1)
+            # never synced: the first score answers FAILED_PRECONDITION
+            t0 = time.perf_counter()
+            with pytest.raises(grpc.RpcError):
+                solver.schedule(problems)
+            elapsed = time.perf_counter() - t0
+            assert elapsed < 1.5 * 1.4, (
+                f"schedule took {elapsed:.2f}s — the deadline budget did "
+                "not bound the re-sync retry path (old stacking would "
+                "run ~2.7s here)"
+            )
+        finally:
+            stall.set()
+            solver.close()
+            srv.stop(0)
+
+    def test_dead_solver_fails_within_one_budget(self):
+        import grpc
+
+        clusters = synthetic_fleet(4, seed=2)
+        solver = RemoteSolver(
+            "127.0.0.1:1", timeout_seconds=1.0,
+            cluster_source=lambda: clusters,
+        )
+        try:
+            t0 = time.perf_counter()
+            with pytest.raises(grpc.RpcError):
+                solver.schedule(_problems(clusters, n=2, seed=4))
+            assert time.perf_counter() - t0 < 1.8
+        finally:
+            solver.close()

@@ -175,6 +175,43 @@ def obs_block(od: dict) -> str:
     )
 
 
+def chaos_block(cd: dict) -> str:
+    """Rows for a ``bench.py --chaos`` record (the chaos-failover tier):
+    time-to-stable-placement after the seeded kill wave, the displaced-
+    binding count against the batched-solve count, the oracle-parity
+    flag, and the breaker's degraded/recovery story."""
+    scale = cd.get("metric", "").removeprefix("chaos_storm_")
+    parity = {True: "IDENTICAL", False: "DIVERGED"}[
+        bool(cd.get("oracle_identical"))
+    ]
+    degraded = cd.get("degraded_storm_s") or []
+    degraded_s = ", ".join(f"{s:.1f}s" for s in degraded) or "n/a"
+    return "\n".join(
+        [
+            f"| chaos {scale}: kill {len(cd.get('killed_clusters', []))} "
+            f"clusters + partition 1 estimator server mid-wave → stable "
+            f"placement | {fmt(cd.get('time_to_stable_s'))} "
+            f"(steady storm p50 disarmed "
+            f"{fmt(cd.get('steady_p50_disarmed_s'))}) |",
+            f"| chaos {scale}: displaced bindings / batched solves | "
+            f"{cd.get('displaced_bindings', 0):,} displaced rescheduled "
+            f"in {cd.get('solves_failover_wave', 0)} batched solve(s) — "
+            f"ordered ClusterAffinities fallback as one tensorized pass, "
+            f"not per-binding Python |",
+            f"| chaos {scale}: oracle parity (numpy per-binding replay of "
+            f"the seeded event log, seed {cd.get('chaos_seed')}) | "
+            f"{parity} ({cd.get('oracle_mismatches', 0)} mismatches, "
+            f"{cd.get('replay_events', 0)} logged fault events) |",
+            f"| chaos {scale}: estimator channel degraded mode | breaker "
+            f"open observed={cd.get('breaker_open_observed')}, degraded "
+            f"storms {degraded_s}, "
+            f"{cd.get('degraded_estimator_passes', 0)} degraded passes "
+            f"(never replay-armed), recovered half-open→closed without "
+            f"operator action={cd.get('breaker_recovered_closed')} |",
+        ]
+    )
+
+
 def extra_block(src: Path) -> str:
     """Dispatch an extra record file by its metric prefix."""
     d = json.loads(src.read_text())
@@ -187,6 +224,8 @@ def extra_block(src: Path) -> str:
         return estimator_block(d)
     if metric.startswith("observability_wave"):
         return obs_block(d)
+    if metric.startswith("chaos_storm"):
+        return chaos_block(d)
     raise SystemExit(f"{src}: unrecognized bench record metric {metric!r}")
 
 

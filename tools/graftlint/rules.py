@@ -10,6 +10,8 @@
 |       | no scheduler imports from ops/                                   |
 | GL006 | metric naming: registry.counter/gauge/histogram names must carry |
 |       | the karmada_tpu_/karmada_scheduler_ prefix and be unique         |
+| GL007 | bounded RPCs: every gRPC unary stub / urlopen call site passes   |
+|       | an explicit timeout (watch streams are deliberately unbounded)   |
 
 Each rule is a pure-AST pass over one ``ModuleInfo`` (plus cross-module
 ``finalize`` hooks); nothing here imports jax.
@@ -812,3 +814,130 @@ class ImportHygiene(Rule):
                         anchor=mod.qualname(node) or "<module>",
                         detail=f"scheduler:{bad}",
                     )
+
+
+# --------------------------------------------------------------------------
+# GL007 — bounded RPCs: explicit timeout on every unary call site
+# --------------------------------------------------------------------------
+#
+# ISSUE 7 satellite: an RPC without a deadline is an unbounded stall — a
+# black-holed peer freezes whatever thread issued it, and mid-storm that
+# is a scheduling wave. Channels are built once (``chan.unary_unary(...)``
+# assigned to an attribute or name); this rule tracks those stub bindings
+# per scope and requires a ``timeout=`` keyword at every direct CALL of a
+# stub (and every ``stub.future(...)``). ``unary_stream`` watch streams
+# are exempt — they are deliberately open-ended and bounded by their
+# reconnect loop. ``urllib.request.urlopen`` must pass ``timeout=`` too.
+
+
+def _is_stub_factory(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "unary_unary"
+    )
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    return any(
+        kw.arg == "timeout" or kw.arg is None  # **kwargs may carry it
+        for kw in call.keywords
+    )
+
+
+@rule
+class BoundedRpc(Rule):
+    id = "GL007"
+    title = (
+        "gRPC unary stubs and urlopen must pass an explicit timeout "
+        "(no unbounded RPCs)"
+    )
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        # ---- collect stub bindings: self._x = chan.unary_unary(...) per
+        # class, and bare x = chan.unary_unary(...) per module
+        attr_stubs: set[str] = set()
+        name_stubs: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) or not _is_stub_factory(
+                node.value
+            ):
+                continue
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    attr_stubs.add(target.attr)
+                elif isinstance(target, ast.Name):
+                    name_stubs.add(target.id)
+        # urlopen aliases: `from urllib.request import urlopen [as u]`
+        urlopen_names: set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "urllib.request":
+                for alias in node.names:
+                    if alias.name == "urlopen":
+                        urlopen_names.add(alias.asname or alias.name)
+
+        def is_stub_ref(expr: ast.AST) -> Optional[str]:
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in attr_stubs
+            ):
+                return f"self.{expr.attr}"
+            if isinstance(expr, ast.Name) and expr.id in name_stubs:
+                return expr.id
+            return None
+
+        def is_urlopen(expr: ast.AST) -> bool:
+            if isinstance(expr, ast.Name) and expr.id in urlopen_names:
+                return True
+            # ONLY urllib.request.urlopen(...) / request.urlopen(...) —
+            # an arbitrary `pool.urlopen(...)` (urllib3 et al.) is out of
+            # scope for this rule
+            if not (
+                isinstance(expr, ast.Attribute) and expr.attr == "urlopen"
+            ):
+                return False
+            base = expr.value
+            if isinstance(base, ast.Name):
+                return base.id == "request"
+            return (
+                isinstance(base, ast.Attribute)
+                and base.attr == "request"
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "urllib"
+            )
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            stub = is_stub_ref(node.func)
+            kind = None
+            if stub is not None:
+                kind = f"stub:{stub}"
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "future"
+                and is_stub_ref(node.func.value) is not None
+            ):
+                stub = is_stub_ref(node.func.value)
+                kind = f"future:{stub}"
+            elif is_urlopen(node.func):
+                kind = "urlopen"
+            if kind is None or _has_timeout_kw(node):
+                continue
+            yield Finding(
+                rule=self.id, path=mod.rel, line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"unbounded RPC: {kind.split(':', 1)[-1]} is called "
+                    "without an explicit timeout= — a black-holed peer "
+                    "stalls this thread indefinitely (thread a deadline "
+                    "budget through the call, utils.backoff.Deadline)"
+                ),
+                anchor=mod.qualname(node) or "<module>", detail=kind,
+            )
