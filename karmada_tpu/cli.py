@@ -1203,6 +1203,20 @@ def build_parser() -> tuple:
         help="print only the per-wave phase summaries",
     )
 
+    qu = sub.add_parser(
+        "quota",
+        help="quota-plane operations: `quota status [--metrics HOST:PORT]` "
+        "prints per-namespace limit/used/denied from the metrics endpoint "
+        "(karmada_tpu_quota_limit / _used / _denied_total families)",
+    )
+    qu.add_argument("action", choices=("status",))
+    qu.add_argument(
+        "--metrics", default="",
+        help="HOST:PORT of the plane's metrics endpoint; without it the "
+        "CURRENT process's in-proc registry answers (useful under an "
+        "embedded plane)",
+    )
+
     li = sub.add_parser(
         "lint",
         help="run graftlint, the repo's two-tier static analyzer: AST "
@@ -1306,6 +1320,87 @@ def cmd_trace_dump(
     return doc
 
 
+#: the quota families `quota status` reads off the exposition — kept in
+#: one place so the verb and its parser cannot drift
+_QUOTA_FAMILIES = (
+    "karmada_tpu_quota_limit",
+    "karmada_tpu_quota_used",
+    "karmada_tpu_quota_denied_total",
+)
+
+
+def _parse_exposition_lines(text: str, families) -> list:
+    """(family, labels dict, value) rows for the requested families from
+    Prometheus text exposition — enough of the format for the flat
+    counter/gauge families the quota plane exports."""
+    import re as _re
+
+    out = []
+    line_re = _re.compile(
+        r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$"
+    )
+    label_re = _re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    wanted = set(families)
+    # single-pass unescape: sequential str.replace corrupts values with
+    # literal backslashes (an escaped \\ followed by n would collapse to
+    # a newline)
+    esc = {"\\\\": "\\", '\\"': '"', "\\n": "\n"}
+    unescape = _re.compile(r'\\\\|\\"|\\n')
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = line_re.match(line.strip())
+        if m is None or m.group("name") not in wanted:
+            continue
+        labels = {
+            k: unescape.sub(lambda mm: esc[mm.group(0)], v)
+            for k, v in label_re.findall(m.group("labels") or "")
+        }
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        out.append((m.group("name"), labels, value))
+    return out
+
+
+def cmd_quota_status(metrics: str = "") -> dict:
+    """The ``quota status`` verb: per-namespace limit/used/denied, read
+    from a running process's /metrics endpoint (``metrics="host:port"``)
+    or this process's in-proc registry. The families are the quota
+    plane's exposition (FRQ status controller sets limit/used; the
+    scheduler's denial path counts denied), so the verb needs no store
+    access — any scrapable plane answers."""
+    if metrics:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://{metrics}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+        rows = _parse_exposition_lines(text, _QUOTA_FAMILIES)
+    else:
+        from .utils.metrics import registry as _registry
+
+        rows = _parse_exposition_lines(
+            _registry.render(), _QUOTA_FAMILIES
+        )
+    namespaces: dict = {}
+    for family, labels, value in rows:
+        ns = labels.get("namespace", "")
+        entry = namespaces.setdefault(
+            ns, {"resources": {}, "denied_total": 0}
+        )
+        if family == "karmada_tpu_quota_denied_total":
+            entry["denied_total"] = int(value)
+            continue
+        res = labels.get("resource", "")
+        slot = entry["resources"].setdefault(res, {"limit": 0, "used": 0})
+        slot["limit" if family.endswith("_limit") else "used"] = int(value)
+    return {"namespaces": namespaces}
+
+
 def cmd_warmup(manifest: str = "", expand: bool = True) -> dict:
     """The ``warmup`` verb: replay the trace manifest through AOT
     compilation on the current backend (scheduler.prewarm.warmup), so a
@@ -1361,6 +1456,14 @@ def main(argv: Optional[list[str]] = None) -> int:
                 args.metrics, wave=args.wave, summary=args.summary
             )
         except Exception as exc:  # unreachable endpoint, bad JSON
+            print(json.dumps({"error": str(exc)}))
+            return 1
+        print(json.dumps(doc, indent=2))
+        return 0
+    if args.command == "quota":
+        try:
+            doc = cmd_quota_status(args.metrics)
+        except Exception as exc:  # unreachable endpoint, bad text
             print(json.dumps({"error": str(exc)}))
             return 1
         print(json.dumps(doc, indent=2))

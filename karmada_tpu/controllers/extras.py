@@ -282,26 +282,125 @@ class WorkloadRebalancerController:
 
 
 class FederatedResourceQuotaController:
-    """Static assignment sync: per-cluster ResourceQuota slices shipped as
-    Works; status aggregation sums member-reported usage
-    (federatedresourcequota/federated_resource_quota_sync_controller.go +
-    _status_controller.go)."""
+    """Static assignment sync + LIVE usage accounting.
+
+    Per-cluster ResourceQuota slices still ship as Works
+    (federatedresourcequota/federated_resource_quota_sync_controller.go),
+    but ``status.overall_used`` is now recomputed from bound
+    ResourceBindings — the reference's FRQ status controller shape: one
+    sweep over the namespace's bindings sums ``assigned replicas x
+    per-replica request`` per tracked resource (each replica occupying one
+    pod, mirroring the estimator's implicit pods request). The member-
+    reported aggregation this replaces double-counted the very workloads
+    the plane itself propagated and went stale between member status
+    syncs; binding-derived usage moves in the same settle wave as the
+    schedule, which is what the scheduler's admission plane keys on.
+
+    Binding events enqueue only the namespaces that actually carry an FRQ
+    (a 100k-binding storm in unquota'd namespaces never touches this
+    worker), and the batched reconcile computes every dirty FRQ from ONE
+    sweep over the binding list."""
 
     def __init__(self, store: Store, runtime: Runtime, members=None) -> None:
         self.store = store
-        self.members = members
-        self.worker = runtime.new_worker("frq", self._reconcile)
-        store.watch("FederatedResourceQuota", lambda e: self.worker.enqueue(e.key))
+        self.members = members  # kept for constructor compat (unused)
+        self.worker = runtime.new_worker(
+            "frq", self._reconcile, reconcile_batch=self._reconcile_batch
+        )
+        # namespace -> FRQ keys, maintained from watch events so the
+        # per-binding-event check is one set lookup
+        self._frq_by_ns: dict[str, set[str]] = {}
+        for frq in store.list("FederatedResourceQuota"):
+            self._frq_by_ns.setdefault(
+                frq.meta.namespace, set()
+            ).add(frq.meta.namespaced_name)
+        store.watch("FederatedResourceQuota", self._on_quota_event)
         store.watch("Cluster", self._on_cluster_event)
+        store.watch("ResourceBinding", self._on_binding_event)
+
+    def _on_quota_event(self, event) -> None:
+        frq = event.obj
+        ns = frq.meta.namespace
+        if event.type == "Deleted":
+            keys = self._frq_by_ns.get(ns, set())
+            keys.discard(frq.meta.namespaced_name)
+            if keys:
+                # surviving FRQs re-reconcile so the namespace's gauge
+                # sweep drops the deleted quota's samples
+                for key in keys:
+                    self.worker.enqueue(key)
+            else:
+                # last FRQ of the namespace: retire its gauge samples, or
+                # `quota status` reports the dead quota's limits forever
+                from ..utils.metrics import quota_limit, quota_used
+
+                quota_limit.remove_matching(namespace=ns)
+                quota_used.remove_matching(namespace=ns)
+        else:
+            self._frq_by_ns.setdefault(ns, set()).add(
+                frq.meta.namespaced_name
+            )
+            self.worker.enqueue(frq.meta.namespaced_name)
 
     def _on_cluster_event(self, event) -> None:
         for frq in self.store.list("FederatedResourceQuota"):
             self.worker.enqueue(frq.meta.namespaced_name)
 
+    def _on_binding_event(self, event) -> None:
+        keys = self._frq_by_ns.get(event.obj.meta.namespace)
+        if keys:
+            for key in keys:
+                self.worker.enqueue(key)
+
+    def _usage_by_namespace(self, namespaces: set) -> dict:
+        """One sweep over the binding list: namespace -> {resource: used}
+        for the requested namespaces. Delegates to the scheduler plane's
+        single usage formula (scheduler.quota.usage_from_bindings) so the
+        accounting the status controller writes and the demand math the
+        admission kernel charges can never disagree."""
+        from ..scheduler.quota import usage_from_bindings
+
+        return usage_from_bindings(self.store, namespaces)
+
     def _reconcile(self, key: str) -> Optional[str]:
-        frq = self.store.get("FederatedResourceQuota", key)
-        if frq is None:
-            return DONE
+        return self._reconcile_batch([key]).get(key, DONE)
+
+    def _reconcile_batch(self, keys) -> dict:
+        out: dict = {}
+        live: list = []
+        for key in keys:
+            frq = self.store.get("FederatedResourceQuota", key)
+            out[key] = DONE
+            if frq is not None:
+                live.append((key, frq))
+        if not live:
+            return out
+        namespaces = {frq.meta.namespace for _, frq in live}
+        usage = self._usage_by_namespace(namespaces)
+        for key, frq in live:
+            self._reconcile_one(frq, usage.get(frq.meta.namespace, {}))
+        # gauge exposition is a per-namespace CLEAR-then-SET sweep over
+        # every live FRQ: a deleted quota, or a spec edit dropping a
+        # resource, retires its stale samples instead of serving them
+        # forever
+        from ..utils.metrics import quota_limit, quota_used
+
+        for ns in namespaces:
+            quota_limit.remove_matching(namespace=ns)
+            quota_used.remove_matching(namespace=ns)
+            ns_usage = usage.get(ns, {})
+            for key in self._frq_by_ns.get(ns, set()):
+                frq = self.store.get("FederatedResourceQuota", key)
+                if frq is None:
+                    continue
+                for res, limit in frq.spec.overall.items():
+                    quota_limit.set(int(limit), namespace=ns, resource=res)
+                    quota_used.set(
+                        int(ns_usage.get(res, 0)), namespace=ns, resource=res
+                    )
+        return out
+
+    def _reconcile_one(self, frq, ns_usage: dict) -> None:
         for assignment in frq.spec.static_assignments:
             cluster = self.store.get("Cluster", assignment.cluster_name)
             if cluster is None:
@@ -323,18 +422,11 @@ class FederatedResourceQuotaController:
                         spec=WorkSpec(workload=[quota]),
                     )
                 )
-        # status aggregation from member-side quota status
-        overall_used: dict[str, int] = {}
-        if self.members is not None:
-            for assignment in frq.spec.static_assignments:
-                member = self.members.get(assignment.cluster_name)
-                if member is None or not member.reachable:
-                    continue
-                obj = member.get("v1/ResourceQuota", frq.meta.namespace, frq.meta.name)
-                if obj is None or not obj.status:
-                    continue
-                for res_name, used in obj.status.get("used", {}).items():
-                    overall_used[res_name] = overall_used.get(res_name, 0) + int(used)
+        # live accounting: only the tracked resources are reported (the
+        # reference reports used for spec.overall's resource set)
+        overall_used = {
+            res: int(ns_usage.get(res, 0)) for res in frq.spec.overall
+        }
         changed = False
         if frq.status.overall != frq.spec.overall:
             frq.status.overall = dict(frq.spec.overall)
@@ -344,4 +436,3 @@ class FederatedResourceQuotaController:
             changed = True
         if changed:
             self.store.apply(frq)
-        return DONE

@@ -89,6 +89,15 @@ class SchedulerController:
         # the batch cap bounds ONE engine pass; the device-resident fleet
         # path amortizes per-pass dispatch+fetch costs over the whole batch,
         # so a storm should drain in as few passes as possible
+        # quota plane: FRQ events bump the quota generation (the engine's
+        # batch-identity replay and the denied-binding retry gate both key
+        # on it) and re-enqueue ONLY the denied bindings of the touched
+        # namespace — a quota raise clears QuotaExceeded without a full
+        # re-pack of the fleet
+        self._quota_gen = 0
+        self._quota_snapshot = None
+        self._quota_snap_gen = -1  # generation the cached snapshot is for
+        self._quota_denied: dict[tuple, int] = {}  # (kind, key) -> gen
         self.worker = runtime.new_worker(
             "scheduler", self._reconcile,
             reconcile_batch=self._reconcile_batch, batch_size=131072,
@@ -96,6 +105,7 @@ class SchedulerController:
         store.watch("ResourceBinding", self._on_binding_event)
         store.watch("ClusterResourceBinding", self._on_binding_event)
         store.watch("Cluster", self._on_cluster_event)
+        store.watch("FederatedResourceQuota", self._on_quota_event)
 
     # -- events ------------------------------------------------------------
 
@@ -109,9 +119,20 @@ class SchedulerController:
             return  # our own writeback echo
         self.worker.enqueue((event.kind, event.key))
 
+    def _on_quota_event(self, event) -> None:
+        self._quota_gen += 1
+        self._quota_snap_gen = -1  # rebuild the packed snapshot lazily
+        ns = event.obj.meta.namespace if event.obj is not None else ""
+        for (kind, key), _gen in list(self._quota_denied.items()):
+            if not ns or key.split("/", 1)[0] == ns:
+                self.worker.enqueue((kind, key))
+
     def _on_cluster_event(self, event) -> None:
         self._snapshot = None  # invalidate; rebuild lazily
         self._solver_synced = False  # sidecar re-sync before next schedule
+        # quota caps pack against the cluster columns: rebuild the quota
+        # snapshot against the refreshed cluster snapshot too
+        self._quota_snap_gen = -1
         if self.estimator_registry is not None:
             # member state moved: memoized accurate estimates are stale
             # (EstimatorRegistry.invalidate staleness contract)
@@ -133,6 +154,76 @@ class SchedulerController:
                 self._solver_synced = True
             return self.solver
         return self._inproc_engine()
+
+    @staticmethod
+    def _quota_enforcement_enabled() -> bool:
+        import os
+
+        return os.environ.get(
+            "KARMADA_TPU_QUOTA_ENFORCEMENT", "1"
+        ).lower() not in ("0", "false", "")
+
+    def _quota_namespaces(self) -> set:
+        """Namespaces carrying an FRQ when enforcement is on (empty =
+        the quota plane is inert for routing purposes)."""
+        if not self._quota_enforcement_enabled():
+            return set()
+        return {
+            frq.meta.namespace
+            for frq in self.store.list("FederatedResourceQuota")
+        }
+
+    def _route_engine_for_quota(self, engine, problems=()):
+        """The solver sidecar has no quota channel: a wave that must
+        enforce quota falls back to the in-proc engine (the same
+        degraded-mode seam transport failures use) instead of silently
+        scheduling quota'd bindings unbounded. Scoped to the WAVE: only
+        waves that actually contain bindings in quota'd namespaces
+        reroute — one team's FRQ must not cost every other namespace the
+        sidecar."""
+        if hasattr(engine, "set_quota"):
+            return engine
+        quota_ns = self._quota_namespaces()
+        if not quota_ns or not any(
+            p.namespace in quota_ns for p in problems
+        ):
+            return engine
+        if not getattr(self, "_quota_solver_warned", False):
+            self._quota_solver_warned = True
+            print(
+                "# scheduler: FederatedResourceQuota enforcement is not "
+                "supported over the solver sidecar; quota waves take the "
+                "in-proc engine (set KARMADA_TPU_QUOTA_ENFORCEMENT=0 to "
+                "route them to the sidecar unenforced)",
+                flush=True,
+            )
+        return self._inproc_engine()
+
+    def _ensure_engine_quota(self, engine) -> None:
+        """Hand the engine a current QuotaSnapshot (None = no FRQs or
+        enforcement disabled). In-proc engines only: the solver sidecar
+        has no quota channel — _route_engine_for_quota sends quota waves
+        to the in-proc path before this runs."""
+        if not hasattr(engine, "set_quota"):
+            return
+        if not self._quota_enforcement_enabled():
+            # live kill switch: the engine's quota hook disarms this pass
+            # (the packed snapshot cache survives for a re-enable)
+            engine.set_quota(None)
+            return
+        if self._quota_snap_gen != self._quota_gen:
+            from ..scheduler.quota import build_quota_snapshot
+
+            qsnap = None
+            frqs = self.store.list("FederatedResourceQuota")
+            if frqs:
+                qsnap = build_quota_snapshot(
+                    frqs, engine.snapshot, self._quota_gen,
+                    store=self.store,
+                )
+            self._quota_snapshot = qsnap
+            self._quota_snap_gen = self._quota_gen
+        engine.set_quota(self._quota_snapshot)
 
     def _inproc_engine(self):
         """The snapshot-backed in-process engine — the default when no
@@ -213,9 +304,31 @@ class SchedulerController:
             kind, key = kind_key
             rb = self.store.get(kind, key)
             if rb is None:
+                self._quota_denied.pop(kind_key, None)
                 out[kind_key] = DONE
                 continue
             should, fresh = self._needs_scheduling(rb)
+            # quota-denied retry gate: a denied binding re-schedules on
+            # the NEXT quota generation (FRQ spec/usage moved), not every
+            # queue wave — and it MUST re-schedule then, even when the
+            # generic gate sees nothing to do (a never-placed denied
+            # binding has empty spec.clusters and an up-to-date observed
+            # generation). An explicit Fresh trigger bypasses the gate.
+            denied_at = self._quota_denied.get(kind_key)
+            if denied_at is not None and not fresh:
+                if (
+                    denied_at == self._quota_gen
+                    and rb.status.scheduler_observed_generation
+                    == rb.meta.generation
+                ):
+                    # same quota generation AND unchanged binding spec:
+                    # stay parked. A spec change (e.g. scaled down to fit)
+                    # bumps the generation and must retry immediately —
+                    # its own usage is unchanged, so no quota event would
+                    # ever unpark it otherwise.
+                    out[kind_key] = DONE
+                    continue
+                should = True  # quota or the binding moved: retry now
             if not should:
                 out[kind_key] = DONE
                 continue
@@ -229,7 +342,10 @@ class SchedulerController:
         with tracer.span("scheduler.pass") as sp:
             problems = [p for _, _, p, _ in todo]
             try:
-                engine = self._get_engine()
+                engine = self._route_engine_for_quota(
+                    self._get_engine(), problems
+                )
+                self._ensure_engine_quota(engine)
                 results = engine.schedule(problems)
             except Exception as exc:  # noqa: BLE001 — transport triage below
                 if self.solver is None or not _is_transport_error(exc):
@@ -249,7 +365,12 @@ class SchedulerController:
                     f"({type(exc).__name__}); in-proc solve for this pass",
                     flush=True,
                 )
-                results = self._inproc_engine().schedule(problems)
+                fallback = self._inproc_engine()
+                # the fallback engine may retain a QuotaSnapshot from an
+                # earlier quota wave: refresh it (or clear it, when
+                # enforcement is off / the FRQ went away) before solving
+                self._ensure_engine_quota(fallback)
+                results = fallback.schedule(problems)
             sp.attrs["bindings"] = len(todo)
         scheduler_pass_seconds.observe(sp.duration)
         per_item = (time.perf_counter() - start) / len(todo)
@@ -265,8 +386,14 @@ class SchedulerController:
                 self.worker.enqueue(kind_key)
                 out[kind_key] = DONE
             return out
+        from ..scheduler.quota import QUOTA_EXCEEDED_ERROR
+
         changed_rbs = []
         for (kind_key, rb, _, fresh), result in zip(todo, results):
+            if result.error == QUOTA_EXCEEDED_ERROR:
+                self._quota_denied[kind_key] = self._quota_gen
+            else:
+                self._quota_denied.pop(kind_key, None)
             if self._write_back(rb, result, fresh):
                 changed_rbs.append(rb)
             e2e_scheduling_duration.observe(per_item)
@@ -314,6 +441,7 @@ class SchedulerController:
                 t.from_cluster for t in rb.spec.graceful_eviction_tasks
             ),
             fresh=fresh,
+            namespace=rb.meta.namespace or "",
         )
 
     def _write_back(self, rb: ResourceBinding, result, fresh: bool = False) -> bool:
@@ -364,15 +492,27 @@ class SchedulerController:
             ):
                 changed = True
         else:
+            from ..scheduler.quota import (
+                QUOTA_EXCEEDED_ERROR,
+                QUOTA_EXCEEDED_REASON,
+            )
+
             rb.status.scheduler_observed_generation = rb.meta.generation
+            quota_hit = result.error == QUOTA_EXCEEDED_ERROR
             if set_condition(
                 rb.status.conditions,
                 Condition(
                     type=SCHEDULED,
                     status=False,
-                    reason="NoClusterFit",
+                    reason=(
+                        QUOTA_EXCEEDED_REASON if quota_hit else "NoClusterFit"
+                    ),
                     message=result.error,
                 ),
             ):
                 changed = True
+                if quota_hit:
+                    from ..utils.metrics import quota_denied
+
+                    quota_denied.inc(namespace=rb.meta.namespace or "")
         return changed

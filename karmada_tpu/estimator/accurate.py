@@ -849,6 +849,14 @@ class EstimatorRegistry:
             route(name, est, conn)
 
         def fetch_batch(conn, members):
+            # NOTE: the registry's profile matrix is np.unique'd ACROSS
+            # namespaces, so this path sends no per-row namespaces — the
+            # server's ResourceQuota plugin stays inert here exactly as
+            # it does on the registry's unary fallback (which also sends
+            # namespace=""). Namespace-aware callers that want the
+            # member-quota cap populate MaxAvailableReplicasBatchRequest.
+            # namespaces per row; wire parity with the unary path is
+            # asserted in tests/test_estimator_batch.py.
             from .service import MaxAvailableReplicasBatchRequest
 
             dims = list(members[0][1].dims_provider())

@@ -109,7 +109,8 @@ def _batch_to_pb(
     req: MaxAvailableReplicasBatchRequest,
 ) -> "bpb.MaxAvailableReplicasBatchRequest":
     msg = bpb.MaxAvailableReplicasBatchRequest(
-        clusters=list(req.clusters), dims=list(req.dims)
+        clusters=list(req.clusters), dims=list(req.dims),
+        namespaces=list(getattr(req, "namespaces", []) or []),
     )
     for row in req.rows:
         msg.rows.add().values.extend(int(v) for v in row)
@@ -123,6 +124,7 @@ def _pb_to_batch(
         clusters=list(msg.clusters),
         dims=list(msg.dims),
         rows=[list(row.values) for row in msg.rows],
+        namespaces=list(msg.namespaces),
     )
 
 

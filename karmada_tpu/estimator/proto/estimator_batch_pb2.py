@@ -51,6 +51,10 @@ def _build() -> "descriptor_pool.DescriptorPool":
         (1, "clusters", _F.TYPE_STRING, True),
         (2, "dims", _F.TYPE_STRING, True),
         (3, "rows", "Int64Row", True),
+        # one namespace per row (quota-plugin parity with the unary
+        # path); proto3 repeated fields are backward/forward compatible —
+        # empty on old clients, ignored by old servers
+        (4, "namespaces", _F.TYPE_STRING, True),
     )
     _message(
         fdp, "ClusterBatchResult",

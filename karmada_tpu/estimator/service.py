@@ -70,11 +70,15 @@ class MaxAvailableReplicasBatchRequest:
     """One RPC per SERVER per pass: the whole unique-profile matrix for
     every cluster the server hosts (empty ``clusters`` = all hosted).
     ``rows`` are positional over ``dims``; the server projects them onto
-    its own dim order by name."""
+    its own dim order by name. ``namespaces`` optionally carries one
+    namespace per row so the server's ResourceQuota plugin caps each
+    row's answer exactly like the unary path does (empty = no namespaces,
+    the pre-quota wire shape — old clients keep working)."""
 
     clusters: list[str] = field(default_factory=list)
     dims: list[str] = field(default_factory=list)
     rows: list = field(default_factory=list)  # U x len(dims) ints
+    namespaces: list[str] = field(default_factory=list)  # one per row
 
 
 @dataclass
@@ -174,6 +178,33 @@ class EstimatorService:
             if u
             else np.zeros(0, np.int32)
         )
+        # ResourceQuota plugin parity with the unary path: a row carrying
+        # a namespace is capped through the SAME plugin call the unary
+        # handler makes, over the same projected request dict the unary
+        # fallback client would send — the batch answer for (namespace,
+        # profile) is the unary answer by construction (feature-gated,
+        # like the unary path)
+        if req.namespaces and self.estimator.quota_plugin is not None:
+            from ..utils.features import RESOURCE_QUOTA_ESTIMATE, feature_gate
+
+            if feature_gate.enabled(RESOURCE_QUOTA_ESTIMATE):
+                out = np.asarray(out).copy()
+                for j, ns in enumerate(req.namespaces[:u]):
+                    if not ns:
+                        continue
+                    requirements = ReplicaRequirements(
+                        resource_request={
+                            d: int(q)
+                            for d, q in zip(req.dims, req.rows[j])
+                            if q > 0
+                        },
+                        namespace=ns,
+                    )
+                    cap = self.estimator.quota_plugin.estimate(
+                        ns, requirements
+                    )
+                    if cap is not None:
+                        out[j] = min(int(out[j]), max(int(cap), 0))
         return MaxAvailableReplicasBatchResponse(
             results=[
                 ClusterBatchResult(
@@ -233,7 +264,8 @@ class MultiClusterEstimatorService:
             if svc is None:
                 continue
             sub = MaxAvailableReplicasBatchRequest(
-                clusters=[name], dims=req.dims, rows=req.rows
+                clusters=[name], dims=req.dims, rows=req.rows,
+                namespaces=req.namespaces,
             )
             results.extend(svc.max_available_replicas_batch(sub).results)
         return MaxAvailableReplicasBatchResponse(results=results)

@@ -45,4 +45,8 @@ from .estimate import (  # noqa: E402,F401
     general_estimate_interned,
     merge_estimates,
 )
+from .quota import (  # noqa: E402,F401
+    quota_admit,
+    quota_cluster_caps,
+)
 from . import masks  # noqa: E402,F401

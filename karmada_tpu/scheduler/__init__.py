@@ -1,6 +1,12 @@
 """Batched TPU scheduler (ref: pkg/scheduler)."""
 
 from .core import BindingProblem, ScheduleResult, TensorScheduler  # noqa: F401
+from .quota import (  # noqa: F401
+    QUOTA_EXCEEDED_ERROR,
+    QUOTA_EXCEEDED_REASON,
+    QuotaSnapshot,
+    build_quota_snapshot,
+)
 from .snapshot import (  # noqa: F401
     ClusterSnapshot,
     CompiledPlacement,

@@ -148,6 +148,7 @@ class BindingProblem:
     prev: dict[str, int] = dc_field(default_factory=dict)  # spec.clusters
     evict_clusters: tuple[str, ...] = ()  # graceful-eviction tasks
     fresh: bool = False  # reschedule triggered
+    namespace: str = ""  # quota-admission namespace ("" = not quota'd)
 
 
 @dataclass
@@ -254,6 +255,24 @@ class TensorScheduler:
         # request-profile bytes -> availability row [C] (per snapshot gen)
         self._sel_profile_rows: dict = {}
         self._sel_profile_gen = -1
+        # quota plane (scheduler.quota.QuotaSnapshot | None): admission
+        # runs as ONE batched kernel pass before the solve; static-
+        # assignment caps fold into availability as one more estimator.
+        # Disarmed = a single `is None` check per schedule() call.
+        self.quota = None
+        # (problem ids, quota generation, admitted sub-list, denied
+        # results) of the last wave with denials: keeps the admitted
+        # sub-list IDENTITY-stable across steady storm passes so the
+        # batch-identity fast paths below still fire under enforcement
+        self._quota_cache: Optional[tuple] = None
+        # device mirror of the static-assignment cap tensor, keyed by the
+        # quota snapshot's cap_token (caps change rarely; remaining often)
+        self._caps_dev = None
+        self._caps_dev_token = None
+        # engine-level trace ledger for the quota kernels (the fleet table
+        # ledgers the solve family; admission dispatches engine-side)
+        self._engine_traces: set = set()
+        self._engine_new_trace = False
 
     PLACEMENT_CACHE_CAP = 8192
     #: minimum eligible-batch size before the device-resident path engages
@@ -329,10 +348,248 @@ class TensorScheduler:
         trace signature the fleet table had not dispatched before (a compile
         ran, or — on the async tunnel — is still queued). Bench warmup loops
         poll this until a pass is compile-stable before opening a timed
-        window."""
+        window. Engine-dispatched quota kernels count too."""
         return bool(
-            self._fleet is not None and self._fleet.new_trace_last_pass
+            (self._fleet is not None and self._fleet.new_trace_last_pass)
+            or self._engine_new_trace
         )
+
+    def set_quota(self, quota) -> None:
+        """Swap in a (re)built QuotaSnapshot (None = enforcement off).
+
+        A changed ``cap_token`` (static-assignment content or cluster
+        columns moved) drops the fleet table: cap rows are baked into its
+        interned profile slots. A generation-only bump (remaining moved —
+        the common case: usage recompute, quota raise) keeps every packed
+        row and trace; only the admission partition recomputes — a denied
+        binding clears on a quota raise without a full re-pack."""
+        old = self.quota
+        self.quota = quota
+        # a quota with NO static assignments bakes nothing into the fleet
+        # profile slots — treat its cap token as absent so toggling
+        # enforcement (or FRQ churn without caps) never drops the table
+        new_tok = (
+            quota.cap_token
+            if quota is not None and quota.cap_index
+            else None
+        )
+        old_tok = (
+            old.cap_token if old is not None and old.cap_index else None
+        )
+        if new_tok != old_tok:
+            self._fleet = None
+            self._batch_ids = None
+            self._batch_cache = None
+            self._batch_problems = None
+            self._est_batch = None
+            self._quota_cache = None
+            self._caps_dev = None
+            self._caps_dev_token = None
+            # derived spread selections rank groups on cap-folded
+            # availability: cap content changes invalidate them
+            self._derived_rows.clear()
+
+    # -- quota admission ---------------------------------------------------
+
+    _ENGINE_TRACE_KERNELS = {"Q": "quota_admit", "K": "quota_cluster_caps"}
+
+    def _mark_trace(self, *key) -> bool:
+        """Engine-side trace ledger for the quota kernels — the fleet
+        table's contract (new-trace flag + compile counter + manifest
+        record eligibility), for kernels dispatched outside it."""
+        if key in self._engine_traces:
+            return False
+        self._engine_traces.add(key)
+        self._engine_new_trace = True
+        from ..utils.metrics import kernel_compiles
+
+        bucket = "x".join(
+            str(v) for v in key[1:] if isinstance(v, (int, bool))
+        )[:64]
+        kernel_compiles.inc(
+            kernel=self._ENGINE_TRACE_KERNELS.get(key[0], str(key[0])),
+            bucket=bucket,
+        )
+        return True
+
+    def _record_trace(self, kernel: str, key, arrays, **statics) -> None:
+        """Best-effort manifest record of a fresh engine-side trace (the
+        fleet table's semantics: durability is optional, the wave is
+        not)."""
+        manifest = self.trace_manifest
+        if manifest is None:
+            return
+        try:
+            manifest.record(kernel, key, arrays, statics)
+        except Exception as exc:  # noqa: BLE001 — never abort a wave
+            import logging
+
+            logging.getLogger("karmada_tpu").warning(
+                "trace manifest record of %s failed (%s)",
+                kernel, type(exc).__name__,
+            )
+
+    def _caps_device(self):
+        """Device mirror of the static-assignment cap tensor, rebuilt only
+        when the quota snapshot's cap content changes."""
+        q = self.quota
+        if self._caps_dev is None or self._caps_dev_token != q.cap_token:
+            self._caps_dev = jnp.asarray(q.cluster_caps)
+            self._caps_dev_token = q.cap_token
+        return self._caps_dev
+
+    def _quota_cap_rows(self, problems) -> Optional[np.ndarray]:
+        """int32[B] row into the cap tensor per binding (-1 = uncapped),
+        or None when no binding is in a capped namespace."""
+        q = self.quota
+        if q is None or not q.has_caps:
+            return None
+        cap_index = q.cap_index
+        rows = np.fromiter(
+            (cap_index.get(p.namespace, -1) for p in problems),
+            np.int32,
+            len(problems),
+        )
+        return rows if (rows >= 0).any() else None
+
+    def _quota_caps_np(self, cap_rows, requests) -> np.ndarray:
+        """Host mirror of the cap estimate (same kernel body as the
+        device form — cluster_caps_np instantiates it over numpy)."""
+        from ..ops.quota import cluster_caps_np
+
+        return cluster_caps_np(
+            self.quota.cluster_caps, cap_rows, requests
+        )
+
+    def _quota_caps_dev(self, cap_rows, requests) -> jnp.ndarray:
+        from ..ops.quota import quota_cluster_caps
+
+        caps_dev = self._caps_device()
+        key = (
+            "K", int(len(cap_rows)), tuple(int(s) for s in caps_dev.shape),
+        )
+        arrays = (
+            caps_dev,
+            jnp.asarray(cap_rows, jnp.int32),
+            jnp.asarray(requests, jnp.int64),
+        )
+        if self._mark_trace(*key):
+            self._record_trace("quota_cluster_caps", key, arrays)
+        return quota_cluster_caps(*arrays)
+
+    def _quota_admission(self, problems):
+        """One batched admission pass over the wave. Returns
+        ``(partition, pending_debit)``: partition is None when no binding
+        is quota'd or every row admitted, else (admitted sub-list, denied
+        results as (index, ScheduleResult) pairs) — identity-stable
+        across steady passes via _quota_cache so the batch-identity fast
+        paths keep firing under enforcement. ``pending_debit`` is the
+        wave's admitted demand per namespace, to be committed by the
+        caller AFTER the solve (None on cache replay — already
+        committed)."""
+        from ..ops.quota import quota_admit
+        from .quota import QUOTA_EXCEEDED_ERROR
+
+        q = self.quota
+        ns_index = q.ns_index
+        b = len(problems)
+        ns_ids = np.fromiter(
+            (ns_index.get(p.namespace, -1) for p in problems), np.int32, b
+        )
+        if not (ns_ids >= 0).any():
+            return None, None
+        cache = self._quota_cache
+        ids = np.fromiter(map(id, problems), np.int64, b)
+        if (
+            cache is not None
+            and cache[1] == q.generation
+            and len(cache[0]) == b
+            and np.array_equal(cache[0], ids)
+        ):
+            if cache[2] is None:  # cached all-admitted wave
+                return None, None
+            return (cache[2], cache[3]), None
+        demand = np.zeros((b, len(q.dims)), np.int64)
+        for i in np.flatnonzero(ns_ids >= 0):
+            p = problems[i]
+            delta = p.replicas - sum(p.prev.values())
+            if delta > 0:
+                demand[i] = q.demand_row(p.requests, delta)
+        # pow2 row padding bounds the admission kernel's trace count;
+        # pad rows are unquota'd zero-demand and always admit
+        b_pad = 1 << max(0, (b - 1).bit_length())
+        if b_pad > b:
+            ns_ids = np.pad(ns_ids, (0, b_pad - b), constant_values=-1)
+            demand = np.pad(demand, ((0, b_pad - b), (0, 0)))
+        n_pad = 1 << max(2, (q.remaining.shape[0] - 1).bit_length())
+        remaining = q.remaining
+        if n_pad > remaining.shape[0]:
+            from ..ops.quota import UNLIMITED
+
+            remaining = np.pad(
+                remaining,
+                ((0, n_pad - remaining.shape[0]), (0, 0)),
+                constant_values=UNLIMITED,
+            )
+        arrays = (
+            jnp.asarray(ns_ids),
+            jnp.asarray(demand),
+            jnp.asarray(remaining),
+        )
+        key = ("Q", b_pad, n_pad, int(remaining.shape[1]))
+        if self._mark_trace(*key):
+            self._record_trace("quota_admit", key, arrays)
+        admitted_dev, wave_used = quota_admit(*arrays)
+        admitted = np.asarray(admitted_dev)[:b]
+        # the wave's admitted demand is the PENDING debit against the
+        # working remaining: a drain spanning multiple engine passes
+        # within ONE quota generation (batch splits, follow-on waves
+        # before the usage controller recomputes) must not re-admit the
+        # same budget. The caller commits it AFTER the solve so a pass
+        # that dies mid-solve (worker bisect/retry) charges nothing; the
+        # next generation rebuilds remaining from recomputed usage, so
+        # debit and accounting never double-count.
+        wu = np.asarray(wave_used)[: q.remaining.shape[0]]
+        debit = wu if wu.any() else None
+        if admitted.all():
+            # cache the all-admitted outcome: a steady storm re-passing
+            # the same wave skips the demand rebuild and the kernel.
+            # The problems list is PINNED so a recycled id() cannot alias
+            # a stale partition (the _batch_problems hazard).
+            self._quota_cache = (
+                ids, q.generation, None, None, np.zeros(0, np.int64),
+                list(problems),
+            )
+            return None, debit
+        denied_idx = np.flatnonzero(~admitted)
+        denied = [
+            (
+                int(i),
+                ScheduleResult(
+                    key=problems[i].key, error=QUOTA_EXCEEDED_ERROR
+                ),
+            )
+            for i in denied_idx
+        ]
+        # identity stability: an unchanged partition re-uses the PREVIOUS
+        # admitted sub-list object, so the inner batch-identity paths see
+        # the very same list across steady storm passes
+        if (
+            cache is not None
+            and len(cache[4]) == len(denied_idx)
+            and np.array_equal(cache[4], denied_idx)
+            and len(cache[0]) == b
+            and np.array_equal(cache[0], ids)
+        ):
+            sub = cache[2]
+        else:
+            sub = [problems[i] for i in np.flatnonzero(admitted)]
+        # the full problems list is pinned (last element) so a recycled
+        # id() cannot alias a stale partition
+        self._quota_cache = (
+            ids, q.generation, sub, denied, denied_idx, list(problems)
+        )
+        return (sub, denied), debit
 
     @property
     def cap_shrink_pending(self) -> bool:
@@ -342,6 +599,68 @@ class TensorScheduler:
         return bool(self._fleet is not None and self._fleet.shrink_pending)
 
     def schedule(self, problems: Sequence[BindingProblem]) -> list[ScheduleResult]:
+        """Quota admission wrapper around the solve: when a QuotaSnapshot
+        is set and the wave touches quota'd namespaces, ONE batched
+        admission kernel partitions the wave; denied bindings answer a
+        QuotaExceeded result without being solved, admitted ones ride the
+        unchanged batched paths below. Disarmed quota costs one `is None`
+        check."""
+        self._engine_new_trace = False
+        q = self.quota
+        if q is not None and q.active:
+            part, debit = self._quota_admission(problems)
+            if part is not None:
+                sub, denied = part
+                try:
+                    sub_res = self._schedule_inner(sub)
+                except BaseException:
+                    # a failed solve charges nothing AND drops the armed
+                    # partition cache: the retry (same or rebuilt problem
+                    # objects) re-admits against the uncharged remaining
+                    self._quota_cache = None
+                    raise
+                # the wave's budget debit COMMITS only after the solve
+                # returned: a pass that dies mid-solve (poisoned key,
+                # backend error — the worker bisects and retries) must
+                # not leave its demand charged, or the retry re-admits
+                # against an already-debited remaining and spuriously
+                # denies bindings that fit
+                self._apply_quota_debit(debit)
+                results: list = [None] * len(problems)
+                for i, res in denied:
+                    results[i] = res
+                it = iter(sub_res)
+                for i in range(len(problems)):
+                    if results[i] is None:
+                        results[i] = next(it)
+                return results
+            try:
+                res = self._schedule_inner(problems)
+            except BaseException:
+                self._quota_cache = None
+                raise
+            self._apply_quota_debit(debit)
+            return res
+        return self._schedule_inner(problems)
+
+    def _apply_quota_debit(self, debit) -> None:
+        """Commit one admitted wave's demand against the working
+        remaining (see QuotaSnapshot: debit within a generation, rebuilt
+        from recomputed usage at the next). None = nothing to commit
+        (cache replay, or no quota'd rows)."""
+        if debit is None:
+            return
+        from ..ops.quota import UNLIMITED as _UNL
+
+        q = self.quota
+        limited = q.remaining < _UNL
+        q.remaining = np.where(
+            limited, np.maximum(q.remaining - debit, 0), q.remaining
+        )
+
+    def _schedule_inner(
+        self, problems: Sequence[BindingProblem]
+    ) -> list[ScheduleResult]:
         import time as _time
 
         # estimator-backed batch-identity fast path: extra estimators force
@@ -617,6 +936,15 @@ class TensorScheduler:
                 self._pack_chunk(sub_p, sub_c, 0)
             )
             avail = self._selection_availability(requests, replicas, gen)
+            # static-assignment caps bound the SELECTION's availability
+            # too: group selection must rank groups on the same
+            # cap-folded numbers the divide will see, or it can pick a
+            # group the capped divide cannot fill
+            cap_rows = self._quota_cap_rows(sub_p)
+            if cap_rows is not None:
+                avail = np.minimum(
+                    avail, self._quota_caps_np(cap_rows, requests)
+                ).astype(np.int32)
             candidates = select_clusters_batch(
                 snap, sub_p, sub_c, 0, feasible, avail, prev
             )
@@ -966,6 +1294,31 @@ class TensorScheduler:
             jnp.asarray(snap.has_summary)[None, :], general, jnp.int32(-1)
         )
 
+    def _profile_table_quota(
+        self, profiles_np: np.ndarray, prof_ns: np.ndarray
+    ) -> jnp.ndarray:
+        """``_profile_table`` with the static-assignment quota ceiling
+        folded per (profile, namespace) slot — the fleet table's interned
+        profiles carry a cap-namespace id beside the request vector, so
+        the device-resident path divides against cap-bounded availability
+        with NO kernel-signature change. The fold mirrors the host merge:
+        a constrained cell becomes a real estimator answer (min of the
+        general answer — or the untouched sentinel — and the cap), an
+        unconstrained cell passes through, including the -1 no-summary
+        convention this table uses."""
+        table = self._profile_table(profiles_np)
+        q = self.quota
+        prof_ns = np.asarray(prof_ns, np.int32)
+        if q is None or not q.has_caps or not (prof_ns >= 0).any():
+            return table
+        caps_out = self._quota_caps_dev(prof_ns, profiles_np)
+        mi = jnp.int32(2**31 - 1)
+        return jnp.where(
+            caps_out < mi,
+            jnp.minimum(jnp.where(table < 0, mi, table), caps_out),
+            table,
+        )
+
     def _models_active(self) -> bool:
         """Whether the resource-model estimator path would answer — THE
         predicate _profile_table activates the model estimation with; the
@@ -977,36 +1330,56 @@ class TensorScheduler:
         )
 
     def _availability_np(
-        self, requests: np.ndarray, replicas: np.ndarray
+        self,
+        requests: np.ndarray,
+        replicas: np.ndarray,
+        cap_rows: Optional[np.ndarray] = None,
     ) -> np.ndarray:
         """Host mirror of ``_availability`` for the tiny-batch fast path
         (general + resource-model estimators — callers gate off
         out-of-tree estimators only): the shared ``host_profile_table``
         plus merge_estimates' exact sentinel semantics (no-summary -> no
-        answer -> clamp to spec.Replicas; zero-replica short-circuit)."""
+        answer -> clamp to spec.Replicas; zero-replica short-circuit).
+        ``cap_rows`` folds the static-assignment quota caps as one more
+        estimator answer, mirroring the device path's merge order: min
+        over estimates FIRST, then the zero-replica override, then the
+        untouched-sentinel clamp."""
         mi = 2**31 - 1
         uniq, inv = np.unique(requests, axis=0, return_inverse=True)
         dense = host_profile_table(
             self.snapshot, uniq, models_active=self._models_active()
         )[inv]
+        if cap_rows is not None:
+            dense = np.minimum(
+                dense, self._quota_caps_np(cap_rows, requests)
+            )
         reps_col = replicas.astype(np.int64)[:, None]
         avail = np.where(reps_col == 0, mi, dense)
         avail = np.where(avail == mi, reps_col, avail)
         return np.minimum(avail, mi).astype(np.int32)
 
-    def _availability(self, requests: np.ndarray, replicas: np.ndarray) -> jnp.ndarray:
+    def _availability(
+        self,
+        requests: np.ndarray,
+        replicas: np.ndarray,
+        cap_rows: Optional[np.ndarray] = None,
+    ) -> jnp.ndarray:
         """calAvailableReplicas (core/util.go:54-104): min-merge over
         registered estimators, sentinel clamped to spec.Replicas.
 
         Request rows are interned host-side (np.unique): the general/model
         estimators run per unique profile ([U, C]) and per-binding rows are a
         gather — fleets carry few unique ReplicaRequirements, so this removes
-        the O(B x C x R) division hot loop."""
+        the O(B x C x R) division hot loop. ``cap_rows`` joins the merge as
+        one more estimator answer (the static-assignment quota ceiling,
+        MAX_INT32 = no constraint)."""
         profiles_np, prof_inv = np.unique(requests, axis=0, return_inverse=True)
         reps = jnp.asarray(replicas)
         general = self._profile_table(profiles_np)
         # profile -> binding gather ([U, C] -> [B, C])
         estimates = [general[jnp.asarray(prof_inv.astype(np.int32))]]
+        if cap_rows is not None:
+            estimates.append(self._quota_caps_dev(cap_rows, requests))
         for est in self.extra_estimators:
             # out-of-tree estimators see the full per-binding requests
             estimates.append(jnp.asarray(est(jnp.asarray(requests), reps)))
@@ -1054,11 +1427,14 @@ class TensorScheduler:
             padded * snap.num_clusters <= 1 << 16
             and not self.extra_estimators
         )
+        cap_rows = self._quota_cap_rows(problems)
+        if cap_rows is not None and padded > b:
+            cap_rows = np.pad(cap_rows, (0, padded - b), constant_values=-1)
         with algo_timer.time(schedule_step="Score"):
             avail = (
-                self._availability_np(requests, replicas)
+                self._availability_np(requests, replicas, cap_rows)
                 if host_small
-                else self._availability(requests, replicas)
+                else self._availability(requests, replicas, cap_rows)
             )
 
         # Select: spread-constraint group selection narrows the candidate set
@@ -1169,11 +1545,14 @@ class TensorScheduler:
             padded * snap.num_clusters <= 1 << 16
             and not self.extra_estimators
         )
+        cap_rows = self._quota_cap_rows(problems)
+        if cap_rows is not None and padded > b:
+            cap_rows = np.pad(cap_rows, (0, padded - b), constant_values=-1)
         with algo_timer.time(schedule_step="Score"):
             avail = (
-                self._availability_np(requests, replicas)
+                self._availability_np(requests, replicas, cap_rows)
                 if host_small
-                else self._availability(requests, replicas)
+                else self._availability(requests, replicas, cap_rows)
             )
 
         with algo_timer.time(schedule_step="Select"):

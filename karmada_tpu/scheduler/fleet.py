@@ -65,6 +65,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.divide import AGGREGATED, DUPLICATED as S_DUPLICATED, _divide_batch
 from ..ops.estimate import MAX_INT32, merge_estimates
+from ..ops.quota import (
+    quota_admit as _quota_admit,
+    quota_cluster_caps as _quota_cluster_caps,
+)
 
 log = logging.getLogger("karmada_tpu")
 
@@ -794,6 +798,11 @@ FLEET_KERNELS = {
     "fleet_pass": _fleet_pass,
     "fleet_entries": _fleet_entries,
     "fleet_bits": _fleet_bits,
+    # quota plane (ops.quota): dispatched engine-side (TensorScheduler's
+    # admission wrapper + cap fold), registered here so prewarm replay and
+    # the graftlint IR tier see them like every other solve-family kernel
+    "quota_admit": _quota_admit,
+    "quota_cluster_caps": _quota_cluster_caps,
 }
 
 
@@ -1029,6 +1038,13 @@ class FleetTable:
         self._gvk_list: list[str] = []
         self._prof_slot: dict[bytes, int] = {}
         self._profiles: list[np.ndarray] = []
+        # cap-namespace id per interned profile (-1 = uncapped): profiles
+        # of bindings in namespaces with static-assignment quotas intern
+        # per (request vector, cap ns) so the profile table row carries
+        # the cap-folded availability — the quota ceiling reaches the
+        # divide kernel with no kernel-signature change. Stable for the
+        # table's lifetime: the engine drops the table on cap changes.
+        self._prof_ns: list[int] = []
         # requests-tuple -> profile slot memo over _prof_slot: skips the
         # per-row dim-vector build (zeros + dim_index loop + tobytes) that
         # dominates bulk onboarding (a restart's first wave packs EVERY
@@ -1332,7 +1348,13 @@ class FleetTable:
         if self._req_slot_snap is not snap:
             self._req_slot = {}
             self._req_slot_snap = snap
-        rkey = (tuple(problem.requests.items()), problem.replicas > 0)
+        quota = getattr(self.engine, "quota", None)
+        qns = (
+            quota.cap_index.get(problem.namespace, -1)
+            if quota is not None and quota.cap_index
+            else -1
+        )
+        rkey = (tuple(problem.requests.items()), problem.replicas > 0, qns)
         pslot = self._req_slot.get(rkey)
         if pslot is None:
             vec = np.zeros(len(snap.dims), np.int64)
@@ -1343,12 +1365,13 @@ class FleetTable:
             pods = snap.dim_index("pods")
             if pods is not None and problem.replicas > 0:
                 vec[pods] = max(vec[pods], 1)
-            pkey = vec.tobytes()
+            pkey = vec.tobytes() + qns.to_bytes(4, "little", signed=True)
             pslot = self._prof_slot.get(pkey)
             if pslot is None:
                 pslot = len(self._profiles)
                 self._prof_slot[pkey] = pslot
                 self._profiles.append(vec)
+                self._prof_ns.append(qns)
                 self._tables_dirty = True
             self._req_slot[rkey] = pslot
         st["prof_idx"][row] = pslot
@@ -1612,10 +1635,16 @@ class FleetTable:
         # never gathered — prof_idx stays below the live count)
         pad_p = _pow2(max(len(profs), 4))
         profs_dev = profs
+        prof_ns = np.asarray(self._prof_ns, np.int32)
         if pad_p > len(profs):
             profs_dev = np.zeros((pad_p, profs.shape[1]), profs.dtype)
             profs_dev[: len(profs)] = profs
-        prof_table = self.engine._profile_table(profs_dev)
+            prof_ns = np.concatenate(
+                [prof_ns, np.full(pad_p - len(profs), -1, np.int32)]
+            )
+        # quota-aware table: cap-namespace profile slots get the static-
+        # assignment ceiling min-folded into their availability row
+        prof_table = self.engine._profile_table_quota(profs_dev, prof_ns)
         _mark("prof_table")
         # host mirror of the estimator max (general + models): the device
         # form is a blocking scalar fetch (~0.1s tunnel round-trip) and

@@ -71,6 +71,8 @@ _KERNELS = (
     "fleet_pass",
     "fleet_entries",
     "fleet_bits",
+    "quota_admit",
+    "quota_cluster_caps",
 )
 
 

@@ -157,6 +157,14 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "tracks the transitions).",
         ),
         EnvFlag(
+            "KARMADA_TPU_QUOTA_ENFORCEMENT", "1",
+            "FederatedResourceQuota admission in the scheduler "
+            "(controllers.scheduler_controller): set to 0 to disable the "
+            "quota plane entirely — no QuotaSnapshot is built and the "
+            "engine's admission hook stays a single `is None` check. "
+            "Member-side static-assignment Works still sync either way.",
+        ),
+        EnvFlag(
             "KARMADA_TPU_DRYRUN_REAL_DEVICES", "0",
             "Multichip dryrun escape hatch (__graft_entry__): set to 1 to "
             "run on the default backend's real devices instead of forcing "

@@ -127,6 +127,15 @@ class Gauge:
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
 
+    def remove_matching(self, **labels) -> None:
+        """Drop every sample whose label set CONTAINS these pairs — the
+        cleanup hook for gauges keyed by a deleted object (e.g. a removed
+        FederatedResourceQuota's per-resource limit/used samples)."""
+        match = set(labels.items())
+        with self._lock:
+            for key in [k for k in self._values if match <= set(k)]:
+                del self._values[key]
+
     def render(self) -> Iterable[str]:
         if self.help:
             yield _help_line(self.name, self.help)
@@ -362,6 +371,23 @@ degraded_passes = registry.counter(
     "in-proc fallback solve, estimator = at least one registered cluster "
     "answered UnauthenticReplica (such a pass never arms batch-identity "
     "replay)",
+)
+quota_denied = registry.counter(
+    "karmada_tpu_quota_denied_total",
+    "bindings newly denied admission by FederatedResourceQuota "
+    "enforcement, by namespace (incremented when the QuotaExceeded "
+    "condition lands on the binding; a denied binding retries on the "
+    "next quota generation, not every pass)",
+)
+quota_limit = registry.gauge(
+    "karmada_tpu_quota_limit",
+    "FederatedResourceQuota spec.overall limit by namespace and resource "
+    "(canonical integer units; set by the FRQ status controller)",
+)
+quota_used = registry.gauge(
+    "karmada_tpu_quota_used",
+    "FederatedResourceQuota status.overall_used by namespace and "
+    "resource, recomputed live from bound ResourceBindings",
 )
 
 

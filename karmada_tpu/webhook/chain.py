@@ -278,6 +278,23 @@ def validate_federated_resource_quota(frq) -> None:
             raise ValidationError(
                 f"static assignments for {res!r} exceed the overall quota"
             )
+    # quota-shrink guard (the reference validates spec updates against
+    # live usage): an update that CHANGES overall — spec.overall differs
+    # from the last-reconciled status.overall — must not drop any tracked
+    # resource below current status.overall_used. The status controller's
+    # own writes always carry status.overall == spec.overall (it syncs
+    # them in the same reconcile), so recording over-usage that predates a
+    # quota (bindings bound before the FRQ existed) is never blocked.
+    used = frq.status.overall_used or {}
+    for res, limit in frq.spec.overall.items():
+        if (
+            frq.status.overall.get(res) != limit
+            and used.get(res, 0) > limit
+        ):
+            raise ValidationError(
+                f"cannot shrink overall[{res!r}] to {limit} below current "
+                f"usage {used[res]}"
+            )
 
 
 def validate_resource_binding(rb) -> None:

@@ -668,3 +668,121 @@ class TestChannelResilience:
                 srv2.stop(0)
         finally:
             conn.close()
+
+
+class TestQuotaPluginWireParity:
+    """ISSUE 8 satellite: the batch matrix path must apply the
+    ResourceQuota plugin's namespace cap identically to the per-profile
+    unary path — for every (namespace, profile) the batch row's answer
+    over the wire equals the unary answer with the same namespace."""
+
+    def _quota_service(self):
+        from karmada_tpu.estimator.accurate import ResourceQuotaPlugin
+
+        caches = make_member_caches(["q"], cpu_step=64_000)
+        plugin = ResourceQuotaPlugin({
+            "teamA": {"cpu": 3_000},  # caps cpu-requesting profiles at 3/req
+            "teamB": {"cpu": 10_000},
+        })
+        return EstimatorService(
+            AccurateEstimator("q", caches["q"], quota_plugin=plugin)
+        )
+
+    def _parity(self, conn):
+        cpus = [1000, 500, 250]
+        rows = reqs_matrix(cpus).tolist()
+        for ns in ("teamA", "teamB", "unquotad", ""):
+            batch = conn.call(
+                "MaxAvailableReplicasBatch",
+                MaxAvailableReplicasBatchRequest(
+                    clusters=["q"], dims=list(DIMS), rows=rows,
+                    namespaces=[ns] * len(rows),
+                ),
+            )
+            got = list(batch.results[0].max_replicas)
+            want = [
+                conn.call(
+                    "MaxAvailableReplicas",
+                    MaxAvailableReplicasRequest(
+                        cluster="q",
+                        resource_request={
+                            d: int(v) for d, v in zip(DIMS, row) if v > 0
+                        },
+                        namespace=ns,
+                    ),
+                ).max_replicas
+                for row in rows
+            ]
+            assert got == want, (ns, got, want)
+        return True
+
+    def test_inproc_parity_and_cap_applied(self):
+        from karmada_tpu.estimator.service import EstimatorConnection
+        from karmada_tpu.utils.features import (
+            RESOURCE_QUOTA_ESTIMATE,
+            feature_gate,
+        )
+
+        svc = self._quota_service()
+        conn = EstimatorConnection("q", svc)
+        feature_gate.set(RESOURCE_QUOTA_ESTIMATE, True)
+        try:
+            assert self._parity(conn)
+            # and the cap actually bites: 1000m profile in teamA fits 3
+            resp = conn.call(
+                "MaxAvailableReplicasBatch",
+                MaxAvailableReplicasBatchRequest(
+                    clusters=["q"], dims=list(DIMS),
+                    rows=reqs_matrix([1000]).tolist(),
+                    namespaces=["teamA"],
+                ),
+            )
+            assert list(resp.results[0].max_replicas) == [3]
+        finally:
+            feature_gate.set(RESOURCE_QUOTA_ESTIMATE, False)
+
+    def test_grpc_wire_parity_and_namespace_roundtrip(self):
+        from karmada_tpu.utils.features import (
+            RESOURCE_QUOTA_ESTIMATE,
+            feature_gate,
+        )
+
+        svc = self._quota_service()
+        srv = EstimatorGrpcServer(
+            MultiClusterEstimatorService({"q": svc})
+        )
+        port = srv.start()
+        conn = GrpcEstimatorConnection(
+            "q", f"127.0.0.1:{port}", timeout_seconds=5.0
+        )
+        feature_gate.set(RESOURCE_QUOTA_ESTIMATE, True)
+        try:
+            assert self._parity(conn)
+        finally:
+            feature_gate.set(RESOURCE_QUOTA_ESTIMATE, False)
+            conn.close()
+            srv.stop()
+
+    def test_namespace_free_batch_unchanged(self):
+        """Old clients (no namespaces field) keep the pre-quota answers
+        even with a plugin registered and the feature on."""
+        from karmada_tpu.estimator.service import EstimatorConnection
+        from karmada_tpu.utils.features import (
+            RESOURCE_QUOTA_ESTIMATE,
+            feature_gate,
+        )
+
+        svc = self._quota_service()
+        conn = EstimatorConnection("q", svc)
+        feature_gate.set(RESOURCE_QUOTA_ESTIMATE, True)
+        try:
+            resp = conn.call(
+                "MaxAvailableReplicasBatch",
+                MaxAvailableReplicasBatchRequest(
+                    clusters=["q"], dims=list(DIMS),
+                    rows=reqs_matrix([1000]).tolist(),
+                ),
+            )
+            assert list(resp.results[0].max_replicas) == [64]  # node fit
+        finally:
+            feature_gate.set(RESOURCE_QUOTA_ESTIMATE, False)

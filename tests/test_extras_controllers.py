@@ -294,7 +294,7 @@ class TestWorkloadRebalancer:
 
 
 class TestFederatedResourceQuota:
-    def test_static_assignments_propagate_and_aggregate(self):
+    def test_static_assignments_propagate_and_live_accounting(self):
         cp = make_plane(2)
         cp.store.apply(
             FederatedResourceQuota(
@@ -313,157 +313,21 @@ class TestFederatedResourceQuota:
         cp.settle()
         q1 = cp.members.get("member1").get("v1/ResourceQuota", "default", "quota")
         assert q1 is not None and q1.spec["hard"]["cpu"] == 6000
-        # member reports usage
-        cp.members.get("member1").set_workload_status(
-            "v1/ResourceQuota", "default", "quota", {"used": {"cpu": 2500}}
-        )
-        cp.members.get("member2").set_workload_status(
-            "v1/ResourceQuota", "default", "quota", {"used": {"cpu": 1000}}
-        )
-        # quota status aggregation runs on the frq worker; poke it
-        frq = cp.store.get("FederatedResourceQuota", "default/quota")
-        cp.frq_controller.worker.enqueue("default/quota")
-        cp.settle()
-        frq = cp.store.get("FederatedResourceQuota", "default/quota")
-        assert frq.status.overall_used == {"cpu": 3500}
-        assert frq.status.overall == {"cpu": 10_000}
-
-
-class TestClusterScopedBindings:
-    def test_cluster_role_propagates_via_crb(self):
-        from karmada_tpu.api.policy import ClusterPropagationPolicy
-
-        cp = make_plane(2)
-        role = Resource(
-            api_version="rbac.authorization.k8s.io/v1",
-            kind="ClusterRole",
-            meta=ObjectMeta(name="viewer"),
-            spec={"rules": [{"apiGroups": [""], "resources": ["pods"],
-                             "verbs": ["get", "list"]}]},
-        )
-        for m in cp.members.names():
-            cp.members.get(m).api_enablements.append(
-                "rbac.authorization.k8s.io/v1/ClusterRole"
-            )
-        # refresh cluster status with new enablements
-        cp.settle()
-        cp.store.apply(role)
-        cp.store.apply(
-            ClusterPropagationPolicy(
-                meta=ObjectMeta(name="roles"),
-                spec=PropagationSpec(
-                    resource_selectors=[
-                        ResourceSelector(
-                            api_version="rbac.authorization.k8s.io/v1",
-                            kind="ClusterRole",
-                        )
-                    ],
-                    placement=duplicated_placement(),
-                ),
-            )
-        )
-        cp.settle()
-        crb = cp.store.get("ClusterResourceBinding", "viewer-clusterrole")
-        assert crb is not None
-        for m in ("member1", "member2"):
-            assert (
-                cp.members.get(m).get(
-                    "rbac.authorization.k8s.io/v1/ClusterRole", "", "viewer"
-                )
-                is not None
-            )
-
-    def test_fresh_uses_plane_clock(self):
-        """Regression: last_scheduled_time must come from the plane clock.
-        With wall time leaking in, a fake-clock rescheduleTriggeredAt could
-        never exceed it and Fresh silently degraded to a steady no-op."""
-        clock = [7000.0]
-        cp = ControlPlane(clock=lambda: clock[0])
-        cp.join_cluster(new_cluster("small", cpu="4", memory="200Gi"))
-        cp.store.apply(new_deployment("app", replicas=4, cpu="1"))
+        # overall_used is recomputed LIVE from bound ResourceBindings
+        # (the reference's FRQ status controller), not member-reported
+        # quota statuses: a scheduled workload's assigned replicas x
+        # per-replica request lands in status in the same settle wave
         cp.store.apply(nginx_policy(dynamic_weight_placement()))
-        cp.settle()
-        rb = cp.store.get("ResourceBinding", "default/app-deployment")
-        assert {tc.name for tc in rb.spec.clusters} == {"small"}
-
-        # a much larger cluster joins; Steady mode keeps placements...
-        cp.join_cluster(new_cluster("big", cpu="400", memory="800Gi"))
-        clock[0] += 10
-        cp.settle()
-        rb = cp.store.get("ResourceBinding", "default/app-deployment")
-        assert {tc.name for tc in rb.spec.clusters} == {"small"}
-
-        # ...until a rebalancer triggers Fresh, which must actually fire
-        # (fake trigger time > fake last_scheduled_time) and redistribute
-        cp.store.apply(WorkloadRebalancer(
-            meta=ObjectMeta(name="go-fresh"),
-            spec=WorkloadRebalancerSpec(workloads=[
-                ObjectReferenceSelector(kind="Deployment", name="app")]),
-        ))
-        clock[0] += 10
-        cp.settle()
-        rb = cp.store.get("ResourceBinding", "default/app-deployment")
-        assert "big" in {tc.name for tc in rb.spec.clusters}
-
-    def test_ttl_restarts_from_latest_finish(self):
-        """A spec update re-processes the rebalancer; finish_time restamps
-        at the new completion, so the TTL measures from the LATEST finish
-        (and the defensive reset keeps a hypothetical pending state alive —
-        our in-proc results are always terminal, reference: Successful or
-        Failed)."""
-        clock = [5000.0]
-        cp = ControlPlane(clock=lambda: clock[0])
-        cp.join_cluster(new_cluster("member1", cpu="100", memory="200Gi"))
-        cp.store.apply(new_deployment("app", replicas=2))
-        cp.store.apply(nginx_policy(dynamic_weight_placement()))
-        cp.settle()
-        cp.store.apply(WorkloadRebalancer(
-            meta=ObjectMeta(name="rb-grow"),
-            spec=WorkloadRebalancerSpec(
-                workloads=[ObjectReferenceSelector(kind="Deployment",
-                                                   name="app")],
-                ttl_seconds_after_finished=60,
-            ),
-        ))
-        cp.settle()
-        first_finish = cp.store.get("WorkloadRebalancer", "rb-grow").status.finish_time
-        assert first_finish == clock[0]
-
-
-class TestFederatedResourceQuota:
-    def test_static_assignments_propagate_and_aggregate(self):
-        cp = make_plane(2)
-        cp.store.apply(
-            FederatedResourceQuota(
-                meta=ObjectMeta(name="quota", namespace="default"),
-                spec=FederatedResourceQuotaSpec(
-                    overall={"cpu": 10_000},
-                    static_assignments=[
-                        StaticClusterAssignment(cluster_name="member1",
-                                                hard={"cpu": 6000}),
-                        StaticClusterAssignment(cluster_name="member2",
-                                                hard={"cpu": 4000}),
-                    ],
-                ),
-            )
-        )
-        cp.settle()
-        q1 = cp.members.get("member1").get("v1/ResourceQuota", "default", "quota")
-        assert q1 is not None and q1.spec["hard"]["cpu"] == 6000
-        # member reports usage
-        cp.members.get("member1").set_workload_status(
-            "v1/ResourceQuota", "default", "quota", {"used": {"cpu": 2500}}
-        )
-        cp.members.get("member2").set_workload_status(
-            "v1/ResourceQuota", "default", "quota", {"used": {"cpu": 1000}}
-        )
-        # quota status aggregation runs on the frq worker; poke it
-        frq = cp.store.get("FederatedResourceQuota", "default/quota")
-        cp.frq_controller.worker.enqueue("default/quota")
+        cp.store.apply(new_deployment("quotad", replicas=3, cpu="500m"))
         cp.settle()
         frq = cp.store.get("FederatedResourceQuota", "default/quota")
-        assert frq.status.overall_used == {"cpu": 3500}
+        assert frq.status.overall_used == {"cpu": 1500}
         assert frq.status.overall == {"cpu": 10_000}
+        # scale down -> usage follows in the next wave
+        cp.store.apply(new_deployment("quotad", replicas=1, cpu="500m"))
+        cp.settle()
+        frq = cp.store.get("FederatedResourceQuota", "default/quota")
+        assert frq.status.overall_used == {"cpu": 500}
 
 
 class TestClusterScopedBindings:
@@ -577,3 +441,29 @@ class TestClusterScopedBindings:
             clock[0] += 100
             cp.settle()
             assert cp.store.get("WorkloadRebalancer", "rb-grow") is not None
+
+    def test_ttl_restarts_from_latest_finish(self):
+        """A spec update re-processes the rebalancer; finish_time restamps
+        at the new completion, so the TTL measures from the LATEST finish
+        (and the defensive reset keeps a hypothetical pending state alive —
+        our in-proc results are always terminal, reference: Successful or
+        Failed)."""
+        clock = [5000.0]
+        cp = ControlPlane(clock=lambda: clock[0])
+        cp.join_cluster(new_cluster("member1", cpu="100", memory="200Gi"))
+        cp.store.apply(new_deployment("app", replicas=2))
+        cp.store.apply(nginx_policy(dynamic_weight_placement()))
+        cp.settle()
+        cp.store.apply(WorkloadRebalancer(
+            meta=ObjectMeta(name="rb-grow"),
+            spec=WorkloadRebalancerSpec(
+                workloads=[ObjectReferenceSelector(kind="Deployment",
+                                                   name="app")],
+                ttl_seconds_after_finished=60,
+            ),
+        ))
+        cp.settle()
+        first_finish = cp.store.get("WorkloadRebalancer", "rb-grow").status.finish_time
+        assert first_finish == clock[0]
+
+

@@ -1,0 +1,176 @@
+"""Quota kernels (ops.quota) vs the sequential numpy oracle
+(refimpl.quota_np): admission decisions and cap tensors must be identical
+for any inputs — the kernel is one sort + segment cumsum, the oracle a
+per-binding Python loop sharing no code with it."""
+
+import numpy as np
+import pytest
+
+from karmada_tpu.ops.quota import (
+    DEMAND_CLAMP,
+    MAX_INT32,
+    UNLIMITED,
+    cluster_caps_np,
+    quota_admit,
+    quota_cluster_caps,
+)
+from karmada_tpu.refimpl.quota_np import (
+    admit_wave_np,
+    cluster_caps_seq,
+)
+
+
+class TestQuotaAdmit:
+    def test_fifo_head_of_line(self):
+        """First-come wins inside a wave: a denied binding's demand holds
+        its place in line, so a later smaller request cannot leapfrog."""
+        ns = np.zeros(3, np.int32)
+        demand = np.array([[6], [6], [3]], np.int64)
+        remaining = np.array([[10]], np.int64)
+        admitted, used = quota_admit(ns, demand, remaining)
+        assert np.asarray(admitted).tolist() == [True, False, False]
+        assert np.asarray(used).tolist() == [[6]]
+
+    def test_unquotad_rows_always_admit(self):
+        ns = np.array([-1, 0, -1], np.int32)
+        demand = np.array([[100], [100], [100]], np.int64)
+        remaining = np.array([[0]], np.int64)
+        admitted, used = quota_admit(ns, demand, remaining)
+        assert np.asarray(admitted).tolist() == [True, False, True]
+        assert np.asarray(used).tolist() == [[0]]
+
+    def test_unlimited_dim_never_constrains(self):
+        ns = np.zeros(2, np.int32)
+        demand = np.array([[5, 10**9], [5, 10**9]], np.int64)
+        remaining = np.array([[10, UNLIMITED]], np.int64)
+        admitted, _ = quota_admit(ns, demand, remaining)
+        assert np.asarray(admitted).tolist() == [True, True]
+
+    def test_multi_dim_all_must_fit(self):
+        ns = np.zeros(2, np.int32)
+        demand = np.array([[5, 5], [5, 5]], np.int64)
+        remaining = np.array([[100, 7]], np.int64)  # dim 1 blocks row 2
+        admitted, _ = quota_admit(ns, demand, remaining)
+        assert np.asarray(admitted).tolist() == [True, False]
+
+    def test_interleaved_namespaces_keep_arrival_order(self):
+        """Namespace grouping is a STABLE sort: within each namespace the
+        cumsum runs in arrival order even when rows interleave."""
+        ns = np.array([0, 1, 0, 1, 0], np.int32)
+        demand = np.array([[4], [9], [4], [9], [4]], np.int64)
+        remaining = np.array([[9], [18]], np.int64)
+        admitted, used = quota_admit(ns, demand, remaining)
+        # ns0: 4, 8 ok; 12 > 9 denied. ns1: 9, 18 both ok.
+        assert np.asarray(admitted).tolist() == [True, True, True, True, False]
+        assert np.asarray(used).tolist() == [[8], [18]]
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_randomized_oracle_identity(self, seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(10):
+            b = int(rng.integers(1, 130))
+            n = int(rng.integers(1, 9))
+            r = int(rng.integers(1, 5))
+            ns = rng.integers(-1, n, b).astype(np.int32)
+            demand = rng.integers(0, 25, (b, r)).astype(np.int64)
+            demand[ns < 0] = 0
+            remaining = rng.integers(0, 80, (n, r)).astype(np.int64)
+            remaining[rng.random((n, r)) < 0.25] = UNLIMITED
+            a_dev, u_dev = quota_admit(ns, demand, remaining)
+            a_np, u_np = admit_wave_np(ns.tolist(), demand, remaining)
+            assert np.asarray(a_dev).tolist() == a_np
+            assert np.array_equal(np.asarray(u_dev), u_np)
+
+    def test_demand_clamp_headroom(self):
+        """A wave of clamp-sized demands must not overflow the cumsum."""
+        b = 64
+        ns = np.zeros(b, np.int32)
+        demand = np.full((b, 1), DEMAND_CLAMP, np.int64)
+        remaining = np.array([[UNLIMITED]], np.int64)
+        admitted, used = quota_admit(ns, demand, remaining)
+        assert np.asarray(admitted).all()
+        assert int(np.asarray(used)[0, 0]) == b * DEMAND_CLAMP
+
+
+class TestClusterCaps:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_device_numpy_sequential_identity(self, seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(10):
+            ncap = int(rng.integers(1, 5))
+            c = int(rng.integers(1, 12))
+            r = int(rng.integers(1, 5))
+            b = int(rng.integers(1, 24))
+            caps = rng.integers(0, 200, (ncap, c, r)).astype(np.int64)
+            caps[rng.random((ncap, c, r)) < 0.3] = UNLIMITED
+            rows = rng.integers(-1, ncap, b).astype(np.int32)
+            reqs = rng.integers(0, 12, (b, r)).astype(np.int64)
+            dev = np.asarray(quota_cluster_caps(caps, rows, reqs))
+            mirror = cluster_caps_np(caps, rows, reqs)
+            assert np.array_equal(dev, mirror)
+            for i in range(b):
+                assert np.array_equal(
+                    dev[i], cluster_caps_seq(caps, int(rows[i]), reqs[i])
+                )
+
+    def test_uncapped_rows_answer_no_constraint(self):
+        caps = np.full((1, 3, 2), 10, np.int64)
+        out = np.asarray(quota_cluster_caps(
+            caps, np.array([-1], np.int32), np.array([[5, 5]], np.int64)
+        ))
+        assert (out == MAX_INT32).all()
+
+    def test_unlimited_cell_with_huge_request(self):
+        """An UNLIMITED cap must never constrain, even when the request is
+        large enough that UNLIMITED // request would fall below
+        MAX_INT32."""
+        caps = np.full((1, 1, 1), UNLIMITED, np.int64)
+        req = np.array([[2**40]], np.int64)
+        out = np.asarray(quota_cluster_caps(
+            caps, np.array([0], np.int32), req
+        ))
+        assert out[0, 0] == MAX_INT32
+
+    def test_min_over_requested_dims(self):
+        caps = np.array([[[12, 9]]], np.int64)  # one cluster, dims 12 / 9
+        req = np.array([[4, 3]], np.int64)  # fits 3 by either dim
+        out = np.asarray(quota_cluster_caps(
+            caps, np.array([0], np.int32), req
+        ))
+        assert out[0, 0] == 3
+        # zero-request dim is ignored
+        req2 = np.array([[4, 0]], np.int64)
+        out2 = np.asarray(quota_cluster_caps(
+            caps, np.array([0], np.int32), req2
+        ))
+        assert out2[0, 0] == 3  # 12 // 4
+
+
+class TestOverflowHardening:
+    def test_demand_row_scale_cannot_wrap(self):
+        """An absurd-but-legal request x a huge replica delta must clamp,
+        never wrap int64 to zero/negative demand (which would bypass
+        admission and INCREASE remaining on debit)."""
+        from karmada_tpu.scheduler.quota import QuotaSnapshot
+
+        q = QuotaSnapshot(
+            dims=["cpu", "memory"], ns_index={"a": 0},
+            remaining=np.zeros((1, 2), np.int64),
+            cap_index={}, cluster_caps=np.zeros((0, 1, 2), np.int64),
+            generation=1, cap_token=0,
+        )
+        row = q.demand_row({"memory": 2**43}, 2**21)  # would wrap to 0
+        assert row.tolist() == [0, DEMAND_CLAMP]
+        row2 = q.demand_row({"memory": 2**43}, 2**21 - 1)  # would wrap < 0
+        assert (row2 >= 0).all() and row2[1] == DEMAND_CLAMP
+
+    def test_admit_rejects_over_bound_waves(self):
+        from karmada_tpu.ops.quota import MAX_ADMIT_ROWS
+
+        b = MAX_ADMIT_ROWS * 2
+        with pytest.raises(AssertionError):
+            quota_admit(
+                np.zeros(b, np.int32),
+                np.zeros((b, 1), np.int64),
+                np.zeros((1, 1), np.int64),
+            )

@@ -1,0 +1,856 @@
+"""Quota enforcement plane (ISSUE 8): FederatedResourceQuota as tensor
+constraints in the Assign path, live usage accounting, denial conditions,
+and the quota-capped HPA-surge scenario."""
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api import PropagationPolicy, PropagationSpec, ResourceSelector
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.api.policy import (
+    FederatedResourceQuota,
+    FederatedResourceQuotaSpec,
+    FederatedResourceQuotaStatus,
+    StaticClusterAssignment,
+)
+from karmada_tpu.api.work import SCHEDULED
+from karmada_tpu.controlplane import ControlPlane
+from karmada_tpu.scheduler import (
+    QUOTA_EXCEEDED_ERROR,
+    BindingProblem,
+    ClusterSnapshot,
+    TensorScheduler,
+    build_quota_snapshot,
+)
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    new_cluster,
+    new_deployment,
+)
+from karmada_tpu.utils.quantity import parse_resource_list
+from karmada_tpu.webhook.chain import (
+    ValidationError,
+    validate_federated_resource_quota,
+)
+
+CPU_REQ = parse_resource_list({"cpu": "1"})
+
+
+def frq(ns, overall, static=(), used=None):
+    q = FederatedResourceQuota(
+        meta=ObjectMeta(name="q", namespace=ns),
+        spec=FederatedResourceQuotaSpec(
+            overall=dict(overall), static_assignments=list(static)
+        ),
+    )
+    if used is not None:
+        q.status = FederatedResourceQuotaStatus(
+            overall=dict(overall), overall_used=dict(used)
+        )
+    return q
+
+
+def problem(key, ns, replicas, prev=None):
+    return BindingProblem(
+        key=key, placement=dynamic_weight_placement(), replicas=replicas,
+        requests=CPU_REQ, gvk="apps/v1/Deployment",
+        prev=dict(prev or {}), namespace=ns,
+    )
+
+
+class TestEngineAdmission:
+    def setup_method(self):
+        self.snap = ClusterSnapshot(
+            [new_cluster(f"m{i}", cpu="1000", memory="2000Gi") for i in range(4)]
+        )
+
+    def test_fifo_denial_and_unquotad_passthrough(self):
+        eng = TensorScheduler(self.snap, chunk_size=1024)
+        eng.set_quota(build_quota_snapshot(
+            [frq("a", {"cpu": 5000})], self.snap, generation=1
+        ))
+        ps = [problem(f"a/b{i}", "a", 2) for i in range(4)] + [
+            problem("z/b0", "z", 2)
+        ]
+        res = eng.schedule(ps)
+        assert [r.error for r in res] == [
+            "", "", QUOTA_EXCEEDED_ERROR, QUOTA_EXCEEDED_ERROR, "",
+        ]
+        assert sum(res[0].clusters.values()) == 2
+
+    def test_delta_demand_admits_steady_reschedule(self):
+        """A binding already holding its replicas has zero delta demand:
+        re-scheduling the same wave against a fully-used quota must not
+        deny it (usage is recomputed from bound state, not double-charged
+        per pass)."""
+        eng = TensorScheduler(self.snap, chunk_size=1024)
+        # remaining 0: used == limit
+        eng.set_quota(build_quota_snapshot(
+            [frq("a", {"cpu": 4000}, used={"cpu": 4000})],
+            self.snap, generation=1,
+        ))
+        held = problem("a/held", "a", 2, prev={"m0": 1, "m1": 1})
+        fresh_new = problem("a/new", "a", 2)
+        res = eng.schedule([held, fresh_new])
+        assert res[0].error == ""  # delta 0: admitted
+        assert res[1].error == QUOTA_EXCEEDED_ERROR  # delta 2 cpu: denied
+
+    def test_denied_partition_replays_until_generation_bump(self):
+        eng = TensorScheduler(self.snap, chunk_size=1024)
+        eng.set_quota(build_quota_snapshot(
+            [frq("a", {"cpu": 3000})], self.snap, generation=1
+        ))
+        ps = [problem(f"a/b{i}", "a", 2) for i in range(3)]
+        res1 = eng.schedule(ps)
+        assert [bool(r.success) for r in res1] == [True, False, False]
+        # same wave, same generation: the quota cache replays the
+        # partition (and the admitted sub-list identity is stable)
+        res2 = eng.schedule(ps)
+        assert [r.error for r in res2] == [r.error for r in res1]
+        # generation bump with a raised quota re-admits
+        eng.set_quota(build_quota_snapshot(
+            [frq("a", {"cpu": 60000})], self.snap, generation=2
+        ))
+        res3 = eng.schedule(ps)
+        assert all(r.success for r in res3)
+
+    def test_static_caps_bound_placement_host_and_fleet(self):
+        """The static-assignment cap tensor bounds per-cluster replicas
+        identically on the host-small path and the device-resident fleet
+        path (cap rows fold into interned profile slots)."""
+        q = build_quota_snapshot(
+            [frq("c", {"cpu": 10_000_000},
+                 static=[StaticClusterAssignment(
+                     cluster_name="m0", hard={"cpu": 3000})])],
+            self.snap, generation=1,
+        )
+        fleet_eng = TensorScheduler(self.snap, chunk_size=1024)
+        fleet_eng.set_quota(q)
+        many = [problem(f"c/f{i}", "c", 8) for i in range(300)]
+        rf = fleet_eng.schedule(many)
+        assert fleet_eng._fleet is not None  # fleet path engaged
+        assert all(r.success for r in rf)
+        assert all(r.clusters.get("m0", 0) <= 3 for r in rf)
+        host_eng = TensorScheduler(self.snap, chunk_size=1024)
+        host_eng.set_quota(q)
+        for i in (0, 7, 150, 299):
+            r1 = host_eng.schedule([problem(f"c/f{i}", "c", 8)])[0]
+            assert r1.clusters == rf[i].clusters
+
+    def test_cap_change_drops_fleet_but_generation_bump_does_not(self):
+        eng = TensorScheduler(self.snap, chunk_size=1024)
+        eng.set_quota(build_quota_snapshot(
+            [frq("c", {"cpu": 10_000_000})], self.snap, generation=1
+        ))
+        many = [problem(f"c/f{i}", "c", 4) for i in range(300)]
+        eng.schedule(many)
+        fleet = eng._fleet
+        assert fleet is not None
+        # generation-only bump (remaining moved): the table survives
+        eng.set_quota(build_quota_snapshot(
+            [frq("c", {"cpu": 9_000_000})], self.snap, generation=2
+        ))
+        assert eng._fleet is fleet
+        # disarming a CAP-FREE quota bakes nothing into the profile
+        # slots: the table survives the toggle both ways
+        eng.set_quota(None)
+        assert eng._fleet is fleet
+        eng.set_quota(build_quota_snapshot(
+            [frq("c", {"cpu": 9_000_000})], self.snap, generation=2
+        ))
+        assert eng._fleet is fleet
+        # cap content change: profile slots embed cap rows — rebuild
+        eng.set_quota(build_quota_snapshot(
+            [frq("c", {"cpu": 10_000_000},
+                 static=[StaticClusterAssignment(
+                     cluster_name="m1", hard={"cpu": 1000})])],
+            self.snap, generation=3,
+        ))
+        assert eng._fleet is None
+
+
+def quota_plane(n_clusters=4, overall=None):
+    cp = ControlPlane()
+    for i in range(n_clusters):
+        cp.join_cluster(
+            new_cluster(f"m{i}", cpu="1000", memory="2000Gi", pods=10000)
+        )
+    cp.settle()
+    cp.store.apply(PropagationPolicy(
+        meta=ObjectMeta(name="pol", namespace="teamA"),
+        spec=PropagationSpec(
+            resource_selectors=[
+                ResourceSelector(api_version="apps/v1", kind="Deployment")
+            ],
+            placement=dynamic_weight_placement(),
+        ),
+    ))
+    if overall is not None:
+        cp.store.apply(FederatedResourceQuota(
+            meta=ObjectMeta(name="q", namespace="teamA"),
+            spec=FederatedResourceQuotaSpec(overall=dict(overall)),
+        ))
+    return cp
+
+
+def scheduled_condition(cp, key):
+    rb = cp.store.get("ResourceBinding", key)
+    return next(c for c in rb.status.conditions if c.type == SCHEDULED)
+
+
+class TestQuotaPlane:
+    def test_denial_condition_usage_accounting_and_raise(self):
+        cp = quota_plane(overall={"cpu": 5000})
+        for i in range(4):
+            cp.store.apply(
+                new_deployment(f"w{i}", namespace="teamA", replicas=2, cpu="1")
+            )
+        cp.settle()
+        conds = [
+            scheduled_condition(cp, f"teamA/w{i}-deployment") for i in range(4)
+        ]
+        assert [c.status for c in conds] == [True, True, False, False]
+        assert conds[2].reason == "QuotaExceeded"
+        # live accounting from bound ResourceBindings only
+        q = cp.store.get("FederatedResourceQuota", "teamA/q")
+        assert q.status.overall_used == {"cpu": 4000}
+        from karmada_tpu.utils.metrics import quota_denied, quota_used
+
+        assert quota_denied.value(namespace="teamA") >= 2
+        assert quota_used.value(namespace="teamA", resource="cpu") == 4000
+        # raising the quota clears the denials WITHOUT re-packing the
+        # admitted fleet: only the denied bindings re-solve
+        solves0 = cp.scheduler._engine.solve_batches
+        q.spec.overall = {"cpu": 20000}
+        cp.store.apply(q)
+        cp.settle()
+        for i in range(4):
+            assert scheduled_condition(
+                cp, f"teamA/w{i}-deployment"
+            ).status, i
+        assert cp.scheduler._engine.solve_batches - solves0 <= 2
+        assert cp.scheduler._quota_denied == {}
+        q = cp.store.get("FederatedResourceQuota", "teamA/q")
+        assert q.status.overall_used == {"cpu": 8000}
+
+    def test_denied_binding_skips_requeue_until_generation(self):
+        """A denied binding parks: re-enqueuing it within the same quota
+        generation never reaches the engine (no per-pass retry storm)."""
+        cp = quota_plane(overall={"cpu": 1000})
+        cp.store.apply(
+            new_deployment("big", namespace="teamA", replicas=8, cpu="1")
+        )
+        cp.settle()
+        assert (
+            scheduled_condition(cp, "teamA/big-deployment").reason
+            == "QuotaExceeded"
+        )
+        solves0 = cp.scheduler._engine.solve_batches
+        cp.scheduler.worker.enqueue(
+            ("ResourceBinding", "teamA/big-deployment")
+        )
+        cp.settle()
+        assert cp.scheduler._engine.solve_batches == solves0
+
+    def test_enforcement_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("KARMADA_TPU_QUOTA_ENFORCEMENT", "0")
+        cp = quota_plane(overall={"cpu": 1000})
+        cp.store.apply(
+            new_deployment("big", namespace="teamA", replicas=8, cpu="1")
+        )
+        cp.settle()
+        assert scheduled_condition(cp, "teamA/big-deployment").status
+
+    def test_usage_counts_pods_implicitly(self):
+        cp = quota_plane(overall={"pods": 100})
+        cp.store.apply(
+            new_deployment("w", namespace="teamA", replicas=3, cpu="1")
+        )
+        cp.settle()
+        q = cp.store.get("FederatedResourceQuota", "teamA/q")
+        assert q.status.overall_used == {"pods": 3}
+
+
+class TestQuotaShrinkValidation:
+    def test_shrink_below_usage_rejected(self):
+        q = frq("a", {"cpu": 1000}, used={"cpu": 4000})
+        q.status.overall = {"cpu": 8000}  # last-reconciled spec differs
+        with pytest.raises(ValidationError, match="cannot shrink"):
+            validate_federated_resource_quota(q)
+
+    def test_shrink_above_usage_allowed(self):
+        q = frq("a", {"cpu": 5000}, used={"cpu": 4000})
+        q.status.overall = {"cpu": 8000}
+        validate_federated_resource_quota(q)
+
+    def test_status_controller_write_with_over_usage_allowed(self):
+        """The status controller records over-usage (bindings bound before
+        the FRQ existed) with status.overall synced to spec.overall — that
+        write must pass: only a CHANGED overall is a shrink."""
+        q = frq("a", {"cpu": 1000}, used={"cpu": 4000})  # status.overall
+        # synced by the controller in the same reconcile
+        validate_federated_resource_quota(q)
+
+    def test_fresh_create_without_status_allowed(self):
+        validate_federated_resource_quota(frq("a", {"cpu": 1000}))
+
+
+class TestHpaSurgePath:
+    """ISSUE 8 satellite: a simultaneous multi-binding rescale through the
+    scale-up dispense cohort — engine.solve_batches stays O(chunks) and
+    scale-ups credit surviving placements."""
+
+    def _surge_plane(self, n_workloads):
+        cp = ControlPlane()
+        for i in range(4):
+            cp.join_cluster(
+                new_cluster(f"m{i}", cpu="4000", memory="8000Gi", pods=100000)
+            )
+        cp.settle()
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="pol", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=dynamic_weight_placement(),
+            ),
+        ))
+        for i in range(n_workloads):
+            cp.store.apply(
+                new_deployment(f"s{i}", replicas=2, cpu="100m")
+            )
+        cp.settle()
+        return cp
+
+    def test_cron_surge_is_batched_and_credits_survivors(self):
+        import calendar
+
+        base = calendar.timegm((2026, 1, 1, 8, 59, 30, 0, 0, 0))
+        clock = [float(base)]
+        cp = ControlPlane(clock=lambda: clock[0])
+        for i in range(4):
+            cp.join_cluster(
+                new_cluster(f"m{i}", cpu="4000", memory="8000Gi", pods=100000)
+            )
+        cp.settle()
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="pol", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=dynamic_weight_placement(),
+            ),
+        ))
+        n = 40
+        for i in range(n):
+            cp.store.apply(new_deployment(f"s{i}", replicas=2, cpu="100m"))
+        from karmada_tpu.api.autoscaling import (
+            CronFederatedHPA,
+            CronFederatedHPARule,
+            CronFederatedHPASpec,
+            ScaleTargetRef,
+        )
+
+        for i in range(n):
+            cp.store.apply(CronFederatedHPA(
+                meta=ObjectMeta(name=f"cron{i}", namespace="default"),
+                spec=CronFederatedHPASpec(
+                    scale_target_ref=ScaleTargetRef(
+                        kind="Deployment", name=f"s{i}"
+                    ),
+                    rules=[CronFederatedHPARule(
+                        name="surge", schedule="0 9 * * *",
+                        target_replicas=10,
+                    )],
+                ),
+            ))
+        cp.settle()
+        before = {}
+        for i in range(n):
+            rb = cp.store.get("ResourceBinding", f"default/s{i}-deployment")
+            assert sum(tc.replicas for tc in rb.spec.clusters) == 2
+            before[i] = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        solves0 = cp.scheduler._engine.solve_batches
+        clock[0] += 40  # crosses 09:00: every cron rule fires this tick
+        cp.settle()
+        surge_solves = cp.scheduler._engine.solve_batches - solves0
+        # one simultaneous 40-binding rescale = O(chunks) batched solves,
+        # never one per binding
+        assert surge_solves <= 4, surge_solves
+        for i in range(n):
+            rb = cp.store.get("ResourceBinding", f"default/s{i}-deployment")
+            after = {tc.name: tc.replicas for tc in rb.spec.clusters}
+            assert sum(after.values()) == 10
+            # scale-up cohort: surviving placements are credited (init =
+            # previous), so no previously-placed cluster loses replicas
+            for name, prev_reps in before[i].items():
+                assert after.get(name, 0) >= prev_reps, (i, before[i], after)
+
+    def test_replica_calculator_drives_scale_up_through_binding(self):
+        """The per-pod replica calculator path (FederatedHPA over
+        workload_pods) feeds the same scale-up dispense: the binding's
+        replicas follow the calculator's proposal and survivors keep
+        their placements."""
+        from karmada_tpu.api.autoscaling import (
+            FederatedHPA,
+            FederatedHPASpec,
+            MetricSpec,
+            ScaleTargetRef,
+        )
+
+        clock = [0.0]
+        cp = ControlPlane(clock=lambda: clock[0])
+        for i in (1, 2):
+            cp.join_cluster(new_cluster(f"member{i}", cpu="100", memory="200Gi"))
+        cp.store.apply(new_deployment("web", replicas=4))
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="p", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=dynamic_weight_placement(),
+            ),
+        ))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        before = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        # every pod at 90% of a 500m request against a 45% target -> 2x
+        for tc in rb.spec.clusters:
+            cp.members.get(tc.name).workload_pods["default/web"] = [
+                {"name": f"{tc.name}-p{j}", "request": 500, "value": 450}
+                for j in range(tc.replicas)
+            ]
+        cp.store.apply(FederatedHPA(
+            meta=ObjectMeta(name="web-hpa", namespace="default"),
+            spec=FederatedHPASpec(
+                scale_target_ref=ScaleTargetRef(kind="Deployment", name="web"),
+                min_replicas=1, max_replicas=16,
+                metrics=[MetricSpec(
+                    resource_name="cpu", target_average_utilization=45
+                )],
+                stabilization_window_seconds=0,
+            ),
+        ))
+        clock[0] += 30
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/web-deployment")
+        after = {tc.name: tc.replicas for tc in rb.spec.clusters}
+        assert sum(after.values()) == 8, after
+        for name, prev_reps in before.items():
+            assert after.get(name, 0) >= prev_reps
+
+    def test_surge_respects_quota(self):
+        """A surge into a tight quota admits up to the remaining headroom
+        and denies the rest with QuotaExceeded — the bench scenario at
+        test scale."""
+        import calendar
+
+        base = calendar.timegm((2026, 1, 1, 8, 59, 30, 0, 0, 0))
+        clock = [float(base)]
+        cp = ControlPlane(clock=lambda: clock[0])
+        for i in range(4):
+            cp.join_cluster(
+                new_cluster(f"m{i}", cpu="4000", memory="8000Gi", pods=100000)
+            )
+        cp.settle()
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="pol", namespace="teamA"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(api_version="apps/v1", kind="Deployment")
+                ],
+                placement=dynamic_weight_placement(),
+            ),
+        ))
+        # 8 workloads x 2 replicas x 1 cpu = 16 cpu bound; quota 24 cpu:
+        # a surge to 4 replicas each (delta 2 cpu per workload) admits 4
+        cp.store.apply(FederatedResourceQuota(
+            meta=ObjectMeta(name="q", namespace="teamA"),
+            spec=FederatedResourceQuotaSpec(overall={"cpu": 24000}),
+        ))
+        from karmada_tpu.api.autoscaling import (
+            CronFederatedHPA,
+            CronFederatedHPARule,
+            CronFederatedHPASpec,
+            ScaleTargetRef,
+        )
+
+        for i in range(8):
+            cp.store.apply(
+                new_deployment(f"s{i}", namespace="teamA", replicas=2, cpu="1")
+            )
+            cp.store.apply(CronFederatedHPA(
+                meta=ObjectMeta(name=f"cron{i}", namespace="teamA"),
+                spec=CronFederatedHPASpec(
+                    scale_target_ref=ScaleTargetRef(
+                        kind="Deployment", name=f"s{i}"
+                    ),
+                    rules=[CronFederatedHPARule(
+                        name="surge", schedule="0 9 * * *",
+                        target_replicas=4,
+                    )],
+                ),
+            ))
+        cp.settle()
+        q = cp.store.get("FederatedResourceQuota", "teamA/q")
+        assert q.status.overall_used == {"cpu": 16000}
+        clock[0] += 40
+        cp.settle()
+        scaled = denied = 0
+        for i in range(8):
+            rb = cp.store.get("ResourceBinding", f"teamA/s{i}-deployment")
+            total = sum(tc.replicas for tc in rb.spec.clusters)
+            cond = next(
+                c for c in rb.status.conditions if c.type == SCHEDULED
+            )
+            if total == 4:
+                scaled += 1
+                assert cond.status
+            else:
+                assert total == 2  # denied surge keeps the held replicas
+                assert cond.reason == "QuotaExceeded"
+                denied += 1
+        assert scaled == 4 and denied == 4, (scaled, denied)
+        q = cp.store.get("FederatedResourceQuota", "teamA/q")
+        assert q.status.overall_used == {"cpu": 24000}
+
+
+class TestQuotaStatusVerb:
+    def test_in_proc_and_http_status(self):
+        from karmada_tpu.cli import cmd_quota_status
+        from karmada_tpu.utils.metrics import (
+            MetricsServer,
+            quota_denied,
+            quota_limit,
+            quota_used,
+        )
+
+        quota_limit.set(5000, namespace="verbNS", resource="cpu")
+        quota_used.set(4000, namespace="verbNS", resource="cpu")
+        quota_denied.inc(3, namespace="verbNS")
+        doc = cmd_quota_status()
+        entry = doc["namespaces"]["verbNS"]
+        assert entry["resources"]["cpu"] == {"limit": 5000, "used": 4000}
+        assert entry["denied_total"] == 3
+        srv = MetricsServer()
+        port = srv.start()
+        try:
+            remote = cmd_quota_status(f"127.0.0.1:{port}")
+        finally:
+            srv.stop()
+        assert remote["namespaces"]["verbNS"] == entry
+
+
+class TestQuotaPrewarm:
+    def test_admission_traces_record_and_replay(self, tmp_path):
+        """The engine-side quota kernels ledger like the fleet solve
+        family: a fresh admission dispatch records its compile inputs to
+        the trace manifest, and prewarm replay compiles the record in a
+        jax-free-boot fashion."""
+        from karmada_tpu.scheduler.prewarm import TraceManifest, replay
+
+        snap = ClusterSnapshot(
+            [new_cluster(f"m{i}", cpu="1000", memory="2000Gi") for i in range(4)]
+        )
+        manifest = TraceManifest(str(tmp_path / "m.json"))
+        eng = TensorScheduler(
+            snap, chunk_size=1024, trace_manifest=manifest
+        )
+        eng.set_quota(build_quota_snapshot(
+            [frq("a", {"cpu": 10_000_000},
+                 static=[StaticClusterAssignment(
+                     cluster_name="m0", hard={"cpu": 1000})])],
+            snap, generation=1,
+        ))
+        # fleet-sized batch: the caps kernel dispatches on the device
+        # profile-table fold (tiny batches take the numpy caps mirror)
+        ps = [problem(f"a/b{i}", "a", 1) for i in range(300)]
+        eng.schedule(ps)
+        assert eng.last_pass_new_trace  # fresh admission trace this pass
+        kernels = {r["kernel"] for r in manifest.records}
+        assert "quota_admit" in kernels, kernels
+        assert "quota_cluster_caps" in kernels, kernels
+        stats = replay(manifest, expand=False)
+        assert stats["failed"] == 0 and stats["compiled"] >= 2, stats
+
+
+class TestReviewRegressions:
+    """Regression coverage for the review findings on the quota plane."""
+
+    def test_cross_pass_debit_within_generation(self):
+        """Consecutive engine passes within ONE quota generation share a
+        debited remaining: pass 2 cannot re-admit the budget pass 1
+        spent (multi-batch drains before the usage recompute)."""
+        snap = ClusterSnapshot(
+            [new_cluster(f"m{i}", cpu="1000", memory="2000Gi") for i in range(4)]
+        )
+        eng = TensorScheduler(snap, chunk_size=1024)
+        eng.set_quota(build_quota_snapshot(
+            [frq("a", {"cpu": 4000})], snap, generation=1
+        ))
+        r1 = eng.schedule([problem("a/x", "a", 2)])  # 2 cpu: admitted
+        assert r1[0].success
+        # 3 cpu > the 2 cpu left after the debit: denied, even though the
+        # snapshot generation never moved
+        r2 = eng.schedule([problem("a/y", "a", 3)])
+        assert r2[0].error == QUOTA_EXCEEDED_ERROR
+        # a fresh generation rebuilds remaining from recomputed usage
+        eng.set_quota(build_quota_snapshot(
+            [frq("a", {"cpu": 4000}, used={"cpu": 2000})],
+            snap, generation=2,
+        ))
+        r3 = eng.schedule([problem("a/y", "a", 2)])
+        assert r3[0].success
+
+    def test_denied_binding_retries_on_own_spec_change(self):
+        """A parked denial must unpark when the BINDING's spec changes
+        (scale-down to fit): its own usage is unchanged, so no quota
+        event would ever retry it otherwise."""
+        cp = quota_plane(overall={"cpu": 3000})
+        cp.store.apply(
+            new_deployment("big", namespace="teamA", replicas=8, cpu="1")
+        )
+        cp.settle()
+        assert (
+            scheduled_condition(cp, "teamA/big-deployment").reason
+            == "QuotaExceeded"
+        )
+        cp.store.apply(
+            new_deployment("big", namespace="teamA", replicas=2, cpu="1")
+        )
+        cp.settle()
+        cond = scheduled_condition(cp, "teamA/big-deployment")
+        assert cond.status, cond
+        rb = cp.store.get("ResourceBinding", "teamA/big-deployment")
+        assert sum(tc.replicas for tc in rb.spec.clusters) == 2
+
+    def test_frq_delete_retires_gauges(self):
+        from karmada_tpu.utils.metrics import quota_limit, quota_used
+
+        cp = quota_plane(overall={"cpu": 5000})
+        cp.store.apply(
+            new_deployment("w", namespace="teamA", replicas=2, cpu="1")
+        )
+        cp.settle()
+        assert quota_limit.value(namespace="teamA", resource="cpu") == 5000
+        cp.store.delete("FederatedResourceQuota", "teamA/q")
+        cp.settle()
+        assert quota_limit.value(namespace="teamA", resource="cpu") == 0
+        assert quota_used.value(namespace="teamA", resource="cpu") == 0
+
+    def test_quota_waves_route_around_engines_without_quota(self):
+        """An engine with no quota channel (the solver sidecar shape)
+        must not serve a quota'd wave: routing falls back to the in-proc
+        engine instead of silently skipping enforcement."""
+        cp = quota_plane(overall={"cpu": 1000})
+
+        class QuotalessEngine:  # the sidecar client surface: no set_quota
+            pass
+
+        wave = [problem("teamA/x", "teamA", 2)]
+        routed = cp.scheduler._route_engine_for_quota(QuotalessEngine(), wave)
+        assert hasattr(routed, "set_quota")  # the in-proc TensorScheduler
+        # and with enforcement disabled the sidecar engine passes through
+        import os
+
+        os.environ["KARMADA_TPU_QUOTA_ENFORCEMENT"] = "0"
+        try:
+            dummy = QuotalessEngine()
+            assert cp.scheduler._route_engine_for_quota(dummy, wave) is dummy
+        finally:
+            os.environ.pop("KARMADA_TPU_QUOTA_ENFORCEMENT", None)
+
+    def test_failed_solve_charges_nothing(self):
+        """A pass that dies mid-solve must not leave its demand debited
+        (the worker bisects and retries with rebuilt problem objects):
+        the retry re-admits against the uncharged remaining."""
+        snap = ClusterSnapshot(
+            [new_cluster(f"m{i}", cpu="1000", memory="2000Gi") for i in range(4)]
+        )
+        eng = TensorScheduler(snap, chunk_size=1024)
+        eng.set_quota(build_quota_snapshot(
+            [frq("a", {"cpu": 2000})], snap, generation=1
+        ))
+        boom = RuntimeError("mid-solve death")
+        inner = eng._schedule_inner
+
+        def dying(problems):
+            raise boom
+
+        eng._schedule_inner = dying
+        with pytest.raises(RuntimeError):
+            eng.schedule([problem("a/x", "a", 2)])
+        eng._schedule_inner = inner
+        # retry with REBUILT objects (the bisect shape): still admits
+        r = eng.schedule([problem("a/x", "a", 2)])
+        assert r[0].success, r[0].error
+        # and the committed wave IS charged: the next distinct wave in
+        # the same generation sees the debited remaining
+        r2 = eng.schedule([problem("a/y", "a", 1)])
+        assert r2[0].error == QUOTA_EXCEEDED_ERROR
+
+    def test_partial_frq_delete_and_resource_drop_retire_gauges(self):
+        from karmada_tpu.utils.metrics import quota_limit
+
+        cp = quota_plane(overall={"cpu": 5000, "memory": 1 << 30})
+        cp.store.apply(
+            new_deployment("w", namespace="teamA", replicas=2, cpu="1")
+        )
+        cp.settle()
+        assert quota_limit.value(namespace="teamA", resource="memory") > 0
+        # spec edit dropping a resource retires its samples
+        q = cp.store.get("FederatedResourceQuota", "teamA/q")
+        q.spec.overall = {"cpu": 5000}
+        cp.store.apply(q)
+        cp.settle()
+        assert quota_limit.value(namespace="teamA", resource="memory") == 0
+        assert quota_limit.value(namespace="teamA", resource="cpu") == 5000
+        # partial delete: a second FRQ dies, the survivor's sweep drops
+        # the dead quota's samples
+        cp.store.apply(FederatedResourceQuota(
+            meta=ObjectMeta(name="q2", namespace="teamA"),
+            spec=FederatedResourceQuotaSpec(overall={"pods": 50}),
+        ))
+        cp.settle()
+        assert quota_limit.value(namespace="teamA", resource="pods") == 50
+        cp.store.delete("FederatedResourceQuota", "teamA/q2")
+        cp.settle()
+        assert quota_limit.value(namespace="teamA", resource="pods") == 0
+        assert quota_limit.value(namespace="teamA", resource="cpu") == 5000
+
+    def test_solver_routing_scoped_to_quotad_waves(self):
+        """One namespace's FRQ must not cost every other namespace the
+        sidecar: only waves containing quota'd-namespace bindings
+        reroute."""
+        cp = quota_plane(overall={"cpu": 5000})
+
+        class QuotalessEngine:
+            pass
+
+        dummy = QuotalessEngine()
+        quota_wave = [problem("teamA/x", "teamA", 2)]
+        other_wave = [problem("teamB/x", "teamB", 2)]
+        assert cp.scheduler._route_engine_for_quota(dummy, other_wave) is dummy
+        routed = cp.scheduler._route_engine_for_quota(dummy, quota_wave)
+        assert hasattr(routed, "set_quota")
+
+    def test_fresh_frq_over_existing_usage_counts_live(self):
+        """An FRQ created over a namespace with EXISTING bound usage must
+        enforce from live bindings in the same settle — its status hasn't
+        been reconciled yet, and trusting the empty overall_used would
+        admit a full extra budget nothing ever revokes."""
+        cp = quota_plane()  # no FRQ yet
+        cp.store.apply(
+            new_deployment("old", namespace="teamA", replicas=4, cpu="1")
+        )
+        cp.settle()  # 4 cpu bound, unquota'd
+        # quota equal to existing usage + a new same-size deployment in
+        # ONE settle: the new one must be denied
+        cp.store.apply(FederatedResourceQuota(
+            meta=ObjectMeta(name="q", namespace="teamA"),
+            spec=FederatedResourceQuotaSpec(overall={"cpu": 4000}),
+        ))
+        cp.store.apply(
+            new_deployment("new", namespace="teamA", replicas=4, cpu="1")
+        )
+        cp.settle()
+        assert scheduled_condition(cp, "teamA/old-deployment").status
+        cond = scheduled_condition(cp, "teamA/new-deployment")
+        assert cond.reason == "QuotaExceeded", cond
+
+    def test_solver_fallback_refreshes_engine_quota(self):
+        """The solver transport-failure fallback must not enforce a
+        STALE QuotaSnapshot retained on the in-proc engine from an
+        earlier quota wave."""
+        cp = quota_plane(overall={"cpu": 1000})
+        cp.store.apply(
+            new_deployment("big", namespace="teamA", replicas=8, cpu="1")
+        )
+        cp.settle()
+        assert (
+            scheduled_condition(cp, "teamA/big-deployment").reason
+            == "QuotaExceeded"
+        )
+        # the in-proc engine retains the tight snapshot; disable
+        # enforcement and drive the solver-fallback path directly
+        import os
+
+        engine = cp.scheduler._inproc_engine()
+        assert engine.quota is not None
+
+        class DeadSolver:
+            def schedule(self, problems):
+                raise ConnectionError("sidecar down")
+
+            def sync_clusters(self, clusters):
+                pass
+
+        cp.scheduler.solver = DeadSolver()
+        cp.scheduler._solver_synced = True
+        os.environ["KARMADA_TPU_QUOTA_ENFORCEMENT"] = "0"
+        try:
+            # a spec change re-gates the binding; enforcement is off, so
+            # the wave takes the DeadSolver -> in-proc fallback, which
+            # must clear the engine's retained tight snapshot
+            cp.store.apply(
+                new_deployment("big", namespace="teamA", replicas=6, cpu="1")
+            )
+            cp.settle()
+        finally:
+            os.environ.pop("KARMADA_TPU_QUOTA_ENFORCEMENT", None)
+            cp.scheduler.solver = None
+        assert scheduled_condition(cp, "teamA/big-deployment").status
+
+    def test_spread_selection_sees_capped_availability(self):
+        """Group selection must rank spread groups on the same cap-folded
+        availability the divide uses: a capped primary group that cannot
+        fit loses to an uncapped group that can."""
+        from karmada_tpu.api.policy import (
+            ClusterAffinity,
+            ClusterPreferences,
+            Placement,
+            ReplicaSchedulingStrategy,
+            SpreadConstraint,
+            StaticClusterWeight,
+        )
+
+        snap = ClusterSnapshot(
+            [new_cluster(f"m{i}", cpu="1000", memory="2000Gi") for i in range(4)]
+        )
+        eng = TensorScheduler(snap, chunk_size=1024)
+        # m0+m1 capped to 1 cpu each for namespace "c": 8 replicas of
+        # 1 cpu cannot fit a 2-cluster group drawn from them
+        eng.set_quota(build_quota_snapshot(
+            [frq("c", {"cpu": 10_000_000},
+                 static=[
+                     StaticClusterAssignment(cluster_name="m0",
+                                             hard={"cpu": 1000}),
+                     StaticClusterAssignment(cluster_name="m1",
+                                             hard={"cpu": 1000}),
+                 ])],
+            snap, generation=1,
+        ))
+        pl = Placement(
+            spread_constraints=[SpreadConstraint(
+                spread_by_field="cluster", min_groups=2, max_groups=2,
+            )],
+            replica_scheduling=ReplicaSchedulingStrategy(
+                replica_scheduling_type="Divided",
+                replica_division_preference="Weighted",
+                weight_preference=ClusterPreferences(dynamic_weight="AvailableReplicas"),
+            ),
+        )
+        p = BindingProblem(
+            key="c/spread", placement=pl, replicas=8, requests=CPU_REQ,
+            gvk="apps/v1/Deployment", namespace="c",
+        )
+        res = eng.schedule([p])[0]
+        assert res.success, res.error
+        placed = res.clusters
+        assert sum(placed.values()) == 8
+        # the capped clusters cannot carry more than 1 each; the
+        # selection must have favored uncapped capacity
+        assert placed.get("m0", 0) <= 1 and placed.get("m1", 0) <= 1, placed

@@ -212,6 +212,48 @@ def chaos_block(cd: dict) -> str:
     )
 
 
+def quota_block(qd: dict) -> str:
+    """Rows for a ``bench.py --quota`` record (the quota-enforcement
+    tier): the CronFederatedHPA surge against tightened namespace quotas,
+    the oracle-parity flags for admission AND placements, the
+    enforcement-overhead bound against quota-disabled storms, and the
+    raise-without-re-pack proof."""
+    scale = qd.get("metric", "").removeprefix("quota_surge_")
+    adm = {True: "IDENTICAL", False: "DIVERGED"}[
+        bool(qd.get("admission_identical"))
+    ]
+    plc = {True: "IDENTICAL", False: "DIVERGED"}[
+        bool(qd.get("placements_identical"))
+    ]
+    return "\n".join(
+        [
+            f"| quota {scale}: CronFederatedHPA surge "
+            f"({qd.get('surged_bindings', 0):,} bindings rescaling into "
+            f"{qd.get('quota_namespaces', 0)} quota'd namespaces, "
+            f"{qd.get('capped_namespaces', 0)} with static caps) | "
+            f"{fmt(qd.get('surge_wave_s'))} wave, "
+            f"{qd.get('surge_solves', 0)} batched solve(s) — "
+            f"{qd.get('scaled_bindings', 0):,} scaled, "
+            f"{qd.get('denied_bindings', 0):,} denied QuotaExceeded |",
+            f"| quota {scale}: oracle parity (sequential numpy admission "
+            f"+ per-pass divider replay) | admission {adm} "
+            f"({qd.get('admission_checked', 0):,} decisions), placements "
+            f"{plc} ({qd.get('placements_checked', 0):,} rows) |",
+            f"| quota {scale}: enforcement overhead on steady storms | "
+            f"wall enforced {fmt(qd.get('steady_p50_enforced_s'))} vs "
+            f"disabled {fmt(qd.get('steady_p50_disabled_s'))} "
+            f"({qd.get('enforcement_overhead_x', 0):.3f}×); engine "
+            f"schedule {fmt(qd.get('steady_sched_enforced_s'))} vs "
+            f"{fmt(qd.get('steady_sched_disabled_s'))} "
+            f"({qd.get('sched_overhead_x', 0):.3f}×) |",
+            f"| quota {scale}: quota raise clears denials without a "
+            f"re-pack | namespace {qd.get('raise_namespace')}: cleared "
+            f"all={qd.get('raise_cleared_all')} in "
+            f"{qd.get('raise_solves')} batched solve(s) |",
+        ]
+    )
+
+
 def extra_block(src: Path) -> str:
     """Dispatch an extra record file by its metric prefix."""
     d = json.loads(src.read_text())
@@ -226,6 +268,8 @@ def extra_block(src: Path) -> str:
         return obs_block(d)
     if metric.startswith("chaos_storm"):
         return chaos_block(d)
+    if metric.startswith("quota_surge"):
+        return quota_block(d)
     raise SystemExit(f"{src}: unrecognized bench record metric {metric!r}")
 
 

@@ -192,6 +192,38 @@ def _specs_merge_estimates() -> list:
     )]
 
 
+def _specs_quota_admit() -> list:
+    # B-pow2 wave rows x pow2 namespace rows — the engine's admission
+    # padding shape (scheduler.core._quota_admission)
+    return [
+        KernelSpec(
+            "base",
+            (((_B,), "int32"), ((_B, _R), "int64"), ((_U, _R), "int64")),
+        ),
+        KernelSpec(
+            "wide-wave",
+            (
+                ((4 * _B,), "int32"),
+                ((4 * _B, _R), "int64"),
+                ((2 * _U, _R), "int64"),
+            ),
+        ),
+    ]
+
+
+def _specs_quota_cluster_caps() -> list:
+    return [
+        KernelSpec(
+            "base",
+            (
+                ((_U, _C, _R), "int64"),
+                ((_B,), "int32"),
+                ((_B, _R), "int64"),
+            ),
+        ),
+    ]
+
+
 def _specs_masks_contains_all() -> list:
     return [KernelSpec(
         "base", (((_C, 2), "uint32"), ((2,), "uint32")),
@@ -379,6 +411,16 @@ ENTRY_POINTS: dict = {
         _entry("merge_estimates", "ops", "karmada_tpu.ops.estimate",
                "merge_estimates", "karmada_tpu/ops/estimate.py",
                _specs_merge_estimates),
+        # quota family: dispatched engine-side (TensorScheduler) but
+        # manifest-recorded like the fleet solve family, so prewarm can
+        # replay admission traces at boot (IR004 keeps the three
+        # registries — FLEET_KERNELS / prewarm._KERNELS / here — equal)
+        _entry("quota_admit", "ops", "karmada_tpu.ops.quota",
+               "quota_admit", "karmada_tpu/ops/quota.py",
+               _specs_quota_admit, manifest="quota_admit"),
+        _entry("quota_cluster_caps", "ops", "karmada_tpu.ops.quota",
+               "quota_cluster_caps", "karmada_tpu/ops/quota.py",
+               _specs_quota_cluster_caps, manifest="quota_cluster_caps"),
         _entry("masks.contains_all", "masks", "karmada_tpu.ops.masks",
                "contains_all", "karmada_tpu/ops/masks.py",
                _specs_masks_contains_all),
