@@ -1311,7 +1311,15 @@ def cmd_trace_dump(
     else:
         from .utils.tracing import tracer
 
-        doc = {"waves": tracer.wave_summaries(), "spans": tracer.dump()}
+        # sys.modules-gated mesh report (see MetricsServer): a CLI that
+        # never built an engine has no mesh, and importing the mesh
+        # module just to say so would drag jax into the offline verb
+        pm = sys.modules.get("karmada_tpu.parallel.mesh")
+        doc = {
+            "mesh": pm.active_mesh_shape() if pm is not None else None,
+            "waves": tracer.wave_summaries(),
+            "spans": tracer.dump(),
+        }
     if wave is not None:
         doc["spans"] = [s for s in doc["spans"] if s.get("wave") == wave]
         doc["waves"] = [w for w in doc["waves"] if w.get("wave") == wave]

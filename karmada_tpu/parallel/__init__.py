@@ -1,5 +1,12 @@
 """Device-mesh sharding of the scheduling solver."""
 
+from .mesh import (  # noqa: F401
+    active_mesh_shape,
+    mesh_from_shape,
+    mesh_shape,
+    resolve_mesh,
+    scheduling_mesh,
+)
 from .solver import (  # noqa: F401
     default_mesh,
     make_sharded_step,

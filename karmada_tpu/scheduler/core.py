@@ -197,11 +197,24 @@ class TensorScheduler:
         from .prewarm import resolve_manifest
 
         self.trace_manifest = resolve_manifest(trace_manifest)
-        # optional jax.sharding.Mesh with axes ("b", "c"): the fleet solve
-        # shards its row axis over "b" (and the cluster axis over "c" when
-        # shard_clusters) via sharding constraints — multi-chip scale-out
-        # of the production path, placement-identical to single-device
-        self.mesh = mesh
+        # scheduling-grid mesh (jax.sharding.Mesh with axes ("b", "c")):
+        # the fleet solve shards its row axis over "b" (and the cluster
+        # axis over "c" when shard_clusters) via sharding constraints —
+        # multi-chip scale-out of the production path, placement-
+        # identical to single-device. Resolved ONCE here, the manifest
+        # pattern: an explicit Mesh passes through, None falls back to
+        # the KARMADA_TPU_MESH_DEVICES env default, False forces
+        # single-device even with the env set.
+        from ..parallel.mesh import record_active_mesh, resolve_mesh
+
+        self.mesh = resolve_mesh(mesh)
+        if self.mesh is not None:
+            record_active_mesh(self.mesh)
+            # a >1 cluster axis only exists to shard clusters: opt in
+            # automatically so the env knob alone configures both axes
+            shard_clusters = bool(
+                shard_clusters or self.mesh.shape.get("c", 1) > 1
+            )
         self.shard_clusters = shard_clusters
         # callables (requests[B,R] int64, replicas[B] int32) -> int32[B,C]
         # availability with -1 for "no answer" (accurate estimators plug here)
@@ -354,6 +367,17 @@ class TensorScheduler:
             or self._engine_new_trace
         )
 
+    @property
+    def mesh_info(self):
+        """Canonical shape of the scheduling mesh — ``(("b", nb),
+        ("c", nc))``, or None single-device. The reporting form: the
+        solver sidecar's boot line, ``/debug/traces`` and the warmup
+        stats all quote it so an operator can tell a single-chip from an
+        8-chip plane."""
+        from ..parallel.mesh import mesh_shape
+
+        return mesh_shape(self.mesh)
+
     def set_quota(self, quota) -> None:
         """Swap in a (re)built QuotaSnapshot (None = enforcement off).
 
@@ -465,15 +489,28 @@ class TensorScheduler:
         from ..ops.quota import quota_cluster_caps
 
         caps_dev = self._caps_device()
-        key = (
-            "K", int(len(cap_rows)), tuple(int(s) for s in caps_dev.shape),
-        )
         arrays = (
             caps_dev,
             jnp.asarray(cap_rows, jnp.int32),
             jnp.asarray(requests, jnp.int64),
         )
-        if self._mark_trace(*key):
+        # meshed cap fold: binding rows shard over "b" (cap tensor
+        # replicates via _caps_device's one-time upload); ledger key per
+        # mesh shape, manifest-unrecorded when meshed (see
+        # _quota_admission for the rationale)
+        q_mesh_el = None
+        if self.mesh is not None:
+            from ..parallel.mesh import mesh_shape, shard_rows
+
+            rows_dev, req_dev = shard_rows(self.mesh, arrays[1], arrays[2])
+            if rows_dev is not arrays[1]:
+                q_mesh_el = mesh_shape(self.mesh)
+            arrays = (caps_dev, rows_dev, req_dev)
+        key = (
+            "K", int(len(cap_rows)), tuple(int(s) for s in caps_dev.shape),
+            q_mesh_el,
+        )
+        if self._mark_trace(*key) and q_mesh_el is None:
             self._record_trace("quota_cluster_caps", key, arrays)
         return quota_cluster_caps(*arrays)
 
@@ -536,8 +573,24 @@ class TensorScheduler:
             jnp.asarray(demand),
             jnp.asarray(remaining),
         )
-        key = ("Q", b_pad, n_pad, int(remaining.shape[1]))
-        if self._mark_trace(*key):
+        # meshed admission: the wave rows shard over "b" (the quota
+        # family's FAMILY_SPECS layout), the remaining tensor replicates
+        # — quota_admit's sort/cumsum ride GSPMD collectives, placement-
+        # identical to single-device. The ledger key carries the mesh
+        # shape (a sharded-input executable is a distinct compile), but
+        # meshed dispatches stay manifest-UNRECORDED: the kernel has no
+        # mesh static, so a replay could only compile the single-device
+        # form and would fake coverage.
+        q_mesh_el = None
+        if self.mesh is not None:
+            from ..parallel.mesh import mesh_shape, shard_rows
+
+            ns_dev, dem_dev = shard_rows(self.mesh, arrays[0], arrays[1])
+            if ns_dev is not arrays[0]:  # divisible: placement happened
+                q_mesh_el = mesh_shape(self.mesh)
+            arrays = (ns_dev, dem_dev, arrays[2])
+        key = ("Q", b_pad, n_pad, int(remaining.shape[1]), q_mesh_el)
+        if self._mark_trace(*key) and q_mesh_el is None:
             self._record_trace("quota_admit", key, arrays)
         admitted_dev, wave_used = quota_admit(*arrays)
         admitted = np.asarray(admitted_dev)[:b]

@@ -225,6 +225,7 @@ def _row_masks(cp_bits, cp_static, gvk_bits, incomplete_en, cpc, gvc, psc,
         "has_aggregated", "all_rows", "mesh", "shard_c",
         "pack21",
     ),
+    donate_argnames=("prev_entries",),
 )
 def _fleet_solve(
     cp_bits,  # uint8[U, 2*W8]: bitpacked [aff&spread_field | taint]
@@ -237,7 +238,10 @@ def _fleet_solve(
     replicas, strategy,  # int32[cap]
     fresh,  # bool[cap]
     prev_sites, prev_counts,  # int32[cap, K_PREV]
-    prev_entries,  # int32[cap, k_out] — last pass's entry rows (delta base)
+    prev_entries,  # int32[cap, k_res] — last pass's entry rows (delta
+    # base). DONATED: the updated resident aliases this buffer, so the
+    # persistent entry base never double-buffers in HBM and a settle
+    # drain re-uses the same device allocation pass after pass.
     *,
     chunk: int,
     n_chunks: int,
@@ -347,23 +351,41 @@ def _fleet_solve(
     if k_res > k_out:
         entries = jnp.pad(entries, ((0, 0), (0, k_res - k_out)))
     if all_rows:
-        pe = lax.dynamic_slice_in_dim(prev_entries, 0, entries.shape[0], 0)
+        # int32 offsets: the SPMD partitioner mixes the shard-offset
+        # arithmetic (s32) with the slice start, and an x64-default s64
+        # start fails HLO verification on the row-sharded resident
+        z32 = jnp.int32(0)
+        pe = lax.dynamic_slice_in_dim(
+            prev_entries, z32, entries.shape[0], 0
+        )
         changed = (entries != pe).any(axis=1) & valid
         new_resident = lax.dynamic_update_slice_in_dim(
-            prev_entries, entries, 0, 0
+            prev_entries, entries, z32, 0
         )
     else:
         changed = (entries != prev_entries[r]).any(axis=1) & valid
         new_resident = prev_entries.at[
             jnp.where(valid, r, prev_entries.shape[0])
         ].set(entries, mode="drop")
+    # pin the updated resident to the layout it was allocated with
+    # (row-sharded under a mesh): donation aliases input->output only
+    # when the shardings agree, so the constraint is what keeps the
+    # persistent base buffer-stable across passes
+    new_resident = shard(new_resident, "b", None)
 
     # compact changed rows' (site, count) pairs into one row-major entry
-    # stream; zero entries are the padding the per-row vectors carry
-    valid_e = ((entries > 0) & changed[:, None]).reshape(-1)
+    # stream; zero entries are the padding the per-row vectors carry.
+    # The compaction is a GLOBAL prefix scan — replicate its inputs
+    # explicitly: without the constraint, the resident's row sharding
+    # back-propagates into the cumsum/scatter and the partitioned scan
+    # emits a corrupt stream (observed on the CPU SPMD partitioner:
+    # changed-entry totals beyond the theoretical bound)
+    entries_w = shard(entries, None, None)
+    changed_w = shard(changed, None)
+    valid_e = ((entries_w > 0) & changed_w[:, None]).reshape(-1)
     offs = jnp.cumsum(valid_e.astype(jnp.int32)) - valid_e
     total = offs[-1] + valid_e[-1].astype(jnp.int32)
-    packed = entries.reshape(-1)
+    packed = entries_w.reshape(-1)
     write = jnp.where(valid_e & (offs < e_cap), offs, e_cap)
     buf = jnp.zeros((e_cap + 1,), jnp.int32).at[write].set(packed)
     stream = buf[:e_cap]
@@ -374,7 +396,7 @@ def _fleet_solve(
         n_placed
         | (unsched.astype(jnp.int32) << 8)
         | (has_cand.astype(jnp.int32) << 9)
-        | (changed.astype(jnp.int32) << 10)
+        | (changed_w.astype(jnp.int32) << 10)
     )
     c_total = cp_static.shape[1]
     if c_total <= 0xFFFF:
@@ -566,10 +588,14 @@ def _fleet_pass(
         # per-row scatter overhead is what made this form wrong for the
         # 100k storm, which is exactly the all_rows case)
         if all_rows:
-            old_d = lax.dynamic_slice(rd, (i * chunk, 0), (chunk, c))
-            old_m = lax.dynamic_slice_in_dim(rm, i * chunk, chunk, 0)
-            rd = lax.dynamic_update_slice(rd, dense8, (i * chunk, 0))
-            rm = lax.dynamic_update_slice_in_dim(rm, meta, i * chunk, 0)
+            # int32 shard-safe offsets (see _fleet_solve: the partitioner
+            # rejects s64 starts on the row-sharded residents)
+            off = (i * chunk).astype(jnp.int32)
+            z32 = jnp.int32(0)
+            old_d = lax.dynamic_slice(rd, (off, z32), (chunk, c))
+            old_m = lax.dynamic_slice_in_dim(rm, off, chunk, 0)
+            rd = lax.dynamic_update_slice(rd, dense8, (off, z32))
+            rm = lax.dynamic_update_slice_in_dim(rm, meta, off, 0)
         else:
             old_d = rd[rc]
             old_m = rm[rc]
@@ -608,9 +634,18 @@ def _fleet_pass(
     (res_dense, res_meta), outs = lax.scan(
         body, (res_dense, res_meta), jnp.arange(n_chunks)
     )
-    changed = outs[0].reshape(-1)  # bool[n_pad]
-    meta = outs[1].reshape(-1)
-    dcounts = outs[2].reshape(-1)
+    # pin the updated residents to their allocation layout (row-sharded
+    # under a mesh): matching in/out shardings keep the donation aliased,
+    # so the dense grid never double-buffers across passes
+    res_dense = shard(res_dense, "b", c_ax)
+    res_meta = shard(res_meta, "b")
+    # the wire build below is GLOBAL prefix-scan + scatter compaction:
+    # replicate its inputs explicitly so the residents' row sharding
+    # cannot back-propagate into the cumsums (the CPU SPMD partitioner
+    # emits corrupt streams for sharded global scans — see _fleet_solve)
+    changed = shard(outs[0].reshape(-1), None)  # bool[n_pad]
+    meta = shard(outs[1].reshape(-1), None)
+    dcounts = shard(outs[2].reshape(-1), None)
 
     # wire: [4B total][bitmask n_pad/8 B][m_cap x 2B changed metas in row
     # order][4B dtotal][d_cap x 3B cell deltas] (delta section only when
@@ -648,7 +683,9 @@ def _fleet_pass(
         # cell-delta stream: deltas of changed rows whose dcount fits the
         # meta field (<= 62), compacted in bitmask row order; overflow
         # rows (sentinel 63) ship via phase B instead
-        deltas_all = outs[3].reshape(changed.shape[0], -1)
+        deltas_all = shard(
+            outs[3].reshape(changed.shape[0], -1), None, None
+        )
         contrib = changed & (dcounts <= 62)
         rowv = jnp.where(contrib[:, None], deltas_all, 0).reshape(-1)
         validv = rowv != 0
@@ -673,6 +710,7 @@ def _fleet_pass(
     jax.jit,
     static_argnames=(
         "chunk", "n_chunks", "k_out", "e_cap", "byte_wire", "pack21",
+        "mesh",
     ),
 )
 def _fleet_entries(
@@ -685,6 +723,7 @@ def _fleet_entries(
     e_cap: int,  # exact-or-larger (host sums changed n_placed): no overflow
     byte_wire: bool,
     pack21: bool = False,
+    mesh=None,  # the resident's mesh: gathers cross shards; scans replicate
 ):
     """Phase B: sort-compact ONLY the changed rows' dense vectors into the
     row-major (site << 8 | count) entry stream. Runs at the changed-row
@@ -704,6 +743,13 @@ def _fleet_entries(
         return carry, jnp.where(srt == 2**31 - 1, 0, srt)
 
     _, ents = lax.scan(body, 0, jnp.arange(n_chunks))
+    # replicate before the global compaction scan: the dense resident
+    # input is row-sharded on mesh engines, and a sharded cumsum is
+    # exactly the CPU-SPMD corruption _fleet_solve guards against
+    if mesh is not None:
+        ents = lax.with_sharding_constraint(
+            ents, NamedSharding(mesh, P())
+        )
     entries = ents.reshape(-1, k_out)  # [m_pad, k_out]
     valid_e = (entries > 0).reshape(-1)
     offs = jnp.cumsum(valid_e.astype(jnp.int32)) - valid_e
@@ -1021,6 +1067,23 @@ class FleetTable:
         # buffer by the chunk, so n_pad must stay pow2-aligned — the
         # engine's chunk_size is a perf knob, not a semantic one
         self.chunk = 1 << max(engine.chunk_size, 256).bit_length() - 1
+        # engine-level mesh, validated ONCE against the table's quanta:
+        # chunk/cap/n_pad are all pow2 (>= 256), so any pow2 "b" extent
+        # up to the chunk divides every bucket this table will ever pad
+        # to — the mesh-divisible-bucket contract. A non-pow2 or oversized
+        # extent falls back to single-device for the whole table (loudly:
+        # silently dropping chips would fake a scaling number).
+        mesh = getattr(engine, "mesh", None)
+        if mesh is not None:
+            b_sz = mesh.shape.get("b", 1)
+            if b_sz & (b_sz - 1) or b_sz > self.chunk:
+                log.warning(
+                    "fleet mesh disabled: binding axis %d is not a power "
+                    "of two dividing the %d-row chunk quantum; the solve "
+                    "runs single-device", b_sz, self.chunk,
+                )
+                mesh = None
+        self._mesh = mesh
         self.cap = 0
         self.n_rows = 0
         self._key_row: dict[str, int] = {}
@@ -1074,6 +1137,10 @@ class FleetTable:
         self._resident_entries = None
         self._host_entries: Optional[np.ndarray] = None
         self._k_res = 1  # running max entry width (grow-only)
+        # mesh layout (canonical shape tuple) the residents were born on:
+        # rides every resident-bearing trace key, and a layout change
+        # reallocates the residents (next pass re-reports every row)
+        self._resident_mesh = None
         # two-phase dense path (see _fleet_pass/_fleet_entries): the dense
         # assignment + meta words live on device; _host_meta mirrors the
         # meta resident so results decode without a full per-pass fetch
@@ -1107,6 +1174,9 @@ class FleetTable:
         self._result_gen = 0
         # per-phase wall times of the last pass (bench breakdown surface)
         self.last_breakdown: dict[str, float] = {}
+        # host->device bytes of the current pass (state upload/scatter +
+        # row indices), reset by _sync_device; surfaces as upload_mb
+        self._last_upload_bytes = 0
         # trace-signature ledger: every distinct static-arg combination we
         # dispatch is one XLA trace — and on the async tunnel a fresh trace's
         # remote compile does NOT block at dispatch; it surfaces at the next
@@ -1183,11 +1253,19 @@ class FleetTable:
 
     def _record_trace(self, kernel: str, key, arrays, **statics) -> None:
         """Persist a fresh trace's compile inputs (shapes + statics) to
-        the manifest. Meshed dispatches are skipped — a Mesh is not
-        serializable and the multi-chip shape re-warms live. Best-effort:
-        manifest failures must never reach the scheduling path."""
-        if self._manifest is None or statics.get("mesh") is not None:
+        the manifest. A meshed dispatch records its mesh as the canonical
+        SHAPE tuple (parallel.mesh.mesh_shape) — the Mesh object is not
+        serializable but its shape is the compile identity, and replay
+        rebuilds a live mesh over the booting process's devices (a boot
+        that cannot host the recorded shape counts the record failed and
+        never seeds the ledger from it). Best-effort: manifest failures
+        must never reach the scheduling path."""
+        if self._manifest is None:
             return
+        if statics.get("mesh") is not None:
+            from ..parallel.mesh import mesh_shape
+
+            statics = {**statics, "mesh": mesh_shape(statics["mesh"])}
         try:
             self._manifest.record(kernel, key, arrays, statics)
         except Exception as exc:  # noqa: BLE001 — manifest failures must
@@ -1651,9 +1729,17 @@ class FleetTable:
         # this rebuild runs EVERY churn pass (snapshot gen bumps per drift)
         self._avail_max = self._host_avail_max(profs)
         _mark("avail_max")
-        self._dev_tables = (
-            cp_bits_dev, cp_static_dev, gvk_dev, prof_table, inc_dev
-        )
+        # under a mesh the slot tables replicate explicitly (empty-spec
+        # NamedSharding): they are gathered per row by slot index inside
+        # the sharded solve, and a one-time replicated upload beats a
+        # per-pass broadcast from device 0. device_put is a no-op for
+        # arrays already committed to the target sharding (the
+        # incremental append path mutates replicated arrays in place).
+        tables = (cp_bits_dev, cp_static_dev, gvk_dev, prof_table, inc_dev)
+        if self._mesh is not None:
+            repl = NamedSharding(self._mesh, P())
+            tables = tuple(jax.device_put(a, repl) for a in tables)
+        self._dev_tables = tables
         self._mask_token = token
         self._tables_dirty = False
 
@@ -1672,22 +1758,36 @@ class FleetTable:
         valid = table != mi
         return int(table[valid].max()) if valid.any() else 0
 
+    def _upload_state(self) -> tuple:
+        """Full packed-state upload. Under a mesh the state replicates
+        EXPLICITLY across every device (NamedSharding with an empty spec):
+        the solve gathers per-row state by arbitrary row index, so a
+        replica-local gather beats a per-pass broadcast of the whole
+        grid from device 0."""
+        arrays = tuple(jnp.asarray(self._st[k]) for k in _STATE_FIELDS)
+        self._last_upload_bytes += sum(
+            self._st[k].nbytes for k in _STATE_FIELDS
+        )
+        if self._mesh is None:
+            return arrays
+        return tuple(
+            jax.device_put(a, NamedSharding(self._mesh, P()))
+            for a in arrays
+        )
+
     def _sync_device(self) -> None:
+        self._last_upload_bytes = 0
         if self._tables_dirty or (
             getattr(self.engine, "_snapshot_gen", 0) != self._snapshot_gen
         ):
             self._rebuild_tables()
         if self._dev_state is None:
-            self._dev_state = tuple(
-                jnp.asarray(self._st[k]) for k in _STATE_FIELDS
-            )
+            self._dev_state = self._upload_state()
             self._dirty.clear()
         elif self._dirty:
             rows = np.fromiter(self._dirty, np.int64, len(self._dirty))
             if len(rows) > self.cap // 2:
-                self._dev_state = tuple(
-                    jnp.asarray(self._st[k]) for k in _STATE_FIELDS
-                )
+                self._dev_state = self._upload_state()
             else:
                 # pow2-pad the scatter (repeating the first row: duplicate
                 # writes of identical values are idempotent) so distinct
@@ -1699,7 +1799,12 @@ class FleetTable:
                     [rows, np.full(pad - len(rows), rows[0], np.int64)]
                 )
                 vals = tuple(self._st[k][rows_p] for k in _STATE_FIELDS)
-                self._mark_trace("S", self.cap, pad)
+                self._last_upload_bytes += rows_p.nbytes + sum(
+                    v.nbytes for v in vals
+                )
+                self._mark_trace(
+                    "S", self.cap, pad, self._mesh is not None
+                )
                 self._dev_state = _scatter_rows(
                     self._dev_state, jnp.asarray(rows_p), vals
                 )
@@ -1835,6 +1940,7 @@ class FleetTable:
             ar = np.full(n_pad, -1, np.int32)
             ar[:n] = rows_np
             rows_dev = jnp.asarray(ar)
+            self._last_upload_bytes += ar.nbytes
 
         reps_sel = st["replicas"][rows_np]
         strat_sel = st["strategy"][rows_np]
@@ -1868,11 +1974,22 @@ class FleetTable:
                 # rows-buffer length, and the state cap — the old
                 # (chunk, n_chunks)-only key let a slot-table growth mint
                 # a new XLA trace that new_trace_last_pass never reported
+                from ..parallel.mesh import mesh_shape as _bits_mesh_shape
+
                 key = (
                     "B", _chunk, _n_chunks, _tables[0].shape,
                     int(_rows.shape[0]), int(_state[0].shape[0]),
+                    # canonical mesh shape: the bits inputs commit to the
+                    # mesh (replicated), so each shape is a distinct
+                    # executable — a bool here let a mesh=2 manifest
+                    # fake-warm a mesh=8 boot
+                    _bits_mesh_shape(self._mesh),
                 )
-                if self._mark_trace(*key):
+                if self._mark_trace(*key) and self._mesh is None:
+                    # meshed dispatches stay manifest-UNRECORDED: the
+                    # kernel has no mesh static, so a replay could only
+                    # compile the single-device form and would seed this
+                    # key as falsely warmed (see _quota_admission)
                     self._record_trace(
                         "fleet_bits", key, (*_tables, _rows, *_state),
                         chunk=_chunk, n_chunks=_n_chunks,
@@ -1884,40 +2001,74 @@ class FleetTable:
         safe = int(
             np.minimum(np.where(is_dup, 0, reps_sel), k_out).sum()
         )
-        # engine-level mesh: shard the row axis (and optionally the cluster
-        # axis) when the chunk/cluster extents divide the mesh evenly;
-        # uneven extents fall back to single-device semantics
-        mesh = getattr(self.engine, "mesh", None)
+        # table-validated mesh (see __init__): the row axis shards over
+        # "b" on every pass — batches are padded to the pow2 chunk, so
+        # the mesh-divisible bucket holds by construction. The cluster
+        # axis additionally shards when the engine opted in AND c divides
+        # the "c" extent. mesh_el is the mesh's canonical SHAPE: the
+        # trace-key/manifest element (a Mesh object is process-local; its
+        # shape is the compile identity across processes and boots).
+        from ..parallel.mesh import mesh_shape as _mesh_shape
+
+        mesh = self._mesh
         shard_c = False
         if mesh is not None:
-            b_sz = mesh.shape.get("b", 1)
             c_sz = mesh.shape.get("c", 1)
-            if eff_chunk % max(b_sz, 1):
-                mesh = None
-            else:
-                shard_c = (
-                    getattr(self.engine, "shard_clusters", False)
-                    and c_sz > 1
-                    and c % c_sz == 0
-                )
+            shard_c = (
+                getattr(self.engine, "shard_clusters", False)
+                and c_sz > 1
+                and c % c_sz == 0
+            )
+        mesh_el = _mesh_shape(mesh)
         shared = dict(
             problems=problems, rows_np=rows_np, rows_dev=rows_dev, tmr=tmr,
             n=n, n_pad=n_pad, eff_chunk=eff_chunk, n_chunks=n_chunks,
             is_all=is_all, c=c, k_out=k_out, wide=wide, fast=fast,
             has_agg=has_agg, bits_src=bits_src, is_dup=is_dup, safe=safe,
-            mesh=mesh, shard_c=shard_c, byte_wire=c <= 0xFFFF,
+            mesh=mesh, mesh_el=mesh_el, shard_c=shard_c,
+            byte_wire=c <= 0xFFFF,
             # 21-bit entry packing: 2.625 B/entry when the site id fits
             # 13 bits — the churn wire is tunnel-bandwidth-bound
             pack21=c <= (1 << 13), t0=t0,
         )
+        # host->device transfer of THIS pass so far (state scatter/upload
+        # + row indices): the multichip bench's steady-pass bound — a
+        # steady storm must ship changed rows' bytes, never the grid
+        tmr["upload_mb"] = self._last_upload_bytes / 1e6
         if self.cap * c <= DENSE_RESIDENT_MAX_BYTES:
             return self._solve_dense(**shared)
         return self._solve_legacy(**shared)
 
+    def _alloc_resident(self, shape, dtype, mesh, *, c_axis=False):
+        """Zeroed resident born on the solve's sharding layout (rows over
+        mesh axis "b", optionally clusters over "c"): donation aliases
+        input->output only when the shardings agree, so a resident must
+        START on the layout the kernels pin their outputs to — otherwise
+        the first meshed pass silently copies instead of aliasing."""
+        if mesh is None:
+            return jnp.zeros(shape, dtype)
+        axes = ["b"] + [None] * (len(shape) - 1)
+        if c_axis and len(shape) > 1:
+            axes[1] = "c"
+        return jnp.zeros(
+            shape, dtype, device=NamedSharding(mesh, P(*axes))
+        )
+
+    def _upload_resident(self, host, mesh, *, c_axis=False):
+        """Host mirror -> device resident on the same layout rule as
+        ``_alloc_resident`` (the donation-overflow re-upload path)."""
+        arr = jnp.asarray(host)
+        if mesh is None:
+            return arr
+        axes = ["b"] + [None] * (arr.ndim - 1)
+        if c_axis and arr.ndim > 1:
+            axes[1] = "c"
+        return jax.device_put(arr, NamedSharding(mesh, P(*axes)))
+
     def _solve_legacy(
         self, *, problems, rows_np, rows_dev, tmr, n, n_pad, eff_chunk,
         n_chunks, is_all, c, k_out, wide, fast, has_agg, bits_src, is_dup,
-        safe, mesh, shard_c, byte_wire, pack21, t0,
+        safe, mesh, mesh_el, shard_c, byte_wire, pack21, t0,
     ) -> "_FleetResultList":
         """Single-dispatch entry-resident solve — the path for tables whose
         dense mirror would exceed the HBM budget (multi-million-row
@@ -1929,15 +2080,19 @@ class FleetTable:
         # delta base: device-resident per-row entry vectors + the matching
         # host mirror, k_res wide (grow-only running max of k_out so a
         # straggler batch with smaller replicas doesn't wipe the base).
-        # Table growth or a k_res increase resets both — the next pass
-        # reports every row changed and refills them.
+        # Table growth, a k_res increase, or a mesh-layout change resets
+        # both — the next pass reports every row changed and refills them.
         k_res = max(self._k_res, k_out)
         if (
             self._resident_entries is None
             or self._resident_entries.shape != (self.cap, k_res)
+            or self._resident_mesh != mesh_el
         ):
-            self._resident_entries = jnp.zeros((self.cap, k_res), jnp.int32)
+            self._resident_entries = self._alloc_resident(
+                (self.cap, k_res), jnp.int32, mesh
+            )
             self._host_entries = np.zeros((self.cap, k_res), np.int32)
+            self._resident_mesh = mesh_el
         self._k_res = k_res
 
         # fetched bytes scale with e_cap, so tune it to ~1.25x the last
@@ -1952,10 +2107,13 @@ class FleetTable:
         # a churn burst overflows once, reruns at the safe bound, and the
         # cap follows it back up
         def l_key(cap: int) -> tuple:
+            # mesh_el (the canonical mesh SHAPE, not a bool): partitioned
+            # executables are distinct per mesh shape, and the manifest
+            # key must never let a mesh=1 record seed a mesh=8 boot
             return (
                 "L", self.cap, c, self._dev_tables[0].shape, eff_chunk,
                 n_chunks, k_out, k_res, cap, wide, fast, has_agg, is_all,
-                mesh is not None, shard_c, pack21 and byte_wire,
+                mesh_el, shard_c, pack21 and byte_wire,
             )
 
         prev_e = self._e_cap_cur
@@ -1981,12 +2139,12 @@ class FleetTable:
                 self._e_shrink_desire = (None, 0)
         self._e_cap_cur = e_cap
 
-        def solve(rows_slice, cap):
+        def solve(rows_slice, cap, resident):
             if self._mark_trace(*l_key(cap)):
                 self._record_trace(
                     "fleet_solve", l_key(cap),
                     (*self._dev_tables, rows_slice, *self._dev_state,
-                     self._resident_entries),
+                     resident),
                     chunk=eff_chunk, n_chunks=n_chunks, k_out=k_out,
                     k_res=k_res, e_cap=cap, wide=wide, fast=fast,
                     has_aggregated=has_agg, all_rows=is_all, mesh=mesh,
@@ -1996,7 +2154,7 @@ class FleetTable:
                 *self._dev_tables,
                 rows_slice,
                 *self._dev_state,
-                self._resident_entries,
+                resident,
                 chunk=eff_chunk,
                 n_chunks=n_chunks,
                 k_out=k_out,
@@ -2029,7 +2187,12 @@ class FleetTable:
 
         tmr["prep"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        flat, resident = solve(rows_dev, e_cap)
+        # the resident base is DONATED into the dispatch: detach the
+        # attribute first so a pass that dies mid-solve leaves no
+        # deleted-buffer reference behind (the next pass re-seeds the
+        # delta base instead of crashing on a consumed array)
+        res_in, self._resident_entries = self._resident_entries, None
+        flat, resident = solve(rows_dev, e_cap, res_in)
         tmr["dispatch"] = _time.perf_counter() - t0
         # device fence at the span boundary: block_until_ready splits the
         # on-device execute (plus compile, when this pass minted a fresh
@@ -2043,9 +2206,18 @@ class FleetTable:
         raw = np.asarray(flat)
         fetched_bytes = raw.nbytes
         total, meta, stream = decode(raw, e_cap)
-        if total > e_cap:  # overflow: rerun at the safe bound (the resident
-            # base is the PRE-pass array either way — adopt the rerun's)
-            flat, resident = solve(rows_dev, cap_round(safe))
+        if total > e_cap:
+            # overflow: rerun at the safe bound. The first dispatch
+            # DONATED the pre-pass resident, so the rerun diffs against a
+            # re-upload of the host mirror — identical content by
+            # construction (the fold below has not run yet). One extra
+            # upload on the rare overflow pass buys alias-in-place on
+            # every steady pass.
+            res_in = self._upload_resident(self._host_entries, mesh)
+            tmr["upload_mb"] = (
+                tmr.get("upload_mb", 0.0) + self._host_entries.nbytes / 1e6
+            )
+            flat, resident = solve(rows_dev, cap_round(safe), res_in)
             raw = np.asarray(flat)
             fetched_bytes += raw.nbytes
             total, meta, stream = decode(raw, cap_round(safe))
@@ -2097,11 +2269,20 @@ class FleetTable:
         ``c``), so the same trace could be ledgered under two keys —
         spuriously flipping ``new_trace_last_pass`` (and double-entering
         the manifest). Keyed on the resident's OWN shape: that is the
-        array the trace closes over."""
+        array the trace closes over. The resident's mesh layout rides
+        along — a row-sharded dense resident compiles a different
+        (gather-collective-bearing) executable than a single-device one."""
         return (
             "E", self._res_dense.shape[0], self._res_dense.shape[1],
             chunk, n_chunks, k_out, e_cap, byte_wire, pack21,
+            self._resident_mesh,
         )
+
+    @property
+    def _entries_mesh(self):
+        """Mesh arg for a phase-B dispatch: the mesh the dense resident
+        was allocated on (None when it was born single-device)."""
+        return self._mesh if self._resident_mesh is not None else None
 
     def _mark_entries_trace(
         self, rows_dev, *, chunk, n_chunks, k_out, e_cap, byte_wire, pack21,
@@ -2113,6 +2294,7 @@ class FleetTable:
                 "fleet_entries", key, (self._res_dense, rows_dev),
                 chunk=chunk, n_chunks=n_chunks, k_out=k_out, e_cap=e_cap,
                 byte_wire=byte_wire, pack21=pack21,
+                mesh=self._entries_mesh,
             )
 
     def _fetch_fold_exact(
@@ -2146,6 +2328,7 @@ class FleetTable:
             e_cap=e_cap,
             byte_wire=byte_wire,
             pack21=pack21 and byte_wire,
+            mesh=self._entries_mesh,
         )
         tmr["dispatch_b"] = _time.perf_counter() - t_b
         t_b = _time.perf_counter()
@@ -2163,7 +2346,7 @@ class FleetTable:
     def _solve_dense(
         self, *, problems, rows_np, rows_dev, tmr, n, n_pad, eff_chunk,
         n_chunks, is_all, c, k_out, wide, fast, has_agg, bits_src, is_dup,
-        safe, mesh, shard_c, byte_wire, pack21, t0,
+        safe, mesh, mesh_el, shard_c, byte_wire, pack21, t0,
     ) -> "_FleetResultList":
         """Two-phase solve: _fleet_pass (divide + dense diff, ~13 KB wire
         on a steady pass) and, only when rows changed, _fleet_entries over
@@ -2171,12 +2354,19 @@ class FleetTable:
         overflow rerun by construction)."""
         import time as _time
 
-        if self._res_dense is None or self._res_dense.shape != (
-            self.cap, c
+        if (
+            self._res_dense is None
+            or self._res_dense.shape != (self.cap, c)
+            or self._resident_mesh != mesh_el
         ):
-            self._res_dense = jnp.zeros((self.cap, c), jnp.uint8)
-            self._res_meta = jnp.zeros((self.cap,), jnp.int32)
+            self._res_dense = self._alloc_resident(
+                (self.cap, c), jnp.uint8, mesh, c_axis=shard_c
+            )
+            self._res_meta = self._alloc_resident(
+                (self.cap,), jnp.int32, mesh
+            )
             self._host_meta = np.zeros(self.cap, np.int32)
+            self._resident_mesh = mesh_el
         # host entry mirror: width grows in place (no resident to reset —
         # the dense base is width-independent)
         k_res = max(self._k_res, k_out)
@@ -2199,10 +2389,12 @@ class FleetTable:
             return min(q, n_pad)
 
         def a_key(m: int, d: int) -> tuple:
+            # mesh_el: canonical mesh shape (see l_key) — partitioned
+            # executables and their manifest records are per-shape
             return (
                 "A", self.cap, c, self._dev_tables[0].shape, eff_chunk,
                 n_chunks, wide, fast, has_agg, is_all, m, d,
-                mesh is not None, shard_c,
+                mesh_el, shard_c,
             )
 
         # cap tuning, demand-based. Every distinct (m_cap, d_cap) pair is a
@@ -2283,12 +2475,18 @@ class FleetTable:
                 has_aggregated=has_agg, all_rows=is_all, m_cap=m_cap,
                 d_cap=d_cap, mesh=mesh, shard_c=shard_c,
             )
+        # the dense residents are DONATED into the pass: detach the
+        # attributes first so a dispatch that dies cannot leave deleted-
+        # buffer references (the next pass reallocates a zeroed, mutually
+        # consistent resident/mirror pair and re-reports every row)
+        rd_in, self._res_dense = self._res_dense, None
+        rm_in, self._res_meta = self._res_meta, None
         flat, rowbuf, rd, rm = _fleet_pass(
             *self._dev_tables,
             rows_dev,
             *self._dev_state,
-            self._res_dense,
-            self._res_meta,
+            rd_in,
+            rm_in,
             chunk=eff_chunk,
             n_chunks=n_chunks,
             wide=wide,
@@ -2342,6 +2540,7 @@ class FleetTable:
                 e_cap=spec_cap,
                 byte_wire=byte_wire,
                 pack21=pack21 and byte_wire,
+                mesh=self._entries_mesh,
             )
         tmr["dispatch"] = _time.perf_counter() - t0
         # device fence (see _solve_legacy): splits phase A's on-device
@@ -2381,7 +2580,7 @@ class FleetTable:
             m_pad_f = max(4096, _pow2(total))
             rows_f = np.full(m_pad_f, -1, np.int32)
             rows_f[:total] = ch_rows
-            self._mark_trace("G", self.cap, m_pad_f)
+            self._mark_trace("G", self.cap, m_pad_f, self._resident_mesh)
             mraw = np.asarray(
                 _gather_meta(self._res_meta, jnp.asarray(rows_f))
             )

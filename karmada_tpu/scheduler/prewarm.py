@@ -210,9 +210,13 @@ def _listify(v):
 
 def _statics_from_json(statics: dict) -> dict:
     """Inverse of record(): lists back to tuples (``fast``), everything
-    else verbatim. ``mesh`` is always None in recorded specs (meshed
-    dispatches are not recorded — a Mesh is not serializable and the
-    multi-chip deployment re-warms live)."""
+    else verbatim. A meshed record's ``mesh`` static is its canonical
+    SHAPE tuple (parallel.mesh.mesh_shape) — kept in shape form here so
+    content signatures round-trip byte-identically (the IR004 canon);
+    ``replay()`` materializes a live Mesh over the booting process's
+    devices just before compiling (parallel.mesh.materialize_mesh_statics
+    — a backend that cannot host the recorded shape fails that record,
+    which keeps it out of ``warmed_keys`` and off the seeded ledger)."""
     return {k: _retuple(v) for k, v in statics.items()}
 
 
@@ -300,6 +304,13 @@ def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
                 for shape, dtype in r["in_shapes"]
             ]
             statics = _statics_from_json(r["statics"])
+            # a meshed record carries its mesh as the canonical shape;
+            # build the live mesh over THIS process's devices (raises —
+            # counting the record failed — when the backend cannot host
+            # it, so an 8-chip record can never fake-warm a 1-chip boot)
+            from ..parallel.mesh import materialize_mesh_statics
+
+            statics = materialize_mesh_statics(statics)
             try:
                 # one dummy-data execution: trace + compile (persistent-
                 # cache hit when seeded) + run, leaving the jit dispatch
@@ -363,6 +374,16 @@ def warmup(
     stats = replay(manifest, expand=expand)
     stats["manifest"] = manifest.path
     stats["cache_dir"] = cache_dir
+    # the boot's scheduling-mesh identity rides the warmup stats so the
+    # operator (and the orchestrator scraping the JSON line) can tell a
+    # single-chip from an 8-chip plane before any engine is built
+    from ..parallel.mesh import mesh_shape, resolve_mesh
+
+    try:
+        stats["mesh"] = mesh_shape(resolve_mesh(None))
+    except Exception as exc:  # noqa: BLE001 — a misconfigured mesh env
+        # fails loudly at ENGINE construction; warmup only reports
+        stats["mesh"] = f"error: {exc}"
     return stats
 
 

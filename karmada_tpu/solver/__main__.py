@@ -141,6 +141,24 @@ def main(argv=None) -> None:
             sys.stdout.flush()
             _os._exit(4)
         print(f"solver backend {platform[0]}", flush=True)
+    # scheduling-mesh report: when the env requests a mesh
+    # (KARMADA_TPU_MESH_DEVICES), resolve and print its shape so the
+    # orchestrator (and `karmadactl-tpu trace dump`) can tell a
+    # single-chip from an 8-chip plane. Env-gated: without the knob this
+    # prints nothing and never touches the backend.
+    if os.environ.get("KARMADA_TPU_MESH_DEVICES", "").strip() not in (
+        "", "0", "1"
+    ):
+        from ..parallel.mesh import mesh_shape, resolve_mesh
+
+        try:
+            shape = mesh_shape(resolve_mesh(None))
+        except Exception as exc:  # noqa: BLE001 — report, then let the
+            # first engine construction fail loudly with the same error
+            print(f"solver mesh error: {exc}", flush=True)
+        else:
+            axes = " ".join(f"{n}={s}" for n, s in (shape or ()))
+            print(f"solver mesh {axes or 'single-device'}", flush=True)
     if manifest is not None:
         # prewarm AFTER the port/backend lines the orchestrator scrapes:
         # compiles run off the serving path (the plane connects and syncs

@@ -157,6 +157,24 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "tracks the transitions).",
         ),
         EnvFlag(
+            "KARMADA_TPU_MESH_DEVICES", "",
+            "Device count of the scheduling-grid mesh "
+            "(parallel.mesh.resolve_mesh): engines shard the fleet solve "
+            "along the bindings axis over the first N visible devices. "
+            "Empty/0/1 = single-device (mesh off); 'auto' = every visible "
+            "device. CPU CI dry-runs combine it with "
+            "--xla_force_host_platform_device_count=N in XLA_FLAGS. A "
+            "value the backend cannot host fails engine construction "
+            "loudly instead of silently running single-device.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_MESH_CLUSTER_AXIS", "1",
+            "Cluster-axis extent of the scheduling mesh (the 'c' axis): "
+            "1 = pure binding-parallel; >1 additionally shards the "
+            "cluster axis (the dispense sorts ride c-axis collectives). "
+            "Must divide KARMADA_TPU_MESH_DEVICES.",
+        ),
+        EnvFlag(
             "KARMADA_TPU_QUOTA_ENFORCEMENT", "1",
             "FederatedResourceQuota admission in the scheduler "
             "(controllers.scheduler_controller): set to 0 to disable the "

@@ -442,8 +442,20 @@ class MetricsServer:
 
                     from .tracing import tracer
 
+                    # the scheduling-mesh shape rides the dump so `trace
+                    # dump` tells a single-chip from an 8-chip plane.
+                    # sys.modules-gated: a process that never imported
+                    # the mesh module has no mesh, and importing it here
+                    # would drag jax into lean processes (the bus)
+                    import sys as _sys
+
+                    pm = _sys.modules.get("karmada_tpu.parallel.mesh")
+                    mesh = (
+                        pm.active_mesh_shape() if pm is not None else None
+                    )
                     body = json.dumps(
                         {
+                            "mesh": mesh,
                             "waves": tracer.wave_summaries(),
                             "spans": tracer.dump(),
                         }

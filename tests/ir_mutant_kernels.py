@@ -35,3 +35,16 @@ def ir003_const_capture(x):
 @partial(jax.jit, donate_argnames=("buf",))
 def ir005_dropped_donation(x, buf):  # buf donated, no aliasable output
     return x + buf.sum()
+
+
+@partial(jax.jit, donate_argnames=("buf",))
+def ir005_reshaped_donation(x, buf):  # donation silently dropped: a
+    # reshape at the kernel boundary leaves no output of the donated
+    # buffer's shape for XLA to alias into
+    return (buf + x).reshape(2, -1)
+
+
+@partial(jax.jit, donate_argnames=("buf",))
+def ir005_astype_donation(x, buf):  # donation silently dropped: a dtype
+    # widen at the boundary breaks the identical-shape+dtype alias rule
+    return (buf + x).astype(jnp.int64)

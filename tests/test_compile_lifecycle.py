@@ -245,6 +245,47 @@ class TestRestoreContract:
         eng.schedule(toy_problems())
         assert eng.last_pass_new_trace is True
 
+    def test_restore_across_mesh_change(self, tmp_path):
+        """A manifest recorded at mesh=1 must NOT seed ``new_trace=False``
+        on a multi-device boot (the partitioned executables are distinct
+        compiles — their ledger keys carry the mesh shape), while a
+        meshed engine's own records DO warm the next meshed boot and the
+        single-device records keep warming single-device engines."""
+        from karmada_tpu.parallel.mesh import scheduling_mesh
+
+        path = tmp_path / "manifest.json"
+        seed_manifest(path)  # single-device records
+        prewarm.warmup(str(path))
+        snap = ClusterSnapshot(synthetic_fleet(C, seed=7))
+        meshed = TensorScheduler(
+            snap, mesh=scheduling_mesh(2), trace_manifest=str(path)
+        )
+        meshed.schedule(toy_problems())
+        assert meshed.last_pass_new_trace is True, (
+            "a mesh=1 manifest fake-warmed a mesh=2 boot"
+        )
+        # the meshed pass recorded its partitioned traces (mesh shape in
+        # the statics); a fresh warmup replays them over this process's
+        # devices and a meshed restart is then genuinely warm
+        for _ in range(2):
+            meshed.schedule(toy_problems())
+        stats = prewarm.warmup(str(path))
+        assert stats["failed"] == 0 and stats["compiled"] > 0
+        recorded_meshes = {
+            json.dumps(r["statics"].get("mesh"))
+            for r in prewarm.TraceManifest(str(path)).records
+        }
+        assert '[["b", 2], ["c", 1]]' in recorded_meshes
+        meshed2 = TensorScheduler(
+            snap, mesh=scheduling_mesh(2), trace_manifest=str(path)
+        )
+        meshed2.schedule(toy_problems())
+        assert meshed2.last_pass_new_trace is False
+        # and the original single-device records still warm 1-chip boots
+        single = TensorScheduler(snap, trace_manifest=str(path))
+        single.schedule(toy_problems())
+        assert single.last_pass_new_trace is False
+
     def test_restart_smoke_subprocess(self, tmp_path):
         """The real restart: process 1 schedules and exits; process 2
         prewarms from the manifest + persistent cache and must run its

@@ -95,19 +95,42 @@ MUTANTS = {
     "IR005": mutant_entry(
         "ir005_dropped_donation", (((4,), "int32"), ((8,), "int32"))
     ),
+    # silently-UN-donated variants: the donated invar HAS a plausible
+    # consumer, but a reshape/astype at the kernel boundary leaves no
+    # identically-shaped output to alias into — exactly how a refactor
+    # quietly doubles the resident's HBM footprint
+    "IR005-reshape": mutant_entry(
+        "ir005_reshaped_donation", (((8,), "int32"), ((8,), "int32"))
+    ),
+    "IR005-astype": mutant_entry(
+        "ir005_astype_donation", (((8,), "int32"), ((8,), "int32"))
+    ),
 }
 
 
 @pytest.mark.parametrize("rule_id", sorted(MUTANTS))
 def test_mutant_fires_and_fails_gate(rule_id):
     entry = MUTANTS[rule_id]
+    rule = rule_id.split("-")[0]
     result = run_ir(entries={entry.name: entry}, root=REPO, baseline=None)
     assert not result.ok, f"{rule_id} mutant passed the gate"
-    hits = [f for f in result.findings if f.rule == rule_id]
+    hits = [f for f in result.findings if f.rule == rule]
     assert hits, f"{rule_id} did not fire on its mutant"
     assert all(f.path == MUTANT_PATH for f in hits)
-    others = [f for f in result.findings if f.rule != rule_id]
+    others = [f for f in result.findings if f.rule != rule]
     assert not others, [f.render() for f in others]
+
+
+def test_sharded_specs_cover_fleet_kernels():
+    # the sharded grid contract (ISSUE 9): every mesh-parameterized entry
+    # point traces under a >=2-device spec, so IR001-IR005 — including
+    # the donation audit over the row-sharded residents — cover the
+    # PARTITIONED executables, not just the single-device forms
+    for name in ("fleet_solve", "fleet_pass", "fleet_entries"):
+        variants = {s.variant: s for s in ENTRY_POINTS[name].make_specs()}
+        spec = variants.get("sharded-b2")
+        assert spec is not None, f"{name} lost its sharded spec"
+        assert spec.statics.get("mesh") == (("b", 2), ("c", 1))
 
 
 def test_ir001_detail_names_dtype_and_primitive():

@@ -294,7 +294,19 @@ def _specs_fleet_solve() -> list:
         spec("narrow-fast-partial", wide=False, fast=fast_idx,
              all_rows=False, pack21=False),
         spec("next-e-bucket", e_cap=_cap_round(e_cap + 1)),
+        # sharded grid: the same program under a 2-device ("b") mesh —
+        # trace_spec materializes the shape into a live Mesh, so IR001-
+        # IR005 (incl. the donation audit over the row-sharded resident)
+        # run over the PARTITIONED executable's jaxpr, not just the
+        # single-device form
+        spec("sharded-b2", mesh=_MESH2),
     ]
+
+
+#: canonical 2-device mesh shape for the sharded spec variants (the
+#: serialized form the trace manifest also records; trace_spec builds the
+#: live mesh over the forced host devices at trace time)
+_MESH2 = (("b", 2), ("c", 1))
 
 
 def _specs_fleet_pass() -> list:
@@ -320,6 +332,9 @@ def _specs_fleet_pass() -> list:
         spec("wide-allrows"),
         spec("narrow-fast-delta", wide=False, fast=fast_idx,
              d_cap=D_FLOOR, all_rows=False),
+        # sharded grid under a 2-device mesh (see _specs_fleet_solve):
+        # proves the donated dense residents still alias when partitioned
+        spec("sharded-b2", mesh=_MESH2),
     ]
 
 
@@ -336,6 +351,11 @@ def _specs_fleet_entries() -> list:
                    {**base, "byte_wire": True, "pack21": True}),
         KernelSpec("word-wire", shapes,
                    {**base, "byte_wire": False, "pack21": False}),
+        # sharded grid: phase B over a row-sharded dense resident (the
+        # mesh engines' form — gathers cross shards, scans replicate)
+        KernelSpec("sharded-b2", shapes,
+                   {**base, "byte_wire": True, "pack21": True,
+                    "mesh": _MESH2}),
     ]
 
 
@@ -493,8 +513,23 @@ def ops_registry_drift(root: Optional[Path] = None) -> tuple:
 
 def _import_jax():
     # the auditor must never grab a TPU: default to CPU before the first
-    # jax import (a caller that already imported jax keeps its platform)
+    # jax import (a caller that already imported jax keeps its platform).
+    # The sharded entry-point specs trace under a >=2-device mesh, so the
+    # forced-host-device flag is ensured BEFORE the first backend init —
+    # a caller that already initialized a 1-device backend surfaces the
+    # mesh-build failure as an IR004 trace failure (loud, not skipped).
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # inline (NOT parallel.mesh.ensure_host_devices): importing any
+    # karmada_tpu module pulls jax, and XLA_FLAGS is captured at jax
+    # IMPORT — the flag must be in the env before that first import
+    import re as _re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = _re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if not m or int(m.group(1)) < 2:
+        opt = "--xla_force_host_platform_device_count=2"
+        flags = flags.replace(m.group(0), opt) if m else f"{flags} {opt}"
+        os.environ["XLA_FLAGS"] = flags.strip()
     import jax
 
     return jax
@@ -539,6 +574,13 @@ def trace_spec(entry: KernelEntry, spec: KernelSpec, line: int = 1):
     ]
     args = spec.group(structs) if spec.group else tuple(structs)
     statics = dict(spec.statics)
+    # a sharded spec (registry variant or meshed manifest record) carries
+    # its mesh as the canonical SHAPE — build the live Mesh over this
+    # process's devices the same way prewarm replay does, so the audited
+    # jaxpr is the partitioned program the serving path dispatches
+    from karmada_tpu.parallel.mesh import materialize_mesh_statics
+
+    statics = materialize_mesh_statics(statics)
     closed = jax.make_jaxpr(lambda *a: fn(*a, **statics))(*args)
     return TracedKernel(
         entry=entry, spec=spec, closed_jaxpr=closed, line=line,
