@@ -39,8 +39,12 @@ def main(argv=None) -> None:
     # chaos: arm deterministic fault injection from the environment
     # (KARMADA_TPU_FAULT_SPEC; disarmed when empty — zero overhead)
     from ..utils.faultinject import arm_from_env
+    from ..utils.tracing import register_peers_from_env, tracer
 
     arm_from_env()
+    # cross-process tracing: handler spans export as proc="bus"
+    tracer.set_process("bus")
+    register_peers_from_env()
 
     import os
 

@@ -207,8 +207,13 @@ def main(argv=None) -> None:
     # agent's bus channel (StoreReplica Apply/Delete/Watch) carries the
     # bus.rpc/bus.watch injection points
     from ..utils.faultinject import arm_from_env
+    from ..utils.tracing import register_peers_from_env, tracer
 
     arm_from_env()
+    # cross-process tracing: the agent's bus.rpc client spans export as
+    # proc="agent"
+    tracer.set_process("agent")
+    register_peers_from_env()
     agent_main(
         args.target,
         args.cluster,

@@ -124,6 +124,11 @@ class StoreBusServer:
             options=[("grpc.so_reuseport", 0)],
         )
 
+        from ..utils.tracing import decode_trace_metadata, tracer
+
+        def _ctx(context):
+            return decode_trace_metadata(context.invocation_metadata())
+
         def watch(request: pb.WatchRequest, context):
             kinds = frozenset(request.kinds)
             q: queue.Queue = queue.Queue(maxsize=100_000)
@@ -134,20 +139,36 @@ class StoreBusServer:
             with self._lock:
                 self._subscribers.append((q, kinds, dead))
                 bus_subscribers.set(len(self._subscribers))
-            if request.replay:
-                for kind in sorted(self.store.kinds()):
-                    if kinds and kind not in kinds:
-                        continue
-                    for obj in self.store.list(kind):
-                        yield pb.Event(
-                            type="Added",
-                            kind=kind,
-                            key=obj.meta.namespaced_name,
-                            resource_version=obj.meta.resource_version,
-                            object_json=encode_object(obj),
-                        )
-            # the Bookmark marks the replay boundary: clients report synced
-            # only after it (the list-then-watch initial-sync contract)
+            # the replay-to-bookmark window is the costly, attributable
+            # part of a Watch (the live tail is unbounded by design —
+            # GL007's stream exemption). MANUAL span, not a context
+            # manager: a generator suspends mid-replay with the handler
+            # thread going on to serve other RPCs, so a stack-pushed span
+            # would adopt their spans as children
+            sp = tracer.server_open_manual(
+                "bus.watch", _ctx(context), kinds=len(kinds)
+            )
+            try:
+                replayed = 0
+                if request.replay:
+                    for kind in sorted(self.store.kinds()):
+                        if kinds and kind not in kinds:
+                            continue
+                        for obj in self.store.list(kind):
+                            replayed += 1
+                            yield pb.Event(
+                                type="Added",
+                                kind=kind,
+                                key=obj.meta.namespaced_name,
+                                resource_version=obj.meta.resource_version,
+                                object_json=encode_object(obj),
+                            )
+                sp.attrs["replayed"] = replayed
+            finally:
+                tracer.close_manual(sp)
+            # the Bookmark marks the replay boundary: clients report
+            # synced only after it (the list-then-watch initial-sync
+            # contract)
             yield pb.Event(type="Bookmark")
             try:
                 while context.is_active() and not dead[0]:
@@ -173,32 +194,43 @@ class StoreBusServer:
                     bus_subscribers.set(len(self._subscribers))
 
         def apply(request: pb.ApplyRequest, context):
-            try:
-                obj = decode_object(request.kind, request.object_json)
-                applied = self.store.apply(
-                    obj,
-                    expected_rv=(
-                        request.expected_rv if request.conditional else None
-                    ),
-                )
-                return pb.ApplyResponse(
-                    resource_version=applied.meta.resource_version
-                )
-            except ConflictError as e:
-                # typed over the wire — a CAS loser must see a 409, not a
-                # 500 (and never by pattern-matching error text)
-                return pb.ApplyResponse(error=str(e), conflict=True)
-            except Exception as e:  # noqa: BLE001 — wire surface
-                return pb.ApplyResponse(error=str(e))
+            with tracer.server_span(
+                "bus.apply", _ctx(context), kind=request.kind,
+            ) as sp:
+                try:
+                    obj = decode_object(request.kind, request.object_json)
+                    applied = self.store.apply(
+                        obj,
+                        expected_rv=(
+                            request.expected_rv
+                            if request.conditional
+                            else None
+                        ),
+                    )
+                    return pb.ApplyResponse(
+                        resource_version=applied.meta.resource_version
+                    )
+                except ConflictError as e:
+                    # typed over the wire — a CAS loser must see a 409,
+                    # not a 500 (and never by pattern-matching error text)
+                    sp.attrs["error"] = "conflict"
+                    return pb.ApplyResponse(error=str(e), conflict=True)
+                except Exception as e:  # noqa: BLE001 — wire surface
+                    sp.attrs["error"] = type(e).__name__
+                    return pb.ApplyResponse(error=str(e))
 
         def delete(request: pb.DeleteRequest, context):
-            try:
-                gone = self.store.delete(
-                    request.kind, request.key, force=request.force
-                )
-                return pb.DeleteResponse(deleted=gone is not None)
-            except Exception as e:  # noqa: BLE001
-                return pb.DeleteResponse(error=str(e))
+            with tracer.server_span(
+                "bus.delete", _ctx(context), kind=request.kind,
+            ) as sp:
+                try:
+                    gone = self.store.delete(
+                        request.kind, request.key, force=request.force
+                    )
+                    return pb.DeleteResponse(deleted=gone is not None)
+                except Exception as e:  # noqa: BLE001
+                    sp.attrs["error"] = type(e).__name__
+                    return pb.DeleteResponse(error=str(e))
 
         handlers = {
             "Watch": grpc.unary_stream_rpc_method_handler(
@@ -310,6 +342,7 @@ class StoreReplica:
             self._channel = grpc.secure_channel(target, creds)
         else:
             self._channel = grpc.insecure_channel(target)
+        self._target = target
         self.store = Store()
         self.kinds = kinds
         self._watch = self._channel.unary_stream(
@@ -433,12 +466,20 @@ class StoreReplica:
         ConflictError, so those get one bounded attempt."""
         from ..utils.backoff import Deadline, call_with_resilience
         from ..utils.faultinject import apply_fault, fault_point
+        from ..utils.tracing import trace_metadata, tracer
 
         def attempt(timeout: float):
-            apply_fault(
-                fault_point("bus.rpc", method), "bus.rpc", method
-            )
-            return stub(req, timeout=timeout)
+            # one client span per wire ATTEMPT (retries open fresh spans,
+            # so a retried write's server spans each re-parent under the
+            # attempt that carried them)
+            with tracer.span(
+                "bus.rpc", remote=True, peer=self._target, method=method,
+            ):
+                md = trace_metadata(tracer.current_context())
+                apply_fault(
+                    fault_point("bus.rpc", method), "bus.rpc", method
+                )
+                return stub(req, timeout=timeout, metadata=md)
 
         return call_with_resilience(
             attempt,

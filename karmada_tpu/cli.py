@@ -1185,9 +1185,17 @@ def build_parser() -> tuple:
         help="wave-trace operations: `trace dump --metrics HOST:PORT` "
         "fetches /debug/traces from a running process (plane, solver, "
         "estimator, bus — any MetricsServer) and prints the span ring + "
-        "per-wave phase summaries as JSON",
+        "per-wave phase summaries as JSON; `trace dump --stitch` "
+        "additionally pulls every registered peer's ring and merges the "
+        "cross-process wave trees (per-process + per-channel columns); "
+        "`trace analyze RECORD` re-renders a flight-recorder JSONL "
+        "record's attribution offline",
     )
-    tr.add_argument("action", choices=("dump",))
+    tr.add_argument("action", choices=("dump", "analyze"))
+    tr.add_argument(
+        "record", nargs="?", default="",
+        help="flight-recorder JSONL path (trace analyze)",
+    )
     tr.add_argument(
         "--metrics", default="",
         help="HOST:PORT of the target process's metrics endpoint; "
@@ -1196,11 +1204,23 @@ def build_parser() -> tuple:
     )
     tr.add_argument(
         "--wave", type=int, default=None,
-        help="restrict the span dump to one wave id",
+        help="restrict the span dump to one wave id (dump), or pick the "
+        "flight record for that wave (analyze; default: the last record)",
     )
     tr.add_argument(
         "--summary", action="store_true",
         help="print only the per-wave phase summaries",
+    )
+    tr.add_argument(
+        "--stitch", action="store_true",
+        help="pull /debug/traces from every peer (--peers, the dumped "
+        "process's registered peers, or KARMADA_TPU_TRACE_PEERS) and "
+        "merge the cross-process wave trees",
+    )
+    tr.add_argument(
+        "--peers", default="",
+        help="comma-separated name=host:port peer metrics endpoints for "
+        "--stitch (overrides the dumped process's registry)",
     )
 
     qu = sub.add_parser(
@@ -1294,13 +1314,33 @@ def cmd_lint(
 
 
 def cmd_trace_dump(
-    metrics: str = "", wave: Optional[int] = None, summary: bool = False
+    metrics: str = "",
+    wave: Optional[int] = None,
+    summary: bool = False,
+    stitch: bool = False,
+    peers: str = "",
 ) -> dict:
     """The ``trace dump`` verb: the wave-trace ring + per-wave phase
     summaries, either from a remote process's ``/debug/traces`` endpoint
     (``metrics="host:port"``) or this process's in-proc tracer. The
     per-phase summary is the same shape the observability bench records
-    (BENCH_OBS_r*.json), so a dumped wave reads against the docs table."""
+    (BENCH_OBS_r*.json), so a dumped wave reads against the docs table.
+
+    ``stitch=True`` additionally pulls ``/debug/traces`` from every peer
+    (``peers="name=host:port,..."`` wins, else the dumped process's own
+    registered peers, else this process's registry incl.
+    KARMADA_TPU_TRACE_PEERS) and merges the cross-process wave trees:
+    remote handler roots re-parent under their originating client spans
+    and per-process/per-channel self-time columns come out
+    (utils.tracing.stitch_dumps)."""
+    from .utils.tracing import (
+        fetch_peer_dumps,
+        register_peers_from_env,
+        stitch_dumps,
+        trace_debug_doc,
+    )
+    from .utils.tracing import peers as registered_peers
+
     if metrics:
         import urllib.request
 
@@ -1309,23 +1349,55 @@ def cmd_trace_dump(
         ) as resp:
             doc = json.loads(resp.read().decode())
     else:
-        from .utils.tracing import tracer
-
-        # sys.modules-gated mesh report (see MetricsServer): a CLI that
-        # never built an engine has no mesh, and importing the mesh
-        # module just to say so would drag jax into the offline verb
-        pm = sys.modules.get("karmada_tpu.parallel.mesh")
-        doc = {
-            "mesh": pm.active_mesh_shape() if pm is not None else None,
-            "waves": tracer.wave_summaries(),
-            "spans": tracer.dump(),
+        doc = trace_debug_doc()
+    if stitch:
+        peer_map: dict = {}
+        if peers:
+            for part in peers.split(","):
+                name, sep, addr = part.strip().partition("=")
+                if sep and name and addr:
+                    peer_map[name.strip()] = addr.strip()
+        else:
+            peer_map = dict(doc.get("peers") or {})
+            if not peer_map:
+                register_peers_from_env()
+                peer_map = registered_peers()
+        # never re-fetch the dumped process itself
+        peer_map = {
+            name: addr for name, addr in peer_map.items()
+            if addr != metrics
         }
+        doc = stitch_dumps(
+            doc, fetch_peer_dumps(peer_map, wave=wave), wave=wave
+        )
     if wave is not None:
         doc["spans"] = [s for s in doc["spans"] if s.get("wave") == wave]
         doc["waves"] = [w for w in doc["waves"] if w.get("wave") == wave]
     if summary:
         doc.pop("spans", None)
     return doc
+
+
+def cmd_trace_analyze(path: str, wave: Optional[int] = None) -> dict:
+    """The ``trace analyze`` verb: re-derive a flight-recorder record's
+    attribution from its raw spans, offline. ``wave`` picks the record
+    for that wave id (default: the newest record in the file); the
+    result carries the recomputed summary, an ``identical`` flag proving
+    the stitcher re-derives exactly what was recorded, and the rendered
+    attribution table."""
+    from .utils.tracing import analyze_record, load_flight_records
+
+    records = load_flight_records(path)
+    if not records:
+        raise ValueError(f"{path}: no flight records")
+    if wave is not None:
+        matching = [r for r in records if r.get("wave") == wave]
+        if not matching:
+            raise ValueError(f"{path}: no flight record for wave {wave}")
+        record = matching[-1]
+    else:
+        record = records[-1]
+    return analyze_record(record)
 
 
 #: the quota families `quota status` reads off the exposition — kept in
@@ -1459,9 +1531,26 @@ def main(argv: Optional[list[str]] = None) -> int:
             changed_only=args.changed_only,
         )
     if args.command == "trace":
+        if args.action == "analyze":
+            if not args.record:
+                print(json.dumps(
+                    {"error": "trace analyze needs a record path"}
+                ))
+                return 1
+            try:
+                doc = cmd_trace_analyze(args.record, wave=args.wave)
+            except Exception as exc:  # missing/corrupt record file
+                print(json.dumps({"error": str(exc)}))
+                return 1
+            table = doc.pop("table", "")
+            print(json.dumps(doc, indent=2))
+            if table:
+                print(table, file=sys.stderr)
+            return 0
         try:
             doc = cmd_trace_dump(
-                args.metrics, wave=args.wave, summary=args.summary
+                args.metrics, wave=args.wave, summary=args.summary,
+                stitch=args.stitch, peers=args.peers,
             )
         except Exception as exc:  # unreachable endpoint, bad JSON
             print(json.dumps({"error": str(exc)}))

@@ -789,7 +789,14 @@ class EstimatorRegistry:
         if self._pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
-            self._pool = ThreadPoolExecutor(max_workers)
+            from ..utils.tracing import ContextPropagatingExecutor
+
+            # context-propagating: ping/fetch tasks open their RPC spans
+            # under the refresh span that submitted them (estimator.rpc
+            # must not land in wave 0 on a bare pool thread)
+            self._pool = ContextPropagatingExecutor(
+                ThreadPoolExecutor(max_workers)
+            )
         return self._pool
 
     def _fetch(self, fetch, uniq, prof_keys, max_workers, remaining) -> None:

@@ -207,17 +207,35 @@ class EstimatorGrpcServer:
 
         # served-RPC accounting at the wire choke point (covers the single-
         # and multi-cluster services alike): the estimator PROCESS's
-        # /metrics answers with this family (ISSUE 6 c)
+        # /metrics answers with this family (ISSUE 6 c). Each handler
+        # records one ``estimator.serve`` span under the CALLER's wave —
+        # the trace context rides the invocation metadata (ISSUE 10)
         from ..utils.metrics import estimator_server_requests
+        from ..utils.tracing import decode_trace_metadata, tracer
+
+        def _ctx(context):
+            return decode_trace_metadata(context.invocation_metadata())
 
         def max_available(request: pb.MaxAvailableReplicasRequest, context):
             estimator_server_requests.inc(method="MaxAvailableReplicas")
-            resp = self._service.max_available_replicas(_pb_to_req(request))
+            with tracer.server_span(
+                "estimator.serve", _ctx(context),
+                method="MaxAvailableReplicas",
+            ):
+                resp = self._service.max_available_replicas(
+                    _pb_to_req(request)
+                )
             return pb.MaxAvailableReplicasResponse(max_replicas=resp.max_replicas)
 
         def unschedulable(request: pb.UnschedulableReplicasRequest, context):
             estimator_server_requests.inc(method="GetUnschedulableReplicas")
-            resp = self._service.get_unschedulable_replicas(_pb_to_unsched(request))
+            with tracer.server_span(
+                "estimator.serve", _ctx(context),
+                method="GetUnschedulableReplicas",
+            ):
+                resp = self._service.get_unschedulable_replicas(
+                    _pb_to_unsched(request)
+                )
             return pb.UnschedulableReplicasResponse(
                 unschedulable_replicas=resp.unschedulable_replicas
             )
@@ -226,16 +244,24 @@ class EstimatorGrpcServer:
             request: "bpb.MaxAvailableReplicasBatchRequest", context
         ):
             estimator_server_requests.inc(method="MaxAvailableReplicasBatch")
-            resp = self._service.max_available_replicas_batch(
-                _pb_to_batch(request)
-            )
+            with tracer.server_span(
+                "estimator.serve", _ctx(context),
+                method="MaxAvailableReplicasBatch",
+            ) as sp:
+                sp.attrs["rows"] = len(request.rows)
+                resp = self._service.max_available_replicas_batch(
+                    _pb_to_batch(request)
+                )
             return _batch_resp_to_pb(resp)
 
         def get_generations(request: "bpb.GetGenerationsRequest", context):
             estimator_server_requests.inc(method="GetGenerations")
-            return _gens_resp_to_pb(
-                self._service.get_generations(_pb_to_gens(request))
-            )
+            with tracer.server_span(
+                "estimator.serve", _ctx(context), method="GetGenerations",
+            ):
+                return _gens_resp_to_pb(
+                    self._service.get_generations(_pb_to_gens(request))
+                )
 
         handlers = {
             "MaxAvailableReplicas": grpc.unary_unary_rpc_method_handler(
@@ -386,6 +412,7 @@ class GrpcEstimatorConnection:
     def call(self, method: str, request):
         from ..utils.backoff import CircuitBreakerOpen
         from ..utils.faultinject import apply_fault, fault_point
+        from ..utils.tracing import trace_metadata, tracer
 
         if not self.breaker.allow():
             raise CircuitBreakerOpen(
@@ -393,12 +420,21 @@ class GrpcEstimatorConnection:
             )
         ok = False
         try:
-            apply_fault(
-                fault_point("estimator.rpc", f"{method}:{self.cluster}"),
-                "estimator.rpc", f"{method}:{self.cluster}",
-                channel=self._channel,
-            )
-            resp = self._call(method, request)
+            # ONE client span per wire attempt (a caller's retry opens a
+            # fresh span, so each server-side span re-parents under
+            # exactly one client span); the context is captured INSIDE
+            # the span so the server records under this span's id
+            with tracer.span(
+                "estimator.rpc", remote=True, peer=self.target,
+                cluster=self.cluster, method=method,
+            ):
+                md = trace_metadata(tracer.current_context())
+                apply_fault(
+                    fault_point("estimator.rpc", f"{method}:{self.cluster}"),
+                    "estimator.rpc", f"{method}:{self.cluster}",
+                    channel=self._channel,
+                )
+                resp = self._call(method, request, md)
             ok = True
             return resp
         except UnsupportedMethodError:
@@ -418,18 +454,26 @@ class GrpcEstimatorConnection:
             (self.breaker.record_success if ok
              else self.breaker.record_failure)()
 
-    def _call(self, method: str, request):
+    def _call(self, method: str, request, metadata=()):
         if method == "MaxAvailableReplicas":
-            resp = self._max_available(_req_to_pb(request), timeout=self.timeout)
+            resp = self._max_available(
+                _req_to_pb(request), timeout=self.timeout, metadata=metadata
+            )
             return MaxAvailableReplicasResponse(max_replicas=resp.max_replicas)
         if method == "GetUnschedulableReplicas":
-            resp = self._unschedulable(_unsched_to_pb(request), timeout=self.timeout)
+            resp = self._unschedulable(
+                _unsched_to_pb(request), timeout=self.timeout,
+                metadata=metadata,
+            )
             return UnschedulableReplicasResponse(
                 unschedulable_replicas=resp.unschedulable_replicas
             )
         if method == "MaxAvailableReplicasBatch":
             try:
-                resp = self._batch(_batch_to_pb(request), timeout=self.timeout)
+                resp = self._batch(
+                    _batch_to_pb(request), timeout=self.timeout,
+                    metadata=metadata,
+                )
             except grpc.RpcError as exc:
                 if exc.code() == grpc.StatusCode.UNIMPLEMENTED:
                     raise self._unimplemented(method, exc) from exc
@@ -439,7 +483,8 @@ class GrpcEstimatorConnection:
         if method == "GetGenerations":
             try:
                 resp = self._generations(
-                    _gens_to_pb(request), timeout=self.timeout
+                    _gens_to_pb(request), timeout=self.timeout,
+                    metadata=metadata,
                 )
             except grpc.RpcError as exc:
                 if exc.code() == grpc.StatusCode.UNIMPLEMENTED:
@@ -457,6 +502,7 @@ class GrpcEstimatorConnection:
         if method == "MaxAvailableReplicas":
             from ..utils.backoff import CircuitBreakerOpen
             from ..utils.faultinject import apply_fault, fault_point
+            from ..utils.tracing import TraceContext, trace_metadata, tracer
 
             # non-consuming breaker gate (engaged(), not allow()): futures
             # resolve off-thread, so outcomes feed the breaker via a done
@@ -465,21 +511,40 @@ class GrpcEstimatorConnection:
                 raise CircuitBreakerOpen(
                     f"estimator {self.target} breaker is open"
                 )
-            apply_fault(
-                fault_point(
-                    "estimator.rpc", f"{method}:{self.cluster}:future"
-                ),
-                "estimator.rpc", f"{method}:{self.cluster}",
-                channel=self._channel,
+            # the in-flight window closes from the grpc done callback (on
+            # another thread), so the client span is MANUAL — and the
+            # propagated context names the manual span itself, so the
+            # server span re-parents under the attempt that carried it
+            sp = tracer.open_manual(
+                "estimator.rpc", remote=True, peer=self.target,
+                cluster=self.cluster, method=method,
             )
-            fut = self._max_available.future(
-                _req_to_pb(request), timeout=self.timeout
-            )
+            md = trace_metadata(TraceContext(
+                wave=sp.wave, trace_id=sp.trace_id, span_id=sp.span_id,
+                proc=tracer.proc,
+            ))
+            try:
+                apply_fault(
+                    fault_point(
+                        "estimator.rpc", f"{method}:{self.cluster}:future"
+                    ),
+                    "estimator.rpc", f"{method}:{self.cluster}",
+                    channel=self._channel,
+                )
+                fut = self._max_available.future(
+                    _req_to_pb(request), timeout=self.timeout, metadata=md
+                )
+            except BaseException:
+                tracer.close_manual(sp)
+                raise
             fut.add_done_callback(
                 lambda f: (
-                    self.breaker.record_failure()
-                    if (not f.cancelled() and f.exception() is not None)
-                    else self.breaker.record_success()
+                    tracer.close_manual(sp),
+                    (
+                        self.breaker.record_failure()
+                        if (not f.cancelled() and f.exception() is not None)
+                        else self.breaker.record_success()
+                    ),
                 )
             )
             return fut

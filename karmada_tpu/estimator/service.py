@@ -291,6 +291,16 @@ class EstimatorConnection:
         self._service = service
 
     def call(self, method: str, request):
+        # the in-proc seam records the SAME server-side span the gRPC
+        # handlers do (trace shape is transport-independent); the caller
+        # shares the process, so it nests under the caller's open span
+        # directly — no metadata, no remote_parent, no network column
+        from ..utils.tracing import tracer
+
+        with tracer.server_span("estimator.serve", None, method=method):
+            return self._dispatch(method, request)
+
+    def _dispatch(self, method: str, request):
         if method == "MaxAvailableReplicas":
             return self._service.max_available_replicas(request)
         if method == "GetUnschedulableReplicas":
@@ -338,12 +348,16 @@ class EstimatorClientPool:
         # bounded shared executor for the fan-out: a raw Thread per cluster
         # per query (the previous shape) costs a ~8 MiB stack + spawn each
         # at thousands of members; the executor spawns lazily up to the
-        # bound and reuses threads across passes
+        # bound and reuses threads across passes. Context-propagating: the
+        # per-cluster RPC spans must land in the wave that fanned out, not
+        # in wave 0 on a bare pool thread
         from concurrent.futures import ThreadPoolExecutor
 
-        self._executor = ThreadPoolExecutor(
+        from ..utils.tracing import ContextPropagatingExecutor
+
+        self._executor = ContextPropagatingExecutor(ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="estimator-fanout"
-        )
+        ))
 
     def connection(self, cluster: str) -> Optional[EstimatorConnection]:
         with self._lock:

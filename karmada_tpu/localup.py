@@ -38,12 +38,16 @@ import time
 from typing import Optional
 
 
-def spawn_child(cmd: list[str], platform: str = "cpu") -> subprocess.Popen:
+def spawn_child(
+    cmd: list[str], platform: str = "cpu", extra_env: dict | None = None
+) -> subprocess.Popen:
     """Spawn a component child process: ``platform`` selects its jax
     backend (default CPU — control-plane components must never dial the
     accelerator), package importable regardless of the caller's cwd.
     Shared by LocalUp and the process operator — one copy of the env
-    construction.
+    construction. ``extra_env`` overlays the inherited environment (the
+    orchestrator hands the plane child its peers' trace endpoints this
+    way).
 
     The accelerator is SINGLE-CLIENT: exactly one component per machine
     may run with a non-cpu platform (deployment-wise that is the solver
@@ -53,7 +57,8 @@ def spawn_child(cmd: list[str], platform: str = "cpu") -> subprocess.Popen:
     programmatically, so each child entrypoint re-asserts the policy via
     utils.platform.apply_child_platform()."""
     env = dict(
-        os.environ, JAX_PLATFORMS=platform, KARMADA_TPU_PLATFORM=platform
+        os.environ, JAX_PLATFORMS=platform, KARMADA_TPU_PLATFORM=platform,
+        **(extra_env or {}),
     )
     if platform != "cpu":
         # the test harness exports --xla_force_host_platform_device_count
@@ -159,6 +164,10 @@ def serve_plane_replica(args) -> None:
     from .utils.member import MemberCluster
     from .utils.metrics import MetricsServer
     from .utils.net import parse_hostport as addr
+    from .utils.tracing import register_peers_from_env, tracer
+
+    tracer.set_process("plane")
+    register_peers_from_env()
 
     replica = StoreReplica(args.connect_bus)
     replica.start()
@@ -284,6 +293,10 @@ def serve_plane(args) -> None:
     from .search.proxyserver import ClusterProxyServer
     from .utils.builders import new_cluster
     from .utils.metrics import MetricsServer
+    from .utils.tracing import register_peers_from_env, tracer
+
+    tracer.set_process("plane")
+    register_peers_from_env()
 
     if args.feature_gates:
         from .utils.features import feature_gate
@@ -501,9 +514,10 @@ class LocalUp:
         self.endpoints: dict[str, int] = {}
 
     def _spawn(
-        self, name: str, cmd: list[str], platform: str = "cpu"
+        self, name: str, cmd: list[str], platform: str = "cpu",
+        extra_env: dict | None = None,
     ) -> subprocess.Popen:
-        proc = spawn_child(cmd, platform=platform)
+        proc = spawn_child(cmd, platform=platform, extra_env=extra_env)
         self.procs[name] = proc
         return proc
 
@@ -521,7 +535,7 @@ class LocalUp:
                 solver_cmd = [
                     py, "-m", "karmada_tpu.solver", "--address",
                     "127.0.0.1:0", "--report-backend",
-                    "--backend-timeout", "90",
+                    "--backend-timeout", "90", "--metrics-port", "0",
                 ]
                 if self.warmup_manifest is not None:
                     # an explicit "" propagates as the child's opt-out
@@ -532,6 +546,9 @@ class LocalUp:
                         "solver", solver_cmd, platform=self.solver_platform,
                     )
                     self.endpoints["solver"] = _scrape_port(p, r"port (\d+)")
+                    self.endpoints["solver_metrics"] = _scrape_port(
+                        p, r"metrics listening on port (\d+)"
+                    )
                     self.solver_backend = scrape_line(
                         p, r"solver backend (\S+)", timeout=150.0
                     )
@@ -563,9 +580,26 @@ class LocalUp:
                 p = self._spawn(
                     "estimator",
                     [py, "-m", "karmada_tpu.estimator", "--cluster", "member1",
-                     "--address", "127.0.0.1:0"],
+                     "--address", "127.0.0.1:0", "--metrics-port", "0"],
                 )
                 self.endpoints["estimator"] = _scrape_port(p, r"port (\d+)")
+                self.endpoints["estimator_metrics"] = _scrape_port(
+                    p, r"metrics listening on port (\d+)"
+                )
+
+            # the plane child learns where to stitch cross-process traces
+            # from: every spawned peer's metrics endpoint, exported as
+            # KARMADA_TPU_TRACE_PEERS (utils.tracing boot hook)
+            peer_specs = [
+                f"{name.removesuffix('_metrics')}=127.0.0.1:{port}"
+                for name, port in self.endpoints.items()
+                if name.endswith("_metrics")
+            ]
+            plane_env = (
+                {"KARMADA_TPU_TRACE_PEERS": ",".join(peer_specs)}
+                if peer_specs
+                else None
+            )
 
             plane_cmd = [
                 py, "-m", "karmada_tpu.localup", "serve",
@@ -587,7 +621,7 @@ class LocalUp:
                 plane_cmd += ["--feature-gates", self.feature_gates]
             if self.warmup_manifest is not None:
                 plane_cmd += ["--warmup-manifest", self.warmup_manifest]
-            p = self._spawn("plane", plane_cmd)
+            p = self._spawn("plane", plane_cmd, extra_env=plane_env)
             deadline = time.time() + 240
             while time.time() < deadline:
                 line = p.stdout.readline()

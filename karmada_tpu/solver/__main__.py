@@ -43,12 +43,27 @@ def main(argv=None) -> None:
         "(0 = ephemeral, printed as 'metrics listening on port N'; "
         "default: $KARMADA_TPU_METRICS_PORT, empty = disabled)",
     )
+    p.add_argument(
+        "--estimator", action="append", default=[],
+        help="NAME=HOST:PORT of an accurate-estimator server for cluster "
+        "NAME (repeatable; same HOST:PORT shares one channel): the "
+        "sidecar's engines min-merge live estimator answers into "
+        "availability exactly like the in-proc plane does (localup serve "
+        "--estimator) — the estimator channel moves WITH the engine when "
+        "scheduling moves into the sidecar",
+    )
     args = p.parse_args(argv)
     # chaos: arm deterministic fault injection from the environment
     # (KARMADA_TPU_FAULT_SPEC; disarmed when empty — zero overhead)
     from ..utils.faultinject import arm_from_env
+    from ..utils.tracing import register_peers_from_env, tracer
 
     arm_from_env()
+    # cross-process tracing: this process's spans export as proc="solver"
+    # (the stitcher keys on it) and any configured peers register for
+    # stitched dumps taken FROM this process
+    tracer.set_process("solver")
+    register_peers_from_env()
 
     def read(path):
         return open(path, "rb").read() if path else None
@@ -80,14 +95,71 @@ def main(argv=None) -> None:
         from ..scheduler.prewarm import TraceManifest
 
         manifest = TraceManifest(manifest_path)
-        service = SolverService(
-            engine_factory=lambda snap: TensorScheduler(
-                snap, trace_manifest=manifest
-            )
-        )
+
+        def base_factory(snap):
+            return TensorScheduler(snap, trace_manifest=manifest)
     else:
+        from ..scheduler import TensorScheduler
+
         manifest = None
-        service = SolverService()
+        base_factory = TensorScheduler
+
+    est_registry = None
+    if args.estimator:
+        # estimator-aware sidecar: register a RemoteAccurateEstimator per
+        # named cluster (channels shared per target) and fold the live
+        # answers into every engine this service builds — the same
+        # min-merge the in-proc plane applies, now ON the process that
+        # actually solves, so the scheduler->solver->estimator chain is
+        # one stitched trace
+        from ..estimator.accurate import EstimatorRegistry
+        from ..estimator.grpc_transport import GrpcEstimatorConnection
+
+        est_registry = EstimatorRegistry()
+        svc_cell: list = []  # filled after SolverService construction
+
+        def engine_dims():
+            return list(svc_cell[0]._engine.snapshot.dims)
+
+        conns: dict = {}
+        from ..estimator.grpc_transport import RemoteAccurateEstimator
+
+        for spec in args.estimator:
+            name, _, target = spec.partition("=")
+            if not name or not target:
+                p.error(f"--estimator wants NAME=HOST:PORT, got {spec!r}")
+            conn = conns.get(target)
+            if conn is None:
+                conn = GrpcEstimatorConnection(name, target)
+                conns[target] = conn
+            est_registry.register(
+                RemoteAccurateEstimator(name, conn, engine_dims)
+            )
+
+        def engine_factory(snap):
+            eng = base_factory(snap)
+            eng.extra_estimators = [
+                est_registry.make_batch_estimator(list(snap.names))
+            ]
+            return eng
+    else:
+        engine_factory = base_factory
+
+    service = SolverService(engine_factory=engine_factory)
+    if est_registry is not None:
+        svc_cell.append(service)
+        # the sidecar has no member-event channel to invalidate the
+        # registry, so every solve revalidates it generation-gated (the
+        # PR 4 contract): one GetGenerations ping per server per pass,
+        # re-fetch only for clusters whose snapshot actually moved — a
+        # memoized answer can never go stale across passes
+        _score = service.score_and_assign
+
+        def score_with_revalidate(request):
+            est_registry.invalidate()
+            return _score(request)
+
+        service.score_and_assign = score_with_revalidate
 
     server = SolverGrpcServer(
         service,

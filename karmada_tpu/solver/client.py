@@ -18,6 +18,7 @@ import grpc
 from ..scheduler import BindingProblem
 from ..utils.backoff import CircuitBreakerOpen, Deadline, default_breaker
 from ..utils.faultinject import apply_fault, fault_point
+from ..utils.tracing import trace_metadata, tracer
 from .proto import solver_pb2 as pb
 from .service import SERVICE_NAME, cluster_to_state, encode_problems
 
@@ -53,6 +54,7 @@ class RemoteSolver:
                 "incomplete client TLS config: client_cert/client_key require "
                 "each other and root_ca"
             )
+        self.target = target
         opts = [("grpc.max_receive_message_length", 256 << 20),
                 ("grpc.max_send_message_length", 256 << 20)]
         if root_ca is not None:
@@ -105,13 +107,20 @@ class RemoteSolver:
             req.clusters.append(cluster_to_state(cl))
         ok = False
         try:
-            apply_fault(
-                fault_point("solver.rpc", "SyncClusters"),
-                "solver.rpc", "SyncClusters", channel=self._channel,
-            )
-            resp = self._sync(
-                req, timeout=self.timeout if timeout is None else timeout
-            )
+            with tracer.span(
+                "solver.rpc", remote=True, peer=self.target,
+                method="SyncClusters",
+            ):
+                md = trace_metadata(tracer.current_context())
+                apply_fault(
+                    fault_point("solver.rpc", "SyncClusters"),
+                    "solver.rpc", "SyncClusters", channel=self._channel,
+                )
+                resp = self._sync(
+                    req,
+                    timeout=self.timeout if timeout is None else timeout,
+                    metadata=md,
+                )
             ok = True
         finally:
             # every admitted call records its outcome: a half-open probe
@@ -140,13 +149,27 @@ class RemoteSolver:
         req = encode_problems(problems)
         req.snapshot_version = self._version
         ok = False
+
+        def score_attempt(attempt: int):
+            # one client span per WIRE attempt: a retried RPC is two
+            # spans, so each server-side ``solver.solve`` span re-parents
+            # under exactly one attempt — never under two parents
+            with tracer.span(
+                "solver.rpc", remote=True, peer=self.target,
+                method="ScoreAndAssign", attempt=attempt,
+            ):
+                md = trace_metadata(tracer.current_context())
+                return self._score(
+                    req, timeout=deadline.attempt_timeout(), metadata=md
+                )
+
         try:
             apply_fault(
                 fault_point("solver.rpc", "ScoreAndAssign"),
                 "solver.rpc", "ScoreAndAssign", channel=self._channel,
             )
             try:
-                resp = self._score(req, timeout=deadline.attempt_timeout())
+                resp = score_attempt(1)
             except grpc.RpcError as e:
                 if (
                     e.code() == grpc.StatusCode.FAILED_PRECONDITION
@@ -161,9 +184,7 @@ class RemoteSolver:
                         check_breaker=False,
                     )
                     req.snapshot_version = self._version
-                    resp = self._score(
-                        req, timeout=deadline.attempt_timeout()
-                    )
+                    resp = score_attempt(2)
                 else:
                     raise
             ok = True
@@ -236,8 +257,15 @@ class HASolver:
 
         results: list = [None] * len(self._solvers)
         errs: list = [None] * len(self._solvers)
+        # fan-out threads inherit the caller's trace context so each
+        # backend's solver.rpc span lands in the wave that synced
+        ctx = tracer.current_context()
 
         def one(i: int) -> None:
+            with tracer.activate(ctx):
+                return _one(i)
+
+        def _one(i: int) -> None:
             try:
                 results[i] = self._solvers[i].sync_clusters(
                     clusters,

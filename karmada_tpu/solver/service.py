@@ -259,23 +259,42 @@ class SolverGrpcServer:
         )
 
         # served-RPC accounting: the sidecar PROCESS's /metrics answers
-        # with this family (ISSUE 6 c)
+        # with this family (ISSUE 6 c). Handlers record ``solver.sync`` /
+        # ``solver.solve`` spans under the CALLER's wave (trace context
+        # decoded from the invocation metadata, ISSUE 10) — the engine's
+        # own scheduler.solve / kernel.* spans nest inside solver.solve,
+        # so the sidecar's kernel attribution stitches into the plane's
+        # wave tree
         from ..utils.metrics import solver_requests
+        from ..utils.tracing import decode_trace_metadata, tracer
+
+        def _ctx(context):
+            return decode_trace_metadata(context.invocation_metadata())
 
         def sync(request: pb.SyncClustersRequest, context):
             solver_requests.inc(method="SyncClusters")
-            version = self._service.sync_clusters(
-                [state_to_cluster(m) for m in request.clusters],
-                request.snapshot_version,
-            )
+            with tracer.server_span(
+                "solver.sync", _ctx(context),
+                clusters=len(request.clusters),
+            ):
+                version = self._service.sync_clusters(
+                    [state_to_cluster(m) for m in request.clusters],
+                    request.snapshot_version,
+                )
             return pb.SyncClustersResponse(snapshot_version=version)
 
         def score(request: pb.ScoreAndAssignRequest, context):
             solver_requests.inc(method="ScoreAndAssign")
-            try:
-                return self._service.score_and_assign(request)
-            except StaleSnapshotError as e:
-                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            with tracer.server_span(
+                "solver.solve", _ctx(context), rows=len(request.problems),
+            ) as sp:
+                try:
+                    return self._service.score_and_assign(request)
+                except StaleSnapshotError as e:
+                    sp.attrs["error"] = "stale_snapshot"
+                    context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION, str(e)
+                    )
 
         handlers = {
             "SyncClusters": grpc.unary_unary_rpc_method_handler(

@@ -175,6 +175,47 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "Must divide KARMADA_TPU_MESH_DEVICES.",
         ),
         EnvFlag(
+            "KARMADA_TPU_TRACE_CAPACITY", "8192",
+            "Span capacity of the wave-trace ring "
+            "(utils.tracing.WaveTracer): 1M-tier storms outgrow the "
+            "default and spans silently aging off the ring degrade "
+            "wave_summary coverage — evictions are counted "
+            "(karmada_tpu_trace_spans_dropped_total + the `dropped` "
+            "field of /debug/traces) so the operator sees when to raise "
+            "it. Read once at tracer construction.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_TRACE_SLO_SECONDS", "",
+            "Arms the slow-wave flight recorder (utils.tracing): a "
+            "closing wave whose wall exceeds this many seconds — or "
+            "during which a breaker transition, degraded pass or "
+            "QuotaExceeded denial fired — persists its stitched trace + "
+            "metrics delta + fired-fault log as one JSONL record under "
+            "KARMADA_TPU_FLIGHT_DIR. Empty (the default) disarms the "
+            "recorder entirely: one env read per wave boundary, nothing "
+            "per span.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_FLIGHT_DIR", "<tmp>/karmada_tpu_flight",
+            "Directory the flight recorder appends flight.jsonl under "
+            "(ring-capped on disk; `karmadactl-tpu trace analyze` "
+            "re-renders a record's attribution offline).",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_FLIGHT_CAP", "64",
+            "Maximum flight-recorder records kept in flight.jsonl "
+            "(oldest dropped first).",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_TRACE_PEERS", "",
+            "Comma-separated `name=host:port` metrics endpoints of the "
+            "plane's peer processes (solver sidecar, estimator servers, "
+            "store bus) for the cross-process trace stitcher; parsed at "
+            "process boot by utils.tracing.register_peers_from_env. "
+            "`trace dump --stitch`, wave_summary(stitched=True) and the "
+            "flight recorder pull /debug/traces from every entry.",
+        ),
+        EnvFlag(
             "KARMADA_TPU_QUOTA_ENFORCEMENT", "1",
             "FederatedResourceQuota admission in the scheduler "
             "(controllers.scheduler_controller): set to 0 to disable the "

@@ -92,6 +92,11 @@ class Counter:
         with self._lock:
             return dict(self._values)
 
+    def snapshot(self) -> dict[str, float]:
+        """JSON-stable samples (label string -> value) — the flight
+        recorder's metrics-delta surface."""
+        return {_label_str(k): v for k, v in self.samples().items()}
+
     def render(self) -> Iterable[str]:
         if self.help:
             yield _help_line(self.name, self.help)
@@ -126,6 +131,10 @@ class Gauge:
     def value(self, **labels) -> float:
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return {_label_str(k): v for k, v in self._values.items()}
 
     def remove_matching(self, **labels) -> None:
         """Drop every sample whose label set CONTAINS these pairs — the
@@ -174,6 +183,17 @@ class Histogram:
             yield
         finally:
             self.observe(time.perf_counter() - start, **labels)
+
+    def snapshot(self) -> dict[str, dict]:
+        """{label string: {count, sum}} — buckets are derivable and the
+        flight recorder's delta only needs the two scalars."""
+        with self._lock:
+            return {
+                _label_str(k): {
+                    "count": self._totals[k], "sum": self._sums[k]
+                }
+                for k in self._totals
+            }
 
     def summary(self, **labels) -> Optional[dict]:
         key = _label_key(labels)
@@ -230,6 +250,12 @@ class Registry:
         h = Histogram(name, help_, buckets)
         self._metrics.append(h)
         return h
+
+    def snapshot(self) -> dict[str, dict]:
+        """family name -> {label string: value | {count, sum}} across the
+        whole registry — the flight recorder snapshots it at wave open
+        and deltas it at wave close (utils.tracing.maybe_flight_record)."""
+        return {m.name: m.snapshot() for m in self._metrics}
 
     def families(self) -> list:
         """(name, type, help) per registered metric — the docs metric
@@ -389,6 +415,12 @@ quota_used = registry.gauge(
     "FederatedResourceQuota status.overall_used by namespace and "
     "resource, recomputed live from bound ResourceBindings",
 )
+trace_spans_dropped = registry.counter(
+    "karmada_tpu_trace_spans_dropped_total",
+    "wave-trace spans evicted off the tracer ring (one inc per "
+    "overwrite) — nonzero means wave_summary coverage is undercounting; "
+    "raise KARMADA_TPU_TRACE_CAPACITY for 1M-tier storms",
+)
 
 
 def render_families_table() -> str:
@@ -439,26 +471,39 @@ class MetricsServer:
                     ctype = "text/plain"
                 elif self.path.startswith("/debug/traces"):
                     import json
+                    from urllib.parse import parse_qs, urlsplit
 
-                    from .tracing import tracer
+                    from .tracing import trace_debug_doc
 
-                    # the scheduling-mesh shape rides the dump so `trace
-                    # dump` tells a single-chip from an 8-chip plane.
-                    # sys.modules-gated: a process that never imported
-                    # the mesh module has no mesh, and importing it here
-                    # would drag jax into lean processes (the bus)
-                    import sys as _sys
-
-                    pm = _sys.modules.get("karmada_tpu.parallel.mesh")
-                    mesh = (
-                        pm.active_mesh_shape() if pm is not None else None
-                    )
+                    # query contract: ?wave=N restricts to one wave,
+                    # ?summary=1 drops the raw span list. Malformed
+                    # values answer 400 — the stitcher must never
+                    # mistake a mis-filtered full dump for a wave dump
+                    qs = parse_qs(urlsplit(self.path).query)
+                    wave = None
+                    raw_wave = (qs.get("wave") or [None])[0]
+                    try:
+                        if raw_wave is not None:
+                            wave = int(raw_wave)
+                        summary = (qs.get("summary") or ["0"])[0] in (
+                            "1", "true", "yes",
+                        )
+                    except ValueError:
+                        body = json.dumps(
+                            {"error": f"bad wave={raw_wave!r}"}
+                        ).encode()
+                        self.send_response(400)
+                        self.send_header(
+                            "Content-Type", "application/json"
+                        )
+                        self.send_header(
+                            "Content-Length", str(len(body))
+                        )
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     body = json.dumps(
-                        {
-                            "mesh": mesh,
-                            "waves": tracer.wave_summaries(),
-                            "spans": tracer.dump(),
-                        }
+                        trace_debug_doc(wave, summary=summary)
                     ).encode()
                     ctype = "application/json"
                 else:

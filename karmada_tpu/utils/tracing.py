@@ -11,28 +11,156 @@ every instrumented region — controller drains, scheduler passes, fleet
 kernel phases, estimator refreshes — records a ``Span`` carrying that
 wave id plus a parent span id, so one storm wave reconstructs as a single
 tree attributing pack/solve/dispatch/render/status time. Spans live in a
-bounded ring (deque), are exported as JSON by ``MetricsServer``'s
+bounded ring, are exported as JSON by ``MetricsServer``'s
 ``/debug/traces`` endpoint and ``karmadactl-tpu trace dump``, and are
 summarized per-phase by ``wave_summary`` (the bench observability tier's
 record format).
 
+Cross-process propagation (ISSUE 10 tentpole): every wave mints a
+plane-unique ``trace_id``; the three transport seams (estimator, solver,
+bus) stamp ``(wave, trace_id, client span id, caller process)`` into gRPC
+metadata on each RPC, and the serving process records its handler spans
+(``estimator.serve``, ``solver.solve``, ``bus.apply``...) under the
+CALLER's wave/trace with the caller's span id as ``remote_parent`` — so a
+storm wave's trace no longer dies at a process boundary. The stitcher
+(``stitch_dumps`` / ``karmadactl-tpu trace dump --stitch``) pulls
+``/debug/traces`` from every registered peer's metrics port, merges by
+``(trace_id, wave)``, re-parents each remote root under its originating
+client span, and computes per-process and per-channel self-time columns —
+``client span − remote root`` per RPC is the network/serialization time
+no single-process view can produce.
+
+The slow-wave flight recorder rides ``end_wave()``: armed by
+``KARMADA_TPU_TRACE_SLO_SECONDS``, a closing wave whose wall exceeds the
+SLO — or during which a breaker transition, degraded pass or QuotaExceeded
+denial fired — persists the full stitched trace + a metrics-registry delta
++ the fired fault-injection log as one JSONL record under
+``KARMADA_TPU_FLIGHT_DIR`` (ring-capped on disk);
+``karmadactl-tpu trace analyze`` re-renders the attribution offline.
+
 Thread-safety: the completed-span ring, wave bookkeeping and summaries
 mutate/read under one lock; the OPEN-span parent chain is thread-local
-(each thread nests its own spans — a span never migrates threads).
+(each thread nests its own spans — a span never migrates threads), and an
+*ambient* thread-local context carries the wave/trace/parent triple onto
+executor threads (fan-out pools) and into server handlers.
 """
 
 from __future__ import annotations
 
 import itertools
+import json
 import logging
+import os
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Optional
 
 log = logging.getLogger("karmada_tpu.trace")
+
+#: env knobs (registered in utils.flags ENV_FLAGS)
+TRACE_CAPACITY_ENV = "KARMADA_TPU_TRACE_CAPACITY"
+TRACE_SLO_ENV = "KARMADA_TPU_TRACE_SLO_SECONDS"
+FLIGHT_DIR_ENV = "KARMADA_TPU_FLIGHT_DIR"
+FLIGHT_CAP_ENV = "KARMADA_TPU_FLIGHT_CAP"
+TRACE_PEERS_ENV = "KARMADA_TPU_TRACE_PEERS"
+
+_DEFAULT_CAPACITY = 8192
+_DEFAULT_FLIGHT_CAP = 64
+
+
+# --------------------------------------------------------------------------
+# span-name registry (graftlint GL008 + the docs span-taxonomy table)
+# --------------------------------------------------------------------------
+
+#: THE span taxonomy: every span name recorded anywhere in the import
+#: graph must appear here (graftlint GL008 enforces it — the stitcher's
+#: channel attribution and the generated docs table key on these names).
+#: A ``*`` suffix registers a dynamic family (``controller.<worker>``).
+SPAN_NAMES: dict[str, str] = {
+    "settle": "one run_until_settled drain — the wave's root span",
+    "controller.*": "one contiguous drain of one controller worker",
+    "scheduler.pass": "one engine pass over a queued binding batch",
+    "scheduler.pack": (
+        "host prologue of a pass: placement compile + spread selection + "
+        "eligibility partition"
+    ),
+    "scheduler.host": "host-path (non-fleet) scheduling of a batch",
+    "scheduler.solve": "one fleet-table solve pass",
+    "kernel.host": "kernel host phases: pack/upsert/sync/decode",
+    "kernel.dispatch": (
+        "kernel dispatch window (sync backends execute inside it; "
+        "compile=true on a fresh-trace pass)"
+    ),
+    "kernel.device": (
+        "fenced on-device execute window (compile=true when the pass "
+        "minted a fresh XLA trace)"
+    ),
+    "kernel.fetch": "post-device wire transfer + decode + entry folds",
+    "estimator.refresh": (
+        "one estimator-registry refresh: generation pings + grouped "
+        "profile fan-out"
+    ),
+    "estimator.rpc": (
+        "client side of one estimator-channel RPC (remote=true; "
+        "peer/method attrs)"
+    ),
+    "estimator.serve": (
+        "server side of one estimator RPC, recorded in the estimator "
+        "process under the CALLER's wave"
+    ),
+    "solver.rpc": "client side of one solver-sidecar RPC (remote=true)",
+    "solver.solve": (
+        "server side of ScoreAndAssign, recorded in the sidecar under "
+        "the caller's wave"
+    ),
+    "solver.sync": (
+        "server side of SyncClusters, recorded in the sidecar under the "
+        "caller's wave"
+    ),
+    "bus.rpc": "client side of one store-bus write-through RPC attempt",
+    "bus.apply": (
+        "server side of one bus Apply, recorded in the bus process under "
+        "the caller's wave"
+    ),
+    "bus.delete": "server side of one bus Delete",
+    "bus.watch": (
+        "server side of one Watch replay (list-then-watch initial sync), "
+        "up to the bookmark"
+    ),
+    "channel.breaker": (
+        "a circuit-breaker state transition (zero-duration marker span)"
+    ),
+}
+
+
+def span_name_registered(name: str) -> bool:
+    """True when ``name`` is in the taxonomy, directly or via a ``*``
+    family (``controller.scheduler`` matches ``controller.*``)."""
+    if name in SPAN_NAMES:
+        return True
+    return any(
+        name.startswith(k[:-1])
+        for k in SPAN_NAMES
+        if k.endswith("*")
+    )
+
+
+def render_span_table() -> str:
+    """The docs/OPERATIONS.md span-taxonomy table, generated from
+    ``SPAN_NAMES`` so prose can never drift from the registry the linter
+    and the stitcher enforce (tools/docs_from_bench.py writes it between
+    the spantaxonomy markers and fails loudly on drift)."""
+    lines = [
+        "| span | what it times |",
+        "|---|---|",
+    ]
+    for name in sorted(SPAN_NAMES):
+        lines.append(f"| `{name}` | {SPAN_NAMES[name]} |")
+    return "\n".join(lines)
 
 
 @dataclass
@@ -72,6 +200,74 @@ class Trace:
 
 
 # --------------------------------------------------------------------------
+# trace context + wire metadata
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """The propagated triple + the caller's process name: what crosses a
+    channel so the remote ``WaveTracer`` records under the caller's wave."""
+
+    wave: int
+    trace_id: str
+    span_id: Optional[int]
+    proc: str
+
+
+#: gRPC metadata keys carrying the context (lowercase per gRPC rules)
+MD_WAVE = "karmada-tpu-wave"
+MD_TRACE = "karmada-tpu-trace"
+MD_SPAN = "karmada-tpu-span"
+MD_PROC = "karmada-tpu-proc"
+
+
+def trace_metadata(ctx: Optional[TraceContext]) -> tuple:
+    """``ctx`` as gRPC invocation metadata pairs (empty when no context —
+    callers splice this into the stub call unconditionally)."""
+    if ctx is None or not ctx.trace_id:
+        return ()
+    return (
+        (MD_WAVE, str(ctx.wave)),
+        (MD_TRACE, ctx.trace_id),
+        (MD_SPAN, "" if ctx.span_id is None else str(ctx.span_id)),
+        (MD_PROC, ctx.proc),
+    )
+
+
+def decode_trace_metadata(pairs) -> Optional[TraceContext]:
+    """Decode a server handler's invocation metadata back to a context.
+    Tolerant: absent or malformed values answer None (an untraced caller
+    must never fail the RPC)."""
+    if not pairs:
+        return None
+    md = {}
+    try:
+        for k, v in pairs:
+            md[str(k).lower()] = v
+    except (TypeError, ValueError):
+        return None
+    trace_id = md.get(MD_TRACE, "")
+    if not trace_id:
+        return None
+    try:
+        wave = int(md.get(MD_WAVE, "0") or 0)
+    except ValueError:
+        return None
+    raw_span = md.get(MD_SPAN, "")
+    span_id: Optional[int] = None
+    if raw_span:
+        try:
+            span_id = int(raw_span)
+        except ValueError:
+            return None
+    return TraceContext(
+        wave=wave, trace_id=str(trace_id), span_id=span_id,
+        proc=str(md.get(MD_PROC, "") or "peer"),
+    )
+
+
+# --------------------------------------------------------------------------
 # wave-scoped span tracing
 # --------------------------------------------------------------------------
 
@@ -90,6 +286,7 @@ class Span:
     wall: float  # time.time at open (absolute anchor for exports)
     end: Optional[float] = None
     attrs: dict = field(default_factory=dict)
+    trace_id: str = ""
 
     @property
     def duration(self) -> float:
@@ -101,11 +298,24 @@ class Span:
             "wave": self.wave,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
+            "trace_id": self.trace_id,
             "start": round(self.start, 6),
             "wall": round(self.wall, 6),
             "duration_s": round(self.duration, 6),
             "attrs": dict(self.attrs),
         }
+
+
+def _env_capacity() -> int:
+    raw = os.environ.get(TRACE_CAPACITY_ENV, "").strip()
+    if not raw:
+        return _DEFAULT_CAPACITY
+    try:
+        return max(int(raw), 16)
+    except ValueError:
+        log.warning("bad %s=%r; using %d", TRACE_CAPACITY_ENV, raw,
+                    _DEFAULT_CAPACITY)
+        return _DEFAULT_CAPACITY
 
 
 class WaveTracer:
@@ -115,12 +325,19 @@ class WaveTracer:
     (the detector stamps one per user-event burst; ``run_until_settled``
     stamps one for any other work source) and ``end_wave()`` closes it
     when the plane reaches quiescence — so one storm, however triggered,
-    is one wave id across every controller it touches."""
+    is one wave id across every controller it touches. Every wave mints a
+    plane-unique ``trace_id``; spans stamp (wave, trace_id) ONCE at open,
+    under the lock — a span opened before ``end_wave()`` but closed after
+    stays attributed to the wave it opened under, never to a since-reused
+    id."""
 
-    def __init__(self, capacity: int = 8192):
-        self.capacity = capacity
+    def __init__(self, capacity: Optional[int] = None):
+        # capacity: explicit argument wins; else KARMADA_TPU_TRACE_CAPACITY
+        # (the 1M-tier storms outgrow the 8192 default — evictions are
+        # counted, never silent)
+        self.capacity = _env_capacity() if capacity is None else capacity
         self._lock = threading.Lock()
-        self._spans: deque[Span] = deque(maxlen=capacity)
+        self._spans: deque[Span] = deque()
         self._wave_seq = itertools.count(1)
         self._span_seq = itertools.count(1)
         self._local = threading.local()
@@ -128,6 +345,22 @@ class WaveTracer:
         self._wave_open = False
         self._wave_reason = ""
         self._wave_started = 0.0
+        #: process name stamped on exports + propagated in metadata (the
+        #: stitcher keys processes on it); entrypoints override via
+        #: set_process ("solver", "estimator", "bus", "agent")
+        self.proc = "plane"
+        # wave -> trace_id (bounded: old waves age out with the ring)
+        self._trace_ids: dict[int, str] = {}
+        # ring-eviction accounting (ISSUE 10 satellite): total + per-wave
+        self._dropped_total = 0
+        self._dropped_by_wave: dict[int, int] = {}
+        self._dropped_counter = None  # lazy karmada_tpu_trace_spans_dropped
+        # flight-recorder baseline captured at begin_wave when armed
+        self._flight_baseline: Optional[dict] = None
+
+    def set_process(self, name: str) -> None:
+        with self._lock:
+            self.proc = name
 
     # -- waves -------------------------------------------------------------
 
@@ -138,11 +371,18 @@ class WaveTracer:
         self._wave_open = True
         self._wave_reason = reason
         self._wave_started = time.perf_counter()
+        self._trace_ids[self.current_wave] = uuid.uuid4().hex[:16]
+        if len(self._trace_ids) > 512:
+            for w in sorted(self._trace_ids)[:-256]:
+                del self._trace_ids[w]
+                self._dropped_by_wave.pop(w, None)
         return self.current_wave
 
     def begin_wave(self, reason: str = "") -> int:
         with self._lock:
-            return self._begin_wave_locked(reason)
+            wave = self._begin_wave_locked(reason)
+        self._flight_begin(wave)
+        return wave
 
     def ensure_wave(self, reason: str = "") -> int:
         # ONE critical section for check-and-open: two threads racing
@@ -151,11 +391,49 @@ class WaveTracer:
         with self._lock:
             if self._wave_open:
                 return self.current_wave
-            return self._begin_wave_locked(reason)
+            wave = self._begin_wave_locked(reason)
+        self._flight_begin(wave)
+        return wave
 
-    def end_wave(self) -> None:
+    def end_wave(self) -> int:
+        """Close the open wave and return its id — the flight recorder
+        (and tests) key on the CLOSED id, not on whatever wave is current
+        by the time they run."""
         with self._lock:
+            closed = self.current_wave
+            was_open = self._wave_open
             self._wave_open = False
+        if was_open:
+            try:
+                maybe_flight_record(self, closed)
+            except Exception as exc:  # noqa: BLE001 — the recorder must
+                # never abort a settle; a broken disk loses the record,
+                # not the wave
+                log.warning("flight recorder failed: %s", exc)
+        return closed
+
+    def wave_trace_id(self, wave: Optional[int] = None) -> str:
+        with self._lock:
+            if wave is None:
+                wave = self.current_wave
+            return self._trace_ids.get(wave, "")
+
+    # -- flight-recorder baseline -----------------------------------------
+
+    def _flight_begin(self, wave: int) -> None:
+        """Capture the metrics/fault baseline for ``wave`` when the flight
+        recorder is armed (KARMADA_TPU_TRACE_SLO_SECONDS set). Disarmed —
+        the default — this is one env read per WAVE, nothing per span."""
+        if flight_slo() is None:
+            return
+        baseline = flight_baseline(wave)
+        with self._lock:
+            self._flight_baseline = baseline
+
+    def flight_baseline_for(self, wave: int) -> Optional[dict]:
+        with self._lock:
+            b = self._flight_baseline
+        return b if (b is not None and b.get("wave") == wave) else None
 
     # -- spans -------------------------------------------------------------
 
@@ -166,22 +444,134 @@ class WaveTracer:
             self._local.stack = stack
         return stack
 
+    def _open_ctx(self) -> tuple[int, str, Optional[int]]:
+        """(wave, trace_id, parent span id) for a span opening NOW on this
+        thread: innermost open span wins, then the thread's ambient
+        context (executor tasks / server handlers), then the process-wide
+        current wave — read under the lock, stamped exactly once."""
+        stack = self._stack()
+        if stack:
+            top = stack[-1]
+            return top.wave, top.trace_id, top.span_id
+        amb = getattr(self._local, "ambient", None)
+        if amb is not None:
+            return amb.wave, amb.trace_id, amb.span_id
+        with self._lock:
+            return (
+                self.current_wave,
+                self._trace_ids.get(self.current_wave, ""),
+                None,
+            )
+
+    def current_context(self) -> TraceContext:
+        """The context a CLIENT seam propagates: the innermost open span
+        (or ambient context) of this thread, else the current wave."""
+        wave, trace_id, parent = self._open_ctx()
+        return TraceContext(
+            wave=wave, trace_id=trace_id, span_id=parent, proc=self.proc
+        )
+
+    @contextmanager
+    def activate(self, ctx: Optional[TraceContext]):
+        """Install ``ctx`` as this thread's ambient context: spans opened
+        with no local parent nest under ``ctx.span_id``'s wave/trace.
+        THE cross-thread propagation primitive — fan-out executors capture
+        ``current_context()`` before submit and activate it in the task."""
+        if ctx is None:
+            yield
+            return
+        prev = getattr(self._local, "ambient", None)
+        self._local.ambient = ctx
+        try:
+            yield
+        finally:
+            self._local.ambient = prev
+
+    def _append(self, sp: Span) -> None:
+        """Ring append with counted eviction (called with the lock NOT
+        held)."""
+        dropped: Optional[Span] = None
+        with self._lock:
+            if len(self._spans) >= self.capacity:
+                dropped = self._spans.popleft()
+                self._dropped_total += 1
+                self._dropped_by_wave[dropped.wave] = (
+                    self._dropped_by_wave.get(dropped.wave, 0) + 1
+                )
+            self._spans.append(sp)
+        if dropped is not None:
+            counter = self._dropped_counter
+            if counter is None:
+                # lazy: utils.metrics is stdlib-only but the tracer must
+                # stay importable before/without the registry
+                from .metrics import trace_spans_dropped as counter
+
+                self._dropped_counter = counter
+            counter.inc()
+
+    def _new_span(
+        self,
+        name: str,
+        wave: int,
+        trace_id: str,
+        parent_id: Optional[int],
+        attrs: dict,
+        *,
+        start: Optional[float] = None,
+        end: Optional[float] = None,
+    ) -> Span:
+        now = time.perf_counter()
+        start = now if start is None else start
+        return Span(
+            name=name,
+            wave=wave,
+            span_id=next(self._span_seq),
+            parent_id=parent_id,
+            start=start,
+            wall=time.time() - (now - start),
+            end=end,
+            attrs=attrs,
+            trace_id=trace_id,
+        )
+
     @contextmanager
     def span(self, name: str, **attrs):
         """Record a span under the current wave, nested under this
-        thread's innermost open span. Yields the ``Span`` so callers can
-        stamp attrs (``kind="device"``, ``compile=True``) mid-flight."""
+        thread's innermost open span (or ambient context). Yields the
+        ``Span`` so callers can stamp attrs (``kind="device"``,
+        ``compile=True``) mid-flight."""
+        wave, trace_id, parent = self._open_ctx()
+        with self._span_at(name, wave, trace_id, parent, dict(attrs)) as sp:
+            yield sp
+
+    @contextmanager
+    def server_span(self, name: str, ctx: Optional[TraceContext], **attrs):
+        """The SERVER half of context propagation: record a handler span
+        under the CALLER's wave/trace. A remote caller's span id cannot be
+        a local parent (ids are per-process), so it lands in
+        ``remote_parent`` (+ ``caller``) for the stitcher to re-parent;
+        an in-process caller (same ``proc``) just nests naturally."""
+        if ctx is None or ctx.proc == self.proc:
+            with self.span(name, **attrs) as sp:
+                yield sp
+            return
+        attrs = dict(attrs)
+        attrs["remote_parent"] = ctx.span_id
+        attrs["caller"] = ctx.proc
+        with self._span_at(name, ctx.wave, ctx.trace_id, None, attrs) as sp:
+            yield sp
+
+    @contextmanager
+    def _span_at(
+        self,
+        name: str,
+        wave: int,
+        trace_id: str,
+        parent: Optional[int],
+        attrs: dict,
+    ):
+        sp = self._new_span(name, wave, trace_id, parent, attrs)
         stack = self._stack()
-        parent = stack[-1].span_id if stack else None
-        sp = Span(
-            name=name,
-            wave=self.current_wave,
-            span_id=next(self._span_seq),
-            parent_id=parent,
-            start=time.perf_counter(),
-            wall=time.time(),
-            attrs=dict(attrs),
-        )
         stack.append(sp)
         try:
             yield sp
@@ -191,30 +581,55 @@ class WaveTracer:
             # a span the caller marked _discard never reaches the ring
             # (speculative spans around drains that turned out empty)
             if not sp.attrs.pop("_discard", False):
-                with self._lock:
-                    self._spans.append(sp)
+                self._append(sp)
 
     def record(self, name: str, duration: float, **attrs) -> Span:
         """Append an already-measured region as a COMPLETED span (ending
         now), nested under this thread's innermost open span — for code
         that times its phases with perf_counter deltas (the fleet pass
         breakdown) rather than nesting context managers."""
-        stack = self._stack()
-        parent = stack[-1].span_id if stack else None
+        wave, trace_id, parent = self._open_ctx()
         now = time.perf_counter()
-        sp = Span(
-            name=name,
-            wave=self.current_wave,
-            span_id=next(self._span_seq),
-            parent_id=parent,
-            start=now - duration,
-            wall=time.time() - duration,
-            end=now,
-            attrs=dict(attrs),
+        sp = self._new_span(
+            name, wave, trace_id, parent, dict(attrs),
+            start=now - duration, end=now,
         )
-        with self._lock:
-            self._spans.append(sp)
+        self._append(sp)
         return sp
+
+    def open_manual(
+        self, name: str, ctx: Optional[TraceContext] = None, **attrs
+    ) -> Span:
+        """Allocate an OPEN span without pushing it on this thread's
+        stack — for in-flight windows that close on another thread (the
+        pipelined ``call_future`` seam closes its client span from the
+        grpc done callback). Close with ``close_manual``; until then the
+        span is not in the ring."""
+        if ctx is None:
+            wave, trace_id, parent = self._open_ctx()
+        else:
+            wave, trace_id, parent = ctx.wave, ctx.trace_id, ctx.span_id
+        return self._new_span(name, wave, trace_id, parent, dict(attrs))
+
+    def server_open_manual(
+        self, name: str, ctx: Optional[TraceContext] = None, **attrs
+    ) -> Span:
+        """``server_span``'s manual-close variant — the same re-parenting
+        contract (a remote caller's span id lands in ``remote_parent`` +
+        ``caller`` with the span parentless locally; an in-process caller
+        nests naturally) for handler windows that suspend across the
+        handler thread (the bus Watch replay generator). Close with
+        ``close_manual``."""
+        if ctx is not None and ctx.proc != self.proc:
+            attrs = dict(attrs)
+            attrs["remote_parent"] = ctx.span_id
+            attrs["caller"] = ctx.proc
+            return self._new_span(name, ctx.wave, ctx.trace_id, None, attrs)
+        return self.open_manual(name, ctx, **attrs)
+
+    def close_manual(self, sp: Span) -> None:
+        sp.end = time.perf_counter()
+        self._append(sp)
 
     # -- export ------------------------------------------------------------
 
@@ -229,21 +644,42 @@ class WaveTracer:
         with self._lock:
             return sorted({s.wave for s in self._spans})
 
+    @property
+    def dropped_total(self) -> int:
+        with self._lock:
+            return self._dropped_total
+
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
             self._wave_open = False
+            self._dropped_total = 0
+            self._dropped_by_wave.clear()
 
-    def wave_summary(self, wave: Optional[int] = None) -> dict:
+    def wave_summary(
+        self, wave: Optional[int] = None, *, stitched: bool = False
+    ) -> dict:
         """Per-phase attribution of one wave (default: the latest one
         with spans): ``total_s`` sums the wave's ROOT spans (parentless —
         the settle drains), ``phases`` maps span name -> summed SELF time
         (duration minus direct children), and ``coverage`` is attributed/
-        total (1.0 by construction unless spans fell off the ring). The
-        bench observability tier compares ``total_s`` against the
-        externally measured wave wall clock for the >=95% criterion."""
+        total. ``dropped`` counts spans of this wave evicted off the ring
+        (coverage silently degrading at 1M-tier was the ISSUE 10
+        satellite). ``stitched=True`` additionally pulls ``/debug/traces``
+        from every registered peer and returns the cross-process summary
+        (``stitch_dumps`` shape) instead of the local one."""
+        if stitched:
+            local = trace_debug_doc(tracer_obj=self)
+            peer_docs = fetch_peer_dumps(peers(), wave=wave)
+            doc = stitch_dumps(local, peer_docs, wave=wave)
+            waves = doc.get("waves", [])
+            if not waves:
+                return self.wave_summary(wave)
+            return waves[-1]
         with self._lock:
             spans = list(self._spans)
+            dropped_by_wave = dict(self._dropped_by_wave)
+            trace_ids = dict(self._trace_ids)
         if wave is None:
             wave = max((s.wave for s in spans), default=0)
         spans = [s for s in spans if s.wave == wave and s.end is not None]
@@ -276,8 +712,12 @@ class WaveTracer:
             if s.attrs.get("compile"):
                 compile_s += s.duration
         attributed = sum(phases.values())
+        trace_id = trace_ids.get(wave, "")
+        if not trace_id and spans:
+            trace_id = spans[0].trace_id
         return {
             "wave": wave,
+            "trace_id": trace_id,
             "total_s": round(total, 6),
             "coverage": round(attributed / total, 4) if total else 0.0,
             "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
@@ -286,6 +726,7 @@ class WaveTracer:
             "compile_s": round(compile_s, 6),
             "host_s": round(max(attributed - device, 0.0), 6),
             "spans": len(spans),
+            "dropped": dropped_by_wave.get(wave, 0),
         }
 
     def wave_summaries(self, last: int = 8) -> list[dict]:
@@ -295,6 +736,541 @@ class WaveTracer:
 #: the process-wide tracer (one ring per process, like the metrics
 #: registry; MetricsServer and the CLI dump read THIS instance)
 tracer = WaveTracer()
+
+
+class ContextPropagatingExecutor:
+    """Submit-side context propagation over any executor: each task runs
+    under the SUBMITTER's trace context (innermost open span at submit
+    time), so fan-out RPC spans land in the wave that fanned them out
+    instead of wave 0. Wraps only ``submit`` — the estimator fan-out pools
+    use nothing else — and delegates the rest."""
+
+    def __init__(self, executor, tracer_obj: Optional[WaveTracer] = None):
+        self._executor = executor
+        self._tracer = tracer_obj or tracer
+
+    def submit(self, fn, *args, **kwargs):
+        tr = self._tracer
+        ctx = tr.current_context()
+
+        def run():
+            with tr.activate(ctx):
+                return fn(*args, **kwargs)
+
+        return self._executor.submit(run)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._executor.shutdown(wait=wait)
+
+    def __getattr__(self, name):
+        return getattr(self._executor, name)
+
+
+# --------------------------------------------------------------------------
+# peer registry: where the stitcher finds the other processes' rings
+# --------------------------------------------------------------------------
+
+_PEERS: dict[str, str] = {}
+_PEERS_LOCK = threading.Lock()
+
+
+def register_peer(name: str, address: str) -> None:
+    """Register a peer process's metrics endpoint (``host:port``) for the
+    stitcher. The plane registers its solver sidecar / estimator servers /
+    bus at boot (localup exports KARMADA_TPU_TRACE_PEERS to the serve
+    process; benches register programmatically)."""
+    with _PEERS_LOCK:
+        _PEERS[name] = address
+
+
+def unregister_peer(name: str) -> None:
+    with _PEERS_LOCK:
+        _PEERS.pop(name, None)
+
+
+def peers() -> dict[str, str]:
+    with _PEERS_LOCK:
+        return dict(_PEERS)
+
+
+def clear_peers() -> None:
+    with _PEERS_LOCK:
+        _PEERS.clear()
+
+
+def register_peers_from_env() -> dict[str, str]:
+    """Parse ``KARMADA_TPU_TRACE_PEERS`` (``name=host:port,...``) into the
+    registry — the boot hook every long-running entrypoint calls."""
+    raw = os.environ.get(TRACE_PEERS_ENV, "").strip()
+    if not raw:
+        return {}
+    added: dict[str, str] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, addr = part.partition("=")
+        if not sep or not name.strip() or not addr.strip():
+            log.warning("bad %s entry %r (want name=host:port)",
+                        TRACE_PEERS_ENV, part)
+            continue
+        register_peer(name.strip(), addr.strip())
+        added[name.strip()] = addr.strip()
+    return added
+
+
+# --------------------------------------------------------------------------
+# the /debug/traces document (shared by MetricsServer + the CLI dump)
+# --------------------------------------------------------------------------
+
+
+def trace_debug_doc(
+    wave: Optional[int] = None,
+    *,
+    summary: bool = False,
+    tracer_obj: Optional[WaveTracer] = None,
+) -> dict:
+    """THE ``/debug/traces`` document: built in one place so the HTTP
+    endpoint, ``karmadactl-tpu trace dump`` and the stitcher can never
+    drift on shape. The scheduling-mesh report is sys.modules-gated: a
+    process that never imported the mesh module has no mesh, and importing
+    it here would drag jax into lean processes (the bus)."""
+    import sys as _sys
+
+    tr = tracer_obj or tracer
+    pm = _sys.modules.get("karmada_tpu.parallel.mesh")
+    doc = {
+        "proc": tr.proc,
+        "mesh": pm.active_mesh_shape() if pm is not None else None,
+        "dropped": tr.dropped_total,
+        "peers": peers(),
+        "waves": tr.wave_summaries(),
+        "spans": tr.dump(),
+    }
+    if wave is not None:
+        doc["spans"] = [s for s in doc["spans"] if s.get("wave") == wave]
+        doc["waves"] = [w for w in doc["waves"] if w.get("wave") == wave]
+    if summary:
+        doc.pop("spans", None)
+    return doc
+
+
+def fetch_peer_dumps(
+    peer_map: dict[str, str], timeout: float = 5.0,
+    wave: Optional[int] = None,
+) -> dict[str, dict]:
+    """Pull ``/debug/traces`` from every peer's metrics port. Unreachable
+    peers are skipped with a warning — a stitched dump of the reachable
+    plane beats no dump. ``wave`` narrows each fetch server-side
+    (``?wave=N`` — peers record under the CALLER's wave id): at 1M-tier
+    capacities the full ring is tens of thousands of spans per peer, and
+    both stitching call sites already know which wave they want."""
+    import urllib.request
+
+    docs: dict[str, dict] = {}
+    query = "" if wave is None else f"?wave={wave}"
+    for name, addr in sorted(peer_map.items()):
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/debug/traces{query}", timeout=timeout
+            ) as resp:
+                docs[name] = json.loads(resp.read().decode())
+        except Exception as exc:  # noqa: BLE001 — peer down: stitch the rest
+            log.warning("trace peer %s (%s) unreachable: %s", name, addr,
+                        type(exc).__name__)
+    return docs
+
+
+# --------------------------------------------------------------------------
+# the stitcher: cross-process trace trees + per-channel attribution
+# --------------------------------------------------------------------------
+
+
+def _span_channel(name: str) -> Optional[str]:
+    """The channel a client RPC span belongs to (its name's first dotted
+    component: ``estimator.rpc`` -> ``estimator``)."""
+    head, sep, _ = name.partition(".")
+    return head if sep else None
+
+
+def stitch_spans(spans: list[dict], wave: int, trace_id: str) -> dict:
+    """Stitch ONE wave's spans (already tagged with ``proc``, merged from
+    every process) into a cross-process summary: remote handler roots
+    re-parent under their originating client spans (``remote_parent`` +
+    ``caller`` attrs), self-times compute across the stitched tree, and
+    each channel's network/serialization time falls out as
+    ``client span − remote roots`` per RPC. Durations only — process
+    clocks are never compared."""
+    sel = [
+        s for s in spans
+        if s.get("wave") == wave
+        and (not trace_id or s.get("trace_id", "") == trace_id)
+    ]
+    by_key = {(s.get("proc", "?"), s["span_id"]): s for s in sel}
+
+    def parent_key(s: dict) -> Optional[tuple]:
+        attrs = s.get("attrs", {})
+        rp, caller = attrs.get("remote_parent"), attrs.get("caller")
+        if caller is not None:
+            key = (caller, rp)
+            return key if key in by_key else None
+        if s.get("parent_id") is not None:
+            key = (s.get("proc", "?"), s["parent_id"])
+            return key if key in by_key else None
+        return None
+
+    child_time: dict[tuple, float] = {}
+    remote_children: dict[tuple, list] = {}
+    parents: dict[tuple, Optional[tuple]] = {}
+    for s in sel:
+        key = (s.get("proc", "?"), s["span_id"])
+        pk = parent_key(s)
+        parents[key] = pk
+        if pk is not None:
+            child_time[pk] = child_time.get(pk, 0.0) + s["duration_s"]
+            if pk[0] != key[0]:
+                remote_children.setdefault(pk, []).append(s)
+
+    # roots: unparented spans that did NOT arrive over a channel. After
+    # re-parenting, a remote handler span is never a root — total_s is
+    # the caller-side wall, exactly what the local summary reports; a
+    # handler span whose client span fell off the ring must not inflate
+    # it either (hence the ``caller`` check, not just parent resolution)
+    roots = [
+        s for s in sel
+        if parents[(s.get("proc", "?"), s["span_id"])] is None
+        and "caller" not in s.get("attrs", {})
+    ]
+    total = sum(s["duration_s"] for s in roots)
+
+    phases: dict[str, float] = {}
+    counts: dict[str, int] = {}
+    process_s: dict[str, float] = {}
+    channels: dict[str, dict] = {}
+    for s in sel:
+        key = (s.get("proc", "?"), s["span_id"])
+        self_time = max(s["duration_s"] - child_time.get(key, 0.0), 0.0)
+        phases[s["name"]] = phases.get(s["name"], 0.0) + self_time
+        counts[s["name"]] = counts.get(s["name"], 0) + 1
+        proc = s.get("proc", "?")
+        process_s[proc] = process_s.get(proc, 0.0) + self_time
+        # per-channel columns from CLIENT rpc spans: server time is the
+        # re-parented remote roots' wall; the remainder of the client
+        # span is wire + serialization — the column no single-process
+        # view can produce
+        if s.get("attrs", {}).get("remote"):
+            ch = _span_channel(s["name"])
+            if ch is not None:
+                slot = channels.setdefault(
+                    ch, {"rpcs": 0, "client_s": 0.0, "server_s": 0.0,
+                         "network_s": 0.0},
+                )
+                server = sum(
+                    c["duration_s"] for c in remote_children.get(key, [])
+                )
+                slot["rpcs"] += 1
+                slot["client_s"] += s["duration_s"]
+                slot["server_s"] += server
+                slot["network_s"] += max(s["duration_s"] - server, 0.0)
+    attributed = sum(phases.values())
+    return {
+        "wave": wave,
+        "trace_id": trace_id,
+        "stitched": True,
+        "total_s": round(total, 6),
+        "coverage": round(attributed / total, 4) if total else 0.0,
+        "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+        "span_counts": dict(sorted(counts.items())),
+        "process_s": {
+            k: round(v, 6) for k, v in sorted(process_s.items())
+        },
+        "channels": {
+            k: {
+                "rpcs": v["rpcs"],
+                "client_s": round(v["client_s"], 6),
+                "server_s": round(v["server_s"], 6),
+                "network_s": round(v["network_s"], 6),
+            }
+            for k, v in sorted(channels.items())
+        },
+        "spans": len(sel),
+        "procs": sorted({s.get("proc", "?") for s in sel}),
+    }
+
+
+def stitch_dumps(
+    local: dict, peer_docs: dict[str, dict], wave: Optional[int] = None
+) -> dict:
+    """Merge the local ``/debug/traces`` doc with the peers' docs into one
+    stitched document: every span tagged with its process, waves keyed by
+    the LOCAL process's (trace_id, wave) and summarized across processes.
+    ``wave`` restricts to one wave (default: every local wave)."""
+    all_spans: list[dict] = []
+    local_proc = local.get("proc", "plane")
+    for s in local.get("spans", []):
+        s = dict(s)
+        s.setdefault("proc", local_proc)
+        all_spans.append(s)
+    dropped = {local_proc: local.get("dropped", 0)}
+    for name, doc in sorted(peer_docs.items()):
+        proc = doc.get("proc", name)
+        dropped[proc] = doc.get("dropped", 0)
+        for s in doc.get("spans", []):
+            s = dict(s)
+            s.setdefault("proc", proc)
+            all_spans.append(s)
+    waves = [
+        w for w in local.get("waves", [])
+        if wave is None or w.get("wave") == wave
+    ]
+    stitched_waves = [
+        stitch_spans(all_spans, w["wave"], w.get("trace_id", ""))
+        for w in waves
+    ]
+    return {
+        "proc": local_proc,
+        "procs": sorted({s.get("proc", "?") for s in all_spans}),
+        "dropped": dropped,
+        "waves": stitched_waves,
+        "spans": all_spans,
+    }
+
+
+def render_attribution_table(summary: dict) -> str:
+    """The stitched-wave attribution table as text (``trace analyze`` and
+    the bench print this; the JSON record stays the machine surface)."""
+    lines = [
+        f"wave {summary.get('wave')} trace {summary.get('trace_id', '')} "
+        f"total {summary.get('total_s', 0.0):.3f}s coverage "
+        f"{summary.get('coverage', 0.0) * 100:.1f}%",
+        "phase                       self_s",
+    ]
+    for name, v in sorted(
+        summary.get("phases", {}).items(), key=lambda kv: -kv[1]
+    ):
+        lines.append(f"{name:<27} {v:8.4f}")
+    if summary.get("process_s"):
+        lines.append("process                     self_s")
+        for name, v in sorted(summary["process_s"].items()):
+            lines.append(f"{name:<27} {v:8.4f}")
+    if summary.get("channels"):
+        lines.append(
+            "channel      rpcs   client_s   server_s  network_s"
+        )
+        for name, v in sorted(summary["channels"].items()):
+            lines.append(
+                f"{name:<10} {v['rpcs']:6d} {v['client_s']:10.4f} "
+                f"{v['server_s']:10.4f} {v['network_s']:10.4f}"
+            )
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# slow-wave flight recorder
+# --------------------------------------------------------------------------
+
+
+def flight_slo() -> Optional[float]:
+    """The armed SLO (seconds), or None when the recorder is off —
+    KARMADA_TPU_TRACE_SLO_SECONDS unset/empty/unparseable means OFF, and
+    the whole recorder costs one env read per wave boundary."""
+    raw = os.environ.get(TRACE_SLO_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def flight_dir() -> str:
+    raw = os.environ.get(FLIGHT_DIR_ENV, "").strip()
+    if raw:
+        return raw
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "karmada_tpu_flight")
+
+
+def _flight_cap() -> int:
+    raw = os.environ.get(FLIGHT_CAP_ENV, "").strip()
+    try:
+        return max(int(raw), 1) if raw else _DEFAULT_FLIGHT_CAP
+    except ValueError:
+        return _DEFAULT_FLIGHT_CAP
+
+
+def flight_baseline(wave: int) -> dict:
+    """The begin-of-wave snapshot the recorder deltas against: the full
+    metrics registry + the fired-fault count."""
+    from .faultinject import injector
+    from .metrics import registry
+
+    inj = injector()
+    return {
+        "wave": wave,
+        "metrics": registry.snapshot(),
+        "fault_events": len(inj.log) if inj is not None else 0,
+    }
+
+
+def _metrics_delta(before: Optional[dict], after: dict) -> dict:
+    """Per-family sample deltas (after − before); families/samples absent
+    from ``before`` delta against 0. Zero deltas are dropped — the record
+    carries what MOVED during the wave."""
+    out: dict = {}
+    before = before or {}
+    for family, samples in after.items():
+        prev = before.get(family, {})
+        fam_delta: dict = {}
+        for key, val in samples.items():
+            if isinstance(val, dict):
+                pv = prev.get(key, {})
+                d = {
+                    k: round(val.get(k, 0) - pv.get(k, 0), 9)
+                    for k in val
+                    if val.get(k, 0) != pv.get(k, 0)
+                }
+                if d:
+                    fam_delta[key] = d
+            else:
+                d = val - prev.get(key, 0)
+                if d:
+                    fam_delta[key] = round(d, 9)
+        if fam_delta:
+            out[family] = fam_delta
+    return out
+
+
+def _delta_total(delta: dict, family: str) -> float:
+    vals = delta.get(family, {})
+    total = 0.0
+    for v in vals.values():
+        if isinstance(v, dict):
+            total += v.get("count", 0)
+        else:
+            total += v
+    return total
+
+
+def maybe_flight_record(tr: WaveTracer, wave: int) -> Optional[str]:
+    """The ``end_wave`` hook: when the recorder is armed and the closing
+    wave breached the SLO — or a breaker transition / degraded pass /
+    QuotaExceeded denial fired during it — persist the stitched trace, the
+    metrics delta and the fired fault log as one JSONL record. Returns the
+    record path when a record was written."""
+    slo = flight_slo()
+    if slo is None:
+        return None
+    from .faultinject import injector
+    from .metrics import registry
+
+    summary = tr.wave_summary(wave)
+    wall = summary.get("total_s", 0.0)
+    baseline = tr.flight_baseline_for(wave) or {}
+    delta = _metrics_delta(baseline.get("metrics"), registry.snapshot())
+    reasons: list[str] = []
+    if wall > slo:
+        reasons.append(f"slo:{wall:.3f}s>{slo:.3f}s")
+    if _delta_total(delta, "karmada_tpu_degraded_passes_total") > 0:
+        reasons.append("degraded-pass")
+    if _delta_total(delta, "karmada_tpu_quota_denied_total") > 0:
+        reasons.append("quota-exceeded")
+    if summary.get("span_counts", {}).get("channel.breaker"):
+        reasons.append("breaker-transition")
+    if not reasons:
+        return None
+
+    inj = injector()
+    fault_log = []
+    if inj is not None:
+        start = baseline.get("fault_events", 0)
+        fault_log = [
+            {"seq": e.seq, "point": e.point, "action": e.action,
+             "key": e.key}
+            for e in inj.log[start:]
+        ]
+    # stitch only now — a healthy wave never pays the peer fetch
+    local = trace_debug_doc(wave=wave, tracer_obj=tr)
+    peer_docs = fetch_peer_dumps(peers(), timeout=2.0, wave=wave)
+    stitched = stitch_dumps(local, peer_docs, wave=wave)
+    stitched_summary = (
+        stitched["waves"][-1] if stitched.get("waves") else summary
+    )
+    record = {
+        "wave": wave,
+        "trace_id": summary.get("trace_id", ""),
+        "proc": tr.proc,
+        "recorded_at": time.time(),
+        "slo_seconds": slo,
+        "wall_s": wall,
+        "reasons": reasons,
+        "summary": stitched_summary,
+        "spans": stitched["spans"],
+        "procs": stitched["procs"],
+        "dropped": stitched["dropped"],
+        "metrics_delta": delta,
+        "fault_events": fault_log,
+    }
+    return _flight_append(record)
+
+
+def _flight_append(record: dict) -> str:
+    """Append one JSONL record under KARMADA_TPU_FLIGHT_DIR, ring-capped:
+    the file keeps at most KARMADA_TPU_FLIGHT_CAP records (oldest
+    dropped)."""
+    dir_ = flight_dir()
+    os.makedirs(dir_, exist_ok=True)
+    path = os.path.join(dir_, "flight.jsonl")
+    line = json.dumps(record, sort_keys=True)
+    cap = _flight_cap()
+    lines: list[str] = []
+    if os.path.exists(path):
+        with open(path, encoding="utf-8") as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    lines.append(line)
+    if len(lines) > cap:
+        lines = lines[-cap:]
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+    log.warning(
+        "flight record: wave %s (%s) -> %s",
+        record["wave"], ",".join(record["reasons"]), path,
+    )
+    return path
+
+
+def load_flight_records(path: str) -> list[dict]:
+    with open(path, encoding="utf-8") as f:
+        return [
+            json.loads(ln) for ln in f.read().splitlines() if ln.strip()
+        ]
+
+
+def analyze_record(record: dict) -> dict:
+    """Re-derive a flight record's attribution from its RAW spans and
+    compare against the summary stored at record time — the offline
+    ``trace analyze`` surface. ``identical`` proves the stitcher is a pure
+    function of the spans (the bench asserts it)."""
+    recomputed = stitch_spans(
+        record.get("spans", []), record.get("wave", 0),
+        record.get("trace_id", ""),
+    )
+    recorded = record.get("summary", {})
+    return {
+        "wave": record.get("wave"),
+        "trace_id": record.get("trace_id", ""),
+        "reasons": record.get("reasons", []),
+        "wall_s": record.get("wall_s"),
+        "slo_seconds": record.get("slo_seconds"),
+        "summary": recomputed,
+        "identical": recomputed == recorded,
+        "metrics_delta": record.get("metrics_delta", {}),
+        "fault_events": record.get("fault_events", []),
+        "table": render_attribution_table(recomputed),
+    }
 
 
 # --------------------------------------------------------------------------

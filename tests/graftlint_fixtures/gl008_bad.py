@@ -1,0 +1,32 @@
+"""GL008 bad fixture: unregistered span names on a tracer receiver."""
+
+
+class _Tracer:
+    def span(self, name, **attrs):
+        return name
+
+    def record(self, name, duration, **attrs):
+        return name
+
+    def server_span(self, name, ctx, **attrs):
+        return name
+
+    def open_manual(self, name, ctx=None, **attrs):
+        return name
+
+
+tracer = _Tracer()
+_tracer = tracer
+
+
+def record_spans(kind: str):
+    # BAD: literal name absent from utils.tracing SPAN_NAMES
+    tracer.span("rogue.span")
+    # BAD: record() with an unregistered literal
+    _tracer.record("another.rogue", 0.25)
+    # BAD: server_span with an unregistered literal
+    tracer.server_span("rogue.serve", None)
+    # BAD: dynamic name whose literal prefix matches no `family.*` entry
+    tracer.span(f"rogue.{kind}")
+    # BAD: dynamic name with no literal head at all
+    tracer.record(f"{kind}.tail", 0.1)

@@ -1,0 +1,39 @@
+"""GL008 good fixture: registered names, dynamic families, exempt
+receivers."""
+
+
+class _Tracer:
+    def span(self, name, **attrs):
+        return name
+
+    def record(self, name, duration, **attrs):
+        return name
+
+    def server_span(self, name, ctx, **attrs):
+        return name
+
+
+tracer = _Tracer()
+
+
+def record_spans(worker: str, phases):
+    # registered literals
+    tracer.span("settle")
+    tracer.record("scheduler.pack", 0.25)
+    tracer.server_span("estimator.serve", None)
+    # dynamic family: literal prefix resolves `controller.*`
+    tracer.span(f"controller.{worker}")
+    # a plain variable is out of static reach (GL006/GL002 precedent)
+    for name, seconds in phases:
+        tracer.record(name, seconds)
+
+
+class _Api:
+    def span(self, label):
+        return label
+
+
+api = _Api()
+# not a tracer receiver: arbitrary APIs with a span-shaped method are out
+# of scope
+unrelated = api.span("not.a.span")

@@ -38,6 +38,7 @@ FIXTURE_ROLES = {
     "GL005": {gl_core.ROLE_ENTRY, gl_core.ROLE_OPS},
     "GL006": set(),
     "GL007": set(),
+    "GL008": set(),
 }
 
 
@@ -130,6 +131,38 @@ def test_gl006_registry_families_unique_and_prefixed():
     assert len(names) == len(set(names)), "duplicate family in registry"
     for name in names:
         assert name.startswith(("karmada_tpu_", "karmada_scheduler_")), name
+
+
+def test_gl008_catches_each_pattern():
+    findings = lint_fixture("gl008_bad.py", FIXTURE_ROLES["GL008"])
+    details = {f.detail for f in findings}
+    assert "rogue.span" in details, "unregistered span() literal not flagged"
+    assert "another.rogue" in details, "unregistered record() not flagged"
+    assert "rogue.serve" in details, "unregistered server_span() not flagged"
+    assert "dynamic:rogue." in details, (
+        "dynamic name with unregistered family prefix not flagged"
+    )
+    assert "dynamic:" in details, (
+        "dynamic name with no literal head not flagged"
+    )
+
+
+def test_gl008_taxonomy_covers_live_names():
+    """The registry GL008 enforces must itself stay well-formed: every
+    family key renders into the docs table and the wildcard matcher
+    resolves the dynamic controller family."""
+    from karmada_tpu.utils.tracing import (
+        SPAN_NAMES,
+        render_span_table,
+        span_name_registered,
+    )
+
+    assert span_name_registered("controller.scheduler")
+    assert span_name_registered("settle")
+    assert not span_name_registered("rogue.span")
+    table = render_span_table()
+    for name in SPAN_NAMES:
+        assert f"`{name}`" in table
 
 
 def test_gl003_resolves_constant_keys():

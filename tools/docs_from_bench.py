@@ -154,25 +154,58 @@ def obs_block(od: dict) -> str:
         ", ".join(f"{k} x{int(v)}" for k, v in sorted(compiles.items()))
         or "none"
     )
-    return "\n".join(
-        [
-            f"| observability {scale}: storm wave wall / span coverage | "
-            f"{fmt(od.get('value'))} wall, {cov * 100:.1f}% attributed to "
-            f"named spans ({od.get('bindings_s', 0):,.0f} bindings/s, "
-            f"{od.get('works', 0):,} works) |",
-            f"| observability {scale}: kernel span split | "
-            f"host(pack/decode) {phases.get('kernel.host', 0.0):.2f}s, "
-            f"dispatch {phases.get('kernel.dispatch', 0.0):.2f}s (sync "
-            f"backends execute inside it), device-fence "
-            f"{phases.get('kernel.device', 0.0):.2f}s, fetch "
-            f"{phases.get('kernel.fetch', 0.0):.2f}s; compile-bearing "
-            f"{od.get('compile_s', 0.0):.2f}s |",
-            f"| observability {scale}: heaviest wave phases (self time) | "
-            f"{top_s} |",
-            f"| observability {scale}: serving-path kernel compiles "
-            f"(whole run) | {comp_s} |",
+    rows = [
+        f"| observability {scale}: storm wave wall / span coverage | "
+        f"{fmt(od.get('value'))} wall, {cov * 100:.1f}% attributed to "
+        f"named spans ({od.get('bindings_s', 0):,.0f} bindings/s, "
+        f"{od.get('works', 0):,} works) |",
+        f"| observability {scale}: kernel span split | "
+        f"host(pack/decode) {phases.get('kernel.host', 0.0):.2f}s, "
+        f"dispatch {phases.get('kernel.dispatch', 0.0):.2f}s (sync "
+        f"backends execute inside it), device-fence "
+        f"{phases.get('kernel.device', 0.0):.2f}s, fetch "
+        f"{phases.get('kernel.fetch', 0.0):.2f}s; compile-bearing "
+        f"{od.get('compile_s', 0.0):.2f}s |",
+        f"| observability {scale}: heaviest wave phases (self time) | "
+        f"{top_s} |",
+        f"| observability {scale}: serving-path kernel compiles "
+        f"(whole run) | {comp_s} |",
+    ]
+    # ISSUE 10: the 4-process stitched wave (plane + solver sidecar +
+    # estimator server + bus) with per-process and per-channel columns,
+    # and the flight-recorder proof
+    st = od.get("stitched")
+    if st:
+        proc_s = ", ".join(
+            f"{k} {v:.2f}s"
+            for k, v in sorted(
+                (st.get("process_s") or {}).items(), key=lambda kv: -kv[1]
+            )
+        )
+        chan_s = "; ".join(
+            f"{k}: {v.get('rpcs', 0)} rpcs, client {v.get('client_s', 0.0):.2f}s"
+            f" = server {v.get('server_s', 0.0):.2f}s + network "
+            f"{v.get('network_s', 0.0):.2f}s"
+            for k, v in sorted((st.get("channels") or {}).items())
+        )
+        rows += [
+            f"| observability {scale}: stitched 4-process wave "
+            f"({', '.join(st.get('procs', []))}) | "
+            f"{fmt(od.get('stitched_wall_s'))} wall, "
+            f"{od.get('stitched_coverage_vs_wall', 0.0) * 100:.1f}% "
+            f"attributed across processes ({st.get('spans', 0)} spans) |",
+            f"| observability {scale}: per-process self time | "
+            f"{proc_s or 'n/a'} |",
+            f"| observability {scale}: per-channel columns "
+            f"(client = server + network/serialization) | "
+            f"{chan_s or 'n/a'} |",
+            f"| observability {scale}: flight recorder (seeded breaker "
+            f"trip mid-wave) | record written="
+            f"{bool(od.get('flight_recorded'))}, reasons "
+            f"{od.get('flight_reasons', [])}, `trace analyze` re-derives "
+            f"identically={od.get('flight_analyze_identical')} |",
         ]
-    )
+    return "\n".join(rows)
 
 
 def chaos_block(cd: dict) -> str:
@@ -401,6 +434,42 @@ def check_metrics_table() -> None:
         )
 
 
+def span_table() -> str:
+    """The generated span-taxonomy table (karmada_tpu.utils.tracing
+    SPAN_NAMES is the single source of truth; graftlint GL008 keeps the
+    recording sites honest)."""
+    sys.path.insert(0, str(ROOT))
+    from karmada_tpu.utils.tracing import render_span_table
+
+    return (
+        "_Generated from `karmada_tpu/utils/tracing.py` SPAN_NAMES by "
+        "`tools/docs_from_bench.py --span-table` — regenerate, don't "
+        "hand-edit._\n\n" + render_span_table()
+    )
+
+
+def check_span_table() -> None:
+    """Fail loudly when the committed OPERATIONS.md span-taxonomy table
+    drifted from the SPAN_NAMES registry (a span the table misses is a
+    span operators can't read in a dumped wave) — runs on EVERY doc
+    regeneration, same pattern as the env-flag gate."""
+    path = ROOT / "docs" / "OPERATIONS.md"
+    m = _marker_re("spantaxonomy").search(path.read_text())
+    if not m:
+        raise SystemExit(
+            f"{path}: no spantaxonomy markers — restore the span-taxonomy "
+            "section and run `python tools/docs_from_bench.py "
+            "--span-table`"
+        )
+    committed_body = m.group(0).split("-->\n", 1)[1].rsplit("<!--", 1)[0]
+    if committed_body.strip() != span_table().strip():
+        raise SystemExit(
+            f"{path}: span-taxonomy table drifted from "
+            "karmada_tpu/utils/tracing.py SPAN_NAMES — run "
+            "`python tools/docs_from_bench.py --span-table`"
+        )
+
+
 def check_ir_registry() -> None:
     """Fail loudly when a kernel family exported from karmada_tpu/ops/ is
     missing from the graftlint IR entry-point registry (or the registry
@@ -425,6 +494,7 @@ def main() -> None:
     if sys.argv[1:] == ["--env-table"]:
         rewrite(ROOT / "docs" / "OPERATIONS.md", env_table(), "envflags")
         check_metrics_table()
+        check_span_table()
         check_ir_registry()
         return
     if sys.argv[1:] == ["--metrics-table"]:
@@ -433,6 +503,15 @@ def main() -> None:
             "metricfamilies",
         )
         check_env_table()
+        check_span_table()
+        check_ir_registry()
+        return
+    if sys.argv[1:] == ["--span-table"]:
+        rewrite(
+            ROOT / "docs" / "OPERATIONS.md", span_table(), "spantaxonomy",
+        )
+        check_env_table()
+        check_metrics_table()
         check_ir_registry()
         return
     src = Path(sys.argv[1])
@@ -454,6 +533,7 @@ def main() -> None:
     rewrite(ROOT / "BASELINE.md", body)
     check_env_table()
     check_metrics_table()
+    check_span_table()
     check_ir_registry()
 
 

@@ -194,6 +194,8 @@ class LintContext:
         self.config = config
         self.modules = modules
         self._env_registry: Optional[dict] = None
+        self._span_registry: Optional[dict] = None
+        self._tracing_mod = None
         self._docs_text: Optional[str] = None
         # module-level NAME = "KARMADA_TPU_..." constants: GL003 resolves
         # os.environ.get(MANIFEST_ENV) through these. Per-module first
@@ -257,6 +259,47 @@ class LintContext:
             )
             self._env_registry = dict(flags.ENV_FLAGS)
         return self._env_registry
+
+    @property
+    def _tracing_module(self):
+        """``utils/tracing`` imported live (same pattern as env_registry —
+        the module is stdlib-only, so the import stays jax-free). GL008's
+        ground truth: both the registry dict AND the wildcard-matching
+        semantics come from here, so the linter's notion of "registered"
+        can never drift from the stitcher's."""
+        if self._tracing_mod is None:
+            import importlib
+            import sys
+
+            root = str(self.config.root)
+            if root not in sys.path:
+                sys.path.insert(0, root)
+            self._tracing_mod = importlib.import_module(
+                self.config.package + ".utils.tracing"
+            )
+        return self._tracing_mod
+
+    @property
+    def span_registry(self) -> dict:
+        """name -> description from utils/tracing.py SPAN_NAMES."""
+        if self._span_registry is None:
+            self._span_registry = dict(self._tracing_module.SPAN_NAMES)
+        return self._span_registry
+
+    def span_registered(self, name: str) -> bool:
+        """``name`` is in the taxonomy, directly or via a ``*`` family."""
+        return self._tracing_module.span_name_registered(name)
+
+    def span_family_registered(self, prefix: str) -> bool:
+        """A dynamic (f-string) span name whose literal head is
+        ``prefix`` resolves to a registered ``*`` family."""
+        if not prefix:
+            return False
+        return any(
+            prefix.startswith(k[:-1])
+            for k in self.span_registry
+            if k.endswith("*")
+        )
 
     @property
     def docs_text(self) -> str:

@@ -12,6 +12,8 @@
 |       | the karmada_tpu_/karmada_scheduler_ prefix and be unique         |
 | GL007 | bounded RPCs: every gRPC unary stub / urlopen call site passes   |
 |       | an explicit timeout (watch streams are deliberately unbounded)   |
+| GL008 | span taxonomy: every span name recorded on a tracer must be      |
+|       | registered in utils.tracing SPAN_NAMES (stitcher + docs key on)  |
 
 Each rule is a pure-AST pass over one ``ModuleInfo`` (plus cross-module
 ``finalize`` hooks); nothing here imports jax.
@@ -813,6 +815,89 @@ class ImportHygiene(Rule):
                         ),
                         anchor=mod.qualname(node) or "<module>",
                         detail=f"scheduler:{bad}",
+                    )
+
+
+# --------------------------------------------------------------------------
+# GL008 — span taxonomy: recorded span names must be registered
+# --------------------------------------------------------------------------
+
+#: WaveTracer methods whose first argument is a span name
+_SPAN_METHODS = {"span", "server_span", "record", "open_manual"}
+
+
+@rule
+class SpanTaxonomy(Rule):
+    id = "GL008"
+    title = (
+        "span names recorded on a tracer must be registered in "
+        "utils.tracing SPAN_NAMES"
+    )
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        """Every ``tracer.span("name")`` / ``.record`` / ``.server_span``
+        / ``.open_manual`` call with a literal (or f-string) first
+        argument must resolve to the central taxonomy — the stitcher's
+        channel attribution and the generated docs span table key on
+        those names, so an unregistered span is invisible to both.
+        Receivers are restricted to tracer-shaped names (``tracer``,
+        ``_tracer``), the GL006 receiver heuristic; a first argument
+        that is a plain variable is out of static reach and stays
+        unchecked (the GL006/GL002 precedent)."""
+        for node in ast.walk(mod.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SPAN_METHODS
+            ):
+                continue
+            recv = node.func.value
+            recv_name = (
+                recv.id if isinstance(recv, ast.Name)
+                else recv.attr if isinstance(recv, ast.Attribute)
+                else None
+            )
+            if recv_name is None or "tracer" not in recv_name.lower():
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            anchor = mod.qualname(node) or "<module>"
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                name = arg.value
+                if not ctx.span_registered(name):
+                    yield Finding(
+                        rule=self.id, path=mod.rel, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"span name {name!r} is not registered in "
+                            "utils.tracing SPAN_NAMES — the stitcher's "
+                            "channel attribution and the docs span-"
+                            "taxonomy table key on the registry; add "
+                            "the name (or a `family.*` entry) there"
+                        ),
+                        anchor=anchor, detail=name,
+                    )
+            elif isinstance(arg, ast.JoinedStr):
+                head = arg.values[0] if arg.values else None
+                prefix = (
+                    head.value
+                    if isinstance(head, ast.Constant)
+                    and isinstance(head.value, str)
+                    else ""
+                )
+                if not ctx.span_family_registered(prefix):
+                    yield Finding(
+                        rule=self.id, path=mod.rel, line=node.lineno,
+                        col=node.col_offset + 1,
+                        message=(
+                            f"dynamic span name with literal prefix "
+                            f"{prefix!r} matches no `family.*` entry in "
+                            "utils.tracing SPAN_NAMES — register the "
+                            "family (a dynamic name needs a literal "
+                            "head the linter and stitcher can key on)"
+                        ),
+                        anchor=anchor, detail=f"dynamic:{prefix}",
                     )
 
 
