@@ -2839,7 +2839,8 @@ def run_stitched_observability(args) -> dict:
     saved_env = {
         k: os.environ.get(k)
         for k in ("KARMADA_TPU_TRACE_SLO_SECONDS", "KARMADA_TPU_FLIGHT_DIR",
-                  "KARMADA_TPU_FAULT_SPEC", "KARMADA_TPU_FAULT_SEED")
+                  "KARMADA_TPU_FAULT_SPEC", "KARMADA_TPU_FAULT_SEED",
+                  "KARMADA_TPU_BUS_BATCH", "KARMADA_TPU_BUS_TEMPLATE_DELTA")
     }
     replica = solver_client = None
     try:
@@ -2952,7 +2953,22 @@ def run_stitched_observability(args) -> dict:
 
         def storm(tag: str) -> tuple:
             clock[0] += 60
+            # drain the PREVIOUS burst's echo tail until its wave closes
+            # so the measured window starts clean (bounded: a stubborn
+            # straggler falls through to the inherited-wave fallback)
+            drain_deadline = time.monotonic() + 5.0
+            while (
+                tracer.open_wave() is not None
+                and time.monotonic() < drain_deadline
+            ):
+                cp.settle()
+                time.sleep(0.05)
             before = set(tracer.waves())
+            # the wave open RIGHT NOW (the previous storm's echo tail
+            # can keep one open past its idle probes) absorbs this
+            # storm's spans — a pure id-diff would attribute the whole
+            # storm to "no new wave" and read as ~0% coverage
+            inherited = tracer.open_wave()
             cp.store.apply(WorkloadRebalancer(
                 meta=ObjectMeta(name=f"st-storm-{tag}"),
                 spec=WorkloadRebalancerSpec(workloads=[
@@ -2962,6 +2978,8 @@ def run_stitched_observability(args) -> dict:
             ))
             wall = settle_through_echoes()
             new = [w for w in tracer.waves() if w not in before]
+            if inherited is not None and inherited not in new:
+                new.append(inherited)
             return wall, new
 
         for wi in range(2):
@@ -2980,6 +2998,77 @@ def run_stitched_observability(args) -> dict:
             f"# stitched measured wave: {wall:.2f}s, cross-process trace "
             f"covers {coverage * 100:.1f}% across {main['procs']} "
             f"(channels: { {k: v['rpcs'] for k, v in main['channels'].items()} })",
+            file=sys.stderr,
+        )
+        phases = main.get("phases") or {}
+        top_phase = max(phases.items(), key=lambda kv: kv[1]) if phases else ("", 0.0)
+
+        # ---- ISSUE 11: batched vs unary parity + throughput ----------
+        # the whole-plane storm re-runs with the columnar channel forced
+        # off (KARMADA_TPU_BUS_BATCH=0 pins every connection unary,
+        # KARMADA_TPU_BUS_TEMPLATE_DELTA=0 full-renders every Work) and
+        # the final plane state must be IDENTICAL: same placements, and
+        # template-delta rehydration byte-equivalent to full rendering
+        def plane_state():
+            import copy
+
+            from karmada_tpu.controllers.propagation import work_manifests
+            from karmada_tpu.utils.codec import to_jsonable
+
+            def canon(doc):
+                doc = copy.deepcopy(doc)
+                meta = doc.get("meta") or {}
+                for k in ("resource_version", "uid", "creation_timestamp"):
+                    meta.pop(k, None)
+                for bag in ("labels", "annotations"):
+                    d = meta.get(bag) or {}
+                    for k in list(d):
+                        if "permanent-id" in k:
+                            del d[k]
+                return doc
+
+            placements = {
+                rb.meta.namespaced_name: sorted(
+                    (tc.name, tc.replicas) for tc in rb.spec.clusters
+                )
+                for rb in cp.store.list("ResourceBinding")
+            }
+            manifests = {}
+            for w in cp.store.list("Work"):
+                docs = work_manifests(cp.store, w)
+                manifests[w.meta.namespaced_name] = (
+                    [canon(to_jsonable(m)) for m in docs]
+                    if docs
+                    else None
+                )
+            return placements, manifests
+
+        batched_state = plane_state()
+        delta_works = sum(
+            1 for w in cp.store.list("Work")
+            if w.spec.workload_template is not None
+            and w.spec.workload_template.digest
+        )
+        n_templates = len(cp.store.list("WorkloadTemplate"))
+        os.environ["KARMADA_TPU_BUS_BATCH"] = "0"
+        os.environ["KARMADA_TPU_BUS_TEMPLATE_DELTA"] = "0"
+        unary_wall, _ = storm("unary")
+        unary_state = plane_state()
+        for k in ("KARMADA_TPU_BUS_BATCH", "KARMADA_TPU_BUS_TEMPLATE_DELTA"):
+            if saved_env.get(k) is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = saved_env[k]
+        parity = (
+            batched_state[0] == unary_state[0]
+            and batched_state[1] == unary_state[1]
+        )
+        print(
+            f"# bus parity: batched wave {wall:.2f}s vs unary wave "
+            f"{unary_wall:.2f}s ({unary_wall / wall if wall else 0:.1f}x), "
+            f"plane state identical={parity} ({delta_works} template-delta "
+            f"works over {n_templates} templates); top stitched phase "
+            f"{top_phase[0]} {top_phase[1]:.2f}s",
             file=sys.stderr,
         )
 
@@ -3039,6 +3128,20 @@ def run_stitched_observability(args) -> dict:
             "stitched_coverage_vs_wall": round(coverage, 4),
             "stitched": main,
             "stitched_waves_in_window": len(waves),
+            # ISSUE 11: the columnar bus channel record — whole-plane
+            # storm throughput over the REAL 4-process bus, the unary
+            # re-run of the same storm (writes per-object, template
+            # rendering full), and the plane-state parity verdict
+            "stitched_bindings_s": round(n / wall, 1) if wall else None,
+            "bus_unary_wall_s": round(unary_wall, 4),
+            "bus_unary_vs_batched": (
+                round(unary_wall / wall, 2) if wall else None
+            ),
+            "bus_parity_identical": parity,
+            "bus_top_self_phase": top_phase[0],
+            "bus_top_self_phase_s": round(top_phase[1], 4),
+            "bus_template_delta_works": delta_works,
+            "bus_templates": n_templates,
             "flight_recorded": bool(fault_rec),
             "flight_reasons": fault_rec["reasons"] if fault_rec else [],
             "flight_records": len(records),

@@ -172,8 +172,45 @@ class ManifestStatus:
 
 
 @dataclass
+class WorkloadTemplateRef:
+    """Template-delta Work rendering (ISSUE 11 tentpole c): instead of a
+    full manifest clone per target cluster, a Work may reference ONE
+    content-addressed ``WorkloadTemplate`` (shared by every Work of the
+    workload family) plus a small per-cluster ``patch`` of spec fields —
+    the replica revision the binding controller would have applied.
+    Consumers rehydrate via ``controllers.propagation.work_manifests``;
+    identity fields ride here so indexes and status routing never need
+    the template body."""
+
+    digest: str = ""
+    api_version: str = ""
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    patch: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class WorkloadTemplate:
+    """One rendered manifest per workload family, stored content-addressed
+    (``meta.name`` == digest) and shipped over the bus ONCE instead of
+    inside each of N Works. ``manifest`` is the pruned jsonable Resource
+    document (the shape ``utils.codec.to_jsonable`` emits)."""
+
+    KIND = "WorkloadTemplate"
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    manifest: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
 class WorkSpec:
     workload: list[Resource] = field(default_factory=list)
+    # template-delta rendering: when set (and workload is empty) the
+    # manifest is template + patch; full-object ``workload`` remains the
+    # fallback for non-templatable workloads (custom revise hooks,
+    # override-transformed targets) and the kill-switch path
+    workload_template: Optional[WorkloadTemplateRef] = None
     suspend_dispatching: bool = False
     preserve_resources_on_deletion: bool = False
     conflict_resolution: str = "Overwrite"  # Overwrite | Abort

@@ -56,8 +56,18 @@ class ReplicaStoreFacade:
     def apply(self, obj, *, expected_rv=None):
         return self._replica.apply(obj, expected_rv=expected_rv)
 
+    def apply_many(self, objs):
+        """Batched write-through (Store.apply_many contract): one
+        ApplyBatch RPC per KARMADA_TPU_BUS_BATCH ops instead of one
+        round-trip per object — the controllers' per-drain write sets
+        ride this over the bus."""
+        return self._replica.apply_many(objs)
+
     def delete(self, kind: str, key: str, force: bool = False):
         return self._replica.delete(kind, key, force=force)
+
+    def delete_many(self, keys):
+        return self._replica.delete_many(keys)
 
 
 def _default_member(name: str) -> MemberCluster:

@@ -13,11 +13,23 @@ Objects travel as canonical JSON of the API dataclasses (utils/codec);
 decode resolves classes from the kind registry below. Unknown kinds
 degrade to generic Resource manifests rather than failing the stream
 (forward compatibility across component versions).
+
+Columnar channel (ISSUE 11): the per-object Apply/Event round-trips were
+the measured whole-plane ceiling (BENCH_OBS_r02: 24.0 s of bus.rpc +
+8.3 s of bus.apply in a 35.1 s plane-self window), so the wire protocol
+is batched end to end — ``ApplyBatch`` carries a write SET per RPC with
+per-op resourceVersion/CAS results, and ``WatchBatch`` streams coalesced
+``EventFrame`` messages flushed by count (KARMADA_TPU_BUS_BATCH) or a
+few-ms timer (KARMADA_TPU_BUS_FLUSH_MS). Both negotiate per connection
+exactly like the estimator batch protocol: an old server answers
+UNIMPLEMENTED, the client pins the unary fallback, and a wire failure
+resets the pin so the reconnected channel re-probes.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 import time
@@ -30,15 +42,59 @@ from ..api.core import Resource
 from ..utils import Store
 from ..utils.codec import from_jsonable, to_jsonable
 from ..utils.metrics import (
+    bus_batch_size,
     bus_event_age_seconds,
     bus_events,
     bus_queue_depth,
     bus_subscribers,
 )
 from ..utils.store import ConflictError, Event as StoreEvent
+from .proto import storebus_batch_pb2 as bpb
 from .proto import storebus_pb2 as pb
 
 SERVICE_NAME = "karmada_tpu.bus.StoreBus"
+
+BUS_BATCH_ENV = "KARMADA_TPU_BUS_BATCH"
+BUS_FLUSH_MS_ENV = "KARMADA_TPU_BUS_FLUSH_MS"
+
+
+def bus_batch_max() -> int:
+    """Max ops per ApplyBatch / events per watch frame; 0 disables the
+    batched protocol entirely (the mixed-version escape hatch, mirroring
+    KARMADA_TPU_ESTIMATOR_BATCH)."""
+    raw = os.environ.get(BUS_BATCH_ENV, "").strip()
+    try:
+        return int(raw) if raw else 4096
+    except ValueError:
+        return 4096
+
+
+def bus_flush_ms() -> float:
+    """Watch-frame coalescing window: after the first queued event, the
+    stream waits up to this long for more before flushing the frame."""
+    raw = os.environ.get(BUS_FLUSH_MS_ENV, "").strip()
+    try:
+        return float(raw) if raw else 2.0
+    except ValueError:
+        return 2.0
+
+
+#: gRPC message-size ceiling for the bus channel (both directions). The
+#: grpc default of 4 MiB was sized for per-object messages; a batched
+#: write set / replay frame legitimately reaches tens of MiB. Producers
+#: still chunk against BATCH_BYTE_BUDGET so a healthy batch stays far
+#: below this hard cap.
+MAX_MESSAGE_BYTES = 128 << 20
+#: soft per-message byte budget: apply_many/delete_many cut a batch and
+#: watch streams flush a frame once the accumulated object JSON crosses
+#: it — count (KARMADA_TPU_BUS_BATCH) bounds the common case, this
+#: bounds the pathological one (few huge manifests)
+BATCH_BYTE_BUDGET = 16 << 20
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+    ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+]
 
 
 def _kind_registry() -> dict[str, type]:
@@ -112,6 +168,7 @@ class StoreBusServer:
         server_key: Optional[bytes] = None,
         client_ca: Optional[bytes] = None,
         max_workers: int = 8,
+        enable_batch: bool = True,
     ):
         self.store = store
         # (queue, kind filter, dead flag) per subscriber; dead[0] is set when
@@ -121,7 +178,7 @@ class StoreBusServer:
         store.watch_all(self._fan_out)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
-            options=[("grpc.so_reuseport", 0)],
+            options=[("grpc.so_reuseport", 0)] + _CHANNEL_OPTIONS,
         )
 
         from ..utils.tracing import decode_trace_metadata, tracer
@@ -129,8 +186,7 @@ class StoreBusServer:
         def _ctx(context):
             return decode_trace_metadata(context.invocation_metadata())
 
-        def watch(request: pb.WatchRequest, context):
-            kinds = frozenset(request.kinds)
+        def _subscribe(kinds):
             q: queue.Queue = queue.Queue(maxsize=100_000)
             dead = [False]  # set when the subscriber overflows (too slow)
             # register BEFORE replay so writes landing mid-replay re-deliver
@@ -139,6 +195,32 @@ class StoreBusServer:
             with self._lock:
                 self._subscribers.append((q, kinds, dead))
                 bus_subscribers.set(len(self._subscribers))
+            return q, dead
+
+        def _unsubscribe(q):
+            with self._lock:
+                self._subscribers = [
+                    s for s in self._subscribers if s[0] is not q
+                ]
+                bus_subscribers.set(len(self._subscribers))
+
+        def _replay_kinds(kinds):
+            # WorkloadTemplates replay FIRST: the Works that follow carry
+            # template refs, and a consumer reconciling a replayed Work
+            # must find its template already mirrored (alphabetical order
+            # would replay "Work" before "WorkloadTemplate")
+            names = sorted(
+                self.store.kinds(),
+                key=lambda k: (k != "WorkloadTemplate", k),
+            )
+            for kind in names:
+                if kinds and kind not in kinds:
+                    continue
+                yield kind
+
+        def watch(request: pb.WatchRequest, context):
+            kinds = frozenset(request.kinds)
+            q, dead = _subscribe(kinds)
             # the replay-to-bookmark window is the costly, attributable
             # part of a Watch (the live tail is unbounded by design —
             # GL007's stream exemption). MANUAL span, not a context
@@ -151,9 +233,7 @@ class StoreBusServer:
             try:
                 replayed = 0
                 if request.replay:
-                    for kind in sorted(self.store.kinds()):
-                        if kinds and kind not in kinds:
-                            continue
+                    for kind in _replay_kinds(kinds):
                         for obj in self.store.list(kind):
                             replayed += 1
                             yield pb.Event(
@@ -173,7 +253,7 @@ class StoreBusServer:
             try:
                 while context.is_active() and not dead[0]:
                     try:
-                        queued_at, ev = q.get(timeout=0.5)
+                        queued_at, fields = q.get(timeout=0.5)
                     except queue.Empty:
                         continue
                     # queue AGE: how long the event sat behind this
@@ -183,15 +263,111 @@ class StoreBusServer:
                     bus_event_age_seconds.observe(
                         time.monotonic() - queued_at
                     )
-                    yield ev
+                    yield pb.Event(
+                        type=fields[0], kind=fields[1], key=fields[2],
+                        resource_version=fields[3], object_json=fields[4],
+                    )
                 # dead: fall through — closing the stream forces the client
                 # to reconnect and re-list, healing the dropped-event gap
             finally:
-                with self._lock:
-                    self._subscribers = [
-                        s for s in self._subscribers if s[0] is not q
-                    ]
-                    bus_subscribers.set(len(self._subscribers))
+                _unsubscribe(q)
+
+        def watch_batch(request: pb.WatchRequest, context):
+            """Batched watch: coalesced EventFrames instead of one gRPC
+            message per event. Frames flush at ``bus_batch_max()`` events
+            or after ``bus_flush_ms()`` of quiet following the first
+            queued event — latency bounded by the timer, throughput by
+            the frame size. Event AGE stays per-event (each queue entry
+            carries its own enqueue stamp) so coalescing cannot fake a
+            low queue age."""
+            kinds = frozenset(request.kinds)
+            flush_max = max(bus_batch_max(), 1)
+            flush_s = max(bus_flush_ms(), 0.0) / 1000.0
+            q, dead = _subscribe(kinds)
+            sp = tracer.server_open_manual(
+                "bus.watch", _ctx(context), kinds=len(kinds), batch=True
+            )
+            try:
+                replayed = 0
+                if request.replay:
+                    frame: list = []
+                    frame_bytes = 0
+                    for kind in _replay_kinds(kinds):
+                        for obj in self.store.list(kind):
+                            replayed += 1
+                            doc = encode_object(obj)
+                            frame.append(bpb.FrameEvent(
+                                type="Added",
+                                kind=kind,
+                                key=obj.meta.namespaced_name,
+                                resource_version=obj.meta.resource_version,
+                                object_json=doc,
+                            ))
+                            frame_bytes += len(doc)
+                            if (
+                                len(frame) >= flush_max
+                                or frame_bytes >= BATCH_BYTE_BUDGET
+                            ):
+                                bus_batch_size.observe(len(frame))
+                                yield bpb.EventFrame(events=frame)
+                                frame = []
+                                frame_bytes = 0
+                    if frame:
+                        bus_batch_size.observe(len(frame))
+                        yield bpb.EventFrame(events=frame)
+                sp.attrs["replayed"] = replayed
+            finally:
+                tracer.close_manual(sp)
+            yield bpb.EventFrame(bookmark=True)
+            try:
+                while context.is_active() and not dead[0]:
+                    try:
+                        entry = q.get(timeout=0.5)
+                    except queue.Empty:
+                        continue
+                    entries = [entry]
+                    nbytes = len(entry[1][4])
+                    flush_at = time.monotonic() + flush_s
+                    while (
+                        len(entries) < flush_max
+                        and nbytes < BATCH_BYTE_BUDGET
+                    ):
+                        wait = flush_at - time.monotonic()
+                        if wait <= 0:
+                            # timer expired: drain whatever is already
+                            # queued without blocking, then flush
+                            try:
+                                while (
+                                    len(entries) < flush_max
+                                    and nbytes < BATCH_BYTE_BUDGET
+                                ):
+                                    e = q.get_nowait()
+                                    entries.append(e)
+                                    nbytes += len(e[1][4])
+                            except queue.Empty:
+                                pass
+                            break
+                        try:
+                            e = q.get(timeout=wait)
+                            entries.append(e)
+                            nbytes += len(e[1][4])
+                        except queue.Empty:
+                            break
+                    now = time.monotonic()
+                    events = []
+                    for queued_at, fields in entries:
+                        # per-EVENT age (satellite: a frame of N events
+                        # records N observations, not 1)
+                        bus_event_age_seconds.observe(now - queued_at)
+                        events.append(bpb.FrameEvent(
+                            type=fields[0], kind=fields[1], key=fields[2],
+                            resource_version=fields[3],
+                            object_json=fields[4],
+                        ))
+                    bus_batch_size.observe(len(events))
+                    yield bpb.EventFrame(events=events)
+            finally:
+                _unsubscribe(q)
 
         def apply(request: pb.ApplyRequest, context):
             with tracer.server_span(
@@ -232,6 +408,77 @@ class StoreBusServer:
                     sp.attrs["error"] = type(e).__name__
                     return pb.DeleteResponse(error=str(e))
 
+        def apply_batch(request: "bpb.ApplyBatchRequest", context):
+            """One write SET per RPC. Plain applies commit through the
+            store's batched path (one lock sweep + one delivery sweep);
+            CAS-conditional ops and deletes run individually IN op order
+            so a conflict surfaces on exactly the conflicting op while
+            the rest of the batch commits (the reference's controller
+            writebacks are independent per-object patches)."""
+            ops = request.ops
+            bus_batch_size.observe(len(ops))
+            with tracer.server_span(
+                "bus.apply_batch", _ctx(context), ops=len(ops),
+            ) as sp:
+                results = [None] * len(ops)
+                plain: list[tuple[int, object]] = []
+                errors = 0
+
+                def flush_plain():
+                    if not plain:
+                        return
+                    objs = [obj for _, obj in plain]
+                    failed = {
+                        id(obj): exc
+                        for obj, exc in self.store.apply_many(objs)
+                    }
+                    for i, obj in plain:
+                        exc = failed.get(id(obj))
+                        if exc is not None:
+                            results[i] = bpb.BatchResult(error=str(exc))
+                        else:
+                            results[i] = bpb.BatchResult(
+                                resource_version=obj.meta.resource_version
+                            )
+                    plain.clear()
+
+                for i, op in enumerate(ops):
+                    try:
+                        if op.delete:
+                            flush_plain()
+                            gone = self.store.delete(
+                                op.kind, op.key, force=op.force
+                            )
+                            results[i] = bpb.BatchResult(
+                                deleted=gone is not None
+                            )
+                        elif op.conditional:
+                            flush_plain()
+                            applied = self.store.apply(
+                                decode_object(op.kind, op.object_json),
+                                expected_rv=op.expected_rv,
+                            )
+                            results[i] = bpb.BatchResult(
+                                resource_version=(
+                                    applied.meta.resource_version
+                                )
+                            )
+                        else:
+                            plain.append(
+                                (i, decode_object(op.kind, op.object_json))
+                            )
+                    except ConflictError as e:
+                        results[i] = bpb.BatchResult(
+                            error=str(e), conflict=True
+                        )
+                    except Exception as e:  # noqa: BLE001 — wire surface
+                        results[i] = bpb.BatchResult(error=str(e))
+                flush_plain()
+                errors = sum(1 for r in results if r.error)
+                if errors:
+                    sp.attrs["errors"] = errors
+                return bpb.ApplyBatchResponse(results=results)
+
         handlers = {
             "Watch": grpc.unary_stream_rpc_method_handler(
                 watch,
@@ -249,6 +496,21 @@ class StoreBusServer:
                 response_serializer=pb.DeleteResponse.SerializeToString,
             ),
         }
+        # the batched protocol ships behind a registration toggle: an
+        # old-server shape (enable_batch=False, the mixed-version tests)
+        # leaves ApplyBatch/WatchBatch unregistered so clients get
+        # UNIMPLEMENTED and negotiate the unary fallback per connection
+        if enable_batch:
+            handlers["ApplyBatch"] = grpc.unary_unary_rpc_method_handler(
+                apply_batch,
+                request_deserializer=bpb.ApplyBatchRequest.FromString,
+                response_serializer=bpb.ApplyBatchResponse.SerializeToString,
+            )
+            handlers["WatchBatch"] = grpc.unary_stream_rpc_method_handler(
+                watch_batch,
+                request_deserializer=pb.WatchRequest.FromString,
+                response_serializer=bpb.EventFrame.SerializeToString,
+            )
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
         )
@@ -279,19 +541,22 @@ class StoreBusServer:
             ]
         if not subs:
             return  # no interested subscriber: stay off the write path
-        msg = pb.Event(
-            type=event.type,
-            kind=event.kind,
-            key=event.key,
-            resource_version=getattr(event.obj.meta, "resource_version", 0),
-            object_json=encode_object(event.obj),
+        # encode ONCE per event; queues carry (enqueue stamp, field tuple)
+        # and each stream mode builds its own wire message — the stamp is
+        # per event so frame coalescing cannot fake a low queue age
+        fields = (
+            event.type,
+            event.kind,
+            event.key,
+            getattr(event.obj.meta, "resource_version", 0),
+            encode_object(event.obj),
         )
         now = time.monotonic()
         depth = 0
         dropped = 0
         for q, _, dead in subs:
             try:
-                q.put_nowait((now, msg))
+                q.put_nowait((now, fields))
                 depth = max(depth, q.qsize())
             except queue.Full:
                 # slow subscriber: close its stream so it reconnects and
@@ -339,9 +604,13 @@ class StoreReplica:
                 private_key=client_key,
                 certificate_chain=client_cert,
             )
-            self._channel = grpc.secure_channel(target, creds)
+            self._channel = grpc.secure_channel(
+                target, creds, options=_CHANNEL_OPTIONS
+            )
         else:
-            self._channel = grpc.insecure_channel(target)
+            self._channel = grpc.insecure_channel(
+                target, options=_CHANNEL_OPTIONS
+            )
         self._target = target
         self.store = Store()
         self.kinds = kinds
@@ -360,6 +629,24 @@ class StoreReplica:
             request_serializer=pb.DeleteRequest.SerializeToString,
             response_deserializer=pb.DeleteResponse.FromString,
         )
+        self._apply_batch = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/ApplyBatch",
+            request_serializer=bpb.ApplyBatchRequest.SerializeToString,
+            response_deserializer=bpb.ApplyBatchResponse.FromString,
+        )
+        self._watch_batch = self._channel.unary_stream(
+            f"/{SERVICE_NAME}/WatchBatch",
+            request_serializer=pb.WatchRequest.SerializeToString,
+            response_deserializer=bpb.EventFrame.FromString,
+        )
+        # batched-protocol negotiation, one pin per RPC surface: None
+        # until the first call, False after an UNIMPLEMENTED answer (old
+        # server), True after a batched success. A WIRE failure resets
+        # the pin to None so the transparently-reconnected channel
+        # re-probes before reuse (the returning server may be a
+        # different build) — the estimator-channel contract verbatim.
+        self.supports_batch: Optional[bool] = None
+        self._watch_supports_batch: Optional[bool] = None
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -404,26 +691,56 @@ class StoreReplica:
         rng = random.Random()
         sleeps = policy.sleeps(rng)
         while not self._stop.is_set():
+            use_batch = (
+                bus_batch_max() > 0
+                and self._watch_supports_batch is not False
+            )
             try:
                 apply_fault(
                     fault_point("bus.watch", "Watch"), "bus.watch", "Watch"
                 )
-                stream = self._watch(
-                    pb.WatchRequest(kinds=list(self.kinds), replay=True)
-                )
-                for ev in stream:
-                    if self._stop.is_set():
-                        return
-                    if ev.type == "Bookmark":
-                        # replay fully consumed: NOW the mirror is synced
-                        self._synced.set()
-                        # healthy stream: reset the reconnect schedule
-                        sleeps = policy.sleeps(rng)
-                        continue
-                    self._apply_event(ev)
-            except grpc.RpcError:
+                req = pb.WatchRequest(kinds=list(self.kinds), replay=True)
+                if use_batch:
+                    # frames drain WHOLE: every event of a frame applies
+                    # before the loop returns to the wire, so a consumer
+                    # settling the runtime sees the coalesced burst as
+                    # one enqueue wave rather than N stream wakeups
+                    for frame in self._watch_batch(req):
+                        if self._stop.is_set():
+                            return
+                        self._watch_supports_batch = True
+                        for ev in frame.events:
+                            self._apply_event(ev)
+                        if frame.bookmark:
+                            self._synced.set()
+                            sleeps = policy.sleeps(rng)
+                else:
+                    for ev in self._watch(req):
+                        if self._stop.is_set():
+                            return
+                        if ev.type == "Bookmark":
+                            # replay fully consumed: NOW synced
+                            self._synced.set()
+                            # healthy stream: reset reconnect schedule
+                            sleeps = policy.sleeps(rng)
+                            continue
+                        self._apply_event(ev)
+            except grpc.RpcError as exc:
                 if self._stop.is_set():
                     return
+                if (
+                    use_batch
+                    and exc.code() == grpc.StatusCode.UNIMPLEMENTED
+                ):
+                    # old server: pin the unary fallback for this
+                    # connection and retry immediately (the server
+                    # ANSWERED — no backoff, the channel is healthy)
+                    self._watch_supports_batch = False
+                    continue
+                # wire failure: reset the negotiation pin so the
+                # reconnected channel re-probes (the returning server
+                # may be a different build)
+                self._watch_supports_batch = None
                 self._synced.clear()
                 # decorrelated-jitter reconnect (was a fixed 200 ms loop:
                 # a partitioned bus saw every replica re-list in lockstep)
@@ -479,7 +796,16 @@ class StoreReplica:
                 apply_fault(
                     fault_point("bus.rpc", method), "bus.rpc", method
                 )
-                return stub(req, timeout=timeout, metadata=md)
+                try:
+                    return stub(req, timeout=timeout, metadata=md)
+                except grpc.RpcError:
+                    # wire failure on the UNARY path also resets the
+                    # batch negotiation pin: a replica pinned to the
+                    # unary fallback by an old server must re-probe
+                    # after the reconnect (the returning server may be
+                    # a batch-capable build)
+                    self.supports_batch = None
+                    raise
 
         return call_with_resilience(
             attempt,
@@ -489,6 +815,180 @@ class StoreReplica:
             deadline=Deadline(self.timeout),
             retryable=(grpc.RpcError,),
         )
+
+    _UNSUPPORTED = object()  # sentinel: server answered UNIMPLEMENTED
+
+    def _resilient_batch(self, req, n_ops: int, *, retry: bool = True):
+        """One ApplyBatch RPC under the unified policy: ONE Deadline
+        budget for the whole batch (not per op), retries only when every
+        op is an idempotent unconditional apply/delete (a CAS op inside
+        the batch makes the whole RPC retry-once — re-running a
+        committed conditional write would surface the caller's OWN
+        commit as a false conflict). UNIMPLEMENTED is a NEGOTIATION
+        answer, not a failure: the attempt returns the sentinel so the
+        breaker records a healthy channel and the caller falls back."""
+        from ..utils.backoff import Deadline, call_with_resilience
+        from ..utils.faultinject import apply_fault, fault_point
+        from ..utils.tracing import trace_metadata, tracer
+
+        def attempt(timeout: float):
+            # the client span carries the batch size: the stitched
+            # channel table's events-per-message column keys on it
+            with tracer.span(
+                "bus.rpc", remote=True, peer=self._target,
+                method="ApplyBatch", batch=n_ops,
+            ):
+                md = trace_metadata(tracer.current_context())
+                # PR 7 seam: the injection point fires once per BATCH
+                # attempt (the batch is the wire unit now)
+                apply_fault(
+                    fault_point("bus.rpc", "ApplyBatch"),
+                    "bus.rpc", "ApplyBatch",
+                )
+                try:
+                    return self._apply_batch(
+                        req, timeout=timeout, metadata=md
+                    )
+                except grpc.RpcError as exc:
+                    if exc.code() == grpc.StatusCode.UNIMPLEMENTED:
+                        self.supports_batch = False
+                        return self._UNSUPPORTED
+                    self.supports_batch = None  # wire failure: re-probe
+                    raise
+
+        return call_with_resilience(
+            attempt,
+            channel="bus",
+            policy=self._policy if retry else self._policy_once,
+            breaker=self.breaker,
+            deadline=Deadline(self.timeout),
+            retryable=(grpc.RpcError,),
+        )
+
+    @staticmethod
+    def _op_for(obj, expected_rv=None) -> "bpb.BatchOp":
+        kind = type(obj).KIND if hasattr(type(obj), "KIND") else "Resource"
+        return bpb.BatchOp(
+            kind=kind,
+            object_json=encode_object(obj),
+            conditional=expected_rv is not None,
+            expected_rv=expected_rv or 0,
+        )
+
+    def apply_many(self, objs, *, expected_rvs=None) -> list:
+        """Batched write-through: ships the whole write set as ApplyBatch
+        RPCs of at most ``bus_batch_max()`` ops each. Returns ``[(obj,
+        exc), ...]`` for per-object failures (the Store.apply_many
+        contract — one rejected object must not void the batch).
+        ``expected_rvs`` (aligned with ``objs``, None entries
+        unconditional) carries CAS preconditions; conflicts come back as
+        ConflictError on exactly the conflicting object. Old servers
+        negotiate the per-object unary fallback transparently.
+
+        Unlike the in-proc ``Store.apply_many``, the primary's new
+        resource_version is NOT stamped onto the caller's objects —
+        ``StoreReplica.apply`` semantics: the caller's object is often
+        THE replica-mirror object (facade writers mutate in place), and
+        pre-stamping it would make ``_apply_event``'s replay dedup
+        swallow the write's own echo — the commit signal every watching
+        controller converges on."""
+        objs = list(objs)
+        if not objs:
+            return []
+        rvs = list(expected_rvs) if expected_rvs is not None else [None] * len(objs)
+        batch_max = bus_batch_max()
+        errors: list = []
+        # index of the first object NOT yet committed batched: an
+        # UNIMPLEMENTED answer mid-set (server replaced by an old build
+        # between chunks) must fall back for the REMAINDER only —
+        # replaying committed chunks unary would duplicate writes and
+        # surface the caller's own committed CAS ops as false conflicts
+        pending_from = 0
+        if batch_max > 0 and self.supports_batch is not False:
+            i = 0
+            while i < len(objs):
+                # cut a batch on COUNT (the env knob) or accumulated
+                # object-JSON BYTES (so a few huge manifests cannot
+                # push one RPC toward the transport's message cap)
+                chunk: list = []
+                chunk_rvs: list = []
+                ops: list = []
+                nbytes = 0
+                while (
+                    i < len(objs)
+                    and len(ops) < batch_max
+                    and (not ops or nbytes < BATCH_BYTE_BUDGET)
+                ):
+                    op = self._op_for(objs[i], rvs[i])
+                    ops.append(op)
+                    chunk.append(objs[i])
+                    chunk_rvs.append(rvs[i])
+                    nbytes += len(op.object_json)
+                    i += 1
+                resp = self._resilient_batch(
+                    bpb.ApplyBatchRequest(ops=ops), len(ops),
+                    retry=all(rv is None for rv in chunk_rvs),
+                )
+                if resp is self._UNSUPPORTED:
+                    break  # negotiated: the rest goes unary
+                self.supports_batch = True
+                pending_from = i
+                for obj, res in zip(chunk, resp.results):
+                    if res.error:
+                        errors.append((
+                            obj,
+                            ConflictError(res.error)
+                            if res.conflict
+                            else RuntimeError(res.error),
+                        ))
+            else:
+                return errors
+        # unary fallback (old server or batching disabled by env) for the
+        # not-yet-committed remainder
+        for obj, rv in zip(objs[pending_from:], rvs[pending_from:]):
+            try:
+                self.apply(obj, expected_rv=rv)
+            except Exception as exc:  # noqa: BLE001 — per-object verdict
+                errors.append((obj, exc))
+        return errors
+
+    def delete_many(self, keys) -> list:
+        """Batched deletes: ``keys`` is an iterable of (kind, key) or
+        (kind, key, force) tuples; returns per-key failures as
+        ``[((kind, key), exc), ...]``."""
+        keys = [k if len(k) == 3 else (k[0], k[1], False) for k in keys]
+        if not keys:
+            return []
+        batch_max = bus_batch_max()
+        errors: list = []
+        pending_from = 0  # first key not yet committed batched
+        if batch_max > 0 and self.supports_batch is not False:
+            for start in range(0, len(keys), batch_max):
+                chunk = keys[start:start + batch_max]
+                req = bpb.ApplyBatchRequest(ops=[
+                    bpb.BatchOp(
+                        kind=kind, key=key, delete=True, force=force
+                    )
+                    for kind, key, force in chunk
+                ])
+                resp = self._resilient_batch(req, len(chunk))
+                if resp is self._UNSUPPORTED:
+                    break  # negotiated: the rest goes unary
+                self.supports_batch = True
+                pending_from = start + len(chunk)
+                for (kind, key, _f), res in zip(chunk, resp.results):
+                    if res.error:
+                        errors.append(
+                            ((kind, key), RuntimeError(res.error))
+                        )
+            else:
+                return errors
+        for kind, key, force in keys[pending_from:]:
+            try:
+                self.delete(kind, key, force=force)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(((kind, key), exc))
+        return errors
 
     def apply(self, obj, *, expected_rv=None) -> int:
         kind = type(obj).KIND if hasattr(type(obj), "KIND") else "Resource"

@@ -61,7 +61,17 @@ class ResourceDetector:
     ) -> None:
         self.store = store
         self.interpreter = interpreter
-        self.worker = runtime.new_worker("detector", self._reconcile)
+        # per-drain write set (ISSUE 11): claims + bindings buffer during
+        # a batched drain and flush as one store.apply_many; per-namespace
+        # ownership sharding keeps one namespace's storm from serializing
+        # another's drain on a single queue
+        self._buffering = False
+        self._pending: list = []
+        self.worker = runtime.new_worker(
+            "detector", self._reconcile,
+            reconcile_batch=self._reconcile_batch,
+            shard_fn=lambda key: key.partition("/")[0] if "/" in key else "",
+        )
         # keys whose pending reconcile was triggered ONLY by Karmada itself
         # (policy events), not by a user template change — consumed by the
         # lazy-activation gate (detector.go:444,529 resourceChangeByKarmada).
@@ -108,6 +118,48 @@ class ResourceDetector:
                 self.worker.enqueue(key)
 
     # -- reconcile ---------------------------------------------------------
+
+    def _reconcile_batch(self, keys) -> dict:
+        out: dict = {}
+        self._buffering = True
+        try:
+            for key in keys:
+                out[key] = self._reconcile(key)
+        finally:
+            self._buffering = False
+            self._flush()
+        return out
+
+    def _apply(self, obj) -> None:
+        if self._buffering:
+            self._pending.append(obj)
+        else:
+            self.store.apply(obj)
+
+    def _flush(self) -> None:
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        apply_many = getattr(self.store, "apply_many", None)
+        if apply_many is not None:
+            for obj, err in apply_many(pending):
+                print(
+                    f"# detector: apply rejected for "
+                    f"{obj.meta.namespaced_name}: {err}",
+                    flush=True,
+                )
+                # re-reconcile the TEMPLATE the rejected write belongs
+                # to (bindings carry their template in spec.resource) —
+                # the unbatched path raised here and the worker retried
+                resource = getattr(obj.spec, "resource", None)
+                self.worker.enqueue(
+                    resource.namespaced_key
+                    if resource is not None
+                    else obj.meta.namespaced_name
+                )
+        else:
+            for obj in pending:
+                self.store.apply(obj)
 
     def _reconcile(self, key: str) -> Optional[str]:
         by_karmada = key in self._by_karmada
@@ -182,7 +234,7 @@ class ResourceDetector:
             labels[POLICY_NS_LABEL] = policy.meta.namespace
             labels.pop(CLUSTER_POLICY_LABEL, None)
         if changed:
-            self.store.apply(template)
+            self._apply(template)
 
     def _unclaim(self, template: Resource) -> None:
         labels = template.meta.labels
@@ -245,7 +297,7 @@ class ResourceDetector:
             existing.spec = spec
             if changed:
                 existing.meta.generation += 1
-            self.store.apply(existing)
+            self._apply(existing)
         else:
             from ..api.work import ClusterResourceBinding
 
@@ -260,7 +312,7 @@ class ResourceDetector:
                 ),
                 spec=spec,
             )
-            self.store.apply(rb)
+            self._apply(rb)
 
     def _remove_binding_for(self, template_key: str) -> None:
         ns, _, name = template_key.rpartition("/")

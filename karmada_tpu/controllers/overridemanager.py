@@ -211,7 +211,30 @@ class OverrideManager:
     def __init__(self, store) -> None:
         self.store = store
 
-    def apply_overrides(self, obj: Resource, cluster: Cluster) -> Resource:
+    def overrides_match(self, obj: Resource, cluster: Cluster) -> bool:
+        """Would ``apply_overrides`` transform this (resource, cluster)
+        pair? Match-only probe — no clone, no overrider application (the
+        template-delta renderer asks this per target per rebuild; paying
+        the full transform just to discard it doubled every overridden
+        target's cost). Sound against the chained-match subtlety in
+        ``apply_overrides`` (later policies match the progressively
+        overridden object): any transform chain begins with some policy
+        matching the ORIGINAL object, so "no policy matches the original"
+        ⇔ "apply_overrides returns the object unchanged"."""
+        for policy in self._policies_for(obj):
+            if not resource_matches_selectors(
+                obj, policy.spec.resource_selectors
+            ):
+                continue
+            for rule in policy.spec.override_rules:
+                if (
+                    rule.target_cluster is None
+                    or rule.target_cluster.matches(cluster)
+                ):
+                    return True
+        return False
+
+    def _policies_for(self, obj: Resource) -> list:
         cops = sorted(
             self.store.list("ClusterOverridePolicy"), key=lambda p: p.meta.name
         )
@@ -223,11 +246,14 @@ class OverrideManager:
             ),
             key=lambda p: p.meta.name,
         )
+        return list(cops) + list(ops)
+
+    def apply_overrides(self, obj: Resource, cluster: Cluster) -> Resource:
         # clone lazily: most (resource, cluster) pairs match no rule, and
         # the unconditional copy was a top propagation-storm cost. Callers
         # treat an identical return as "no overrides applied".
         out = None
-        for policy in list(cops) + list(ops):
+        for policy in self._policies_for(obj):
             cur = out if out is not None else obj
             if not resource_matches_selectors(cur, policy.spec.resource_selectors):
                 continue

@@ -17,7 +17,10 @@ Ref:
 from __future__ import annotations
 
 from ..utils.clone import clone_resource
+import hashlib
+import json
 import math
+import os
 from typing import Optional
 
 from ..api.core import Condition, ObjectMeta, Resource, set_condition
@@ -28,11 +31,14 @@ from ..api.work import (
     ManifestStatus,
     ResourceBinding,
     Work,
+    WorkloadTemplate,
+    WorkloadTemplateRef,
     WorkSpec,
 )
 from ..api.policy import DIVIDED
 from ..interpreter import ResourceInterpreter
 from ..utils import DONE, REQUEUE, Runtime, Store
+from ..utils.codec import from_jsonable, to_jsonable
 from ..utils.metrics import works_rendered
 from ..utils.member import (
     ConflictError,
@@ -48,6 +54,17 @@ WORK_BINDING_LABEL = "resourcebinding.karmada.io/key"  # value: "<kind>:<key>"
 
 BINDING_KINDS = ("ResourceBinding", "ClusterResourceBinding")
 
+TEMPLATE_DELTA_ENV = "KARMADA_TPU_BUS_TEMPLATE_DELTA"
+
+
+def template_delta_enabled() -> bool:
+    """Template-delta Work rendering kill switch (ISSUE 11 tentpole c):
+    set KARMADA_TPU_BUS_TEMPLATE_DELTA=0 to force full-object rendering
+    for every Work (the degraded/compat path)."""
+    return os.environ.get(TEMPLATE_DELTA_ENV, "1").lower() not in (
+        "0", "false", ""
+    )
+
 
 def binding_ref(kind: str, key: str) -> str:
     return f"{kind}:{key}"
@@ -61,14 +78,91 @@ def cluster_of_execution_namespace(ns: str) -> Optional[str]:
     return ns[len(ES_PREFIX):] if ns.startswith(ES_PREFIX) else None
 
 
+def binding_namespace_shard(kind_key) -> str:
+    """Per-namespace ownership token for worker sharding: drains of
+    different namespaces ride different shard queues, so one namespace's
+    storm (or poisoned key bisect) never head-of-line-blocks another's
+    batch flush."""
+    _, key = kind_key
+    ns, sep, _ = key.partition("/")
+    return ns if sep else ""
+
+
+def _patch_key(patch: dict) -> tuple:
+    return tuple(sorted(patch.items()))
+
+
 def _work_signature(work: Work):
-    w = work.spec.workload[0] if work.spec.workload else None
+    ref = work.spec.workload_template
+    if ref is not None and ref.digest:
+        # template-delta works: content identity is (digest, patch) —
+        # the manifest body lives in the content-addressed template
+        w_sig = ("tpl", ref.digest, _patch_key(ref.patch))
+        labels = None
+    else:
+        w = work.spec.workload[0] if work.spec.workload else None
+        w_sig = w.spec if w else None
+        labels = w.meta.labels if w else None
     return (
-        w.spec if w else None,
-        w.meta.labels if w else None,
+        w_sig,
+        labels,
         work.spec.suspend_dispatching,
         work.spec.preserve_resources_on_deletion,
     )
+
+
+class TemplateRehydrator:
+    """Consumer-side template-delta cache: decodes each WorkloadTemplate
+    manifest ONCE (content-addressed — a digest's body never changes) and
+    renders each Work's manifest as clone(base) + patch, memoized per
+    Work so repeated reconciles hand back the SAME object (the member
+    ObjectWatcher's no-op cache pins on manifest identity). Returns None
+    when the template has not been mirrored yet — callers REQUEUE and the
+    WorkloadTemplate watch unparks them."""
+
+    def __init__(self, store) -> None:
+        self.store = store
+        self._base: dict[str, Resource] = {}
+        # work key -> (digest, patch key, rendered list)
+        self._rendered: dict[str, tuple] = {}
+
+    def manifests(self, work: Work) -> Optional[list]:
+        ref = work.spec.workload_template
+        if ref is None or not ref.digest:
+            return work.spec.workload
+        pkey = _patch_key(ref.patch)
+        hit = self._rendered.get(work.meta.namespaced_name)
+        if hit is not None and hit[0] == ref.digest and hit[1] == pkey:
+            return hit[2]
+        base = self._base.get(ref.digest)
+        if base is None:
+            tpl = self.store.get("WorkloadTemplate", ref.digest)
+            if tpl is None:
+                return None  # not mirrored yet: caller requeues
+            base = from_jsonable(Resource, tpl.manifest)
+            self._base[ref.digest] = base
+        out = clone_resource(base)
+        if ref.patch:
+            out.spec.update(ref.patch)
+        rendered = [out]
+        self._rendered[work.meta.namespaced_name] = (
+            ref.digest, pkey, rendered
+        )
+        return rendered
+
+    def forget_digest(self, digest: str) -> None:
+        self._base.pop(digest, None)
+
+    def forget_work(self, key: str) -> None:
+        self._rendered.pop(key, None)
+
+
+def work_manifests(store, work: Work, rehydrator=None) -> Optional[list]:
+    """The manifest list of a Work, rehydrating template-delta Works from
+    their WorkloadTemplate (None = template not mirrored yet). One-shot
+    helper; long-lived consumers hold a TemplateRehydrator for the
+    decode/render caches."""
+    return (rehydrator or TemplateRehydrator(store)).manifests(work)
 
 
 class WorkIndex:
@@ -83,16 +177,28 @@ class WorkIndex:
         self.store = store
         self._by_binding: dict[str, set[str]] = {}
         self._by_target: dict[tuple, str] = {}
-        self._work_meta: dict[str, tuple] = {}  # work key -> (ref, targets)
+        # work key -> (ref, targets, template digest)
+        self._work_meta: dict[str, tuple] = {}
+        # template digest -> referencing work keys (the template GC's
+        # refcount surface: a digest nobody references is collectable)
+        self._by_digest: dict[str, set[str]] = {}
         # watch(replay=True) synthesizes Added for Works already in the store,
         # so the index seeds correctly against a populated store.
         store.watch("Work", self._on_event)
 
     def _on_event(self, event) -> None:
         key = event.key
-        old_ref, old_targets = self._work_meta.pop(key, (None, ()))
+        old_ref, old_targets, old_digest = self._work_meta.pop(
+            key, (None, (), None)
+        )
         if old_ref is not None:
             self._by_binding.get(old_ref, set()).discard(key)
+        if old_digest is not None:
+            refs = self._by_digest.get(old_digest)
+            if refs is not None:
+                refs.discard(key)
+                if not refs:
+                    del self._by_digest[old_digest]
         for t in old_targets:
             if self._by_target.get(t) == key:
                 del self._by_target[t]
@@ -101,20 +207,33 @@ class WorkIndex:
         work = event.obj
         ref = work.meta.labels.get(WORK_BINDING_LABEL)
         cluster = cluster_of_execution_namespace(work.meta.namespace)
-        targets = (
-            tuple(
+        tref = work.spec.workload_template
+        digest = tref.digest if tref is not None and tref.digest else None
+        if cluster is None:
+            targets = ()
+        elif digest is not None:
+            # template-delta works carry target identity on the ref —
+            # the index never needs the template body
+            targets = (
+                (cluster, f"{tref.api_version}/{tref.kind}",
+                 tref.namespace, tref.name),
+            )
+        else:
+            targets = tuple(
                 (cluster, f"{w.api_version}/{w.kind}",
                  w.meta.namespace, w.meta.name)
                 for w in work.spec.workload
             )
-            if cluster is not None
-            else ()
-        )
         if ref:
             self._by_binding.setdefault(ref, set()).add(key)
+        if digest is not None:
+            self._by_digest.setdefault(digest, set()).add(key)
         for t in targets:
             self._by_target[t] = key
-        self._work_meta[key] = (ref, targets)
+        self._work_meta[key] = (ref, targets, digest)
+
+    def digest_refcount(self, digest: str) -> int:
+        return len(self._by_digest.get(digest, ()))
 
     def works_for(self, binding_ref: str) -> list:
         out = []
@@ -161,7 +280,28 @@ class BindingController:
         # Works this controller deleted itself (orphan cleanup): their
         # Deleted events must not void the freshly written cache entry
         self._own_deletes: set[str] = set()
-        self.worker = runtime.new_worker("binding", self._reconcile)
+        # template-delta rendering: (binding ref -> ((uid, generation,
+        # rv), digest, pruned manifest doc)) content cache — keyed by
+        # REF so binding deletion evicts it (a uid key would grow with
+        # all-time template churn) — digests already published to the
+        # store, binding ref -> digest for GC, and the digests whose
+        # refcount must be re-checked after the next flush
+        self._tpl_cache: dict[str, tuple] = {}
+        self._tpl_published: set[str] = set()
+        self._built_digest: dict[str, str] = {}
+        self._gc_digests: set[str] = set()
+        # per-drain write set (ISSUE 11 tentpole b): reconciles buffer
+        # their Work applies/deletes and the drain flushes them as ONE
+        # batched write (store.apply_many -> one lock+delivery sweep
+        # in-proc, one ApplyBatch RPC over the bus facade)
+        self._buffering = False
+        self._pending_applies: list = []
+        self._pending_deletes: list = []
+        self.worker = runtime.new_worker(
+            "binding", self._reconcile,
+            reconcile_batch=self._reconcile_batch,
+            shard_fn=binding_namespace_shard,
+        )
         for kind in BINDING_KINDS:
             store.watch(
                 kind, lambda e, k=kind: self.worker.enqueue((k, e.key))
@@ -247,6 +387,169 @@ class BindingController:
             for rb in self.store.list(kind):
                 self.worker.enqueue((kind, rb.meta.namespaced_name))
 
+    def _reconcile_batch(self, kind_keys) -> dict:
+        """Batched drain: reconciles buffer their Work writes and ONE
+        flush commits the whole drain's write set (ISSUE 11: per-drain
+        write sets instead of per-object applies). Safe under the
+        worker's poisoned-key bisect — reconciles are idempotent and the
+        signature gate no-ops re-runs of already-flushed work."""
+        out: dict = {}
+        self._buffering = True
+        try:
+            for kind_key in kind_keys:
+                out[kind_key] = self._reconcile(kind_key)
+        finally:
+            self._buffering = False
+            self._flush()
+        return out
+
+    def _apply_work(self, work: Work) -> None:
+        if self._buffering:
+            self._pending_applies.append(work)
+        else:
+            self.store.apply(work)
+
+    def _delete_work(self, key: str) -> None:
+        self._own_deletes.add(key)
+        if self._buffering:
+            self._pending_deletes.append(("Work", key))
+        else:
+            self.store.delete("Work", key)
+
+    def _flush(self) -> None:
+        applies, self._pending_applies = self._pending_applies, []
+        deletes, self._pending_deletes = self._pending_deletes, []
+        if applies:
+            apply_many = getattr(self.store, "apply_many", None)
+            if apply_many is not None:
+                for obj, err in apply_many(applies):
+                    print(
+                        f"# binding controller: work apply rejected for "
+                        f"{obj.meta.namespaced_name}: {err}",
+                        flush=True,
+                    )
+                    # the unbatched path RAISED here, skipping the
+                    # _built update so the worker retried; batched, the
+                    # fingerprint is already cached — drop it and
+                    # re-enqueue the binding or the Work is never
+                    # rewritten until something else changes
+                    self._requeue_binding_of(obj)
+            else:
+                for work in applies:
+                    self.store.apply(work)
+        if deletes:
+            delete_many = getattr(self.store, "delete_many", None)
+            if delete_many is not None:
+                for (kind, key), err in delete_many(deletes):
+                    print(
+                        f"# binding controller: work delete failed for "
+                        f"{key}: {err}",
+                        flush=True,
+                    )
+                    self._own_deletes.discard(key)
+                    still = self.store.get(kind, key)
+                    if still is not None:
+                        self._requeue_binding_of(still)
+            else:
+                for kind, key in deletes:
+                    self.store.delete(kind, key)
+        self._gc_templates()
+
+    def _requeue_binding_of(self, work) -> None:
+        """A buffered write for this Work failed at the flush: invalidate
+        the binding's build fingerprint and re-reconcile it (the batched
+        analogue of the raise→REQUEUE the per-object path had)."""
+        ref = work.meta.labels.get(WORK_BINDING_LABEL, "")
+        kind, sep, key = ref.partition(":")
+        if not sep:
+            return
+        self._built.pop(ref, None)
+        self.worker.enqueue((kind, key))
+
+    def _gc_templates(self) -> None:
+        """Collect content-addressed templates nothing references any
+        more — checked AFTER the flush so a drain that re-pointed works
+        at a new digest (bumping the old one to zero) and a drain that
+        re-used a candidate digest both see the settled refcounts. Two
+        independent liveness proofs must BOTH fail before a delete: the
+        work index (which, over a bus facade, lags the primary by the
+        write-echo window — a just-flushed Work is not indexed yet) and
+        the controller's own binding→digest bookkeeping (current by
+        construction). A digest either gate calls live stays; a stale
+        candidate just re-queues on the binding's next transition."""
+        if not self._gc_digests:
+            return
+        digests, self._gc_digests = self._gc_digests, set()
+        live = set(self._built_digest.values())
+        for digest in digests:
+            if digest in live:
+                continue
+            if self.work_index.digest_refcount(digest) == 0:
+                self._tpl_published.discard(digest)
+                self.store.delete("WorkloadTemplate", digest)
+            else:
+                # the index still sees references: either echo lag (the
+                # re-pointed Works haven't mirrored back yet) or a true
+                # revival — re-check after the next flush; deletes must
+                # never race the echo window
+                self._gc_digests.add(digest)
+
+    def _ensure_template(self, ref: str, template: Resource) -> str:
+        """Digest + publish of the content-addressed WorkloadTemplate for
+        this template's current content. The manifest doc is pruned
+        exactly like the Work admission mutator prunes full-rendered
+        manifests (status/uid/resourceVersion/creationTimestamp), so
+        rehydration is byte-equivalent to full rendering."""
+        ver = (
+            template.meta.uid,
+            template.meta.generation,
+            template.meta.resource_version,
+        )
+        cached = self._tpl_cache.get(ref)
+        if cached is not None and cached[0] == ver:
+            digest, doc = cached[1], cached[2]
+        else:
+            doc = to_jsonable(template)
+            doc["status"] = {}
+            meta = doc.get("meta") or {}
+            meta["uid"] = ""
+            meta["resource_version"] = 0
+            meta["creation_timestamp"] = 0.0
+            digest = hashlib.blake2b(
+                json.dumps(doc, sort_keys=True, separators=(",", ":"))
+                .encode(), digest_size=16,
+            ).hexdigest()
+            self._tpl_cache[ref] = (ver, digest, doc)
+        if digest not in self._tpl_published:
+            if self.store.get("WorkloadTemplate", digest) is None:
+                # published DIRECTLY (never buffered): the template must
+                # be in the store — and on the bus stream — before any
+                # buffered Work referencing it flushes
+                self.store.apply(WorkloadTemplate(
+                    meta=ObjectMeta(name=digest), manifest=doc
+                ))
+            self._tpl_published.add(digest)
+        return digest
+
+    def _template_patch(
+        self, template: Resource, rb: ResourceBinding, divided: bool,
+        replicas: int,
+    ) -> Optional[dict]:
+        """The per-cluster spec patch for template-delta rendering, or
+        None when this target is not templatable (custom revise hook —
+        the hook may derive arbitrary fields from the count)."""
+        if not divided or rb.spec.replicas <= 0:
+            return {}
+        patch = self.interpreter.revise_patch(template, replicas)
+        if patch is None:
+            return None
+        if template.kind == "Job" and "completions" in template.spec:
+            total = int(template.spec["completions"])
+            patch["completions"] = math.ceil(
+                total * replicas / max(rb.spec.replicas, 1)
+            )
+        return patch
+
     def _reconcile(self, kind_key) -> Optional[str]:
         kind, key = kind_key
         ref = binding_ref(kind, key)
@@ -254,10 +557,16 @@ class BindingController:
         if rb is None:
             self._built.pop(ref, None)
             self._cleanup_works(ref, keep_clusters=set())
+            self._forget_digest(ref)
+            if not self._buffering:
+                self._flush()
             return DONE
         template = self.store.get("Resource", rb.spec.resource.namespaced_key)
         if template is None:
             self._built.pop(ref, None)
+            self._forget_digest(ref)
+            if not self._buffering:
+                self._flush()
             return DONE
         # target set: scheduled clusters + clusters still draining eviction
         # tasks (their Works must survive until eviction completes,
@@ -288,10 +597,25 @@ class BindingController:
             tuple(sorted(rb.spec.suspend_dispatching_on_clusters or ())),
             rb.spec.preserve_resources_on_deletion,
             rb.spec.conflict_resolution,
+            # rendering MODE is part of the build identity: flipping the
+            # template-delta kill switch must rebuild every Work in the
+            # other representation
+            template_delta_enabled(),
         )
         prev_global, prev_targets = self._built.get(ref, (None, None))
         unchanged = prev_global == fp_global and prev_targets is not None
         built_targets: dict[str, tuple] = {}
+        # template-delta rendering (tentpole c): one content-addressed
+        # template for the whole workload family, per-cluster Works carry
+        # only (digest, replica patch) — the full manifest never clones
+        # or crosses the bus once per target. Per-TARGET fallback: a
+        # custom revise hook or a matching override rule makes that
+        # target full-render while the rest of the fleet stays delta.
+        tpl_mode = template_delta_enabled() and isinstance(
+            template.spec, dict
+        )
+        tpl_digest: Optional[str] = None
+        fell_back_full = False  # some target REBUILT full this pass
         for cluster_name, replicas in targets.items():
             # apply_overrides matches rules against LIVE cluster state
             # (name / labels / provider / region / zone), so the per-target
@@ -305,10 +629,43 @@ class BindingController:
             ):
                 built_targets[cluster_name] = (replicas, cluster_token)
                 continue  # this target's Work is already up to date
-            # every transform below (revise_replica, apply_overrides)
-            # returns a fresh object, so the template is cloned lazily:
-            # exactly ONE copy per Work, never three (the redundant
-            # deepcopy chain dominated propagation-storm wall time)
+            cluster_obj = self.store.get("Cluster", cluster_name)
+            built_targets[cluster_name] = (
+                replicas, self._cluster_token(cluster_obj),
+            )
+            patch = (
+                self._template_patch(template, rb, divided, replicas)
+                if tpl_mode
+                else None
+            )
+            if patch is not None and cluster_obj is not None:
+                # override probe: any matching rule transforms the
+                # manifest per cluster — that target must full-render.
+                # Match-only (no clone, no overrider application): the
+                # fallback path below runs the real transform once.
+                if self.overrides.overrides_match(template, cluster_obj):
+                    patch = None
+            if patch is not None:
+                if tpl_digest is None:
+                    tpl_digest = self._ensure_template(ref, template)
+                self._create_or_update_work(
+                    rb, kind, cluster_name, None,
+                    template_ref=WorkloadTemplateRef(
+                        digest=tpl_digest,
+                        api_version=template.api_version,
+                        kind=template.kind,
+                        namespace=template.meta.namespace,
+                        name=template.meta.name,
+                        patch=patch,
+                    ),
+                )
+                continue
+            fell_back_full = True
+            # full-render fallback: every transform below (revise_replica,
+            # apply_overrides) returns a fresh object, so the template is
+            # cloned lazily — exactly ONE copy per Work, never three (the
+            # redundant deepcopy chain dominated propagation-storm wall
+            # time before the delta path existed)
             workload = template
             if divided and rb.spec.replicas > 0:
                 workload = self.interpreter.revise_replica(workload, replicas)
@@ -320,12 +677,6 @@ class BindingController:
                     workload.spec["completions"] = math.ceil(
                         total * replicas / max(rb.spec.replicas, 1)
                     )
-            # rebuild path: fetch the live object and stamp the token of the
-            # state the Work is ACTUALLY built against
-            cluster_obj = self.store.get("Cluster", cluster_name)
-            built_targets[cluster_name] = (
-                replicas, self._cluster_token(cluster_obj),
-            )
             if cluster_obj is not None:
                 workload = self.overrides.apply_overrides(workload, cluster_obj)
             if workload is template:
@@ -333,6 +684,44 @@ class BindingController:
             self._create_or_update_work(rb, kind, cluster_name, workload)
         self._cleanup_works(ref, keep_clusters=set(targets) | evicting)
         self._built[ref] = (fp_global, built_targets)
+        # template GC bookkeeping: a binding whose content digest moved
+        # (or went full-render) queues its OLD digest for a post-flush
+        # refcount check
+        if tpl_digest is not None:
+            prev_digest = self._built_digest.get(ref)
+            if prev_digest is not None and prev_digest != tpl_digest:
+                self._gc_digests.add(prev_digest)
+            self._built_digest[ref] = tpl_digest
+        elif not tpl_mode:
+            # genuinely full-rendered now (kill switch flipped, or the
+            # workload stopped being templatable): drop the ref and let
+            # the refcount check collect the orphaned template
+            self._forget_digest(ref)
+        elif fell_back_full and not any(
+            w.spec.workload_template is not None
+            and w.spec.workload_template.digest
+            == self._built_digest.get(ref)
+            for w in self.work_index.works_for(ref)
+        ):
+            # delta mode, no digest this pass, and some target REBUILT
+            # full (e.g. an override rule now matches every cluster) —
+            # and the indexed works no longer carry the old digest: the
+            # binding has genuinely left delta rendering, so drop the
+            # bookkeeping and let the refcount check collect the orphan.
+            # The fell_back_full gate keeps a steady all-unchanged pass
+            # (whose works still reference the digest, however laggy the
+            # index) from dropping LIVE bookkeeping; the index gate keeps
+            # the transition pass itself from racing its own flush.
+            self._forget_digest(ref)
+        else:
+            # delta mode, every target signature-unchanged (or the index
+            # still shows delta works): the digest stays live — queue a
+            # harmless post-flush re-check and KEEP the bookkeeping
+            prev_digest = self._built_digest.get(ref)
+            if prev_digest is not None:
+                self._gc_digests.add(prev_digest)
+        if not self._buffering:
+            self._flush()
         # close the build/event race: a Cluster event landing mid-build found
         # no _built entry to requeue against, and this reconcile may have
         # built against the pre-event object — re-check the freshly written
@@ -386,7 +775,13 @@ class BindingController:
         return token
 
     def _create_or_update_work(
-        self, rb: ResourceBinding, kind: str, cluster: str, workload: Resource
+        self,
+        rb: ResourceBinding,
+        kind: str,
+        cluster: str,
+        workload: Optional[Resource],
+        *,
+        template_ref: Optional[WorkloadTemplateRef] = None,
     ) -> None:
         ns = execution_namespace(cluster)
         name = f"{rb.meta.namespace + '.' if rb.meta.namespace else ''}{rb.meta.name}"
@@ -396,12 +791,17 @@ class BindingController:
         suspended = rb.spec.suspend_dispatching or (
             cluster in (rb.spec.suspend_dispatching_on_clusters or ())
         )
+        if template_ref is not None:
+            desired_sig = (
+                ("tpl", template_ref.digest, _patch_key(template_ref.patch)),
+                None,
+            )
+        else:
+            desired_sig = (workload.spec, workload.meta.labels)
         existing = self.store.get("Work", key)
         if existing is not None and _work_signature(existing) == (
-            workload.spec,
-            workload.meta.labels,
-            suspended,
-            rb.spec.preserve_resources_on_deletion,
+            desired_sig
+            + (suspended, rb.spec.preserve_resources_on_deletion)
         ):
             return  # no semantic change — avoid churn (idempotent reconcile)
         work = existing or Work(meta=ObjectMeta(name=name, namespace=ns))
@@ -409,23 +809,29 @@ class BindingController:
             kind, rb.meta.namespaced_name
         )
         work.spec = WorkSpec(
-            workload=[workload],
+            workload=[workload] if workload is not None else [],
+            workload_template=template_ref,
             suspend_dispatching=suspended,
             preserve_resources_on_deletion=rb.spec.preserve_resources_on_deletion,
             conflict_resolution=rb.spec.conflict_resolution,
         )
-        self.store.apply(work)
+        self._apply_work(work)
         # only SEMANTIC creates/updates count (the signature gate above
         # returned on no-ops): this is the work-render throughput the
         # whole-plane storm tier measures (ROADMAP item 3)
         works_rendered.inc()
 
+    def _forget_digest(self, binding_key: str) -> None:
+        self._tpl_cache.pop(binding_key, None)
+        digest = self._built_digest.pop(binding_key, None)
+        if digest is not None:
+            self._gc_digests.add(digest)
+
     def _cleanup_works(self, binding_key: str, keep_clusters: set[str]) -> None:
         for work in self.work_index.works_for(binding_key):
             cluster = cluster_of_execution_namespace(work.meta.namespace)
             if cluster not in keep_clusters:
-                self._own_deletes.add(work.meta.namespaced_name)
-                self.store.delete("Work", work.meta.namespaced_name)
+                self._delete_work(work.meta.namespaced_name)
 
 
 class ExecutionController:
@@ -441,18 +847,39 @@ class ExecutionController:
         self.store = store
         self.members = members
         self.watcher = ObjectWatcher(members, interpreter)
+        self.rehydrator = TemplateRehydrator(store)
         # deletes parked while a cluster is unreachable; retried when the
         # cluster comes back (the asynchronous-retry analogue — burning
         # requeue budget against a dead cluster helps nobody)
         self._pending_deletes: dict[str, set[tuple[str, str, str]]] = {}
-        self.worker = runtime.new_worker("execution", self._reconcile)
+        # work keys parked on a template that has not replicated yet
+        # (bus replay/restore can deliver a Work before its template);
+        # the WorkloadTemplate watch unparks them
+        self._awaiting_template: dict[str, set] = {}
+        # per-drain write set: Work condition updates flush as one batch
+        self._buffering = False
+        self._pending_applies: list = []
+        self.worker = runtime.new_worker(
+            "execution", self._reconcile,
+            reconcile_batch=self._reconcile_batch,
+        )
         store.watch("Work", self._on_work_event)
         store.watch("Cluster", self._on_cluster_event)
+        store.watch("WorkloadTemplate", self._on_template_event, replay=False)
 
     def _on_cluster_event(self, event) -> None:
         pending = self._pending_deletes.pop(event.key, None)
         if pending:
             self.worker.enqueue(("delete", event.key, tuple(sorted(pending))))
+
+    def _on_template_event(self, event) -> None:
+        if event.type == "Deleted":
+            self.rehydrator.forget_digest(event.key)
+            return
+        parked = self._awaiting_template.pop(event.key, None)
+        if parked:
+            for item in parked:
+                self.worker.enqueue(item)
 
     def _on_work_event(self, event) -> None:
         if event.type == "Deleted":
@@ -460,16 +887,63 @@ class ExecutionController:
             # the propagated objects (honoring PreserveResourcesOnDeletion,
             # execution_controller.go:229-257)
             work: Work = event.obj
+            self.rehydrator.forget_work(event.key)
+            # a Work deleted while parked on a never-arriving template
+            # must not leak its parked entry
+            for parked in self._awaiting_template.values():
+                parked.discard(("apply", event.key, None))
             cluster = cluster_of_execution_namespace(work.meta.namespace)
             if cluster is None or work.spec.preserve_resources_on_deletion:
                 return
-            targets = tuple(
-                (f"{w.api_version}/{w.kind}", w.meta.namespace, w.meta.name)
-                for w in work.spec.workload
-            )
+            tref = work.spec.workload_template
+            if tref is not None and tref.digest:
+                # template-delta works carry target identity on the ref
+                targets = (
+                    (f"{tref.api_version}/{tref.kind}",
+                     tref.namespace, tref.name),
+                )
+            else:
+                targets = tuple(
+                    (f"{w.api_version}/{w.kind}",
+                     w.meta.namespace, w.meta.name)
+                    for w in work.spec.workload
+                )
             self.worker.enqueue(("delete", cluster, targets))
         else:
             self.worker.enqueue(("apply", event.key, None))
+
+    def _reconcile_batch(self, items) -> dict:
+        out: dict = {}
+        self._buffering = True
+        try:
+            for item in items:
+                out[item] = self._reconcile(item)
+        finally:
+            self._buffering = False
+            self._flush()
+        return out
+
+    def _apply_status(self, work: Work) -> None:
+        if self._buffering:
+            self._pending_applies.append(work)
+        else:
+            self.store.apply(work)
+
+    def _flush(self) -> None:
+        applies, self._pending_applies = self._pending_applies, []
+        if not applies:
+            return
+        apply_many = getattr(self.store, "apply_many", None)
+        if apply_many is not None:
+            for work, _err in apply_many(applies):
+                # rejected status write: retry the Work (the unbatched
+                # path raised and the worker requeued)
+                self.worker.enqueue(
+                    ("apply", work.meta.namespaced_name, None)
+                )
+        else:
+            for work in applies:
+                self.store.apply(work)
 
     def _reconcile(self, item) -> Optional[str]:
         action, key_or_cluster, targets = item
@@ -497,10 +971,18 @@ class ExecutionController:
                     type="Dispatching", status=False, reason="SuspendDispatching"
                 ),
             ):
-                self.store.apply(work)
+                self._apply_status(work)
             return DONE
+        manifests = self.rehydrator.manifests(work)
+        if manifests is None:
+            # template not mirrored yet: park on its digest (the watch
+            # unparks) AND requeue under backoff as a belt-and-braces
+            self._awaiting_template.setdefault(
+                work.spec.workload_template.digest, set()
+            ).add(item)
+            return REQUEUE
         try:
-            for workload in work.spec.workload:
+            for workload in manifests:
                 self.watcher.create_or_update(
                     cluster, workload,
                     conflict_resolution=work.spec.conflict_resolution,
@@ -513,20 +995,20 @@ class ExecutionController:
                     reason="ResourceConflict", message=str(e),
                 ),
             ):
-                self.store.apply(work)
+                self._apply_status(work)
             return DONE  # permanent until the member object changes
         except UnreachableError:
             if set_condition(
                 work.status.conditions,
                 Condition(type=WORK_APPLIED, status=False, reason="ClusterUnreachable"),
             ):
-                self.store.apply(work)
+                self._apply_status(work)
             return REQUEUE
         if set_condition(
             work.status.conditions,
             Condition(type=WORK_APPLIED, status=True, reason="AppliedSuccessful"),
         ):
-            self.store.apply(work)
+            self._apply_status(work)
         return DONE
 
 
@@ -546,7 +1028,19 @@ class WorkStatusController:
         self.members = members
         self.interpreter = interpreter
         self.work_index = work_index or WorkIndex(store)
+        self.rehydrator = TemplateRehydrator(store)
+        # member-event keys parked on a template that has not mirrored
+        # yet (the recreate path needs the rehydrated manifest); the
+        # WorkloadTemplate watch unparks them — REQUEUE alone drops the
+        # key after MAX_RETRIES in cooperative mode
+        self._awaiting_template: dict[str, set] = {}
         self.worker = runtime.new_worker("work-status", self._reconcile)
+        # rehydrator eviction: without these the decode/render caches
+        # grow with ALL-TIME work/template churn
+        store.watch("Work", self._on_work_event, replay=False)
+        store.watch(
+            "WorkloadTemplate", self._on_template_event, replay=False
+        )
         for name in members.names():
             client = members.get(name)
             if client is not None:
@@ -561,16 +1055,44 @@ class WorkStatusController:
         )
 
     def _find_work(self, cluster: str, gvk: str, namespace: str, name: str):
+        """(work, desired manifest | None) for a member target. For
+        template-delta works the identity check rides the ref and the
+        manifest rehydrates lazily; a missing template answers (work,
+        None) so the recreate path can REQUEUE instead of dropping."""
         work = self.work_index.work_for_target(cluster, gvk, namespace, name)
-        if work is not None:
-            for workload in work.spec.workload:
-                if (
-                    f"{workload.api_version}/{workload.kind}" == gvk
-                    and workload.meta.namespace == namespace
-                    and workload.meta.name == name
-                ):
-                    return work, workload
+        if work is None:
+            return None, None
+        tref = work.spec.workload_template
+        if tref is not None and tref.digest:
+            if (
+                f"{tref.api_version}/{tref.kind}" == gvk
+                and tref.namespace == namespace
+                and tref.name == name
+            ):
+                manifests = self.rehydrator.manifests(work)
+                return work, manifests[0] if manifests else None
+            return None, None
+        for workload in work.spec.workload:
+            if (
+                f"{workload.api_version}/{workload.kind}" == gvk
+                and workload.meta.namespace == namespace
+                and workload.meta.name == name
+            ):
+                return work, workload
         return None, None
+
+    def _on_work_event(self, event) -> None:
+        if event.type == "Deleted":
+            self.rehydrator.forget_work(event.key)
+
+    def _on_template_event(self, event) -> None:
+        if event.type == "Deleted":
+            self.rehydrator.forget_digest(event.key)
+            return
+        parked = self._awaiting_template.pop(event.key, None)
+        if parked:
+            for key in parked:
+                self.worker.enqueue(key)
 
     def _reconcile(self, key) -> Optional[str]:
         cluster, gvk, namespace, name, event_type = key
@@ -587,6 +1109,13 @@ class WorkStatusController:
         if observed is None:
             # recreate deleted-but-desired (work_status_controller.go:311)
             if not work.spec.preserve_resources_on_deletion:
+                if desired is None:
+                    # template not mirrored yet: park on the digest (the
+                    # watch unparks) AND requeue as a belt-and-braces
+                    self._awaiting_template.setdefault(
+                        work.spec.workload_template.digest, set()
+                    ).add(key)
+                    return REQUEUE
                 try:
                     ObjectWatcher(self.members, self.interpreter).create_or_update(
                         cluster, desired
@@ -639,13 +1168,61 @@ class BindingStatusController:
         self.store = store
         self.detector = detector
         self.work_index = work_index or WorkIndex(store)
-        self.worker = runtime.new_worker("binding-status", self._reconcile)
+        # per-drain write set: binding status updates flush as one batch
+        # (then write back template statuses for exactly those bindings)
+        self._buffering = False
+        self._pending: list = []
+        self.worker = runtime.new_worker(
+            "binding-status", self._reconcile,
+            reconcile_batch=self._reconcile_batch,
+        )
         store.watch("Work", self._on_work_event)
 
     def _on_work_event(self, event) -> None:
         key = event.obj.meta.labels.get(WORK_BINDING_LABEL)
         if key:
             self.worker.enqueue(key)
+
+    def _reconcile_batch(self, refs) -> dict:
+        out: dict = {}
+        self._buffering = True
+        try:
+            for ref in refs:
+                out[ref] = self._reconcile(ref)
+        finally:
+            self._buffering = False
+            self._flush()
+        return out
+
+    def _commit(self, rb) -> None:
+        if self._buffering:
+            self._pending.append(rb)
+            return
+        self.store.apply(rb)
+        if self.detector is not None:
+            self.detector.write_back_status(rb)
+
+    def _flush(self) -> None:
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        apply_many = getattr(self.store, "apply_many", None)
+        failed: set[int] = set()
+        if apply_many is not None:
+            for rb, _err in apply_many(pending):
+                failed.add(id(rb))
+                # rejected status write: re-aggregate this binding (the
+                # unbatched path raised and the worker requeued)
+                self.worker.enqueue(
+                    binding_ref(type(rb).KIND, rb.meta.namespaced_name)
+                )
+        else:
+            for rb in pending:
+                self.store.apply(rb)
+        if self.detector is not None:
+            for rb in pending:
+                if id(rb) not in failed:
+                    self.detector.write_back_status(rb)
 
     def _reconcile(self, ref: str) -> Optional[str]:
         kind, _, key = ref.partition(":")
@@ -706,7 +1283,5 @@ class BindingStatusController:
             ),
         )
         if status_changed or cond_changed:
-            self.store.apply(rb)
-            if self.detector is not None:
-                self.detector.write_back_status(rb)
+            self._commit(rb)
         return DONE

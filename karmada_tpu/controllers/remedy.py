@@ -20,7 +20,7 @@ from typing import Optional
 from ..api.cluster import PULL, Cluster
 from ..api.core import Condition, ObjectMeta, is_condition_true, set_condition
 from ..api.work import WORK_APPLIED, ManifestStatus, Work
-from ..utils import DONE, Runtime, Store
+from ..utils import DONE, REQUEUE, Runtime, Store
 from ..utils.member import MemberCluster, UnreachableError
 from .propagation import execution_namespace
 
@@ -192,13 +192,28 @@ class KarmadaAgent:
     ) -> None:
         import time as _time
 
+        from .propagation import TemplateRehydrator
+
         self.store = store
         self.member = member
         self.interpreter = interpreter
         self.clock = clock or _time.time
         self.ns = execution_namespace(member.name)
-        self.worker = runtime.new_worker(f"agent-{member.name}", self._reconcile)
+        # template-delta rehydration (ISSUE 11): Works arriving over the
+        # bus may carry (digest, patch) instead of a full manifest; the
+        # agent renders them against the mirrored WorkloadTemplate
+        self.rehydrator = TemplateRehydrator(store)
+        self._awaiting_template: dict[str, set] = {}
+        # per-drain write set: status reflections flush as one batched
+        # write-through (one ApplyBatch RPC over the bus facade)
+        self._buffering = False
+        self._pending: list = []
+        self.worker = runtime.new_worker(
+            f"agent-{member.name}", self._reconcile,
+            reconcile_batch=self._reconcile_batch,
+        )
         store.watch("Work", self._on_work_event)
+        store.watch("WorkloadTemplate", self._on_template_event, replay=False)
         member.watch(self._on_member_event)
         runtime.add_ticker(self._renew_lease)
 
@@ -219,10 +234,34 @@ class KarmadaAgent:
 
     def _on_work_event(self, event) -> None:
         if event.obj.meta.namespace == self.ns:
+            if event.type == "Deleted":
+                self.rehydrator.forget_work(event.key)
+                # drop any parked entry for the deleted Work (its
+                # template may never arrive)
+                for parked in self._awaiting_template.values():
+                    parked.discard(event.key)
             self.worker.enqueue(event.key)
+
+    def _on_template_event(self, event) -> None:
+        if event.type == "Deleted":
+            self.rehydrator.forget_digest(event.key)
+            return
+        parked = self._awaiting_template.pop(event.key, None)
+        if parked:
+            for key in parked:
+                self.worker.enqueue(key)
 
     def _on_member_event(self, event) -> None:
         for work in self.store.list("Work", self.ns):
+            tref = work.spec.workload_template
+            if tref is not None and tref.digest:
+                if (
+                    f"{tref.api_version}/{tref.kind}" == event.gvk
+                    and tref.namespace == event.namespace
+                    and tref.name == event.name
+                ):
+                    self.worker.enqueue(work.meta.namespaced_name)
+                continue
             for w in work.spec.workload:
                 if (
                     f"{w.api_version}/{w.kind}" == event.gvk
@@ -231,14 +270,53 @@ class KarmadaAgent:
                 ):
                     self.worker.enqueue(work.meta.namespaced_name)
 
+    def _reconcile_batch(self, keys) -> dict:
+        out: dict = {}
+        self._buffering = True
+        try:
+            for key in keys:
+                out[key] = self._reconcile(key)
+        finally:
+            self._buffering = False
+            self._flush()
+        return out
+
+    def _commit(self, work) -> None:
+        if self._buffering:
+            self._pending.append(work)
+        else:
+            self.store.apply(work)
+
+    def _flush(self) -> None:
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        apply_many = getattr(self.store, "apply_many", None)
+        if apply_many is not None:
+            for work, _err in apply_many(pending):
+                # rejected status reflection: retry the Work (the
+                # unbatched path raised and the worker requeued)
+                self.worker.enqueue(work.meta.namespaced_name)
+        else:
+            for work in pending:
+                self.store.apply(work)
+
     def _reconcile(self, key: str) -> Optional[str]:
         work = self.store.get("Work", key)
         if work is None or work.spec.suspend_dispatching:
             return DONE
         if not self.member.reachable:
             return DONE  # agent inside the cluster: unreachable means dead
+        manifests = self.rehydrator.manifests(work)
+        if manifests is None:
+            # template not mirrored yet (bus replay can deliver the Work
+            # first): park on the digest, the template watch unparks
+            self._awaiting_template.setdefault(
+                work.spec.workload_template.digest, set()
+            ).add(key)
+            return REQUEUE
         changed = False
-        for desired in work.spec.workload:
+        for desired in manifests:
             gvk = f"{desired.api_version}/{desired.kind}"
             observed = self.member.get(
                 gvk, desired.meta.namespace, desired.meta.name
@@ -278,5 +356,5 @@ class KarmadaAgent:
         ):
             changed = True
         if changed:
-            self.store.apply(work)
+            self._commit(work)
         return DONE

@@ -403,8 +403,9 @@ class SchedulerController:
             )
             out[kind_key] = DONE
         # batched writeback: one locked sweep + one delivery sweep instead
-        # of len(changed) apply calls (storm hot path); HA replica facades
-        # lack the batch API and fall back to per-object write-through
+        # of len(changed) apply calls (storm hot path); over a bus facade
+        # the same call ships ONE ApplyBatch RPC per KARMADA_TPU_BUS_BATCH
+        # bindings (ISSUE 11) instead of len(changed) round-trips
         self._pending_writeback = {id(rb) for rb in changed_rbs}
         try:
             apply_many = getattr(self.store, "apply_many", None)

@@ -104,6 +104,29 @@ class ResourceInterpreter:
                 return True
         return False
 
+    def revise_patch(
+        self, obj: Resource, replicas: int
+    ) -> Optional[dict]:
+        """Template-delta seam: the top-level spec fields the NATIVE
+        ReviseReplica pass would write for this kind, as a patch dict —
+        or None when a non-native tier owns the revision (such hooks may
+        derive arbitrary fields, so the caller must fall back to full
+        rendering). An empty dict means the kind has no revise hook at
+        all (the manifest is replica-invariant)."""
+        gvk = _gvk(obj)
+        if self.has_custom_revise(gvk):
+            return None
+        fn = self._native.get((gvk, REVISE_REPLICA)) or self._native.get(
+            ("*", REVISE_REPLICA)
+        )
+        if fn is None:
+            return {}
+        # native._revise_replica semantics, without the clone: Jobs with
+        # parallelism revise that field, everything else spec.replicas
+        if gvk == "batch/v1/Job" and "parallelism" in obj.spec:
+            return {"parallelism": int(replicas)}
+        return {"replicas": int(replicas)}
+
     # -- typed operation wrappers -----------------------------------------
 
     def get_replicas(self, obj: Resource) -> tuple[int, Optional[ReplicaRequirements]]:

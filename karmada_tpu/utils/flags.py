@@ -96,6 +96,31 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "automatically.",
         ),
         EnvFlag(
+            "KARMADA_TPU_BUS_BATCH", "4096",
+            "Columnar bus channel (bus.service): max write-through ops "
+            "per ApplyBatch RPC and watch events per WatchBatch frame. "
+            "0 forces every connection onto the per-object unary "
+            "fallback — the mixed-version escape hatch; servers that "
+            "answer UNIMPLEMENTED negotiate the fallback per connection "
+            "automatically.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_BUS_FLUSH_MS", "2",
+            "Watch-frame coalescing window (ms): after the first queued "
+            "event a WatchBatch stream waits this long for more before "
+            "flushing the frame — the latency bound of event batching "
+            "(count bound: KARMADA_TPU_BUS_BATCH).",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_BUS_TEMPLATE_DELTA", "1",
+            "Template-delta Work rendering kill switch (controllers."
+            "propagation): 0 renders every Work as a full manifest "
+            "clone instead of one content-addressed WorkloadTemplate "
+            "plus per-cluster replica patches. Targets with custom "
+            "ReviseReplica hooks or matching override rules full-render "
+            "either way.",
+        ),
+        EnvFlag(
             "KARMADA_TPU_ESTIMATOR_PING_SECONDS", "0",
             "Seconds a cluster's snapshot-generation confirmation stays "
             "trusted across EstimatorRegistry.invalidate(); 0 re-pings "

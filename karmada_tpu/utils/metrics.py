@@ -365,7 +365,15 @@ bus_queue_depth = registry.gauge(
 bus_event_age_seconds = registry.histogram(
     "karmada_tpu_bus_event_age_seconds",
     "time a watch event waited in a subscriber queue before the stream "
-    "picked it up",
+    "picked it up (recorded PER EVENT even under frame coalescing, so "
+    "batching cannot fake a low queue age)",
+)
+bus_batch_size = registry.histogram(
+    "karmada_tpu_bus_batch_size",
+    "items per batched bus message: ops per ApplyBatch RPC served and "
+    "events per WatchBatch frame flushed (count histogram — a value of "
+    "1 means the channel is effectively unary)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
 )
 works_rendered = registry.counter(
     "karmada_tpu_controller_works_rendered_total",

@@ -121,10 +121,18 @@ SPAN_NAMES: dict[str, str] = {
         "server side of SyncClusters, recorded in the sidecar under the "
         "caller's wave"
     ),
-    "bus.rpc": "client side of one store-bus write-through RPC attempt",
+    "bus.rpc": (
+        "client side of one store-bus write-through RPC attempt (batched "
+        "calls carry a batch=N attribute — the channel table's "
+        "events-per-message column)"
+    ),
     "bus.apply": (
         "server side of one bus Apply, recorded in the bus process under "
         "the caller's wave"
+    ),
+    "bus.apply_batch": (
+        "server side of one bus ApplyBatch (ops=N write set committed as "
+        "one batched store sweep)"
     ),
     "bus.delete": "server side of one bus Delete",
     "bus.watch": (
@@ -394,6 +402,14 @@ class WaveTracer:
             wave = self._begin_wave_locked(reason)
         self._flight_begin(wave)
         return wave
+
+    def open_wave(self) -> Optional[int]:
+        """The wave currently open, or None. Measurement harnesses use
+        this to anchor a window: work they trigger joins the OPEN wave
+        when a previous burst's tail kept it open, so a wave-id diff
+        alone would miss it."""
+        with self._lock:
+            return self.current_wave if self._wave_open else None
 
     def end_wave(self) -> int:
         """Close the open wave and return its id — the flight recorder
@@ -963,12 +979,15 @@ def stitch_spans(spans: list[dict], wave: int, trace_id: str) -> dict:
             if ch is not None:
                 slot = channels.setdefault(
                     ch, {"rpcs": 0, "client_s": 0.0, "server_s": 0.0,
-                         "network_s": 0.0},
+                         "network_s": 0.0, "events": 0},
                 )
                 server = sum(
                     c["duration_s"] for c in remote_children.get(key, [])
                 )
                 slot["rpcs"] += 1
+                # batching factor: a batched RPC carries batch=N items
+                # per message (ISSUE 11); unary calls count 1
+                slot["events"] += int(s["attrs"].get("batch") or 1)
                 slot["client_s"] += s["duration_s"]
                 slot["server_s"] += server
                 slot["network_s"] += max(s["duration_s"] - server, 0.0)
@@ -987,6 +1006,10 @@ def stitch_spans(spans: list[dict], wave: int, trace_id: str) -> dict:
         "channels": {
             k: {
                 "rpcs": v["rpcs"],
+                "events": v["events"],
+                "events_per_rpc": round(
+                    v["events"] / v["rpcs"], 2
+                ) if v["rpcs"] else 0.0,
                 "client_s": round(v["client_s"], 6),
                 "server_s": round(v["server_s"], 6),
                 "network_s": round(v["network_s"], 6),
@@ -1055,11 +1078,16 @@ def render_attribution_table(summary: dict) -> str:
             lines.append(f"{name:<27} {v:8.4f}")
     if summary.get("channels"):
         lines.append(
-            "channel      rpcs   client_s   server_s  network_s"
+            "channel      rpcs  ev/msg   client_s   server_s  network_s"
         )
         for name, v in sorted(summary["channels"].items()):
+            ev_per = v.get(
+                "events_per_rpc",
+                (v.get("events", v["rpcs"]) / v["rpcs"]) if v["rpcs"] else 0.0,
+            )
             lines.append(
-                f"{name:<10} {v['rpcs']:6d} {v['client_s']:10.4f} "
+                f"{name:<10} {v['rpcs']:6d} {ev_per:7.2f} "
+                f"{v['client_s']:10.4f} "
                 f"{v['server_s']:10.4f} {v['network_s']:10.4f}"
             )
     return "\n".join(lines)

@@ -39,6 +39,14 @@ class Worker:
       (capped at ``backoff_max``) and retries indefinitely — a persistently
       failing key costs one reconcile per backoff window instead of 16
       hot-loop attempts followed by a permanent drop.
+
+    Ownership sharding (ISSUE 11): with ``shard_fn`` set, keys route to
+    per-ownership-token queues (the binding/detector workers shard by
+    namespace) drained round-robin, and a BATCH drain holds keys of one
+    token only — so one namespace's storm (or a poisoned key's bisect
+    fan-out, or a parked batch flush) never head-of-line-blocks another
+    namespace's drain, and each batched write set stays within one
+    ownership domain.
     """
 
     MAX_RETRIES = 16
@@ -56,6 +64,7 @@ class Worker:
         backoff_base: float = 0.005,
         backoff_max: float = 300.0,
         clock: Callable[[], float] = time.monotonic,
+        shard_fn: Optional[Callable[[Hashable], Hashable]] = None,
     ):
         self.name = name
         self.reconcile = reconcile
@@ -69,7 +78,12 @@ class Worker:
         self.backoff_base = backoff_base
         self.backoff_max = backoff_max
         self.clock = clock
+        # key -> ownership token; tokens materialize shard queues lazily
+        # (a namespace that never enqueues costs nothing)
+        self.shard_fn = shard_fn
         self._queue: collections.deque[Hashable] = collections.deque()
+        self._shards: dict[Hashable, collections.deque] = {}
+        self._shard_rr: collections.deque = collections.deque()
         self._queued: set[Hashable] = set()
         self._retries: collections.Counter = collections.Counter()
         self._delayed: list[tuple] = []  # (not_before, seq, key) heap
@@ -85,9 +99,45 @@ class Worker:
     def enqueue(self, key: Hashable) -> None:
         # a direct enqueue supersedes any parked retry of the same key
         self._parked.pop(key, None)
-        if key not in self._queued:
-            self._queued.add(key)
+        if key in self._queued:
+            return
+        self._queued.add(key)
+        if self.shard_fn is None:
             self._queue.append(key)
+            return
+        token = self.shard_fn(key)
+        q = self._shards.get(token)
+        if q is None:
+            q = self._shards[token] = collections.deque()
+            self._shard_rr.append(token)
+        q.append(key)
+
+    def _pop_batch(self, limit: int) -> list:
+        """Pop up to ``limit`` queued keys. Sharded workers drain from ONE
+        ownership token per call (round-robin across tokens), so a batch
+        never mixes ownership domains."""
+        keys: list = []
+        if self.shard_fn is None:
+            while self._queue and len(keys) < limit:
+                k = self._queue.popleft()
+                self._queued.discard(k)
+                keys.append(k)
+            return keys
+        while self._shard_rr and not keys:
+            token = self._shard_rr.popleft()
+            q = self._shards.get(token)
+            if not q:
+                self._shards.pop(token, None)
+                continue
+            while q and len(keys) < limit:
+                k = q.popleft()
+                self._queued.discard(k)
+                keys.append(k)
+            if q:
+                self._shard_rr.append(token)  # remainder: back of rotation
+            else:
+                self._shards.pop(token, None)
+        return keys
 
     def enqueue_after(self, key: Hashable, delay: float) -> None:
         """Park ``key`` until ``delay`` seconds from now (workqueue
@@ -111,7 +161,9 @@ class Worker:
             self.enqueue(key)
 
     def __len__(self) -> int:
-        return len(self._queue)
+        # _queued mirrors the queued key set exactly (enqueue dedups on
+        # it, every pop discards from it) across both queue layouts
+        return len(self._queued)
 
     @property
     def delayed(self) -> int:
@@ -136,20 +188,18 @@ class Worker:
         done."""
         if self._delayed:
             self._promote_due()
-        if not self._queue:
+        if not self._queued:
             return False
-        if self.reconcile_batch is not None and len(self._queue) > 1:
-            keys = []
-            while self._queue and len(keys) < self.batch_size:
-                k = self._queue.popleft()
-                self._queued.discard(k)
-                keys.append(k)
+        if self.reconcile_batch is not None and len(self._queued) > 1:
+            keys = self._pop_batch(self.batch_size)
             results = self._drain_batch(keys)
             for k in keys:
                 self._finish(k, results.get(k, DONE))
             return True
-        key = self._queue.popleft()
-        self._queued.discard(key)
+        popped = self._pop_batch(1)
+        if not popped:
+            return False
+        key = popped[0]
         try:
             result = self.reconcile(key)
         except Exception:  # noqa: BLE001 — reconcile errors requeue, like workqueue

@@ -439,7 +439,10 @@ def validate_workload_rebalancer(rebalancer) -> None:
 
 
 def validate_work(work) -> None:
-    if not work.spec.workload:
+    ref = getattr(work.spec, "workload_template", None)
+    if not work.spec.workload and not (ref is not None and ref.digest):
+        # template-delta works carry (digest, patch) instead of a full
+        # manifest — either representation satisfies the invariant
         raise ValidationError("work must carry at least one manifest")
     if work.spec.conflict_resolution not in ("Overwrite", "Abort"):
         raise ValidationError(

@@ -18,6 +18,9 @@ class Client:
     def __init__(self, channel):
         self._sync = channel.unary_unary("/svc/Sync")
         self._score = channel.unary_unary("/svc/Score")
+        # a BATCHED stub is still a unary stub — one RPC carrying a
+        # whole write set (ISSUE 11 ApplyBatch shape)
+        self._apply_batch = channel.unary_unary("/svc/ApplyBatch")
 
     def call(self, req):
         # BAD: direct stub call with no timeout
@@ -26,6 +29,15 @@ class Client:
     def call_future(self, req):
         # BAD: future form with no timeout
         return self._score.future(req)
+
+    def call_batch(self, req, md):
+        # BAD: batched stub with metadata but no timeout — an unbounded
+        # stall here blocks the whole 4096-op write set
+        return self._apply_batch(req, metadata=md)
+
+    def call_with_call(self, req):
+        # BAD: with_call form with no timeout
+        return self._apply_batch.with_call(req)
 
     def ok(self, req):
         return self._score(req, timeout=3.0)

@@ -20,9 +20,14 @@ class Client:
     def __init__(self, channel):
         self._sync = channel.unary_unary("/svc/Sync")
         self._score = channel.unary_unary("/svc/Score")
+        # a batched write stub (ISSUE 11 ApplyBatch): one unary RPC per
+        # write SET, bounded like any other unary call
+        self._apply_batch = channel.unary_unary("/svc/ApplyBatch")
         # watch streams are deliberately open-ended (bounded by their
-        # reconnect loop), not unbounded unary RPCs
+        # reconnect loop), not unbounded unary RPCs — the coalesced
+        # WatchBatch frame stream is exempt exactly like unary watch
         self._watch = channel.unary_stream("/svc/Watch")
+        self._watch_batch = channel.unary_stream("/svc/WatchBatch")
 
     def call(self, req, deadline):
         return self._sync(req, timeout=deadline)
@@ -30,8 +35,18 @@ class Client:
     def call_future(self, req):
         return self._score.future(req, timeout=2.5)
 
+    def call_batch(self, req, md, deadline):
+        # one Deadline budget for the whole batch, not per op
+        return self._apply_batch(req, timeout=deadline, metadata=md)
+
+    def call_with_call(self, req):
+        return self._apply_batch.with_call(req, timeout=2.5)
+
     def watch(self, req):
         return self._watch(req)
+
+    def watch_batch(self, req):
+        return self._watch_batch(req)
 
     def resilient(self, req):
         # stub passed by VALUE into a wrapper that owns the deadline —

@@ -1,0 +1,808 @@
+"""Columnar bus channel (ISSUE 11): batched ApplyBatch / WatchBatch wire
+protocol parity against the per-object unary path.
+
+The contract under test: plane state is IDENTICAL batched vs unary — the
+batch protocol changes the wire unit (a write SET per RPC, an event FRAME
+per stream message), never the semantics. Mixed-version negotiation
+(UNIMPLEMENTED → unary fallback, re-probe after reconnect), CAS-once
+conflict isolation inside a batch, per-batch fault injection, per-event
+queue-age accounting, template-delta rehydration byte-equivalence, and
+namespace-sharded worker drains all live here.
+"""
+
+import time
+
+import pytest
+
+from karmada_tpu.api.core import ObjectMeta, Resource
+from karmada_tpu.bus.service import StoreBusServer, StoreReplica
+from karmada_tpu.utils import DONE, Store
+from karmada_tpu.utils.store import ConflictError
+
+
+def _cm(name, payload, ns="ns"):
+    return Resource(
+        api_version="v1", kind="ConfigMap",
+        meta=ObjectMeta(name=name, namespace=ns),
+        spec={"payload": payload},
+    )
+
+
+def _canon(doc: dict) -> dict:
+    """Semantic canonical form of a jsonable Resource doc: identity noise
+    (resource_version bumps from re-applies, per-plane random uids and
+    permanent-id stamps, wall-clock timestamps) stripped — what must be
+    IDENTICAL between the batched/template-delta and unary/full planes."""
+    import copy
+
+    doc = copy.deepcopy(doc)
+    meta = doc.get("meta") or {}
+    for k in ("resource_version", "uid", "creation_timestamp"):
+        meta.pop(k, None)
+    for bag in ("labels", "annotations"):
+        d = meta.get(bag) or {}
+        for k in list(d):
+            if "permanent-id" in k:
+                del d[k]
+    return doc
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+@pytest.fixture()
+def bus():
+    store = Store()
+    server = StoreBusServer(store, "127.0.0.1:0")
+    port = server.start()
+    yield store, port
+    server.stop()
+
+
+@pytest.fixture()
+def old_bus():
+    """An old-build server shape: ApplyBatch/WatchBatch unregistered, so
+    batched calls answer UNIMPLEMENTED and clients negotiate the unary
+    fallback per connection."""
+    store = Store()
+    server = StoreBusServer(store, "127.0.0.1:0", enable_batch=False)
+    port = server.start()
+    yield store, port
+    server.stop()
+
+
+class TestApplyBatch:
+    def test_batched_write_set_roundtrip(self, bus):
+        store, port = bus
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced()
+        objs = [_cm(f"a{i}", i) for i in range(300)]
+        errors = replica.apply_many(objs)
+        assert errors == []
+        # the probe pinned the batched protocol for this connection
+        assert replica.supports_batch is True
+        # the PRIMARY assigned versions (the caller's objects stay
+        # unstamped — StoreReplica.apply semantics: the echo, not the
+        # response, is the commit signal)
+        assert store.get("Resource", "ns/a0").meta.resource_version > 0
+        assert store.get("Resource", "ns/a299").spec["payload"] == 299
+        # the mirror converges through the (batched) watch stream
+        assert _wait(
+            lambda: replica.store.get("Resource", "ns/a299") is not None
+        )
+        replica.close()
+
+    def test_cas_conflict_isolated_to_conflicting_op(self, bus):
+        """A CAS loser surfaces ConflictError on exactly the conflicting
+        object; every other op of the batch commits."""
+        store, port = bus
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced()
+        assert replica.apply_many([_cm("c0", 0), _cm("c1", 1)]) == []
+        good_rv = store.get("Resource", "ns/c1").meta.resource_version
+        loser = _cm("c0", 100)
+        winner = _cm("c1", 101)
+        plain = _cm("c2", 102)
+        errors = replica.apply_many(
+            [loser, winner, plain], expected_rvs=[10_000, good_rv, None]
+        )
+        assert len(errors) == 1
+        obj, exc = errors[0]
+        assert obj is loser and isinstance(exc, ConflictError)
+        assert store.get("Resource", "ns/c0").spec["payload"] == 0
+        assert store.get("Resource", "ns/c1").spec["payload"] == 101
+        assert store.get("Resource", "ns/c2").spec["payload"] == 102
+        replica.close()
+
+    def test_delete_many(self, bus):
+        store, port = bus
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced()
+        assert replica.apply_many([_cm(f"d{i}", i) for i in range(4)]) == []
+        errors = replica.delete_many(
+            [("Resource", "ns/d0"), ("Resource", "ns/d1", True)]
+        )
+        assert errors == []
+        assert store.get("Resource", "ns/d0") is None
+        assert store.get("Resource", "ns/d1") is None
+        assert store.get("Resource", "ns/d2") is not None
+        replica.close()
+
+    def test_env_kill_switch_forces_unary(self, bus, monkeypatch):
+        """KARMADA_TPU_BUS_BATCH=0 is the mixed-version escape hatch: the
+        batched protocol is never even probed."""
+        monkeypatch.setenv("KARMADA_TPU_BUS_BATCH", "0")
+        store, port = bus
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced()
+        assert replica.apply_many([_cm(f"u{i}", i) for i in range(5)]) == []
+        assert replica.supports_batch is None  # never probed
+        assert store.get("Resource", "ns/u4") is not None
+        replica.close()
+
+    def test_batch_size_histogram_observed(self, bus):
+        from karmada_tpu.utils.metrics import bus_batch_size
+
+        store, port = bus
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced()
+        before = (bus_batch_size.summary() or {"count": 0})["count"]
+        assert replica.apply_many([_cm(f"h{i}", i) for i in range(64)]) == []
+        after = (bus_batch_size.summary() or {"count": 0})["count"]
+        # at least the served ApplyBatch observed its op count
+        assert after > before
+        replica.close()
+
+
+class TestMixedVersionNegotiation:
+    def test_old_server_pins_unary_fallback(self, old_bus):
+        store, port = old_bus
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced()  # watch fell back to unary stream
+        objs = [_cm(f"m{i}", i) for i in range(20)]
+        assert replica.apply_many(objs) == []
+        # UNIMPLEMENTED pinned the per-object fallback — and the write
+        # set still committed whole
+        assert replica.supports_batch is False
+        assert replica._watch_supports_batch is False
+        assert store.get("Resource", "ns/m19").spec["payload"] == 19
+        assert store.get("Resource", "ns/m0").meta.resource_version > 0
+        # deletes ride the same pin
+        assert replica.delete_many([("Resource", "ns/m0")]) == []
+        assert store.get("Resource", "ns/m0") is None
+        replica.close()
+
+    def test_wire_failure_resets_pin_and_reprobes(self, old_bus):
+        """An old server pins the unary fallback; when the connection
+        breaks and a NEW (batch-capable) build comes back on the same
+        address, the client re-probes instead of staying unary forever."""
+        store, port = old_bus
+        replica = StoreReplica(
+            f"127.0.0.1:{port}", timeout_seconds=2.0
+        )
+        replica.start()
+        assert replica.wait_synced()
+        assert replica.apply_many([_cm("r0", 0)]) == []
+        assert replica.supports_batch is False
+
+        # the old build dies mid-flight: the next write sees a wire
+        # failure, which RESETS the negotiation pin
+        store2 = Store()
+        server2 = StoreBusServer(store2, "127.0.0.1:0")  # new build
+        try:
+            # find the old server through the fixture teardown ordering:
+            # stop it by severing at the address level is not possible
+            # here, so emulate the upgrade with a fresh replica whose
+            # pin was carried into a wire failure
+            with pytest.raises(Exception):
+                bad = StoreReplica("127.0.0.1:1", timeout_seconds=0.5)
+                bad.supports_batch = False  # pinned by an old server
+                try:
+                    bad.apply(_cm("x", 1))
+                finally:
+                    # unary wire failure resets the batch pin
+                    assert bad.supports_batch is None
+                    bad.close()
+            # a batch-capable server answers the re-probe batched
+            port2 = server2.start()
+            replica2 = StoreReplica(f"127.0.0.1:{port2}")
+            replica2.start()
+            assert replica2.wait_synced()
+            assert replica2.apply_many([_cm("r1", 1)]) == []
+            assert replica2.supports_batch is True
+            replica2.close()
+        finally:
+            server2.stop()
+        replica.close()
+
+    def test_mid_set_unimplemented_falls_back_for_remainder_only(
+        self, bus, monkeypatch
+    ):
+        """A server replaced by an old build BETWEEN chunks of one write
+        set: the committed chunks must not replay unary (duplicate
+        writes; a committed CAS op would surface the caller's own write
+        as a false conflict) — only the uncommitted remainder falls
+        back."""
+        import grpc
+
+        monkeypatch.setenv("KARMADA_TPU_BUS_BATCH", "3")
+        store, port = bus
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced()
+
+        class Unimplemented(grpc.RpcError):
+            def code(self):
+                return grpc.StatusCode.UNIMPLEMENTED
+
+            def details(self):
+                return "unimplemented"
+
+        real = replica._apply_batch
+        calls = [0]
+
+        def flaky(req, timeout=None, metadata=None):
+            calls[0] += 1
+            if calls[0] >= 2:  # the "new build" died after chunk 1
+                raise Unimplemented()
+            return real(req, timeout=timeout, metadata=metadata)
+
+        replica._apply_batch = flaky
+        objs = [_cm(f"ms{i}", i) for i in range(7)]  # 3 batched + 4 unary
+        assert replica.apply_many(objs) == []
+        assert calls[0] == 2  # chunk 1 committed, chunk 2 negotiated
+        assert replica.supports_batch is False
+        for i in range(7):
+            assert store.get("Resource", f"ns/ms{i}").spec["payload"] == i
+        replica.close()
+
+    def test_batch_wire_failure_resets_pin(self):
+        """A wire failure on the BATCH path re-probes too (the server
+        behind the reconnected channel may be a different build)."""
+        replica = StoreReplica("127.0.0.1:1", timeout_seconds=0.5)
+        replica.supports_batch = True  # pinned by a batched success
+        with pytest.raises(Exception):
+            replica.apply_many([_cm("x", 1)])
+        assert replica.supports_batch is None
+        replica.close()
+
+
+class TestWatchBatchParity:
+    def test_batched_and_unary_mirrors_identical(self, bus):
+        """One primary, one batch-capable server, one old-build server:
+        the batched replica and the negotiated-unary replica converge to
+        IDENTICAL mirrors through replay + live tail."""
+        store, port = bus
+        old = StoreBusServer(store, "127.0.0.1:0", enable_batch=False)
+        old_port = old.start()
+        # replayed state
+        for i in range(30):
+            store.apply(_cm(f"pre{i}", i))
+        batched = StoreReplica(f"127.0.0.1:{port}")
+        unary = StoreReplica(f"127.0.0.1:{old_port}")
+        batched.start()
+        unary.start()
+        try:
+            assert batched.wait_synced()
+            assert unary.wait_synced()
+            # live tail: modifications, adds, deletes interleaved
+            for i in range(30):
+                store.apply(_cm(f"pre{i}", i + 1000))
+            for i in range(30, 60):
+                store.apply(_cm(f"pre{i}", i))
+            for i in range(0, 10):
+                store.delete("Resource", f"ns/pre{i}", force=True)
+
+            def snapshot(st):
+                return {
+                    (type(o).__name__, o.meta.namespaced_name):
+                        (o.meta.resource_version, o.spec)
+                    for o in st.list("Resource")
+                }
+
+            want = snapshot(store)
+            assert _wait(lambda: snapshot(batched.store) == want, 10.0)
+            assert _wait(lambda: snapshot(unary.store) == want, 10.0)
+            assert batched._watch_supports_batch is True
+            assert unary._watch_supports_batch is False
+        finally:
+            batched.close()
+            unary.close()
+            old.stop()
+
+    def test_reconnect_replays_batched_and_heals_gap(self):
+        store = Store()
+        server = StoreBusServer(store, "127.0.0.1:0")
+        port = server.start()
+        store.apply(_cm("g0", 0))
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        try:
+            assert _wait(
+                lambda: replica.store.get("Resource", "ns/g0") is not None
+            )
+            server.stop(grace=0)
+            store.apply(_cm("g1", 1))  # written while disconnected
+            server2 = StoreBusServer(store, f"127.0.0.1:{port}")
+            server2.start()
+            try:
+                assert _wait(
+                    lambda: replica.store.get("Resource", "ns/g1")
+                    is not None,
+                    timeout=10.0,
+                )
+                # the reconnected stream re-negotiated batched
+                assert replica._watch_supports_batch is True
+            finally:
+                server2.stop()
+        finally:
+            replica.close()
+
+    def test_event_age_recorded_per_event_not_per_frame(self, bus):
+        """Satellite: a coalesced frame of N events must record N queue-
+        age observations — batching cannot fake a low queue age."""
+        from karmada_tpu.utils.metrics import bus_event_age_seconds
+
+        store, port = bus
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced()
+        before = (bus_event_age_seconds.summary() or {"count": 0})["count"]
+        n = 40
+        # one batched delivery sweep: the flush timer coalesces the burst
+        store.apply_many([_cm(f"age{i}", i) for i in range(n)])
+        assert _wait(
+            lambda: replica.store.get("Resource", f"ns/age{n - 1}")
+            is not None
+        )
+        # the stream has observed one age per delivered event (>= n new
+        # observations for this subscriber)
+        assert _wait(
+            lambda: (bus_event_age_seconds.summary() or {"count": 0})[
+                "count"
+            ] - before >= n
+        )
+        replica.close()
+
+
+class TestFaultInjectionPerBatch:
+    def test_fault_fires_per_batch_attempt(self, bus):
+        """The PR 7 seam fires once per BATCH attempt (the batch is the
+        wire unit now), and the resilience retry commits the set."""
+        from karmada_tpu.utils import faultinject
+
+        store, port = bus
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced()
+        faultinject.arm("bus.rpc=error,count=1,match=ApplyBatch", seed=7)
+        try:
+            errors = replica.apply_many(
+                [_cm(f"f{i}", i) for i in range(50)]
+            )
+            assert errors == []
+            inj = faultinject.injector()
+            fired = [e for e in inj.log if e.point == "bus.rpc"]
+            assert len(fired) == 1  # one injection for the whole batch
+            assert fired[0].key == "ApplyBatch"
+        finally:
+            faultinject.disarm()
+        assert store.get("Resource", "ns/f49") is not None
+        replica.close()
+
+
+class TestTemplateDeltaRendering:
+    def _plane(self, n_deploys=6, n_clusters=3):
+        from karmada_tpu import cli as _cli
+        from karmada_tpu.api import (
+            PropagationPolicy,
+            PropagationSpec,
+            ResourceSelector,
+        )
+        from karmada_tpu.utils.builders import (
+            new_cluster,
+            new_deployment,
+            static_weight_placement,
+        )
+
+        cp = _cli.cmd_init()
+        for i in range(1, n_clusters + 1):
+            cp.join_cluster(
+                new_cluster(f"member{i}", cpu="100", memory="200Gi")
+            )
+        cp.settle()
+        # static 2:1:1 division with enough replicas to spread: every
+        # binding lands Works on ALL clusters with DIFFERENT replica
+        # counts, so the per-cluster template patches genuinely differ
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="p", namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[ResourceSelector(
+                    api_version="apps/v1", kind="Deployment"
+                )],
+                placement=static_weight_placement({
+                    f"member{i}": (2 if i == 1 else 1)
+                    for i in range(1, n_clusters + 1)
+                }),
+            ),
+        ))
+        for i in range(n_deploys):
+            cp.store.apply(
+                new_deployment(f"app{i}", replicas=8 + i,
+                               image="docker.io/nginx:1.25")
+            )
+        cp.settle()
+        return cp
+
+    @staticmethod
+    def _member_state(cp):
+        """Canonical member-side applied objects: the plane's OUTPUT."""
+        from karmada_tpu.utils.codec import to_jsonable
+
+        out = {}
+        for name in cp.members.names():
+            member = cp.members.get(name)
+            for obj in member.list():
+                doc = _canon(to_jsonable(obj))
+                out[(name, obj.meta.namespace, obj.meta.name)] = doc
+        return out
+
+    def test_works_are_template_delta_and_rehydration_byte_equivalent(
+        self, monkeypatch
+    ):
+        """Tentpole (c) acceptance: template-delta rehydration is byte-
+        equivalent to full rendering, and the member-side applied state
+        is identical under either representation."""
+        from karmada_tpu.utils.codec import to_jsonable
+
+        cp = self._plane()
+        works = cp.store.list("Work")
+        delta = [
+            w for w in works
+            if w.spec.workload_template is not None
+            and w.spec.workload_template.digest
+        ]
+        assert delta, "no Work rendered template-delta"
+        # one content-addressed template per workload family, shared
+        digests = {w.spec.workload_template.digest for w in delta}
+        for d in digests:
+            assert cp.store.get("WorkloadTemplate", d) is not None
+        assert len(digests) < len(delta)
+        state_delta = self._member_state(cp)
+
+        # rehydrate each delta Work and compare against the full render
+        # the SAME plane produces with the kill switch thrown
+        from karmada_tpu.controllers.propagation import work_manifests
+
+        rehydrated = {
+            w.meta.namespaced_name: [
+                to_jsonable(m) for m in work_manifests(cp.store, w)
+            ]
+            for w in delta
+        }
+        monkeypatch.setenv("KARMADA_TPU_BUS_TEMPLATE_DELTA", "0")
+        # flipping the kill switch changes the build fingerprint: every
+        # binding re-renders its Works full on the next reconcile
+        for kind in ("ResourceBinding",):
+            for rb in cp.store.list(kind):
+                cp.binding_controller.worker.enqueue(
+                    (kind, rb.meta.namespaced_name)
+                )
+        cp.settle()
+        full_works = cp.store.list("Work")
+        full = {
+            w.meta.namespaced_name: [
+                to_jsonable(m) for m in w.spec.workload
+            ]
+            for w in full_works
+            if w.spec.workload
+        }
+        for key, docs in rehydrated.items():
+            assert key in full
+            assert docs == full[key], f"rehydration diverged for {key}"
+        # the member-side plane output is identical too
+        assert self._member_state(cp) == state_delta
+        # the orphaned templates were garbage-collected once nothing
+        # referenced them
+        assert _wait(
+            lambda: not cp.store.list("WorkloadTemplate"), timeout=2.0
+        ) or not cp.store.list("WorkloadTemplate")
+
+    def test_override_matched_target_full_renders(self):
+        """Per-target fallback: a cluster matched by an override rule
+        full-renders while the rest of the fleet stays delta."""
+        from karmada_tpu.api.policy import (
+            ImageOverrider,
+            OverridePolicy,
+            OverrideSpec,
+            Overriders,
+            RuleWithCluster,
+        )
+        from karmada_tpu.api.policy import ClusterAffinity
+        from karmada_tpu.controllers.propagation import (
+            execution_namespace,
+            work_manifests,
+        )
+
+        from karmada_tpu.api import ResourceSelector
+
+        cp = self._plane(n_deploys=2)
+        cp.store.apply(OverridePolicy(
+            meta=ObjectMeta(name="ov", namespace="default"),
+            spec=OverrideSpec(
+                resource_selectors=[ResourceSelector(
+                    api_version="apps/v1", kind="Deployment"
+                )],
+                override_rules=[RuleWithCluster(
+                    target_cluster=ClusterAffinity(
+                        cluster_names=["member1"]
+                    ),
+                    overriders=Overriders(image_overrider=[ImageOverrider(
+                        component="Registry", operator="replace",
+                        value="override.example.com",
+                    )]),
+                )],
+            ),
+        ))
+        for rb in cp.store.list("ResourceBinding"):
+            cp.binding_controller.worker.enqueue(
+                ("ResourceBinding", rb.meta.namespaced_name)
+            )
+        cp.settle()
+        by_cluster: dict[str, list] = {}
+        for w in cp.store.list("Work"):
+            ns = w.meta.namespace
+            for cl in ("member1", "member2", "member3"):
+                if ns == execution_namespace(cl):
+                    by_cluster.setdefault(cl, []).append(w)
+        assert all(
+            w.spec.workload and w.spec.workload_template is None
+            for w in by_cluster.get("member1", [])
+        ), "override-matched target must full-render"
+        others = by_cluster.get("member2", []) + by_cluster.get(
+            "member3", []
+        )
+        assert any(
+            w.spec.workload_template is not None for w in others
+        ), "unmatched targets should stay template-delta"
+        # and every work still rehydrates to a manifest
+        for w in cp.store.list("Work"):
+            assert work_manifests(cp.store, w), w.meta.namespaced_name
+
+    def test_template_gc_on_binding_delete(self):
+        cp = self._plane(n_deploys=2)
+        assert cp.store.list("WorkloadTemplate")
+        for dep in list(cp.store.list("Resource")):
+            if dep.kind == "Deployment":
+                cp.store.delete(
+                    "Resource", dep.meta.namespaced_name, force=True
+                )
+        cp.settle()
+        # the app Works are gone (system Works — cluster RBAC sync etc. —
+        # are not the binding controller's and stay)
+        assert not [
+            w for w in cp.store.list("Work")
+            if ".app" in w.meta.name or w.meta.name.startswith("default.")
+        ]
+        assert not cp.store.list("WorkloadTemplate"), (
+            "unreferenced templates must be collected"
+        )
+
+    def test_work_delivered_before_template_parks_then_applies(self):
+        """Bus replay can deliver a Work before its WorkloadTemplate on a
+        mid-stream join: the consumer parks on the digest and the
+        template watch unparks it."""
+        from karmada_tpu.api.work import (
+            Work,
+            WorkSpec,
+            WorkloadTemplate,
+            WorkloadTemplateRef,
+        )
+        from karmada_tpu.controllers.propagation import TemplateRehydrator
+        from karmada_tpu.utils.codec import to_jsonable
+
+        store = Store()
+        manifest = Resource(
+            api_version="apps/v1", kind="Deployment",
+            meta=ObjectMeta(name="app", namespace="default"),
+            spec={"replicas": 1, "template": {"x": 1}},
+        )
+        doc = to_jsonable(manifest)
+        ref = WorkloadTemplateRef(
+            digest="d1", api_version="apps/v1", kind="Deployment",
+            namespace="default", name="app", patch={"replicas": 5},
+        )
+        work = Work(
+            meta=ObjectMeta(name="w", namespace="karmada-es-m1"),
+            spec=WorkSpec(workload_template=ref),
+        )
+        rehydrator = TemplateRehydrator(store)
+        assert rehydrator.manifests(work) is None  # parked: no template
+        store.apply(WorkloadTemplate(
+            meta=ObjectMeta(name="d1"), manifest=doc
+        ))
+        out = rehydrator.manifests(work)
+        assert out is not None and out[0].spec["replicas"] == 5
+        assert out[0].spec["template"] == {"x": 1}
+        # memoized render: same object identity on re-reconcile
+        assert rehydrator.manifests(work)[0] is out[0]
+
+
+class TestPlaneOverBusParity:
+    """End-to-end: the whole controller fleet writing through a real gRPC
+    bus — batched vs forced-unary planes converge to identical state."""
+
+    def _run_plane(self, n=12, c=3):
+        from karmada_tpu import cli as _cli
+        from karmada_tpu.api import (
+            PropagationPolicy,
+            PropagationSpec,
+            ResourceSelector,
+        )
+        from karmada_tpu.bus.agent import ReplicaStoreFacade
+        from karmada_tpu.utils.builders import (
+            dynamic_weight_placement,
+            new_cluster,
+            new_deployment,
+        )
+
+        primary = Store()
+        server = StoreBusServer(primary, "127.0.0.1:0")
+        port = server.start()
+        replica = StoreReplica(f"127.0.0.1:{port}")
+        replica.start()
+        assert replica.wait_synced(10)
+        cp = _cli.cmd_init(store=ReplicaStoreFacade(replica))
+        try:
+            for i in range(1, c + 1):
+                cp.join_cluster(
+                    new_cluster(f"member{i}", cpu="100", memory="200Gi")
+                )
+            self._settle(cp)
+            cp.store.apply(PropagationPolicy(
+                meta=ObjectMeta(name="p", namespace="default"),
+                spec=PropagationSpec(
+                    resource_selectors=[ResourceSelector(
+                        api_version="apps/v1", kind="Deployment"
+                    )],
+                    placement=dynamic_weight_placement(),
+                ),
+            ))
+            for i in range(n):
+                cp.store.apply(
+                    new_deployment(f"app{i}", replicas=(i % 4) + 1)
+                )
+            self._settle(cp)
+
+            def works_match_placements() -> bool:
+                self._settle(cp)
+                want = sum(
+                    len(rb.spec.clusters)
+                    for rb in primary.list("ResourceBinding")
+                )
+                have = sum(
+                    1 for w in primary.list("Work")
+                    if ".app" in w.meta.name
+                )
+                return want > 0 and have == want
+
+            assert _wait(works_match_placements, timeout=30.0), (
+                "works never converged to the scheduled placements"
+            )
+            return self._state(cp, primary)
+        finally:
+            replica.close()
+            server.stop()
+
+    @staticmethod
+    def _settle(cp):
+        """Settle through the write-echo stream: a settle's writes become
+        locally visible via the bus echo, which can land after
+        run_until_settled returns."""
+        cp.settle()
+        idle = 0
+        deadline = time.time() + 30
+        while idle < 3 and time.time() < deadline:
+            time.sleep(0.05)
+            if cp.settle() == 0:
+                idle += 1
+            else:
+                idle = 0
+        assert idle >= 3, "plane never settled through echoes"
+
+    @staticmethod
+    def _state(cp, primary):
+        """Timestamp-free canonical plane state: binding placements and
+        REHYDRATED work manifests (representation-independent)."""
+        from karmada_tpu.controllers.propagation import work_manifests
+        from karmada_tpu.utils.codec import to_jsonable
+
+        placements = {
+            rb.meta.namespaced_name: sorted(
+                (tc.name, tc.replicas) for tc in rb.spec.clusters
+            )
+            for rb in primary.list("ResourceBinding")
+        }
+        manifests = {}
+        for w in primary.list("Work"):
+            docs = work_manifests(primary, w)
+            assert docs, f"work {w.meta.namespaced_name} has no manifest"
+            manifests[w.meta.namespaced_name] = [
+                _canon(to_jsonable(m)) for m in docs
+            ]
+        return placements, manifests
+
+    def test_final_state_identical_batched_vs_unary(self, monkeypatch):
+        batched = self._run_plane()
+        monkeypatch.setenv("KARMADA_TPU_BUS_BATCH", "0")
+        monkeypatch.setenv("KARMADA_TPU_BUS_TEMPLATE_DELTA", "0")
+        unary = self._run_plane()
+        assert batched[0] == unary[0], "binding placements diverged"
+        assert batched[1] == unary[1], (
+            "rehydrated work manifests diverged between batched "
+            "template-delta and unary full rendering"
+        )
+
+
+class TestWorkerNamespaceSharding:
+    def test_batch_drain_holds_one_shard_only(self):
+        from karmada_tpu.utils import Runtime
+
+        seen: list[list] = []
+
+        def reconcile(key):
+            return DONE
+
+        def reconcile_batch(keys):
+            seen.append(list(keys))
+            return {k: DONE for k in keys}
+
+        rt = Runtime()
+        w = rt.new_worker(
+            "t", reconcile, reconcile_batch=reconcile_batch,
+            shard_fn=lambda key: key.partition("/")[0],
+        )
+        for i in range(4):
+            w.enqueue(f"ns-a/k{i}")
+            w.enqueue(f"ns-b/k{i}")
+        while len(w):
+            w.process_one()
+        assert seen, "batched drains never ran"
+        for batch in seen:
+            tokens = {k.partition("/")[0] for k in batch}
+            assert len(tokens) == 1, (
+                f"a batch drain mixed ownership domains: {batch}"
+            )
+        drained = {k for b in seen for k in b}
+        assert drained == {
+            f"ns-{t}/k{i}" for t in "ab" for i in range(4)
+        }
+
+    def test_sharded_enqueue_dedup_and_len(self):
+        from karmada_tpu.utils import Runtime
+
+        rt = Runtime()
+        w = rt.new_worker(
+            "t2", lambda k: DONE,
+            shard_fn=lambda key: key.partition("/")[0],
+        )
+        w.enqueue("a/1")
+        w.enqueue("a/1")  # dedup
+        w.enqueue("b/2")
+        assert len(w) == 2
+        assert w.process_one() is True
+        assert w.process_one() is True
+        assert w.process_one() is False
+        assert len(w) == 0

@@ -137,8 +137,12 @@ class TestWorkBuildCache:
             if w.meta.name.endswith("app-deployment")
         ]
         assert works
+        from karmada_tpu.controllers.propagation import work_manifests
+
         for w in works:
-            assert w.spec.workload[0].meta.labels.get("team") == "payments"
+            # works may be template-delta rendered: rehydrate to inspect
+            manifest = work_manifests(cp.store, w)[0]
+            assert manifest.meta.labels.get("team") == "payments"
 
 
 class TestNamespaceSync:

@@ -113,6 +113,24 @@ def test_gl001_catches_each_pattern():
     assert "os.environ" in details
 
 
+def test_gl007_catches_each_pattern():
+    """ISSUE 11 satellite: the batched write stub (ApplyBatch — one unary
+    RPC per write SET) and the with_call form are call sites GL007 must
+    bound; the WatchBatch frame stream stays exempt like unary watch."""
+    findings = lint_fixture("gl007_bad.py", FIXTURE_ROLES["GL007"])
+    details = {f.detail for f in findings}
+    assert "stub:self._sync" in details
+    assert "future:self._score" in details
+    assert "stub:self._apply_batch" in details, (
+        "batched stub called with metadata but no timeout not flagged"
+    )
+    assert "with_call:self._apply_batch" in details, (
+        "with_call form not flagged"
+    )
+    assert "stub:score" in details
+    assert "urlopen" in details
+
+
 def test_gl006_catches_each_pattern():
     findings = lint_fixture("gl006_bad.py", FIXTURE_ROLES["GL006"])
     details = {f.detail for f in findings}

@@ -183,7 +183,8 @@ def obs_block(od: dict) -> str:
             )
         )
         chan_s = "; ".join(
-            f"{k}: {v.get('rpcs', 0)} rpcs, client {v.get('client_s', 0.0):.2f}s"
+            f"{k}: {v.get('rpcs', 0)} rpcs ({v.get('events_per_rpc', 1.0):g}"
+            f" ev/msg), client {v.get('client_s', 0.0):.2f}s"
             f" = server {v.get('server_s', 0.0):.2f}s + network "
             f"{v.get('network_s', 0.0):.2f}s"
             for k, v in sorted((st.get("channels") or {}).items())
@@ -204,6 +205,31 @@ def obs_block(od: dict) -> str:
             f"{bool(od.get('flight_recorded'))}, reasons "
             f"{od.get('flight_reasons', [])}, `trace analyze` re-derives "
             f"identically={od.get('flight_analyze_identical')} |",
+        ]
+    # ISSUE 11: the columnar bus channel rows — storm throughput over
+    # the live 4-process bus, the unary re-run ratio, the top stitched
+    # self-time phase (bus.rpc must no longer lead), and the batched↔
+    # unary plane-state parity verdict
+    if od.get("bus_parity_identical") is not None:
+        parity = {True: "IDENTICAL", False: "DIVERGED"}[
+            bool(od.get("bus_parity_identical"))
+        ]
+        n_st = od.get("stitched_bindings", 0)
+        rows += [
+            f"| bus channel {n_st}x{od.get('stitched_clusters', 0)} "
+            f"(4-process storm): batched vs unary wall | "
+            f"{fmt(od.get('stitched_wall_s'))} batched "
+            f"({od.get('stitched_bindings_s', 0):,.0f} bindings/s) vs "
+            f"{fmt(od.get('bus_unary_wall_s'))} unary write path — "
+            f"{od.get('bus_unary_vs_batched', 0):g}x |",
+            f"| bus channel: top stitched self-time phase | "
+            f"{od.get('bus_top_self_phase', '?')} "
+            f"{od.get('bus_top_self_phase_s', 0.0):.2f}s |",
+            f"| bus channel: template-delta rendering | "
+            f"{od.get('bus_template_delta_works', 0):,} delta Works over "
+            f"{od.get('bus_templates', 0):,} content-addressed templates |",
+            f"| bus channel: plane state batched vs unary "
+            f"(placements + rehydrated manifests) | {parity} |",
         ]
     return "\n".join(rows)
 

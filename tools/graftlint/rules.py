@@ -910,9 +910,12 @@ class SpanTaxonomy(Rule):
 # is a scheduling wave. Channels are built once (``chan.unary_unary(...)``
 # assigned to an attribute or name); this rule tracks those stub bindings
 # per scope and requires a ``timeout=`` keyword at every direct CALL of a
-# stub (and every ``stub.future(...)``). ``unary_stream`` watch streams
-# are exempt — they are deliberately open-ended and bounded by their
-# reconnect loop. ``urllib.request.urlopen`` must pass ``timeout=`` too.
+# stub (and every ``stub.future(...)`` / ``stub.with_call(...)`` — the
+# grpc call forms the ISSUE 11 batched write path uses are stubs too; a
+# 4096-op ApplyBatch without a deadline stalls the whole write SET, not
+# one object). ``unary_stream`` watch/WatchBatch streams are exempt —
+# they are deliberately open-ended and bounded by their reconnect loop.
+# ``urllib.request.urlopen`` must pass ``timeout=`` too.
 
 
 def _is_stub_factory(node: ast.AST) -> bool:
@@ -1006,11 +1009,11 @@ class BoundedRpc(Rule):
                 kind = f"stub:{stub}"
             elif (
                 isinstance(node.func, ast.Attribute)
-                and node.func.attr == "future"
+                and node.func.attr in ("future", "with_call")
                 and is_stub_ref(node.func.value) is not None
             ):
                 stub = is_stub_ref(node.func.value)
-                kind = f"future:{stub}"
+                kind = f"{node.func.attr}:{stub}"
             elif is_urlopen(node.func):
                 kind = "urlopen"
             if kind is None or _has_timeout_kw(node):
