@@ -133,6 +133,14 @@ def build_parser():
         help=argparse.SUPPRESS,
     )
     p.add_argument(
+        "--check", default="", metavar="RECORD",
+        help="perf-regression guard (ISSUE 12): compare RECORD.json "
+        "against the newest committed BENCH_*.json with the same metric "
+        "using tools/benchguard.py's per-metric directional noise "
+        "bands; prints the verdict table and exits non-zero on any "
+        "regression or missing guarded metric",
+    )
+    p.add_argument(
         "--observability", action="store_true",
         help="run the wave-trace observability tier: a whole-plane storm "
         "wave (default 20k bindings x 512 clusters; --bindings/--clusters "
@@ -2745,6 +2753,12 @@ def run_observability(args) -> dict:
             break
         prev_w = w
 
+    # ISSUE 12 (b): the device-byte ledger across the measured steady
+    # wave — resident bytes must not move between steady passes, and the
+    # gauge's samples must sum to the engine's exact nbytes ledger
+    eng = getattr(cp.scheduler, "_engine", None)
+    bytes_before = eng.device_bytes() if eng is not None else {}
+
     wall, sums, main = storm_wave("measured")
     # the acceptance number: how much of the externally measured wall
     # clock the wave tree attributes to named spans (every settle the
@@ -2761,6 +2775,36 @@ def run_observability(args) -> dict:
         f"{main['spans']} spans in the main wave)",
         file=sys.stderr,
     )
+    # device-byte ledger columns (ISSUE 12 b)
+    from karmada_tpu.utils.history import render_history_table
+    from karmada_tpu.utils.metrics import device_bytes as device_bytes_gauge
+
+    bytes_after = eng.device_bytes() if eng is not None else {}
+    dev_samples = device_bytes_gauge.samples()
+    gauge_total = sum(
+        v for k, v in dev_samples.items()
+        if dict(k).get("kind") in bytes_after
+    )
+    platforms = sorted({
+        dict(k).get("platform", "?") for k in dev_samples
+        if dict(k).get("kind") in bytes_after
+    })
+    dev_constant = bool(bytes_after) and bytes_before == bytes_after
+    # gated on a non-empty ledger: an engine that never built must
+    # record "not verified", never a vacuous 0 == 0 pass
+    dev_matches = bool(bytes_after) and (
+        int(gauge_total) == sum(bytes_after.values())
+    )
+    print(
+        f"# observability device bytes: {bytes_after} "
+        f"(steady-constant={dev_constant}, gauge-sum-matches="
+        f"{dev_matches}, platform={platforms})",
+        file=sys.stderr,
+    )
+    # the history-backed per-wave table (ISSUE 12 a)
+    hist = tracer.history
+    hist_rows = hist.rows(window=10)
+    print(render_history_table(hist_rows), file=sys.stderr)
     record = {
         "metric": f"observability_wave_{n // 1000}kx{c}",
         "value": round(wall, 4),
@@ -2781,6 +2825,15 @@ def run_observability(args) -> dict:
         "host_s": main["host_s"],
         "kernel_compiles": compiles,
         "waves_in_window": len(sums),
+        # ISSUE 12: device-byte ledger + per-wave history columns
+        "device_bytes": {k: int(v) for k, v in sorted(bytes_after.items())},
+        "device_bytes_total": int(sum(bytes_after.values())),
+        "device_bytes_steady_constant": dev_constant,
+        "device_bytes_matches_gauge": dev_matches,
+        "device_bytes_platform": ",".join(platforms),
+        "history_waves": hist.sampled,
+        "history_rows": hist_rows[-8:],
+        "history_digests": hist.digests(window=64)["series"],
     }
     del cp
     gc.collect()
@@ -3110,11 +3163,15 @@ def run_stitched_observability(args) -> dict:
             records[-1] if records else None,
         )
         analysis = trc.analyze_record(fault_rec) if fault_rec else {}
+        flight_history = bool(
+            (fault_rec or {}).get("history", {}) or {}
+        ) and bool(fault_rec["history"].get("row"))
         print(
             f"# stitched fault wave: {fault_wall:.2f}s, "
             f"{len(records)} flight record(s), reasons "
             f"{fault_rec['reasons'] if fault_rec else []}, analyze "
-            f"identical={analysis.get('identical')}",
+            f"identical={analysis.get('identical')}, history context "
+            f"attached={flight_history}",
             file=sys.stderr,
         )
         if analysis.get("table"):
@@ -3146,6 +3203,10 @@ def run_stitched_observability(args) -> dict:
             "flight_reasons": fault_rec["reasons"] if fault_rec else [],
             "flight_records": len(records),
             "flight_analyze_identical": analysis.get("identical"),
+            # ISSUE 12: the record carries the breaching wave's history
+            # row + recent-window digests; `trace analyze` renders the
+            # breach-vs-recent table from them offline
+            "flight_history_attached": flight_history,
             "flight_fault_wall_s": round(fault_wall, 4),
             # the recorder's disarmed steady-state (SLO env unset) is one
             # env read per wave boundary and zero per-span work — the
@@ -3515,6 +3576,17 @@ def run_sharded_kernel(args) -> dict:
 
 def main():
     args = build_parser().parse_args()
+    if args.check:
+        # the guard is pure JSON comparison — no jax, no plane; it must
+        # stay runnable on a laptop that cannot build an engine
+        import os
+
+        repo_root = os.path.dirname(os.path.abspath(__file__))
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        from tools.benchguard import main as benchguard_main
+
+        sys.exit(benchguard_main([args.check, "--root", repo_root]))
     # per-tier default scale (see build_parser): explicit flags always win
     if args.bindings is None:
         args.bindings = (

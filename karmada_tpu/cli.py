@@ -1223,6 +1223,39 @@ def build_parser() -> tuple:
         "--stitch (overrides the dumped process's registry)",
     )
 
+    tp = sub.add_parser(
+        "top",
+        help="plane-wide per-wave telemetry table from the history rings "
+        "(`/debug/history`): latest wave per process (wall, coverage, "
+        "bindings/s, rows packed/replayed, compiles, upload/fetch MB, "
+        "per-channel RPCs, device bytes, queue depth) plus "
+        "recent-window p50/p95 digests and live settle-latency "
+        "quantiles off /metrics; `--watch` refreshes in place",
+    )
+    tp.add_argument(
+        "--metrics", default="",
+        help="HOST:PORT of a process's metrics endpoint; without it the "
+        "CURRENT process's in-proc history answers (useful under an "
+        "embedded plane)",
+    )
+    tp.add_argument(
+        "--peers", default="",
+        help="comma-separated name=host:port peer metrics endpoints "
+        "(default: the target's registered peers, else "
+        "KARMADA_TPU_TRACE_PEERS)",
+    )
+    tp.add_argument(
+        "--window", type=int, default=64,
+        help="history rows fetched per process (digests cover the same "
+        "window)",
+    )
+    tp.add_argument("--watch", action="store_true",
+                    help="refresh every --interval seconds until Ctrl-C")
+    tp.add_argument("--interval", type=float, default=2.0)
+    tp.add_argument("--json", dest="as_json", action="store_true",
+                    help="print the raw aggregated document instead of "
+                    "the table")
+
     qu = sub.add_parser(
         "quota",
         help="quota-plane operations: `quota status [--metrics HOST:PORT]` "
@@ -1242,7 +1275,8 @@ def build_parser() -> tuple:
         help="run graftlint, the repo's two-tier static analyzer: AST "
         "tier (GL001 trace safety, GL002 trace-key completeness, GL003 "
         "env-flag registry, GL004 lock discipline, GL005 import hygiene, "
-        "GL006 metric naming) "
+        "GL006 metric naming, GL007 bounded RPCs, GL008 span taxonomy, "
+        "GL009 history series sources) "
         "and, with --ir, the jaxpr-level kernel auditor (IR001 dtype "
         "discipline, IR002 host round-trips, IR003 const capture, IR004 "
         "trace-manifest fidelity, IR005 donation audit)",
@@ -1481,6 +1515,221 @@ def cmd_quota_status(metrics: str = "") -> dict:
     return {"namespaces": namespaces}
 
 
+def exposition_quantiles(
+    text: str, family: str, qs
+) -> dict[float, dict[tuple, float]]:
+    """Bucket-interpolated quantiles straight off Prometheus text
+    exposition (ISSUE 12 satellite): parse ``{family}_bucket`` /
+    ``{family}_count`` rows ONCE with the SAME ``_parse_exposition_
+    lines`` helper the quota-status verb uses, then estimate every
+    requested quantile via the shared ``utils.metrics.bucket_quantile``
+    core — one interpolation rule for the live Histogram and every CLI
+    reading a scrape, so operators stop eyeballing raw cumulative
+    buckets. Returns {q: {non-le label tuple: value}}."""
+    from .utils.metrics import bucket_quantile
+
+    rows = _parse_exposition_lines(
+        text, (family + "_bucket", family + "_count")
+    )
+    buckets: dict[tuple, list] = {}
+    totals: dict[tuple, int] = {}
+    for name, labels, value in rows:
+        if name.endswith("_count"):
+            key = tuple(sorted(labels.items()))
+            totals[key] = int(value)
+            continue
+        le = labels.get("le")
+        if le is None or le.lstrip("+") == "Inf":
+            continue
+        key = tuple(sorted(
+            (k, v) for k, v in labels.items() if k != "le"
+        ))
+        buckets.setdefault(key, []).append((float(le), int(value)))
+    out: dict[float, dict[tuple, float]] = {q: {} for q in qs}
+    for key, bs in buckets.items():
+        bs.sort()
+        bounds = [b for b, _ in bs]
+        counts = [c for _, c in bs]
+        total = totals.get(key, counts[-1] if counts else 0)
+        for q in qs:
+            v = bucket_quantile(q, bounds, counts, total)
+            if v is not None:
+                out[q][key] = v
+    return out
+
+
+def exposition_quantile(
+    text: str, family: str, q: float
+) -> dict[tuple, float]:
+    """One-quantile form of ``exposition_quantiles`` (same parse, same
+    interpolation)."""
+    return exposition_quantiles(text, family, (q,))[q]
+
+
+def cmd_plane_top(
+    metrics: str = "", peers: str = "", window: int = 64
+) -> dict:
+    """The ``top`` verb: aggregate ``/debug/history`` (and the
+    settle-latency histogram off ``/metrics``) across the plane's
+    processes into one document — the target endpoint (or this
+    process's in-proc history), plus every registered peer. Unreachable
+    peers degrade to an ``error`` entry; the reachable plane still
+    renders."""
+    import urllib.request
+
+    from .utils import tracing as trc
+    from .utils.history import history_for
+
+    def fetch(addr: str) -> tuple[dict, str]:
+        with urllib.request.urlopen(
+            f"http://{addr}/debug/history?window={window}", timeout=3
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr}/metrics", timeout=3
+            ) as resp:
+                text = resp.read().decode()
+        except Exception:  # noqa: BLE001 — digest-only degradation
+            text = ""
+        return doc, text
+
+    peer_map: dict[str, str] = {}
+    if peers:
+        for part in peers.split(","):
+            name, sep, addr = part.strip().partition("=")
+            if sep and name.strip() and addr.strip():
+                peer_map[name.strip()] = addr.strip()
+
+    fetched: dict[str, tuple[dict, str]] = {}
+    if metrics:
+        doc, text = fetch(metrics)
+        fetched[doc.get("proc") or "target"] = (doc, text)
+        if not peer_map:
+            peer_map = {
+                n: a for n, a in (doc.get("peers") or {}).items()
+                if a != metrics
+            }
+    else:
+        from .utils.metrics import registry as _registry
+
+        tr = trc.tracer
+        doc = history_for(tr).debug_doc(window=window, proc=tr.proc)
+        doc["peers"] = trc.peers()
+        fetched[tr.proc] = (doc, _registry.render())
+        if not peer_map:
+            peer_map = trc.peers()
+        if not peer_map:
+            # parse the env WITHOUT registering: a read-only monitoring
+            # verb must not flip the embedded plane's every later wave
+            # close into stitched per-close sampling (peers() gates it)
+            import os as _os
+
+            raw = _os.environ.get("KARMADA_TPU_TRACE_PEERS", "")
+            for part in raw.split(","):
+                name, sep, addr = part.strip().partition("=")
+                if sep and name.strip() and addr.strip():
+                    peer_map[name.strip()] = addr.strip()
+
+    # peers fetch CONCURRENTLY: N black-holed peers must cost one
+    # timeout, not N serial ones (a --watch refresh blocks on this)
+    todo = {
+        name: addr for name, addr in sorted(peer_map.items())
+        if name not in fetched
+    }
+    if todo:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(len(todo), 8)) as pool:
+            futures = {
+                name: pool.submit(fetch, addr)
+                for name, addr in todo.items()
+            }
+        for name, fut in futures.items():
+            try:
+                fetched[name] = fut.result()
+            except Exception as exc:  # noqa: BLE001 — peer down:
+                # render the rest
+                fetched[name] = (
+                    {"error": f"{type(exc).__name__}: {exc}"}, ""
+                )
+
+    out: dict = {"window": window, "procs": {}}
+    for name, (doc, text) in fetched.items():
+        if "error" in doc:
+            out["procs"][name] = {"error": doc["error"]}
+            continue
+        entry = {
+            "cap": doc.get("cap"),
+            "sampled": doc.get("sampled"),
+            "evicted": doc.get("evicted"),
+            "rows": doc.get("rows", []),
+            "digests": doc.get("digests", {}),
+        }
+        if text:
+            for fam, slot in (
+                ("karmada_tpu_settle_seconds", "settle"),
+                ("karmada_tpu_scheduler_pass_seconds", "pass"),
+            ):
+                by_q = exposition_quantiles(text, fam, (0.5, 0.95))
+                p50 = by_q[0.5].get(())
+                p95 = by_q[0.95].get(())
+                if p50 is not None:
+                    entry[f"{slot}_p50_s"] = round(p50, 6)
+                if p95 is not None:
+                    entry[f"{slot}_p95_s"] = round(p95, 6)
+        out["procs"][name] = entry
+    return out
+
+
+def render_top(doc: dict) -> str:
+    """The ``top`` table: the latest wave row per process, then the
+    recent-window digests (p50/p95 per headline series) and the live
+    settle quantiles."""
+    from .utils.history import render_history_table
+
+    latest = []
+    for name, entry in sorted(doc.get("procs", {}).items()):
+        for row in entry.get("rows", [])[-1:]:
+            row = dict(row)
+            row["proc"] = name
+            latest.append(row)
+    lines = [render_history_table(latest)] if latest else [
+        "(no history rows sampled yet)"
+    ]
+    for name, entry in sorted(doc.get("procs", {}).items()):
+        if "error" in entry:
+            lines.append(f"{name}: unreachable ({entry['error']})")
+            continue
+        series = (entry.get("digests") or {}).get("series", {})
+        window = (entry.get("digests") or {}).get("window", 0)
+        bits = []
+        for key, label in (
+            ("wall_s", "wall"),
+            ("bindings_s", "bind/s"),
+            ("coverage", "cover"),
+            ("device_bytes", "devB"),
+        ):
+            d = series.get(key)
+            if d:
+                bits.append(
+                    f"{label} p50 {d['p50']:.3g} p95 {d['p95']:.3g}"
+                )
+        for slot in ("settle", "pass"):
+            if f"{slot}_p50_s" in entry:
+                bits.append(
+                    f"{slot} p50 {entry[f'{slot}_p50_s']:.3g}s "
+                    f"p95 {entry.get(f'{slot}_p95_s', 0.0):.3g}s"
+                )
+        if entry.get("evicted"):
+            bits.append(f"evicted {entry['evicted']}")
+        if bits:
+            lines.append(
+                f"{name} (last {window} wave(s)): " + ", ".join(bits)
+            )
+    return "\n".join(lines)
+
+
 def cmd_warmup(manifest: str = "", expand: bool = True) -> dict:
     """The ``warmup`` verb: replay the trace manifest through AOT
     compilation on the current backend (scheduler.prewarm.warmup), so a
@@ -1565,6 +1814,37 @@ def main(argv: Optional[list[str]] = None) -> int:
             return 1
         print(json.dumps(doc, indent=2))
         return 0
+    if args.command == "top":
+        import time as _time
+
+        while True:
+            try:
+                doc = cmd_plane_top(
+                    args.metrics, peers=args.peers, window=args.window
+                )
+            except KeyboardInterrupt:
+                # Ctrl-C mid-fetch in --watch mode is a clean exit,
+                # not a traceback
+                return 0
+            except Exception as exc:  # unreachable target endpoint
+                print(json.dumps({"error": str(exc)}))
+                if not args.watch:
+                    return 1
+                # a watch survives one failed scrape (target restarting)
+                # and retries on the next interval
+                doc = None
+            if doc is not None:
+                if args.as_json:
+                    print(json.dumps(doc, indent=2))
+                else:
+                    print(render_top(doc))
+            if not args.watch:
+                return 0
+            try:
+                _time.sleep(args.interval)
+            except KeyboardInterrupt:
+                return 0
+            print()  # blank separator between refreshes
     if args.command == "warmup":
         stats = cmd_warmup(args.manifest, expand=not args.no_expand)
         print(json.dumps(stats))

@@ -455,12 +455,41 @@ class TensorScheduler:
 
     def _caps_device(self):
         """Device mirror of the static-assignment cap tensor, rebuilt only
-        when the quota snapshot's cap content changes."""
+        when the quota snapshot's cap content changes. Rebuilds refresh
+        the device-byte ledger's quota slice (the fleet table publishes
+        its own kinds per pass)."""
         q = self.quota
         if self._caps_dev is None or self._caps_dev_token != q.cap_token:
             self._caps_dev = jnp.asarray(q.cluster_caps)
             self._caps_dev_token = q.cap_token
+            from ..utils.metrics import device_bytes as device_bytes_gauge
+
+            caps = self._caps_dev
+            try:
+                platform = next(iter(caps.devices())).platform
+            except Exception:  # noqa: BLE001 — label is best-effort
+                platform = "none"
+            device_bytes_gauge.remove_matching(kind="quota_caps")
+            device_bytes_gauge.set(
+                int(caps.nbytes),
+                kind="quota_caps",
+                bucket="x".join(str(int(s)) for s in caps.shape),
+                platform=platform,
+            )
         return self._caps_dev
+
+    def device_bytes(self) -> dict[str, int]:
+        """Resident device bytes by ledger kind across this engine: the
+        fleet table's kinds plus the quota cap tensor — the exact
+        ``nbytes`` of the arrays held (ISSUE 12 b). The bench asserts
+        the sum is constant across steady passes and equals the gauge's
+        samples."""
+        out: dict[str, int] = (
+            self._fleet.device_bytes() if self._fleet is not None else {}
+        )
+        if self._caps_dev is not None:
+            out["quota_caps"] = int(self._caps_dev.nbytes)
+        return out
 
     def _quota_cap_rows(self, problems) -> Optional[np.ndarray]:
         """int32[B] row into the cap tensor per binding (-1 = uncapped),

@@ -1174,6 +1174,9 @@ class FleetTable:
         self._result_gen = 0
         # per-phase wall times of the last pass (bench breakdown surface)
         self.last_breakdown: dict[str, float] = {}
+        # rows (re)packed by the current pass (_pack_row increments):
+        # the packed-vs-replayed split the history ring records per wave
+        self._packed_this_pass = 0
         # host->device bytes of the current pass (state upload/scatter +
         # row indices), reset by _sync_device; surfaces as upload_mb
         self._last_upload_bytes = 0
@@ -1397,6 +1400,7 @@ class FleetTable:
         return row
 
     def _pack_row(self, row: int, problem, compiled) -> None:
+        self._packed_this_pass += 1
         snap = self.engine.snapshot
         st = self._st
         # placement slot
@@ -1816,14 +1820,78 @@ class FleetTable:
         """One fleet pass, wrapped in a ``scheduler.solve`` wave span with
         per-phase kernel child spans (host pack / dispatch / fenced device
         execute / fetch+fold) emitted from the pass breakdown — the
-        device/host attribution surface of ISSUE 6 (b)."""
+        device/host attribution surface of ISSUE 6 (b). The span carries
+        the pass's packed-vs-replayed row split (the churn-attribution
+        series the history ring records per wave, ISSUE 12), and the
+        device-byte ledger publishes after every pass."""
         from ..utils.tracing import tracer
 
         with tracer.span("scheduler.solve") as sp:
             res = self._schedule_pass(problems, compiled)
+            tmr = self.last_breakdown
             sp.attrs["rows"] = len(problems)
+            sp.attrs["rows_packed"] = int(tmr.get("rows_packed", 0))
+            sp.attrs["rows_replayed"] = int(tmr.get("rows_replayed", 0))
             self._emit_phase_spans()
+        self._publish_device_bytes()
         return res
+
+    def device_bytes(self) -> dict[str, int]:
+        """Resident device bytes by ledger kind — the EXACT ``nbytes`` of
+        the arrays this table holds right now (ISSUE 12 b): the packed
+        state grid, the interned slot tables, the donated result
+        residents (legacy entry vectors or dense pair), and the cached
+        all-rows index. The accounting the 1M-on-16GB-HBM question needs
+        before anyone puts the resident grid on a real part."""
+
+        def nb(x) -> int:
+            if x is None:
+                return 0
+            if isinstance(x, tuple):
+                return sum(nb(v) for v in x)
+            return int(getattr(x, "nbytes", 0))
+
+        return {
+            "packed_grid": nb(self._dev_state),
+            "slot_tables": nb(self._dev_tables),
+            "donated_residents": (
+                nb(self._resident_entries)
+                + nb(self._res_dense)
+                + nb(self._res_meta)
+            ),
+            "rows_index": nb(self._all_rows_dev),
+        }
+
+    def _buffer_platform(self) -> str:
+        """Platform of the buffers the ledger counts (PR 9's honesty
+        rule carried to the gauge: forced-host bytes must never read as
+        HBM — the label says whose memory it is)."""
+        for x in (self._dev_state, self._dev_tables, self._res_dense,
+                  self._resident_entries):
+            arr = x[0] if isinstance(x, tuple) and x else x
+            try:
+                if arr is not None:
+                    return next(iter(arr.devices())).platform
+            except Exception:  # noqa: BLE001 — label is best-effort
+                continue
+        return "none"
+
+    def _publish_device_bytes(self) -> None:
+        """Refresh ``karmada_tpu_device_bytes{kind,bucket,platform}``
+        from the live ledger: a clear-then-set sweep per kind so a cap
+        regrow (bucket change) retires its stale sample instead of
+        double-counting. With several engines in one process the gauge
+        reflects the most recently dispatched table — the bucket label
+        says which."""
+        from ..utils.metrics import device_bytes as device_bytes_gauge
+
+        bucket = f"{self.cap}x{self.engine.snapshot.num_clusters}"
+        platform = self._buffer_platform()
+        for kind, nbytes in self.device_bytes().items():
+            device_bytes_gauge.remove_matching(kind=kind)
+            device_bytes_gauge.set(
+                nbytes, kind=kind, bucket=bucket, platform=platform
+            )
 
     #: breakdown keys that are pure host work outside the dispatch/fetch
     #: windows (pack, delta scatter, result decode)
@@ -1847,7 +1915,15 @@ class FleetTable:
         # flag, so the summary's compile_s covers either backend
         fresh = bool(self.new_trace_last_pass)
         phases = [
-            ("kernel.host", host, "host", {}),
+            (
+                "kernel.host",
+                host,
+                "host",
+                # the pass's host->device bytes ride the host span so the
+                # history sampler (and a dumped wave) can read transfer
+                # volume without reaching into the engine
+                {"upload_mb": tmr.get("upload_mb", 0.0)},
+            ),
             (
                 "kernel.dispatch",
                 tmr.get("dispatch", 0.0),
@@ -1883,6 +1959,7 @@ class FleetTable:
         t0 = _time.perf_counter()
         self._pass += 1
         self.new_trace_last_pass = False
+        self._packed_this_pass = 0
         ru = self._reuse
         if ru is not None and ru[0] is problems and ru[1] is compiled:
             # same batch objects as last pass: rows are current (upsert
@@ -1911,6 +1988,12 @@ class FleetTable:
             self._reuse = (problems, compiled, rows_np)
             self._reuse_pass = self._pass
         tmr["upsert"] = _time.perf_counter() - t0
+        # packed-vs-replayed split of THIS pass: a replayed row rode its
+        # fingerprint (or the batch-identity fast path) without re-packing
+        tmr["rows_packed"] = self._packed_this_pass
+        tmr["rows_replayed"] = max(
+            len(problems) - self._packed_this_pass, 0
+        )
         t0 = _time.perf_counter()
         self._sync_device()
         tmr["sync"] = _time.perf_counter() - t0

@@ -179,6 +179,27 @@ class TraceManifest:
             except OSError:
                 pass  # persistence is best-effort; the ledger still holds
 
+    def annotate_memory(self, rec_canon: str, memory: dict) -> None:
+        """Attach a compiled record's XLA ``memory_analysis()`` footprint
+        (temp/output/argument/generated-code bytes) to the matching
+        manifest record — the durable half of the device-memory ledger
+        (ISSUE 12 b): a future boot can read the compile-time memory
+        bill without recompiling. ``memory`` excludes itself from record
+        identity (``_canon`` keys on kernel/shapes/statics only), so
+        annotation never forks a record. Best-effort persistence, like
+        ``record``."""
+        with self._lock:
+            for r in self.records:
+                if _canon(r) == rec_canon:
+                    if r.get("memory") == memory:
+                        return
+                    r["memory"] = memory
+                    try:
+                        self._save()
+                    except OSError:
+                        pass  # the in-memory annotation still holds
+                    return
+
     def keys(self) -> set:
         """The observed ledger keys, as tuples (seeding form)."""
         return {
@@ -292,6 +313,10 @@ def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
     compiled = failed = 0
     ok_canons: set[str] = set()
     errors: list[str] = []
+    # kernel -> {temp/output/argument/generated_code bytes}: the MAX
+    # footprint across this replay's records per kernel family — what an
+    # operator budgets HBM against (karmada_tpu_kernel_memory_bytes)
+    memory_by_kernel: dict[str, dict] = {}
     t0 = time.perf_counter()
     for r in todo:
         fn = registry.get(r["kernel"])
@@ -311,6 +336,7 @@ def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
             from ..parallel.mesh import materialize_mesh_statics
 
             statics = materialize_mesh_statics(statics)
+            aot = None
             try:
                 # one dummy-data execution: trace + compile (persistent-
                 # cache hit when seeded) + run, leaving the jit dispatch
@@ -321,13 +347,51 @@ def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
                 del args
             except Exception:  # noqa: BLE001 — zeros tripped the kernel
                 # fall back to AOT compile: the caches still fill, only
-                # the first dispatch re-traces (off the compile cliff)
-                fn.lower(
+                # the first dispatch re-traces (off the compile cliff).
+                # Kept for the memory hook below — never re-lowered.
+                aot = fn.lower(
                     *(jax.ShapeDtypeStruct(s, d) for s, d in shapes),
                     **statics,
                 ).compile()
             compiled += 1
             ok_canons.add(_canon(r))
+            # device-memory footprint (ISSUE 12 b), best-effort: an
+            # already-annotated record reuses its stored footprint —
+            # zero extra lowerings on every boot after the first; a
+            # fresh record pays ONE extra lowering (the compile itself
+            # is a cache hit behind the execution above / the persistent
+            # cache warmup enables at threshold 0).
+            try:
+                mem = r.get("memory")
+                if mem is None:
+                    if aot is None:
+                        aot = fn.lower(
+                            *(
+                                jax.ShapeDtypeStruct(s, d)
+                                for s, d in shapes
+                            ),
+                            **statics,
+                        ).compile()
+                    ma = aot.memory_analysis()
+                    if ma is not None:
+                        mem = {
+                            "temp_bytes": int(ma.temp_size_in_bytes),
+                            "output_bytes": int(ma.output_size_in_bytes),
+                            "argument_bytes": int(
+                                ma.argument_size_in_bytes
+                            ),
+                            "generated_code_bytes": int(
+                                ma.generated_code_size_in_bytes
+                            ),
+                        }
+                        if r.get("key") is not None:
+                            manifest.annotate_memory(_canon(r), mem)
+                if mem:
+                    slot = memory_by_kernel.setdefault(r["kernel"], {})
+                    for kind, v in mem.items():
+                        slot[kind] = max(slot.get(kind, 0), int(v))
+            except Exception:  # noqa: BLE001 — footprint is telemetry
+                pass
         except Exception as e:  # noqa: BLE001 — partial warm beats no boot
             failed += 1
             if len(errors) < 5:
@@ -341,6 +405,18 @@ def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
     }
     if errors:
         stats["errors"] = errors
+    if memory_by_kernel:
+        stats["memory_bytes"] = {
+            k: dict(sorted(v.items()))
+            for k, v in sorted(memory_by_kernel.items())
+        }
+        from ..utils.metrics import kernel_memory_bytes
+
+        for kernel, mem in memory_by_kernel.items():
+            for kind, v in mem.items():
+                kernel_memory_bytes.set(
+                    v, kernel=kernel, kind=kind.removesuffix("_bytes"),
+                )
     # compile-lifecycle metric hook (ISSUE 6 b): off-serving-path prewarm
     # compiles show on /metrics beside the serving-path compile counter,
     # so an operator can see a boot's compile bill vs the storm's

@@ -232,6 +232,25 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "(oldest dropped first).",
         ),
         EnvFlag(
+            "KARMADA_TPU_HISTORY_CAP", "512",
+            "Wave capacity of the per-process telemetry-history ring "
+            "(utils.history.WaveHistory): every end_wave() samples one "
+            "structured wave row (per-phase self seconds, engine pass "
+            "stats, per-channel RPC counts, device bytes) served as "
+            "/debug/history and aggregated by `karmadactl-tpu top`. "
+            "0 disables sampling entirely; evictions past the cap are "
+            "counted, never silent. Read once at history construction.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_HISTORY_STITCH", "1",
+            "Per-wave stitched history sampling: when trace peers are "
+            "registered (KARMADA_TPU_TRACE_PEERS), each closing wave's "
+            "history row takes its phase attribution from the "
+            "cross-process stitched summary — one narrowed "
+            "/debug/traces?wave=N fetch per peer per wave close. 0 keeps "
+            "sampling local-only (rows still record every local series).",
+        ),
+        EnvFlag(
             "KARMADA_TPU_TRACE_PEERS", "",
             "Comma-separated `name=host:port` metrics endpoints of the "
             "plane's peer processes (solver sidecar, estimator servers, "

@@ -132,6 +132,12 @@ class Gauge:
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
 
+    def samples(self) -> dict[tuple, float]:
+        """Label-set -> value snapshot (the Counter contract; the
+        history sampler and bench records enumerate these)."""
+        with self._lock:
+            return dict(self._values)
+
     def snapshot(self) -> dict[str, float]:
         with self._lock:
             return {_label_str(k): v for k, v in self._values.items()}
@@ -195,6 +201,19 @@ class Histogram:
                 for k in self._totals
             }
 
+    def quantile(self, q: float, **labels) -> Optional[float]:
+        """Bucket-interpolated quantile of one label set (ISSUE 12
+        satellite): the Prometheus ``histogram_quantile`` estimate over
+        the cumulative bucket counts, so CLIs stop eyeballing raw
+        buckets. None when the label set has no observations."""
+        key = _label_key(labels)
+        with self._lock:
+            total = self._totals.get(key, 0)
+            if not total:
+                return None
+            counts = list(self._counts[key])
+        return bucket_quantile(q, self.buckets, counts, total)
+
     def summary(self, **labels) -> Optional[dict]:
         key = _label_key(labels)
         with self._lock:
@@ -230,6 +249,36 @@ class Histogram:
             yield f"{base} {sums_snap[key]}"
             base = f"{self.name}_count{{{label_s}}}" if label_s else f"{self.name}_count"
             yield f"{base} {totals_snap[key]}"
+
+
+def bucket_quantile(
+    q: float, bounds, cumulative_counts, total: int
+) -> Optional[float]:
+    """THE bucket-interpolation core (Prometheus ``histogram_quantile``
+    semantics): ``bounds`` are the finite upper bounds, ``cumulative_
+    counts`` the cumulative observation counts per bound, ``total`` the
+    +Inf count. Linear interpolation inside the landing bucket (the
+    first bucket interpolates from 0); a rank landing in +Inf answers
+    the highest finite bound — the estimate cannot exceed what the
+    buckets resolve. Shared by ``Histogram.quantile`` and the CLI
+    exposition parsers (karmadactl-tpu quota status / top), so the two
+    sides can never drift."""
+    if total <= 0 or not bounds:
+        return None
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile q={q} outside [0, 1]")
+    rank = q * total
+    prev_bound = 0.0
+    prev_count = 0
+    for bound, count in zip(bounds, cumulative_counts):
+        if count >= rank:
+            in_bucket = count - prev_count
+            if in_bucket <= 0:
+                return float(bound)
+            frac = (rank - prev_count) / in_bucket
+            return float(prev_bound + (bound - prev_bound) * frac)
+        prev_bound, prev_count = float(bound), count
+    return float(bounds[-1])
 
 
 class Registry:
@@ -429,6 +478,21 @@ trace_spans_dropped = registry.counter(
     "overwrite) — nonzero means wave_summary coverage is undercounting; "
     "raise KARMADA_TPU_TRACE_CAPACITY for 1M-tier storms",
 )
+device_bytes = registry.gauge(
+    "karmada_tpu_device_bytes",
+    "resident device bytes by ledger kind and table bucket (exact "
+    "nbytes of the arrays the fleet table / engine hold: slot tables, "
+    "packed grid, donated residents, quota cap tensors) — the platform "
+    "label says WHOSE memory (cpu = forced-host bytes, never HBM); "
+    "published once per engine pass",
+)
+kernel_memory_bytes = registry.gauge(
+    "karmada_tpu_kernel_memory_bytes",
+    "per-compiled-kernel XLA memory_analysis footprint by kind (temp = "
+    "transient scratch, output, argument) — recorded when prewarm "
+    "AOT-compiles a manifest trace, so an operator can budget HBM "
+    "before putting a resident grid on real devices",
+)
 
 
 def render_families_table() -> str:
@@ -450,8 +514,9 @@ class MetricsServer:
     /metrics on --metrics-bind-address (cmd/scheduler/app/options/
     options.go:148); this is that endpoint for the TPU-native processes.
     Also answers /healthz (the readiness probe the reference wires via
-    healthz.InstallHandler) and /debug/traces (the wave-trace ring as
-    JSON — utils.tracing.tracer.dump())."""
+    healthz.InstallHandler), /debug/traces (the wave-trace ring as
+    JSON — utils.tracing.tracer.dump()) and /debug/history (the per-wave
+    telemetry ring + sliding-window digests — utils.history)."""
 
     def __init__(
         self,
@@ -477,6 +542,53 @@ class MetricsServer:
                 elif self.path == "/healthz":
                     body = b"ok\n"
                     ctype = "text/plain"
+                elif self.path.startswith("/debug/history"):
+                    import json
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from .history import history_for
+                    from .tracing import tracer
+
+                    # query contract: ?window=N paginates to the last N
+                    # rows (digests cover the same window), ?wave=N
+                    # narrows to one wave, ?digests=0 drops the digest
+                    # block. Malformed values answer 400 — `top` must
+                    # never mistake a mis-filtered full dump for a page
+                    qs = parse_qs(urlsplit(self.path).query)
+                    try:
+                        raw_window = (qs.get("window") or [None])[0]
+                        window = (
+                            int(raw_window) if raw_window is not None
+                            else None
+                        )
+                        raw_wave = (qs.get("wave") or [None])[0]
+                        wave = (
+                            int(raw_wave) if raw_wave is not None else None
+                        )
+                        with_digests = (qs.get("digests") or ["1"])[0] in (
+                            "1", "true", "yes",
+                        )
+                    except ValueError:
+                        body = json.dumps(
+                            {"error": f"bad history query {self.path!r}"}
+                        ).encode()
+                        self.send_response(400)
+                        self.send_header(
+                            "Content-Type", "application/json"
+                        )
+                        self.send_header(
+                            "Content-Length", str(len(body))
+                        )
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    body = json.dumps(
+                        history_for(tracer).debug_doc(
+                            window=window, wave=wave,
+                            with_digests=with_digests, proc=tracer.proc,
+                        )
+                    ).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/debug/traces"):
                     import json
                     from urllib.parse import parse_qs, urlsplit

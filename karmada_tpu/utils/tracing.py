@@ -365,6 +365,14 @@ class WaveTracer:
         self._dropped_counter = None  # lazy karmada_tpu_trace_spans_dropped
         # flight-recorder baseline captured at begin_wave when armed
         self._flight_baseline: Optional[dict] = None
+        # per-tracer wave-history ring (utils.history), built lazily so
+        # the tracer stays importable without the sampler
+        self._history = None
+        # one-shot (wave, stitched doc) handoff: the history sampler
+        # stitches at wave close, and a flight record firing for the
+        # SAME close consumes the result instead of re-fetching every
+        # peer — a breaching wave pays the stitch once
+        self._stitch_handoff = None
 
     def set_process(self, name: str) -> None:
         with self._lock:
@@ -414,12 +422,15 @@ class WaveTracer:
     def end_wave(self) -> int:
         """Close the open wave and return its id — the flight recorder
         (and tests) key on the CLOSED id, not on whatever wave is current
-        by the time they run."""
+        by the time they run. The history sampler runs FIRST so a flight
+        record of the same close can attach the freshly sampled row
+        (utils.history.breach_context)."""
         with self._lock:
             closed = self.current_wave
             was_open = self._wave_open
             self._wave_open = False
         if was_open:
+            self.history.sample(self, closed)
             try:
                 maybe_flight_record(self, closed)
             except Exception as exc:  # noqa: BLE001 — the recorder must
@@ -427,6 +438,20 @@ class WaveTracer:
                 # not the wave
                 log.warning("flight recorder failed: %s", exc)
         return closed
+
+    @property
+    def history(self):
+        """This tracer's per-wave telemetry ring (utils.history.
+        WaveHistory) — the process-wide tracer's instance backs
+        ``/debug/history`` and ``karmadactl-tpu top``."""
+        if self._history is None:
+            from .history import WaveHistory
+
+            fresh = WaveHistory()
+            with self._lock:
+                if self._history is None:
+                    self._history = fresh
+        return self._history
 
     def wave_trace_id(self, wave: Optional[int] = None) -> str:
         with self._lock:
@@ -450,6 +475,17 @@ class WaveTracer:
         with self._lock:
             b = self._flight_baseline
         return b if (b is not None and b.get("wave") == wave) else None
+
+    def consume_stitch_handoff(self, wave: int) -> Optional[dict]:
+        """Take (one-shot) the stitched doc the history sampler built
+        for ``wave`` at this close — None when sampling was local-only
+        or the handoff belongs to another wave."""
+        with self._lock:
+            handoff = self._stitch_handoff
+            self._stitch_handoff = None
+        if handoff is not None and handoff[0] == wave:
+            return handoff[1]
+        return None
 
     # -- spans -------------------------------------------------------------
 
@@ -656,6 +692,15 @@ class WaveTracer:
             spans = [s for s in spans if s.wave == wave]
         return [s.to_json() for s in spans]
 
+    def spans_for(self, wave: int) -> list[Span]:
+        """Completed spans of one wave (ring snapshot, no JSON) — the
+        history sampler aggregates engine pass stats off their attrs."""
+        with self._lock:
+            return [
+                s for s in self._spans
+                if s.wave == wave and s.end is not None
+            ]
+
     def waves(self) -> list[int]:
         with self._lock:
             return sorted({s.wave for s in self._spans})
@@ -671,6 +716,10 @@ class WaveTracer:
             self._wave_open = False
             self._dropped_total = 0
             self._dropped_by_wave.clear()
+            self._stitch_handoff = None
+            hist = self._history
+        if hist is not None:
+            hist.clear()
 
     def wave_summary(
         self, wave: Optional[int] = None, *, stitched: bool = False
@@ -685,9 +734,18 @@ class WaveTracer:
         from every registered peer and returns the cross-process summary
         (``stitch_dumps`` shape) instead of the local one."""
         if stitched:
-            local = trace_debug_doc(tracer_obj=self)
-            peer_docs = fetch_peer_dumps(peers(), wave=wave)
+            # narrowed both sides: the per-wave-close history sampler
+            # rides this path, so the LOCAL doc must not pay the
+            # full-ring JSON build either, and a black-holed peer gets
+            # the flight recorder's short timeout, not urlopen's default
+            local = trace_debug_doc(wave=wave, tracer_obj=self)
+            peer_docs = fetch_peer_dumps(
+                peers(), timeout=2.0, wave=wave, skip_unhealthy=True
+            )
             doc = stitch_dumps(local, peer_docs, wave=wave)
+            if wave is not None:
+                with self._lock:
+                    self._stitch_handoff = (wave, doc)
             waves = doc.get("waves", [])
             if not waves:
                 return self.wave_summary(wave)
@@ -731,18 +789,24 @@ class WaveTracer:
         trace_id = trace_ids.get(wave, "")
         if not trace_id and spans:
             trace_id = spans[0].trace_id
+        dropped = dropped_by_wave.get(wave, 0)
         return {
             "wave": wave,
             "trace_id": trace_id,
             "total_s": round(total, 6),
             "coverage": round(attributed / total, 4) if total else 0.0,
+            # ISSUE 12 satellite: coverage is computed against the FULL
+            # wall even when ring evictions dropped this wave's spans —
+            # flag the degradation instead of letting the ratio silently
+            # undercount (raise KARMADA_TPU_TRACE_CAPACITY)
+            "coverage_degraded": dropped > 0,
             "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
             "span_counts": dict(sorted(counts.items())),
             "device_s": round(device, 6),
             "compile_s": round(compile_s, 6),
             "host_s": round(max(attributed - device, 0.0), 6),
             "spans": len(spans),
-            "dropped": dropped_by_wave.get(wave, 0),
+            "dropped": dropped,
         }
 
     def wave_summaries(self, last: int = 8) -> list[dict]:
@@ -812,6 +876,7 @@ def peers() -> dict[str, str]:
 def clear_peers() -> None:
     with _PEERS_LOCK:
         _PEERS.clear()
+        _PEER_RETRY_AT.clear()
 
 
 def register_peers_from_env() -> dict[str, str]:
@@ -860,40 +925,88 @@ def trace_debug_doc(
         "mesh": pm.active_mesh_shape() if pm is not None else None,
         "dropped": tr.dropped_total,
         "peers": peers(),
-        "waves": tr.wave_summaries(),
-        "spans": tr.dump(),
     }
     if wave is not None:
-        doc["spans"] = [s for s in doc["spans"] if s.get("wave") == wave]
-        doc["waves"] = [w for w in doc["waves"] if w.get("wave") == wave]
+        # narrowed fetch (?wave=N): filter BEFORE serializing and
+        # summarize only the requested wave — per-wave history sampling
+        # and the flight recorder hit this path once per wave close, so
+        # it must not pay the full-ring JSON build
+        doc["waves"] = [
+            w for w in (tr.wave_summary(wave),) if w.get("spans")
+        ]
+        doc["spans"] = tr.dump(wave)
+    else:
+        doc["waves"] = tr.wave_summaries()
+        doc["spans"] = tr.dump()
     if summary:
         doc.pop("spans", None)
     return doc
 
 
+#: addr -> monotonic retry-at for peers that just failed a fetch: the
+#: per-wave-close sampler must not pay a full timeout per close for a
+#: persistently-down peer (skip window; guarded by _PEERS_LOCK)
+_PEER_RETRY_AT: dict[str, float] = {}
+_PEER_SKIP_SECONDS = 30.0
+
+
 def fetch_peer_dumps(
     peer_map: dict[str, str], timeout: float = 5.0,
-    wave: Optional[int] = None,
+    wave: Optional[int] = None, *, skip_unhealthy: bool = False,
 ) -> dict[str, dict]:
-    """Pull ``/debug/traces`` from every peer's metrics port. Unreachable
-    peers are skipped with a warning — a stitched dump of the reachable
-    plane beats no dump. ``wave`` narrows each fetch server-side
-    (``?wave=N`` — peers record under the CALLER's wave id): at 1M-tier
-    capacities the full ring is tens of thousands of spans per peer, and
-    both stitching call sites already know which wave they want."""
+    """Pull ``/debug/traces`` from every peer's metrics port,
+    CONCURRENTLY (N black-holed peers cost one timeout, not N serial
+    ones — the per-wave-close history sampler rides this path).
+    Unreachable peers are skipped with a warning — a stitched dump of
+    the reachable plane beats no dump. ``wave`` narrows each fetch
+    server-side (``?wave=N`` — peers record under the CALLER's wave id):
+    at 1M-tier capacities the full ring is tens of thousands of spans
+    per peer, and both stitching call sites already know which wave they
+    want. ``skip_unhealthy=True`` (the frequent-caller mode: per-wave
+    sampling) additionally skips any peer that failed within the last
+    30s, so a down sidecar costs one timeout per skip window instead of
+    one per wave close; one-shot callers (flight recorder without a
+    handoff, the CLI) keep the always-try default."""
     import urllib.request
 
     docs: dict[str, dict] = {}
     query = "" if wave is None else f"?wave={wave}"
-    for name, addr in sorted(peer_map.items()):
+
+    def fetch_one(addr: str) -> dict:
+        with urllib.request.urlopen(
+            f"http://{addr}/debug/traces{query}", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read().decode())
+
+    if skip_unhealthy:
+        now = time.monotonic()
+        with _PEERS_LOCK:
+            peer_map = {
+                name: addr for name, addr in peer_map.items()
+                if _PEER_RETRY_AT.get(addr, 0.0) <= now
+            }
+    if not peer_map:
+        return docs
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(len(peer_map), 8)) as pool:
+        futures = {
+            name: pool.submit(fetch_one, addr)
+            for name, addr in sorted(peer_map.items())
+        }
+    for name, fut in futures.items():
         try:
-            with urllib.request.urlopen(
-                f"http://{addr}/debug/traces{query}", timeout=timeout
-            ) as resp:
-                docs[name] = json.loads(resp.read().decode())
+            docs[name] = fut.result()
         except Exception as exc:  # noqa: BLE001 — peer down: stitch the rest
-            log.warning("trace peer %s (%s) unreachable: %s", name, addr,
-                        type(exc).__name__)
+            with _PEERS_LOCK:
+                _PEER_RETRY_AT[peer_map[name]] = (
+                    time.monotonic() + _PEER_SKIP_SECONDS
+                )
+            log.warning("trace peer %s (%s) unreachable: %s", name,
+                        peer_map[name], type(exc).__name__)
+        else:
+            with _PEERS_LOCK:
+                _PEER_RETRY_AT.pop(peer_map[name], None)
     return docs
 
 
@@ -909,14 +1022,19 @@ def _span_channel(name: str) -> Optional[str]:
     return head if sep else None
 
 
-def stitch_spans(spans: list[dict], wave: int, trace_id: str) -> dict:
+def stitch_spans(
+    spans: list[dict], wave: int, trace_id: str, *, dropped: int = 0
+) -> dict:
     """Stitch ONE wave's spans (already tagged with ``proc``, merged from
     every process) into a cross-process summary: remote handler roots
     re-parent under their originating client spans (``remote_parent`` +
     ``caller`` attrs), self-times compute across the stitched tree, and
     each channel's network/serialization time falls out as
     ``client span − remote roots`` per RPC. Durations only — process
-    clocks are never compared."""
+    clocks are never compared. ``dropped`` is INPUT data (ring evictions
+    of this wave, summed across the contributing processes — the raw
+    spans cannot carry it): nonzero flags the stitched coverage as
+    degraded, same as the local summary."""
     sel = [
         s for s in spans
         if s.get("wave") == wave
@@ -963,6 +1081,7 @@ def stitch_spans(spans: list[dict], wave: int, trace_id: str) -> dict:
     counts: dict[str, int] = {}
     process_s: dict[str, float] = {}
     channels: dict[str, dict] = {}
+    device = compile_s = 0.0
     for s in sel:
         key = (s.get("proc", "?"), s["span_id"])
         self_time = max(s["duration_s"] - child_time.get(key, 0.0), 0.0)
@@ -970,6 +1089,13 @@ def stitch_spans(spans: list[dict], wave: int, trace_id: str) -> dict:
         counts[s["name"]] = counts.get(s["name"], 0) + 1
         proc = s.get("proc", "?")
         process_s[proc] = process_s.get(proc, 0.0) + self_time
+        # device/compile attribution, the local summary's rule: kind is
+        # a span attr, compile a flag — stitched history rows must not
+        # read zeros for series the local rows populate
+        if s.get("attrs", {}).get("kind") == "device":
+            device += s["duration_s"]
+        if s.get("attrs", {}).get("compile"):
+            compile_s += s["duration_s"]
         # per-channel columns from CLIENT rpc spans: server time is the
         # re-parented remote roots' wall; the remainder of the client
         # span is wire + serialization — the column no single-process
@@ -998,6 +1124,10 @@ def stitch_spans(spans: list[dict], wave: int, trace_id: str) -> dict:
         "stitched": True,
         "total_s": round(total, 6),
         "coverage": round(attributed / total, 4) if total else 0.0,
+        "coverage_degraded": dropped > 0,
+        "dropped": dropped,
+        "device_s": round(device, 6),
+        "compile_s": round(compile_s, 6),
         "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
         "span_counts": dict(sorted(counts.items())),
         "process_s": {
@@ -1046,8 +1176,22 @@ def stitch_dumps(
         w for w in local.get("waves", [])
         if wave is None or w.get("wave") == wave
     ]
+    # per-wave ring evictions summed across the contributing processes
+    # (each doc's wave summaries carry their own `dropped`): the stitched
+    # summary must flag degraded coverage exactly like a local one
+    dropped_by_wave: dict[int, int] = {}
+    for doc in [local, *peer_docs.values()]:
+        for w in doc.get("waves", []):
+            wid = w.get("wave")
+            if wid is not None:
+                dropped_by_wave[wid] = (
+                    dropped_by_wave.get(wid, 0) + int(w.get("dropped", 0) or 0)
+                )
     stitched_waves = [
-        stitch_spans(all_spans, w["wave"], w.get("trace_id", ""))
+        stitch_spans(
+            all_spans, w["wave"], w.get("trace_id", ""),
+            dropped=dropped_by_wave.get(w["wave"], 0),
+        )
         for w in waves
     ]
     return {
@@ -1062,10 +1206,15 @@ def stitch_dumps(
 def render_attribution_table(summary: dict) -> str:
     """The stitched-wave attribution table as text (``trace analyze`` and
     the bench print this; the JSON record stays the machine surface)."""
+    degraded = (
+        f" DEGRADED(dropped={summary.get('dropped', 0)})"
+        if summary.get("coverage_degraded")
+        else ""
+    )
     lines = [
         f"wave {summary.get('wave')} trace {summary.get('trace_id', '')} "
         f"total {summary.get('total_s', 0.0):.3f}s coverage "
-        f"{summary.get('coverage', 0.0) * 100:.1f}%",
+        f"{summary.get('coverage', 0.0) * 100:.1f}%{degraded}",
         "phase                       self_s",
     ]
     for name, v in sorted(
@@ -1218,10 +1367,15 @@ def maybe_flight_record(tr: WaveTracer, wave: int) -> Optional[str]:
              "key": e.key}
             for e in inj.log[start:]
         ]
-    # stitch only now — a healthy wave never pays the peer fetch
-    local = trace_debug_doc(wave=wave, tracer_obj=tr)
-    peer_docs = fetch_peer_dumps(peers(), timeout=2.0, wave=wave)
-    stitched = stitch_dumps(local, peer_docs, wave=wave)
+    # reuse the stitch the history sampler just built for this close
+    # (the sampler runs first in end_wave) — a breaching wave pays the
+    # peer fetch once; with stitched sampling off (no peers registered
+    # or KARMADA_TPU_HISTORY_STITCH=0), only a RECORDED wave pays it
+    stitched = tr.consume_stitch_handoff(wave)
+    if stitched is None:
+        local = trace_debug_doc(wave=wave, tracer_obj=tr)
+        peer_docs = fetch_peer_dumps(peers(), timeout=2.0, wave=wave)
+        stitched = stitch_dumps(local, peer_docs, wave=wave)
     stitched_summary = (
         stitched["waves"][-1] if stitched.get("waves") else summary
     )
@@ -1239,6 +1393,10 @@ def maybe_flight_record(tr: WaveTracer, wave: int) -> Optional[str]:
         "dropped": stitched["dropped"],
         "metrics_delta": delta,
         "fault_events": fault_log,
+        # ISSUE 12: the breaching wave's history row + recent-window
+        # digests (end_wave samples BEFORE recording, so the row exists)
+        # — `trace analyze` renders breach-vs-recent-baseline offline
+        "history": tr.history.breach_context(wave),
     }
     return _flight_append(record)
 
@@ -1281,12 +1439,29 @@ def analyze_record(record: dict) -> dict:
     """Re-derive a flight record's attribution from its RAW spans and
     compare against the summary stored at record time — the offline
     ``trace analyze`` surface. ``identical`` proves the stitcher is a pure
-    function of the spans (the bench asserts it)."""
+    function of the spans (the bench asserts it). The recorded `dropped`
+    count is INPUT data, not derived from the spans, so it feeds back
+    into the re-derivation. A record carrying history context
+    additionally renders the breach-vs-recent-window table."""
+    recorded = record.get("summary", {})
     recomputed = stitch_spans(
         record.get("spans", []), record.get("wave", 0),
         record.get("trace_id", ""),
+        dropped=int(recorded.get("dropped", 0) or 0),
     )
-    recorded = record.get("summary", {})
+    table = render_attribution_table(recomputed)
+    hist = record.get("history")
+    if hist and hist.get("row"):
+        from .history import render_breach_table
+
+        table += "\n" + render_breach_table(hist)
+    # purity check tolerant of OLDER records: summary keys this build
+    # added (coverage_degraded/dropped) are ignored when the recorded
+    # summary predates them — a pre-upgrade flight record must still
+    # prove the stitcher pure, not flag a schema addition
+    recomputed_vs = {
+        k: v for k, v in recomputed.items() if k in recorded
+    }
     return {
         "wave": record.get("wave"),
         "trace_id": record.get("trace_id", ""),
@@ -1294,10 +1469,11 @@ def analyze_record(record: dict) -> dict:
         "wall_s": record.get("wall_s"),
         "slo_seconds": record.get("slo_seconds"),
         "summary": recomputed,
-        "identical": recomputed == recorded,
+        "identical": recomputed_vs == recorded,
         "metrics_delta": record.get("metrics_delta", {}),
         "fault_events": record.get("fault_events", []),
-        "table": render_attribution_table(recomputed),
+        "history": hist,
+        "table": table,
     }
 
 

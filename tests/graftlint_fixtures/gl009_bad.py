@@ -1,0 +1,40 @@
+"""GL009 bad fixture: history series whose sources resolve to nothing —
+an unregistered metric family, a span outside the taxonomy, and a source
+that follows neither grammar."""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class HistorySeries:
+    name: str
+    kind: str
+    source: str
+    description: str
+
+
+class _Registry:
+    def counter(self, name, help_=""):
+        return name
+
+
+registry = _Registry()
+
+# the only family THIS scan can see
+known_total = registry.counter("karmada_tpu_fixture_known_total", "known")
+
+SERIES = {
+    # BAD: no scanned registry defines this family
+    "ghost": HistorySeries(
+        "ghost", "counter", "metric:karmada_tpu_ghost_total", "rotted ref"
+    ),
+    # BAD: span name outside utils.tracing SPAN_NAMES
+    "rogue": HistorySeries(
+        "rogue", "gauge", "span:rogue.phase", "unregistered span"
+    ),
+    # BAD: neither metric:<family> nor span:<name>
+    "bogus": HistorySeries(
+        name="bogus", kind="gauge", source="buckets.raw",
+        description="grammar violation",
+    ),
+}

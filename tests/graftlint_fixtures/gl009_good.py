@@ -1,0 +1,39 @@
+"""GL009 good fixture: every source resolves — a metric family this scan
+defines, taxonomy span names (direct and via a ``*`` family), and a
+non-literal source that stays out of static reach."""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class HistorySeries:
+    name: str
+    kind: str
+    source: str
+    description: str
+
+
+class _Registry:
+    def gauge(self, name, help_=""):
+        return name
+
+
+registry = _Registry()
+
+fixture_bytes = registry.gauge("karmada_tpu_fixture_bytes", "a family")
+
+SERIES = {
+    "bytes": HistorySeries(
+        "bytes", "gauge", "metric:karmada_tpu_fixture_bytes", "resolves"
+    ),
+    "wall": HistorySeries("wall", "gauge", "span:settle", "taxonomy"),
+    "drain": HistorySeries(
+        name="drain", kind="counter", source="span:controller.scheduler",
+        description="resolves via the controller.* family",
+    ),
+}
+
+
+def dynamic(source: str) -> HistorySeries:
+    # a plain variable is out of static reach (GL006/GL002 precedent)
+    return HistorySeries("dyn", "gauge", source, "unchecked")

@@ -39,6 +39,7 @@ FIXTURE_ROLES = {
     "GL006": set(),
     "GL007": set(),
     "GL008": set(),
+    "GL009": set(),
 }
 
 
@@ -163,6 +164,40 @@ def test_gl008_catches_each_pattern():
     assert "dynamic:" in details, (
         "dynamic name with no literal head not flagged"
     )
+
+
+def test_gl009_catches_each_pattern():
+    findings = lint_fixture("gl009_bad.py", FIXTURE_ROLES["GL009"])
+    details = {f.detail for f in findings}
+    assert "ghost:metric:karmada_tpu_ghost_total" in details, (
+        "unregistered metric-family source not flagged"
+    )
+    assert "rogue:span:rogue.phase" in details, (
+        "unregistered span source not flagged"
+    )
+    assert "bogus:buckets.raw" in details, (
+        "source outside the metric:/span: grammar not flagged"
+    )
+
+
+def test_gl009_live_registry_resolves():
+    """The live HISTORY_SERIES registry is GL009's ground truth: every
+    declared source must satisfy the rule the linter enforces — a span
+    source resolves through the taxonomy matcher, a metric source names
+    a registered family."""
+    from karmada_tpu.utils.history import HISTORY_SERIES
+    from karmada_tpu.utils.metrics import registry
+    from karmada_tpu.utils.tracing import span_name_registered
+
+    families = {name for name, _t, _h in registry.families()}
+    for series in HISTORY_SERIES.values():
+        kind, sep, ref = series.source.partition(":")
+        assert sep, series
+        if kind == "span":
+            assert span_name_registered(ref), series
+        else:
+            assert kind == "metric", series
+            assert ref in families, series
 
 
 def test_gl008_taxonomy_covers_live_names():

@@ -1,0 +1,599 @@
+"""ISSUE 12: per-wave telemetry history, the device-byte ledger and the
+perf-regression guard.
+
+Covers the tentpole surfaces — the end_wave sampler (row schema, engine
+pass-stat aggregation, counter deltas), ring-cap eviction accounting
+under a multi-thread open/close-wave hammer (no torn rows), the
+``/debug/history?window=N`` pagination contract, breach context on the
+flight path — plus the satellites: ``coverage_degraded`` surfacing,
+bucket-interpolated ``Histogram.quantile`` against exact synthetic
+values (and its exposition-parser twin), and benchguard fixture
+semantics (synthetic 2x regression fires non-zero, within-band noise
+passes, missing metric is a loud error, never a silent pass)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from karmada_tpu.utils.history import (  # noqa: E402
+    HISTORY_SERIES,
+    ROW_IDENTITY_FIELDS,
+    WaveHistory,
+    render_breach_table,
+    render_history_schema_table,
+    render_history_table,
+)
+from karmada_tpu.utils.tracing import (  # noqa: E402
+    WaveTracer,
+    render_attribution_table,
+    stitch_dumps,
+    trace_debug_doc,
+)
+
+#: row keys every sampled row must carry, fully formed (torn-row check)
+_REQUIRED_KEYS = tuple(name for name, _ in ROW_IDENTITY_FIELDS) + tuple(
+    HISTORY_SERIES
+)
+
+
+def _one_wave(tr: WaveTracer, *, bindings: int = 50, packed: int = 5):
+    tr.begin_wave("test")
+    with tr.span("settle"):
+        with tr.span("scheduler.pass") as sp:
+            sp.attrs["bindings"] = bindings
+            with tr.span("scheduler.solve") as sv:
+                sv.attrs["rows_packed"] = packed
+                sv.attrs["rows_replayed"] = bindings - packed
+    return tr.end_wave()
+
+
+class TestWaveSampling:
+    def test_row_schema_complete(self):
+        tr = WaveTracer(capacity=256)
+        wave = _one_wave(tr, bindings=70, packed=7)
+        row = tr.history.row_for(wave)
+        assert row is not None
+        for key in _REQUIRED_KEYS:
+            assert key in row, f"row missing {key}"
+        assert row["wave"] == wave
+        assert row["bindings"] == 70
+        assert row["rows_packed"] == 7
+        assert row["rows_replayed"] == 63
+        assert row["solve_batches"] == 1
+        assert row["wall_s"] > 0
+        assert row["stitched"] is False
+
+    def test_sampler_failure_never_aborts_the_wave(self, monkeypatch):
+        tr = WaveTracer(capacity=64)
+        monkeypatch.setattr(
+            type(tr.history), "_build_row",
+            lambda self, t, w: (_ for _ in ()).throw(RuntimeError("boom")),
+        )
+        wave = _one_wave(tr)  # must not raise
+        assert wave > 0
+        assert tr.history.rows() == []
+
+    def test_cap_zero_disables_sampling(self, monkeypatch):
+        monkeypatch.setenv("KARMADA_TPU_HISTORY_CAP", "0")
+        tr = WaveTracer(capacity=64)
+        _one_wave(tr)
+        assert not tr.history.enabled
+        assert tr.history.rows() == []
+
+    def test_digests_exact_quantiles(self):
+        h = WaveHistory(cap=16)
+        for i, wall in enumerate([1.0, 2.0, 3.0, 4.0]):
+            h._rows.append(
+                {"wave": i, "wall_s": wall, "phases": {"settle": wall}}
+            )
+        d = h.digests()
+        assert d["window"] == 4
+        assert d["series"]["wall_s"]["p50"] == pytest.approx(2.5)
+        assert d["series"]["wall_s"]["p95"] == pytest.approx(3.85)
+        assert d["series"]["phases.settle"]["p50"] == pytest.approx(2.5)
+
+    def test_breach_context_excludes_breaching_row(self):
+        tr = WaveTracer(capacity=256)
+        for _ in range(4):
+            wave = _one_wave(tr)
+        ctx = tr.history.breach_context(wave)
+        assert ctx["row"]["wave"] == wave
+        assert ctx["recent"]["window"] == 3
+        table = render_breach_table(ctx)
+        assert f"wave {wave} vs last 3" in table
+        assert "wall_s" in table
+
+    def test_history_table_marks_degraded_coverage(self):
+        rows = [{
+            "wave": 9, "wall_s": 1.0, "coverage": 0.5,
+            "coverage_degraded": True, "bindings_s": 10.0,
+        }]
+        assert "50.0!" in render_history_table(rows)
+
+
+class TestConcurrencyHammer:
+    def test_no_torn_rows_and_counted_evictions(self, monkeypatch):
+        """Multi-thread open/close-wave + sample hammer: every row in
+        the ring is COMPLETE (built before append, read under the
+        lock), the ring never exceeds its cap, and evictions are
+        counted exactly."""
+        monkeypatch.setenv("KARMADA_TPU_HISTORY_CAP", "8")
+        tr = WaveTracer(capacity=512)
+        h = tr.history
+        assert h.cap == 8
+        errors: list = []
+        n_threads, per_thread = 4, 25
+
+        def writer(tid: int):
+            try:
+                for _ in range(per_thread):
+                    _one_wave(tr)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        def reader():
+            try:
+                for _ in range(200):
+                    for row in h.rows():
+                        for key in _REQUIRED_KEYS:
+                            assert key in row, f"torn row: no {key}"
+                    h.digests(window=4)
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=writer, args=(i,))
+            for i in range(n_threads)
+        ] + [threading.Thread(target=reader) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        # begin_wave while another thread's wave is open reuses no id:
+        # each begin mints a fresh wave, but an end_wave can close a
+        # wave another thread opened — sampled counts CLOSES, bounded
+        # by the number of begin/end pairs
+        assert 0 < h.sampled <= n_threads * per_thread
+        assert len(h.rows()) == min(h.sampled, 8)
+        assert h.evicted == max(h.sampled - 8, 0)
+
+    def test_rows_returns_copies(self):
+        tr = WaveTracer(capacity=64)
+        wave = _one_wave(tr)
+        tr.history.rows()[0]["wall_s"] = -1
+        assert tr.history.row_for(wave)["wall_s"] != -1
+
+
+class TestDebugHistoryEndpoint:
+    def test_window_pagination_and_digests(self):
+        from karmada_tpu.utils.metrics import MetricsServer
+        from karmada_tpu.utils.tracing import tracer
+
+        tracer.clear()
+        try:
+            for _ in range(6):
+                _one_wave(tracer)
+            srv = MetricsServer()
+            port = srv.start()
+            try:
+                def get(query: str) -> dict:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/debug/history{query}",
+                        timeout=10,
+                    ) as resp:
+                        return json.loads(resp.read().decode())
+
+                full = get("")
+                assert len(full["rows"]) == 6
+                assert full["sampled"] == 6
+                assert full["digests"]["window"] == 6
+
+                page = get("?window=2")
+                assert len(page["rows"]) == 2
+                assert page["digests"]["window"] == 2
+                # pagination keeps the NEWEST rows
+                assert (
+                    page["rows"][-1]["wave"] == full["rows"][-1]["wave"]
+                )
+
+                one = get(f"?wave={full['rows'][0]['wave']}")
+                assert len(one["rows"]) == 1
+
+                lean = get("?window=3&digests=0")
+                assert "digests" not in lean
+
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    get("?window=bogus")
+                assert err.value.code == 400
+            finally:
+                srv.stop()
+        finally:
+            tracer.clear()
+
+    def test_top_aggregates_endpoint(self):
+        from karmada_tpu import cli
+        from karmada_tpu.utils.metrics import MetricsServer, settle_seconds
+        from karmada_tpu.utils.tracing import tracer
+
+        tracer.clear()
+        try:
+            for _ in range(3):
+                _one_wave(tracer, bindings=40)
+            settle_seconds.observe(0.25)
+            srv = MetricsServer()
+            port = srv.start()
+            try:
+                doc = cli.cmd_plane_top(
+                    metrics=f"127.0.0.1:{port}", window=4
+                )
+                (name, entry), = doc["procs"].items()
+                assert entry["rows"], "no history rows fetched"
+                assert "settle_p50_s" in entry
+                table = cli.render_top(doc)
+                assert "bind/s" in table
+            finally:
+                srv.stop()
+        finally:
+            tracer.clear()
+
+
+class TestCoverageDegraded:
+    def test_local_summary_flags_dropped_waves(self):
+        tr = WaveTracer(capacity=16)
+        tr.begin_wave("t")
+        with tr.span("settle"):
+            for i in range(40):  # outgrow the ring mid-wave
+                tr.record("scheduler.pack", 0.001)
+        wave = tr.end_wave()
+        s = tr.wave_summary(wave)
+        assert s["dropped"] > 0
+        assert s["coverage_degraded"] is True
+        assert "DEGRADED" in render_attribution_table(s)
+        # the sampled row carries the flag too
+        assert tr.history.row_for(wave)["coverage_degraded"] is True
+
+    def test_healthy_summary_not_degraded(self):
+        tr = WaveTracer(capacity=256)
+        wave = _one_wave(tr)
+        s = tr.wave_summary(wave)
+        assert s["coverage_degraded"] is False
+        assert "DEGRADED" not in render_attribution_table(s)
+
+    def test_stitched_summary_carries_device_and_compile(self):
+        """Stitched rows must not read zeros for series the local rows
+        populate: stitch_spans computes device_s/compile_s with the
+        local summary's rule (kind attr / compile flag)."""
+        from karmada_tpu.utils.tracing import stitch_spans
+
+        spans = [
+            {"name": "settle", "wave": 1, "span_id": 1,
+             "parent_id": None, "trace_id": "t", "duration_s": 1.0,
+             "attrs": {}, "proc": "plane"},
+            {"name": "kernel.device", "wave": 1, "span_id": 2,
+             "parent_id": 1, "trace_id": "t", "duration_s": 0.25,
+             "attrs": {"kind": "device", "compile": True},
+             "proc": "plane"},
+        ]
+        s = stitch_spans(spans, 1, "t")
+        assert s["device_s"] == pytest.approx(0.25)
+        assert s["compile_s"] == pytest.approx(0.25)
+
+    def test_stitch_handoff_consumed_once(self):
+        tr = WaveTracer(capacity=64)
+        wave = _one_wave(tr)
+        doc = {"waves": [], "spans": [], "procs": [], "dropped": {}}
+        with tr._lock:
+            tr._stitch_handoff = (wave, doc)
+        assert tr.consume_stitch_handoff(wave) is doc
+        assert tr.consume_stitch_handoff(wave) is None  # one-shot
+        with tr._lock:
+            tr._stitch_handoff = (wave, doc)
+        assert tr.consume_stitch_handoff(wave + 1) is None  # wrong wave
+
+    def test_stitched_summary_sums_peer_drops(self):
+        tr = WaveTracer(capacity=16)
+        tr.begin_wave("t")
+        with tr.span("settle"):
+            for _ in range(40):
+                tr.record("scheduler.pack", 0.001)
+        wave = tr.end_wave()
+        local = trace_debug_doc(tracer_obj=tr)
+        doc = stitch_dumps(local, {}, wave=wave)
+        (stitched,) = doc["waves"]
+        assert stitched["dropped"] == tr.wave_summary(wave)["dropped"]
+        assert stitched["coverage_degraded"] is True
+        assert "DEGRADED" in render_attribution_table(stitched)
+
+
+class TestHistogramQuantile:
+    def test_exact_interpolation_on_synthetic_observations(self):
+        from karmada_tpu.utils.metrics import Histogram
+
+        h = Histogram("karmada_tpu_test_q_seconds", buckets=(1, 2, 4, 8))
+        for v in (0.5, 1.5, 3.0, 6.0):
+            h.observe(v)
+        # ranks: q*4 → interpolate within the landing bucket
+        assert h.quantile(0.25) == pytest.approx(1.0)  # first bucket: 0→1
+        assert h.quantile(0.5) == pytest.approx(2.0)
+        assert h.quantile(0.75) == pytest.approx(4.0)
+        assert h.quantile(0.875) == pytest.approx(6.0)  # mid (4, 8]
+        assert h.quantile(1.0) == pytest.approx(8.0)
+        assert h.quantile(0.5, missing="labels") is None
+
+    def test_rank_beyond_last_bound_answers_highest_finite(self):
+        from karmada_tpu.utils.metrics import Histogram
+
+        h = Histogram("karmada_tpu_test_q2_seconds", buckets=(1, 2))
+        h.observe(50.0)  # lands in +Inf only
+        assert h.quantile(0.5) == pytest.approx(2.0)
+
+    def test_exposition_parser_matches_live_histogram(self):
+        """The CLI path (exposition text → shared bucket_quantile) and
+        the in-process Histogram.quantile must answer identically."""
+        from karmada_tpu import cli
+        from karmada_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        h = reg.histogram(
+            "karmada_tpu_test_q3_seconds", "t", buckets=(0.1, 1, 5, 10)
+        )
+        for v in (0.05, 0.5, 0.7, 2.0, 3.0, 7.0, 30.0):
+            h.observe(v)
+        text = reg.render()
+        for q in (0.1, 0.5, 0.9, 0.99):
+            parsed = cli.exposition_quantile(
+                text, "karmada_tpu_test_q3_seconds", q
+            )
+            assert parsed[()] == pytest.approx(h.quantile(q)), q
+
+
+# --------------------------------------------------------------------------
+# benchguard
+# --------------------------------------------------------------------------
+
+from tools import benchguard  # noqa: E402
+
+
+def _write(path: Path, record: dict) -> Path:
+    path.write_text(json.dumps(record))
+    return path
+
+
+_BASELINE = {
+    "metric": "observability_wave_20kx512",
+    "value": 4.0,
+    "coverage_vs_wall": 0.98,
+    "bindings_s": 5000.0,
+}
+
+
+class TestBenchguard:
+    def test_synthetic_2x_regression_fires_nonzero(self, tmp_path):
+        _write(tmp_path / "BENCH_OBS_r01.json", _BASELINE)
+        fresh = _write(
+            tmp_path / "fresh.json",
+            {**_BASELINE, "value": 8.0, "bindings_s": 2500.0},
+        )
+        code, report = benchguard.check_record(fresh, root=tmp_path)
+        assert code == 1
+        verdicts = {v["metric"]: v["verdict"] for v in report["verdicts"]}
+        assert verdicts["value"] == "regression"  # 2.0 >= band 2.0 FIRES
+        assert verdicts["bindings_s"] == "regression"
+        assert verdicts["coverage_vs_wall"] == "ok"
+        assert "REGRESSION" in report["table"]
+
+    def test_within_band_noise_passes(self, tmp_path):
+        _write(tmp_path / "BENCH_OBS_r01.json", _BASELINE)
+        fresh = _write(
+            tmp_path / "fresh.json",
+            {**_BASELINE, "value": 4.8, "bindings_s": 4200.0,
+             "coverage_vs_wall": 0.95},
+        )
+        code, report = benchguard.check_record(fresh, root=tmp_path)
+        assert code == 0, report["table"]
+        assert all(
+            v["verdict"] in ("ok", "improved", "baseline-missing",
+                             "absent")
+            for v in report["verdicts"]
+        )
+
+    def test_missing_metric_is_a_loud_error(self, tmp_path):
+        _write(tmp_path / "BENCH_OBS_r01.json", _BASELINE)
+        fresh_rec = {**_BASELINE, "value": 4.1}
+        del fresh_rec["coverage_vs_wall"]
+        fresh = _write(tmp_path / "fresh.json", fresh_rec)
+        code, report = benchguard.check_record(fresh, root=tmp_path)
+        assert code == 1
+        verdicts = {v["metric"]: v["verdict"] for v in report["verdicts"]}
+        assert verdicts["coverage_vs_wall"] == "missing"
+
+    def test_baseline_predating_a_metric_passes_but_is_reported(
+        self, tmp_path
+    ):
+        old = dict(_BASELINE)
+        del old["bindings_s"]
+        _write(tmp_path / "BENCH_OBS_r01.json", old)
+        fresh = _write(tmp_path / "fresh.json", dict(_BASELINE))
+        code, report = benchguard.check_record(fresh, root=tmp_path)
+        assert code == 0
+        verdicts = {v["metric"]: v["verdict"] for v in report["verdicts"]}
+        assert verdicts["bindings_s"] == "baseline-missing"
+
+    def test_improvement_is_reported_not_failed(self, tmp_path):
+        _write(tmp_path / "BENCH_OBS_r01.json", _BASELINE)
+        fresh = _write(
+            tmp_path / "fresh.json",
+            {**_BASELINE, "value": 1.0, "bindings_s": 20000.0},
+        )
+        code, report = benchguard.check_record(fresh, root=tmp_path)
+        assert code == 0
+        verdicts = {v["metric"]: v["verdict"] for v in report["verdicts"]}
+        assert verdicts["value"] == "improved"
+
+    def test_newest_committed_record_baselines(self, tmp_path):
+        _write(tmp_path / "BENCH_OBS_r01.json",
+               {**_BASELINE, "value": 100.0})
+        _write(tmp_path / "BENCH_OBS_r02.json", _BASELINE)
+        fresh = _write(tmp_path / "fresh.json",
+                       {**_BASELINE, "value": 4.2})
+        code, report = benchguard.check_record(fresh, root=tmp_path)
+        assert code == 0
+        assert report["baseline"].endswith("BENCH_OBS_r02.json")
+
+    def test_no_committed_baseline_refuses_loudly(self, tmp_path):
+        fresh = _write(tmp_path / "fresh.json", dict(_BASELINE))
+        with pytest.raises(SystemExit, match="no committed BENCH_"):
+            benchguard.check_record(fresh, root=tmp_path)
+
+    def test_unknown_family_refuses_loudly(self, tmp_path):
+        fresh = _write(
+            tmp_path / "fresh.json",
+            {"metric": "mystery_tier_1x1", "value": 1.0},
+        )
+        with pytest.raises(SystemExit, match="no guard spec"):
+            benchguard.check_record(fresh, root=tmp_path)
+
+    def test_checked_record_never_baselines_itself(self, tmp_path):
+        fresh = _write(tmp_path / "BENCH_OBS_r03.json", dict(_BASELINE))
+        with pytest.raises(SystemExit, match="no committed BENCH_"):
+            benchguard.check_record(fresh, root=tmp_path)
+
+    def test_cli_exit_codes(self, tmp_path):
+        _write(tmp_path / "BENCH_OBS_r01.json", _BASELINE)
+        good = _write(tmp_path / "fresh.json", dict(_BASELINE))
+        bad = _write(
+            tmp_path / "slow.json", {**_BASELINE, "value": 9.0}
+        )
+        assert benchguard.main(
+            [str(good), "--root", str(tmp_path)]
+        ) == 0
+        assert benchguard.main(
+            [str(bad), "--root", str(tmp_path), "--format", "json"]
+        ) == 1
+
+
+class TestFlightHistoryContext:
+    def test_breach_record_carries_history_and_analyzes(
+        self, tmp_path, monkeypatch
+    ):
+        """A seeded SLO breach attaches the breaching wave's history row
+        + recent-window digests, and trace analyze renders the
+        breach-vs-recent table identically offline."""
+        from karmada_tpu.utils import tracing as trc
+
+        monkeypatch.setenv("KARMADA_TPU_TRACE_SLO_SECONDS", "10000")
+        monkeypatch.setenv("KARMADA_TPU_FLIGHT_DIR", str(tmp_path))
+        tr = WaveTracer(capacity=256)
+        for _ in range(3):
+            _one_wave(tr)
+        # the breaching wave: force the SLO under its wall
+        tr.begin_wave("breach")
+        with tr.span("settle"):
+            time.sleep(0.02)
+        monkeypatch.setenv("KARMADA_TPU_TRACE_SLO_SECONDS", "0.001")
+        wave = tr.end_wave()
+        records = trc.load_flight_records(tmp_path / "flight.jsonl")
+        rec = records[-1]
+        assert rec["wave"] == wave
+        assert rec["history"]["row"]["wave"] == wave
+        assert rec["history"]["recent"]["window"] == 3
+        analysis = trc.analyze_record(rec)
+        assert analysis["identical"] is True
+        assert f"history: wave {wave} vs last 3" in analysis["table"]
+
+    def test_analyze_tolerates_pre_upgrade_records(self, tmp_path,
+                                                   monkeypatch):
+        """A flight record whose summary predates the coverage_degraded/
+        dropped keys must still report identical=True — a schema
+        ADDITION is not a purity failure — while a genuinely divergent
+        summary still fails."""
+        from karmada_tpu.utils import tracing as trc
+
+        monkeypatch.setenv("KARMADA_TPU_TRACE_SLO_SECONDS", "0")
+        monkeypatch.setenv("KARMADA_TPU_FLIGHT_DIR", str(tmp_path))
+        tr = WaveTracer(capacity=64)
+        _one_wave(tr)
+        rec = trc.load_flight_records(tmp_path / "flight.jsonl")[-1]
+        old = dict(rec)
+        old["summary"] = {
+            k: v for k, v in rec["summary"].items()
+            if k not in ("coverage_degraded", "dropped")
+        }
+        assert trc.analyze_record(old)["identical"] is True
+        divergent = dict(old)
+        divergent["summary"] = {
+            **old["summary"], "total_s": old["summary"]["total_s"] + 1
+        }
+        assert trc.analyze_record(divergent)["identical"] is False
+
+
+class TestDeviceBytesLedger:
+    def test_steady_passes_hold_resident_bytes_constant(self):
+        """The ledger answers exact nbytes, steady passes keep it
+        constant, and the gauge's samples sum to the same total with
+        honest platform labels."""
+        from karmada_tpu.scheduler import (
+            BindingProblem,
+            ClusterSnapshot,
+            TensorScheduler,
+        )
+        from karmada_tpu.utils.builders import (
+            dynamic_weight_placement,
+            synthetic_fleet,
+        )
+        from karmada_tpu.utils.metrics import device_bytes as gauge
+        from karmada_tpu.utils.quantity import parse_resource_list
+
+        req = parse_resource_list({"cpu": "250m", "memory": "512Mi"})
+        snap = ClusterSnapshot(synthetic_fleet(40, seed=3))
+        pl = dynamic_weight_placement()
+        problems = [
+            BindingProblem(
+                key=f"b{i}", placement=pl, replicas=(i % 6) + 1,
+                requests=req, gvk="apps/v1/Deployment",
+            )
+            for i in range(300)
+        ]
+        eng = TensorScheduler(snap, trace_manifest="")
+        eng.schedule(problems)
+        first = eng.device_bytes()
+        assert first["packed_grid"] > 0
+        assert first["slot_tables"] > 0
+        eng.schedule(problems)
+        assert eng.device_bytes() == first, "steady pass moved the ledger"
+        samples = gauge.samples()
+        total = sum(
+            v for k, v in samples.items()
+            if dict(k).get("kind") in first
+        )
+        assert int(total) == sum(first.values())
+        platforms = {dict(k).get("platform") for k in samples}
+        assert platforms <= {"cpu"}, (
+            "forced-host bytes must label platform=cpu, never a device "
+            f"platform: {platforms}"
+        )
+        # the history row picks the level up off the gauge
+        tr = WaveTracer(capacity=64)
+        wave = _one_wave(tr)
+        assert tr.history.row_for(wave)["device_bytes"] >= int(total)
+
+
+def test_schema_table_lists_every_series():
+    table = render_history_schema_table()
+    for name in HISTORY_SERIES:
+        assert f"`{name}`" in table
+    for name, _ in ROW_IDENTITY_FIELDS:
+        assert f"`{name}`" in table

@@ -1,0 +1,340 @@
+"""benchguard — the perf-regression guard over the committed bench
+trajectory (ISSUE 12 c).
+
+The repo's BENCH_*.json records are its perf memory; until now nothing
+compared a fresh record against them. This tool does, with per-metric
+DIRECTIONAL noise bands:
+
+- every record family (``metric`` field prefix) declares the metrics it
+  guards in ``SPECS`` — each with a direction (``lower`` = a time, fresh
+  must not grow; ``higher`` = a throughput/ratio, fresh must not shrink)
+  and a multiplicative band sized to the rig noise that family has
+  actually exhibited (the quota bench measured the shared CI rig itself
+  swinging ~2x, so wall-clock bands are generous; coverage ratios are
+  rig-robust and band tight).
+- the baseline is resolved from the COMMITTED records: every
+  ``BENCH_*.json`` in the repo root whose ``metric`` matches the fresh
+  record's, excluding the fresh file itself; the newest (highest ``_rNN``
+  in the filename, then mtime) wins.
+- a breach is ``fresh >= band x worse than baseline`` (>=, so an exact
+  synthetic 2x slowdown against a 2.0 band FIRES); an improvement past
+  the band the other way is reported as ``improved``, never an error.
+- a guarded metric MISSING from the fresh record is a LOUD error (exit
+  nonzero), never a silent pass — a record that stopped carrying a
+  series is itself a regression of the measurement layer. A metric the
+  (older) baseline record predates is reported ``baseline-missing`` and
+  passes: there is nothing to regress against, but it is printed, not
+  swallowed.
+
+Surfaces: ``python -m tools.benchguard RECORD.json`` and
+``bench.py --check RECORD.json`` (the same code path) print the verdict
+table and exit nonzero on any regression or missing metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional, Sequence
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+@dataclass(frozen=True)
+class GuardMetric:
+    """One guarded series of a record family."""
+
+    name: str
+    #: "lower" = smaller is better (seconds); "higher" = bigger is
+    #: better (throughput, coverage, speedup ratios)
+    direction: str
+    #: multiplicative noise band: breach when the fresh value is >= band
+    #: times WORSE than baseline in the guarded direction
+    band: float
+    #: required=True: absent from the FRESH record = loud error.
+    #: required=False: the metric is conditional (e.g. stitched columns
+    #: exist only when the 4-process phase ran) — absence is reported as
+    #: ``absent`` and passes.
+    required: bool = True
+
+
+#: the committed trajectory's guard specs, keyed by ``metric`` prefix
+#: (longest prefix wins). Bands are per-metric and directional — sized
+#: to observed rig noise, not wishful tightness: BENCH history shows the
+#: shared rig swinging up to ~2x on wall clocks (PR 8/11 notes), while
+#: coverage and identity ratios barely move.
+SPECS: dict[str, tuple[GuardMetric, ...]] = {
+    "observability_wave": (
+        GuardMetric("value", "lower", 2.0),
+        GuardMetric("coverage_vs_wall", "higher", 1.25),
+        GuardMetric("bindings_s", "higher", 2.0),
+        GuardMetric("stitched_wall_s", "lower", 3.0, required=False),
+        GuardMetric(
+            "stitched_coverage_vs_wall", "higher", 1.35, required=False
+        ),
+        GuardMetric("stitched_bindings_s", "higher", 3.0, required=False),
+        GuardMetric("bus_unary_vs_batched", "higher", 3.0, required=False),
+    ),
+    "p50_engine_schedule": (
+        GuardMetric("value", "lower", 2.0),
+        GuardMetric("scale1m_steady_p50", "lower", 2.0, required=False),
+        GuardMetric("scale1m_churn_p50", "lower", 2.0, required=False),
+        GuardMetric("churn_p50", "lower", 2.0, required=False),
+        GuardMetric(
+            "whole_plane_bindings_s", "higher", 2.0, required=False
+        ),
+        # vs_python_oracle is deliberately unguarded: the committed
+        # trajectory itself shows it swinging >20x between records (the
+        # oracle's own timing is the denominator) — a band wide enough
+        # to absorb that guards nothing
+    ),
+    "chaos_storm": (
+        GuardMetric("value", "lower", 2.5),
+    ),
+    "quota_surge": (
+        GuardMetric("value", "lower", 2.5),
+    ),
+    "estimator512_wire": (
+        GuardMetric("value", "lower", 2.5),
+    ),
+    "multichip_scaling": (
+        GuardMetric("value", "lower", 2.5),
+    ),
+    "cold_start_first_wave": (
+        GuardMetric("value", "lower", 2.0),
+        # restored-boot first wave over warm wave: the tier's criterion
+        GuardMetric("vs_baseline", "lower", 1.75, required=False),
+    ),
+}
+
+#: verdicts that fail the guard
+FAILING = ("regression", "missing")
+
+
+def load_record(path: Path) -> dict:
+    d = json.loads(path.read_text())
+    # the driver's BENCH_r{N}.json wrapper nests the record under
+    # "parsed" (docs_from_bench handles the same shape)
+    return d["parsed"] if "parsed" in d else d
+
+
+def spec_for(metric: str) -> Optional[tuple]:
+    best = None
+    for prefix, metrics in SPECS.items():
+        if metric.startswith(prefix):
+            if best is None or len(prefix) > len(best[0]):
+                best = (prefix, metrics)
+    return best
+
+
+def _record_rank(path: Path) -> tuple:
+    m = re.search(r"_r(\d+)\.json$", path.name)
+    return (int(m.group(1)) if m else -1, path.stat().st_mtime)
+
+
+def _trajectory_paths(root: Path) -> list[Path]:
+    """The COMMITTED trajectory: git-tracked BENCH_*.json when ``root``
+    is a git checkout — an uncommitted local record must never become
+    the baseline, or repeated local runs re-baseline on each other and
+    a creeping regression never fires. Outside a git checkout (fixture
+    dirs, exported trees) every on-disk record counts."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", str(root), "ls-files", "BENCH_*.json"],
+            capture_output=True, text=True, timeout=10,
+        )
+        if out.returncode == 0:
+            names = [
+                ln.strip() for ln in out.stdout.splitlines() if ln.strip()
+            ]
+            return [root / n for n in names if (root / n).exists()]
+    except Exception:  # noqa: BLE001 — no git: fall through to glob
+        pass
+    return sorted(root.glob("BENCH_*.json"))
+
+
+def find_baseline(
+    metric: str, *, root: Path = ROOT, exclude: Optional[Path] = None
+) -> tuple[Path, dict]:
+    """The committed record the fresh one regresses against: same
+    ``metric``, newest first; the fresh file itself never baselines
+    itself. Loudly refuses when the trajectory has no matching record —
+    a guard with nothing to compare must say so, not pass."""
+    exclude = exclude.resolve() if exclude is not None else None
+    candidates: list[tuple[Path, dict]] = []
+    for path in _trajectory_paths(root):
+        if exclude is not None and path.resolve() == exclude:
+            continue
+        try:
+            rec = load_record(path)
+        except (OSError, ValueError):
+            continue
+        if rec.get("metric") == metric:
+            candidates.append((path, rec))
+    if not candidates:
+        raise SystemExit(
+            f"benchguard: no committed BENCH_*.json in {root} carries "
+            f"metric {metric!r} — record a baseline first (the guard "
+            "never passes by default)"
+        )
+    candidates.sort(key=lambda pr: _record_rank(pr[0]))
+    return candidates[-1]
+
+
+def compare(
+    fresh: dict, baseline: dict, metrics: Sequence[GuardMetric]
+) -> list[dict]:
+    """Per-metric verdicts, every guarded metric accounted for —
+    ``missing`` (loud failure), ``baseline-missing``/``absent``
+    (reported passes), ``regression``, ``improved`` or ``ok``."""
+    out: list[dict] = []
+    for gm in metrics:
+        fv = fresh.get(gm.name)
+        bv = baseline.get(gm.name)
+        row = {
+            "metric": gm.name,
+            "direction": gm.direction,
+            "band": gm.band,
+            "fresh": fv,
+            "baseline": bv,
+            "ratio": None,
+        }
+        if not isinstance(fv, (int, float)) or isinstance(fv, bool):
+            row["verdict"] = "missing" if gm.required else "absent"
+            out.append(row)
+            continue
+        if not isinstance(bv, (int, float)) or isinstance(bv, bool):
+            row["verdict"] = "baseline-missing"
+            out.append(row)
+            continue
+        # worseness ratio: >1 means the fresh record is worse in the
+        # guarded direction, whichever direction that is
+        if gm.direction == "lower":
+            ratio = (fv / bv) if bv else (float("inf") if fv else 1.0)
+        else:
+            ratio = (bv / fv) if fv else (float("inf") if bv else 1.0)
+        row["ratio"] = round(ratio, 4) if ratio != float("inf") else None
+        if ratio >= gm.band:
+            row["verdict"] = "regression"
+        elif ratio <= 1.0 / gm.band:
+            row["verdict"] = "improved"
+        else:
+            row["verdict"] = "ok"
+        out.append(row)
+    return out
+
+
+def render_verdicts(
+    verdicts: list[dict], *, fresh_name: str, baseline_name: str
+) -> str:
+    lines = [
+        f"benchguard: {fresh_name} vs {baseline_name}",
+        f"{'metric':<28} {'dir':<6} {'fresh':>12} {'baseline':>12} "
+        f"{'worse x':>8} {'band':>6}  verdict",
+    ]
+
+    def fmt(v) -> str:
+        if v is None:
+            return "-"
+        return f"{v:.4g}" if isinstance(v, float) else str(v)
+
+    for row in verdicts:
+        lines.append(
+            f"{row['metric']:<28} {row['direction']:<6} "
+            f"{fmt(row['fresh']):>12} {fmt(row['baseline']):>12} "
+            f"{fmt(row['ratio']):>8} {row['band']:>6}  {row['verdict']}"
+        )
+    failing = [v for v in verdicts if v["verdict"] in FAILING]
+    lines.append(
+        f"verdict: {'REGRESSION' if failing else 'pass'} "
+        f"({len(failing)} failing / {len(verdicts)} guarded)"
+    )
+    return "\n".join(lines)
+
+
+def check_record(
+    record_path: str | Path,
+    *,
+    root: Path = ROOT,
+    specs: Optional[dict] = None,
+) -> tuple[int, dict]:
+    """The whole guard for one fresh record: resolve the spec and the
+    committed baseline, compare, and answer (exit_code, report). The
+    report carries the verdict rows + rendered table; exit 1 on any
+    regression or missing metric."""
+    record_path = Path(record_path)
+    fresh = load_record(record_path)
+    metric = fresh.get("metric")
+    if not metric:
+        raise SystemExit(
+            f"benchguard: {record_path} carries no 'metric' field"
+        )
+    table = spec_for(metric) if specs is None else (
+        next(
+            (
+                (p, m) for p, m in sorted(
+                    specs.items(), key=lambda kv: -len(kv[0])
+                )
+                if metric.startswith(p)
+            ),
+            None,
+        )
+    )
+    if table is None:
+        raise SystemExit(
+            f"benchguard: no guard spec for metric family {metric!r} — "
+            "add one to tools/benchguard.py SPECS (the guard never "
+            "passes a family it does not know)"
+        )
+    prefix, metrics = table
+    baseline_path, baseline = find_baseline(
+        metric, root=root, exclude=record_path
+    )
+    verdicts = compare(fresh, baseline, metrics)
+    failing = [v for v in verdicts if v["verdict"] in FAILING]
+    report = {
+        "metric": metric,
+        "family": prefix,
+        "fresh": str(record_path),
+        "baseline": str(baseline_path),
+        "verdicts": verdicts,
+        "failing": len(failing),
+        "ok": not failing,
+        "table": render_verdicts(
+            verdicts,
+            fresh_name=record_path.name,
+            baseline_name=baseline_path.name,
+        ),
+    }
+    return (1 if failing else 0), report
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="benchguard")
+    parser.add_argument("record", help="fresh bench record (JSON)")
+    parser.add_argument(
+        "--root", default=str(ROOT),
+        help="repo root holding the committed BENCH_*.json trajectory",
+    )
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    args = parser.parse_args(argv)
+    code, report = check_record(args.record, root=Path(args.root))
+    if args.format == "json":
+        print(json.dumps(
+            {k: v for k, v in report.items() if k != "table"}, indent=2
+        ))
+    else:
+        print(report["table"])
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -171,6 +171,43 @@ def obs_block(od: dict) -> str:
         f"| observability {scale}: serving-path kernel compiles "
         f"(whole run) | {comp_s} |",
     ]
+    # ISSUE 12: device-byte ledger columns + the history-backed wave
+    # table summary
+    dev = od.get("device_bytes") or {}
+    if dev:
+        dev_s = ", ".join(
+            f"{k} {v / 1e6:.2f} MB" for k, v in sorted(dev.items())
+        )
+        const = {True: "constant", False: "MOVED"}[
+            bool(od.get("device_bytes_steady_constant"))
+        ]
+        rows.append(
+            f"| observability {scale}: resident device bytes "
+            f"({od.get('device_bytes_platform', '?')} buffers; exact "
+            f"nbytes of the held arrays) | {dev_s} — total "
+            f"{od.get('device_bytes_total', 0) / 1e6:.2f} MB, {const} "
+            f"across steady passes, gauge-ledger sum matches="
+            f"{bool(od.get('device_bytes_matches_gauge'))} |"
+        )
+    hist = od.get("history_digests") or {}
+    if hist:
+        bits = []
+        for key, label in (
+            ("wall_s", "wall"),
+            ("bindings_s", "bindings/s"),
+            ("rows_packed", "rows packed"),
+            ("rows_replayed", "rows replayed"),
+        ):
+            d = hist.get(key)
+            if d:
+                bits.append(
+                    f"{label} p50 {d['p50']:g} / p95 {d['p95']:g}"
+                )
+        rows.append(
+            f"| observability {scale}: per-wave history ring "
+            f"({od.get('history_waves', 0)} waves sampled) | "
+            f"{'; '.join(bits) or 'n/a'} |"
+        )
     # ISSUE 10: the 4-process stitched wave (plane + solver sidecar +
     # estimator server + bus) with per-process and per-channel columns,
     # and the flight-recorder proof
@@ -496,6 +533,42 @@ def check_span_table() -> None:
         )
 
 
+def history_table() -> str:
+    """The generated wave-row schema table (karmada_tpu.utils.history
+    ``HISTORY_SERIES`` is the single source of truth; graftlint GL009
+    keeps each series' source reference honest)."""
+    sys.path.insert(0, str(ROOT))
+    from karmada_tpu.utils.history import render_history_schema_table
+
+    return (
+        "_Generated from `karmada_tpu/utils/history.py` HISTORY_SERIES "
+        "by `tools/docs_from_bench.py --history-table` — regenerate, "
+        "don't hand-edit._\n\n" + render_history_schema_table()
+    )
+
+
+def check_history_schema() -> None:
+    """Fail loudly when the committed OPERATIONS.md wave-row schema
+    table drifted from the HISTORY_SERIES registry (a series the table
+    misses is a series operators can't read off /debug/history) — runs
+    on EVERY doc regeneration, same pattern as the env-flag gate."""
+    path = ROOT / "docs" / "OPERATIONS.md"
+    m = _marker_re("historyschema").search(path.read_text())
+    if not m:
+        raise SystemExit(
+            f"{path}: no historyschema markers — restore the Telemetry "
+            "history section and run `python tools/docs_from_bench.py "
+            "--history-table`"
+        )
+    committed_body = m.group(0).split("-->\n", 1)[1].rsplit("<!--", 1)[0]
+    if committed_body.strip() != history_table().strip():
+        raise SystemExit(
+            f"{path}: wave-row schema table drifted from "
+            "karmada_tpu/utils/history.py HISTORY_SERIES — run "
+            "`python tools/docs_from_bench.py --history-table`"
+        )
+
+
 def check_ir_registry() -> None:
     """Fail loudly when a kernel family exported from karmada_tpu/ops/ is
     missing from the graftlint IR entry-point registry (or the registry
@@ -521,6 +594,7 @@ def main() -> None:
         rewrite(ROOT / "docs" / "OPERATIONS.md", env_table(), "envflags")
         check_metrics_table()
         check_span_table()
+        check_history_schema()
         check_ir_registry()
         return
     if sys.argv[1:] == ["--metrics-table"]:
@@ -530,6 +604,7 @@ def main() -> None:
         )
         check_env_table()
         check_span_table()
+        check_history_schema()
         check_ir_registry()
         return
     if sys.argv[1:] == ["--span-table"]:
@@ -538,6 +613,17 @@ def main() -> None:
         )
         check_env_table()
         check_metrics_table()
+        check_history_schema()
+        check_ir_registry()
+        return
+    if sys.argv[1:] == ["--history-table"]:
+        rewrite(
+            ROOT / "docs" / "OPERATIONS.md", history_table(),
+            "historyschema",
+        )
+        check_env_table()
+        check_metrics_table()
+        check_span_table()
         check_ir_registry()
         return
     src = Path(sys.argv[1])
@@ -560,6 +646,7 @@ def main() -> None:
     check_env_table()
     check_metrics_table()
     check_span_table()
+    check_history_schema()
     check_ir_registry()
 
 

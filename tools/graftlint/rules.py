@@ -14,6 +14,8 @@
 |       | an explicit timeout (watch streams are deliberately unbounded)   |
 | GL008 | span taxonomy: every span name recorded on a tracer must be      |
 |       | registered in utils.tracing SPAN_NAMES (stitcher + docs key on)  |
+| GL009 | history series: every HistorySeries source must map to a         |
+|       | registered metric family or the SPAN_NAMES taxonomy              |
 
 Each rule is a pure-AST pass over one ``ModuleInfo`` (plus cross-module
 ``finalize`` hooks); nothing here imports jax.
@@ -1028,4 +1030,129 @@ class BoundedRpc(Rule):
                     "budget through the call, utils.backoff.Deadline)"
                 ),
                 anchor=mod.qualname(node) or "<module>", detail=kind,
+            )
+
+
+# --------------------------------------------------------------------------
+# GL009 — history series: sources must map to a metric family or span name
+# --------------------------------------------------------------------------
+#
+# ISSUE 12 satellite: every per-wave history series (utils/history.py
+# ``HistorySeries``) declares the surface backing it — ``metric:<family>``
+# or ``span:<name>``. A series whose reference rots (family renamed, span
+# retired) would keep rendering plausible zeros forever; this rule makes
+# the reference machine-checked, the GL006/GL008 pattern: metric families
+# are collected across the scanned import graph with GL006's receiver
+# heuristic, span names resolve through the LIVE taxonomy matcher.
+
+
+@rule
+class HistorySeriesSource(Rule):
+    id = "GL009"
+    title = (
+        "history series must source a registered metric family "
+        "(metric:<family>) or a SPAN_NAMES entry (span:<name>)"
+    )
+
+    @staticmethod
+    def _families(ctx: LintContext) -> set:
+        if not hasattr(ctx, "_gl009_families"):
+            ctx._gl009_families = set()
+        return ctx._gl009_families
+
+    @staticmethod
+    def _series(ctx: LintContext) -> list:
+        if not hasattr(ctx, "_gl009_series"):
+            ctx._gl009_series = []
+        return ctx._gl009_series
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        """Collection pass: metric-family definitions (the GL006
+        registry-receiver heuristic) and ``HistorySeries(...)``
+        constructions. Findings emit in ``finalize`` — resolution needs
+        every scanned module's families first."""
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _METRIC_FACTORIES
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                recv = func.value
+                recv_name = (
+                    recv.id if isinstance(recv, ast.Name)
+                    else recv.attr if isinstance(recv, ast.Attribute)
+                    else None
+                )
+                if recv_name is not None and "reg" in recv_name.lower():
+                    self._families(ctx).add(node.args[0].value)
+                continue
+            ctor = (
+                func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None
+            )
+            if ctor != "HistorySeries":
+                continue
+            name = source = None
+            if node.args and isinstance(node.args[0], ast.Constant):
+                name = node.args[0].value
+            if len(node.args) >= 3 and isinstance(node.args[2], ast.Constant):
+                source = node.args[2].value
+            for kw in node.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    name = kw.value.value
+                if kw.arg == "source" and isinstance(kw.value, ast.Constant):
+                    source = kw.value.value
+            if isinstance(source, str):
+                self._series(ctx).append(
+                    (mod, node, str(name or "?"), source)
+                )
+        return iter(())
+
+    def finalize(self, ctx: LintContext) -> Iterator[Finding]:
+        families = self._families(ctx)
+        full_scope = getattr(ctx, "full_scope", True)
+        for mod, node, name, source in self._series(ctx):
+            kind, sep, ref = source.partition(":")
+            if sep and kind == "span":
+                if ctx.span_registered(ref):
+                    continue
+                message = (
+                    f"history series {name!r} sources span {ref!r}, "
+                    "which is not registered in utils.tracing "
+                    "SPAN_NAMES — the sampler would aggregate a span "
+                    "nothing records; register the span or fix the "
+                    "reference"
+                )
+            elif sep and kind == "metric":
+                if ref in families:
+                    continue
+                if not full_scope:
+                    # a scoped scan (--changed-only/--paths) cannot see
+                    # the whole registry, so it cannot prove "never
+                    # registered" — the GL003 staleness precedent
+                    continue
+                message = (
+                    f"history series {name!r} sources metric family "
+                    f"{ref!r}, which no scanned registry defines — the "
+                    "sampler would read a family nothing publishes; "
+                    "register the family (utils/metrics.py) or fix the "
+                    "reference"
+                )
+            else:
+                message = (
+                    f"history series {name!r} source {source!r} is "
+                    "neither `metric:<family>` nor `span:<name>` — the "
+                    "docs schema table and this rule key on that grammar"
+                )
+            yield Finding(
+                rule=self.id, path=mod.rel, line=node.lineno,
+                col=node.col_offset + 1, message=message,
+                anchor=mod.qualname(node) or "<module>",
+                detail=f"{name}:{source}",
             )
