@@ -24,7 +24,10 @@ WORK_APPLIED = "Applied"
 WORK_AVAILABLE = "Available"
 WORK_DEGRADED = "Degraded"
 
-# Eviction producers/reasons (binding_types.go well-knowns)
+# Eviction producers/reasons (binding_types.go well-knowns). The reason
+# codes are registered in the REASONS taxonomy (utils/reasons.py — the
+# API layer stays import-light, so the literals live here and tier-1
+# asserts registry membership; graftlint GL010 guards emission sites)
 EVICTION_PRODUCER_TAINT_MANAGER = "TaintManager"
 EVICTION_REASON_TAINT_UNTOLERATED = "TaintUntolerated"
 EVICTION_REASON_APPLICATION_FAILURE = "ApplicationFailure"

@@ -1130,8 +1130,35 @@ def build_parser() -> tuple:
     ed.add_argument("--editor", default=None,
                     help="editor command (default: $KUBE_EDITOR / $EDITOR)")
 
-    ex = sub.add_parser("explain", help="field docs for a served kind")
-    ex.add_argument("path", help="KIND[.field.subfield...]")
+    ex = sub.add_parser(
+        "explain",
+        help="field docs for a served kind (KIND[.field...]), or — with "
+        "a <ns>/<name> argument — the binding's placement decision "
+        "chain from the provenance plane (/debug/explain): per-stage "
+        "exclusion reasons, the selected affinity group, top-k "
+        "candidates and the final assignment",
+    )
+    ex.add_argument(
+        "path",
+        help="KIND[.field.subfield...] for field docs, or <ns>/<name> "
+        "for a placement explanation",
+    )
+    ex.add_argument(
+        "--wave", type=int, default=None,
+        help="pin the placement explanation to one wave id "
+        "(default: the newest capture holding the binding)",
+    )
+    ex.add_argument(
+        "--metrics", default="",
+        help="HOST:PORT of the scheduling process's metrics endpoint; "
+        "without it the CURRENT process's in-proc ExplainStore answers "
+        "(useful under an embedded plane)",
+    )
+    ex.add_argument(
+        "--json", dest="as_json", action="store_true",
+        help="print the raw explanation document instead of the "
+        "decision-chain view",
+    )
 
     co = sub.add_parser("completion", help="emit a shell completion script")
     co.add_argument("shell", nargs="?", default="bash",
@@ -1276,7 +1303,7 @@ def build_parser() -> tuple:
         "tier (GL001 trace safety, GL002 trace-key completeness, GL003 "
         "env-flag registry, GL004 lock discipline, GL005 import hygiene, "
         "GL006 metric naming, GL007 bounded RPCs, GL008 span taxonomy, "
-        "GL009 history series sources) "
+        "GL009 history series sources, GL010 reason taxonomy) "
         "and, with --ir, the jaxpr-level kernel auditor (IR001 dtype "
         "discipline, IR002 host round-trips, IR003 const capture, IR004 "
         "trace-manifest fidelity, IR005 donation audit)",
@@ -1432,6 +1459,34 @@ def cmd_trace_analyze(path: str, wave: Optional[int] = None) -> dict:
     else:
         record = records[-1]
     return analyze_record(record)
+
+
+def cmd_explain_placement(
+    ref: str, wave: Optional[int] = None, metrics: str = ""
+) -> dict:
+    """The ``explain <ns>/<name>`` verb: one binding's placement
+    decision chain from the provenance plane — either a remote
+    process's ``/debug/explain`` endpoint (``metrics="host:port"``) or
+    this process's in-proc ExplainStore. The answered document is THE
+    ``/debug/explain?binding=`` shape, so the CLI, the HTTP surface and
+    the flight recorder can never drift."""
+    if metrics:
+        import urllib.parse
+        import urllib.request
+
+        query = f"?binding={urllib.parse.quote(ref, safe='')}"
+        if wave is not None:
+            query += f"&wave={wave}"
+        with urllib.request.urlopen(
+            f"http://{metrics}/debug/explain{query}", timeout=10
+        ) as resp:
+            return json.loads(resp.read().decode())
+    from .utils.explainstore import store as explain_store
+    from .utils.tracing import tracer as _tracer
+
+    return explain_store().debug_doc(
+        binding=ref, wave=wave, proc=_tracer.proc
+    )
 
 
 #: the quota families `quota status` reads off the exposition — kept in
@@ -1678,6 +1733,43 @@ def cmd_plane_top(
                     entry[f"{slot}_p50_s"] = round(p50, 6)
                 if p95 is not None:
                     entry[f"{slot}_p95_s"] = round(p95, 6)
+            # ISSUE 13 satellite: the per-process device-byte total the
+            # PR 12 ledger publishes (summed over {kind,bucket}) and the
+            # unschedulable/denied totals off the new reason family —
+            # the history rows carry per-wave deltas; these are the
+            # process-lifetime levels the aggregate used to drop
+            levels = _parse_exposition_lines(
+                text,
+                (
+                    "karmada_tpu_device_bytes",
+                    "karmada_tpu_unschedulable_total",
+                    "karmada_tpu_quota_denied_total",
+                ),
+            )
+            totals = {"karmada_tpu_device_bytes": 0.0,
+                      "karmada_tpu_unschedulable_total": 0.0,
+                      "karmada_tpu_quota_denied_total": 0.0}
+            by_reason: dict = {}
+            for fam, labels, value in levels:
+                totals[fam] += value
+                if fam == "karmada_tpu_unschedulable_total":
+                    reason = labels.get("reason", "")
+                    by_reason[reason] = (
+                        by_reason.get(reason, 0) + int(value)
+                    )
+            entry["device_bytes"] = int(
+                totals["karmada_tpu_device_bytes"]
+            )
+            entry["unschedulable_total"] = int(
+                totals["karmada_tpu_unschedulable_total"]
+            )
+            entry["quota_denied_total"] = int(
+                totals["karmada_tpu_quota_denied_total"]
+            )
+            if by_reason:
+                entry["unschedulable_by_reason"] = dict(
+                    sorted(by_reason.items())
+                )
         out["procs"][name] = entry
     return out
 
@@ -1721,6 +1813,15 @@ def render_top(doc: dict) -> str:
                     f"{slot} p50 {entry[f'{slot}_p50_s']:.3g}s "
                     f"p95 {entry.get(f'{slot}_p95_s', 0.0):.3g}s"
                 )
+        if "device_bytes" in entry:
+            bits.append(f"devB {entry['device_bytes'] / 1e6:.2f}MB")
+        if entry.get("unschedulable_total") or entry.get(
+            "quota_denied_total"
+        ):
+            bits.append(
+                f"unsched/denied {entry.get('unschedulable_total', 0)}"
+                f"/{entry.get('quota_denied_total', 0)}"
+            )
         if entry.get("evicted"):
             bits.append(f"evicted {entry['evicted']}")
         if bits:
@@ -1764,6 +1865,22 @@ def main(argv: Optional[list[str]] = None) -> int:
 
     # offline verbs: no plane, no bus
     if args.command == "explain":
+        if "/" in args.path:
+            # <ns>/<name>: the provenance plane's decision chain
+            try:
+                doc = cmd_explain_placement(
+                    args.path, wave=args.wave, metrics=args.metrics
+                )
+            except Exception as exc:  # unreachable endpoint, bad JSON
+                print(json.dumps({"error": str(exc)}))
+                return 1
+            if args.as_json:
+                print(json.dumps(doc, indent=2))
+            else:
+                from .utils.explainstore import render_explanation
+
+                print(render_explanation(doc.get("binding")))
+            return 0 if doc.get("binding") is not None else 1
         try:
             print(cmd_explain(args.path))
         except KeyError as exc:

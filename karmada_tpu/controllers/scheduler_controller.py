@@ -98,6 +98,13 @@ class SchedulerController:
         self._quota_snapshot = None
         self._quota_snap_gen = -1  # generation the cached snapshot is for
         self._quota_denied: dict[tuple, int] = {}  # (kind, key) -> gen
+        # once-per-transition counter gate (ISSUE 13 satellite): the
+        # SHARED dedup behind quota_denied_total AND unschedulable_total
+        # — a parked binding re-enqueued across passes within one
+        # generation must never double-increment either family
+        from ..utils.reasons import TransitionDedup
+
+        self._reason_dedup = TransitionDedup()
         self.worker = runtime.new_worker(
             "scheduler", self._reconcile,
             reconcile_batch=self._reconcile_batch, batch_size=131072,
@@ -447,7 +454,11 @@ class SchedulerController:
 
     def _write_back(self, rb: ResourceBinding, result, fresh: bool = False) -> bool:
         """Mutate ``rb`` from the schedule result; returns whether it
-        changed (the batch caller owns the store write)."""
+        changed (the batch caller owns the store write). Scheduled=False
+        conditions carry a REASONS-taxonomy code (the classified
+        unschedulability reason, not free text), and every (binding,
+        reason, generation) transition increments
+        ``karmada_tpu_unschedulable_total{reason}`` exactly once."""
         before = [(tc.name, tc.replicas) for tc in rb.spec.clusters]
         changed = rb.status.scheduler_observed_generation != rb.meta.generation
         if result.success and fresh and (
@@ -492,26 +503,41 @@ class SchedulerController:
                 Condition(type=SCHEDULED, status=True, reason="Success"),
             ):
                 changed = True
+            # a later denial after a successful schedule is a NEW
+            # transition and must count again
+            self._reason_dedup.forget(("sched", rb.meta.namespaced_name))
         else:
-            from ..scheduler.quota import (
-                QUOTA_EXCEEDED_ERROR,
-                QUOTA_EXCEEDED_REASON,
-            )
+            from ..scheduler.quota import QUOTA_EXCEEDED_ERROR
+            from ..utils.reasons import classify_error
 
             rb.status.scheduler_observed_generation = rb.meta.generation
             quota_hit = result.error == QUOTA_EXCEEDED_ERROR
+            reason = classify_error(result.error)
             if set_condition(
                 rb.status.conditions,
                 Condition(
                     type=SCHEDULED,
                     status=False,
-                    reason=(
-                        QUOTA_EXCEEDED_REASON if quota_hit else "NoClusterFit"
-                    ),
+                    reason=reason,
                     message=result.error,
                 ),
             ):
                 changed = True
+            # counter transitions dedup independently of the condition
+            # write: re-classifying message drift must not re-count, and
+            # a parked binding re-enqueued across passes within one
+            # generation of ITS OWN spec increments exactly once — a
+            # quota event that re-denies an unchanged binding is the
+            # same ongoing denial, not a new one (the old condition-
+            # transition semantics, minus its success-bounce hole)
+            if self._reason_dedup.observe(
+                ("sched", rb.meta.namespaced_name),
+                reason,
+                rb.meta.generation,
+            ):
+                from ..utils.metrics import unschedulable_total
+
+                unschedulable_total.inc(reason=reason)
                 if quota_hit:
                     from ..utils.metrics import quota_denied
 

@@ -45,6 +45,9 @@ from .estimate import (  # noqa: E402,F401
     general_estimate_interned,
     merge_estimates,
 )
+from .explain import (  # noqa: E402,F401
+    explain_pass,
+)
 from .quota import (  # noqa: E402,F401
     quota_admit,
     quota_cluster_caps,

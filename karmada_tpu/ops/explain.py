@@ -1,0 +1,154 @@
+"""Placement-provenance kernels: batched "why" as tensor reductions.
+
+Ref: the reference scheduler explains a placement through per-binding
+``Scheduled`` conditions and filter-stage events emitted from host
+control flow (generic_scheduler.go's Filter/Score/Select/AssignReplicas
+pipeline, scheduler.go:827-919). Our pipeline runs those stages as
+batched tensor programs, so per-binding host bookkeeping would cost more
+than the solve; instead the whole wave's provenance computes as ONE
+extra armed-only dispatch per pass (disarmed = one ``is None`` check in
+the engine, the PR 7/8 pattern):
+
+- ``explain_pass`` — a packed per-binding x per-cluster EXCLUSION
+  BITMASK, one bit per decision stage in
+  ``utils.reasons.STAGE_REASONS`` order (affinity/group rank,
+  taints/NoExecute, API enablement, estimator availability, quota
+  cluster cap, quota admission, spread constraint), plus a per-binding
+  top-k candidate summary (cluster, availability, credited prev, final
+  assignment, that cluster's mask byte) ranked by (assigned desc,
+  availability desc, index asc).
+
+The stage masks arrive COMPOSED (already-placed leniency folded, the
+selected affinity group's term, the spread selection) — composition is
+the engine's packing layer (TensorScheduler._pack_explain), exactly as
+the solve kernels receive composed feasibility. The numpy oracle
+(refimpl/explain_np.py) re-derives the same bits from the reference
+per-binding/per-cluster decision semantics, sharing no code with this
+kernel, and is asserted bit-identical across the bucket grid, padded
+tails and mesh 1/2/4/8.
+
+Pure integer math (no float64, no host round-trips, no captured consts —
+graftlint IR001-IR005 audit via the entry-point registry). ``mesh``
+shards the binding axis over "b" exactly like the fleet kernels; the
+mesh static is part of the compile identity and manifest records carry
+it as the canonical shape.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..utils.reasons import STAGE_REASONS
+
+#: exclusion-bit positions, derived from the taxonomy's canonical stage
+#: order — the registry (utils/reasons.py) is the single source; these
+#: names exist so kernel code reads as bits, not magic indices
+BIT_AFFINITY = STAGE_REASONS.index("AffinityMismatch")
+BIT_TAINT = STAGE_REASONS.index("TaintUntolerated")
+BIT_API = STAGE_REASONS.index("ApiNotEnabled")
+BIT_AVAILABILITY = STAGE_REASONS.index("NoAvailableReplicas")
+BIT_QUOTA_CAP = STAGE_REASONS.index("QuotaCapExceeded")
+BIT_QUOTA_ADMIT = STAGE_REASONS.index("QuotaExceeded")
+BIT_SPREAD = STAGE_REASONS.index("SpreadConstraintUnsatisfied")
+N_STAGES = len(STAGE_REASONS)
+assert N_STAGES <= 8, "exclusion mask is one uint8 per cell"
+
+#: top-k summary column layout (int32[B, K, TOPK_COLS])
+TOPK_COLS = 5  # cluster index, avail, prev, assigned, mask byte
+
+
+@partial(jax.jit, static_argnames=("k", "mesh", "shard_c"))
+def explain_pass(
+    aff_ok,  # bool[B, C]: in the SELECTED affinity group's mask
+    taint_ok,  # bool[B, C]: taints tolerated (leniency + eviction folded)
+    api_ok,  # bool[B, C]: API/GVK enabled (leniency folded)
+    spread_ok,  # bool[B, C]: spread fields pass + spread selection keeps it
+    avail,  # int32[B, C]: merged estimator availability (pre-cap)
+    caps,  # int32[B, C]: quota cluster-cap estimate (MAX_INT32 = no cap)
+    admitted,  # bool[B]: survived batched quota admission
+    dynamic,  # bool[B]: dynamic-weight strategy family (consults avail)
+    replicas,  # int32[B]
+    assignment,  # int32[B, C]: the pass's final assignment
+    prev,  # int32[B, C]: credited previous placements
+    *,
+    k: int,
+    mesh=None,  # jax.sharding.Mesh with axes ("b", "c") — None = single-device
+    shard_c: bool = False,
+):
+    """One armed-only provenance dispatch over a padded chunk. Returns
+    ``(mask uint8[B, C], topk int32[B, K, TOPK_COLS])``. Padding rows
+    (replicas == 0, all-False masks) decode as fully-excluded and are
+    sliced off by the capture layer."""
+    b, c = aff_ok.shape
+    assert k <= c, (k, c)
+    c_ax = "c" if (mesh is not None and shard_c) else None
+
+    def shard(a, *axes):
+        if mesh is None:
+            return a
+        return lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P(*axes))
+        )
+
+    aff_ok = shard(aff_ok, "b", c_ax)
+    taint_ok = shard(taint_ok, "b", c_ax)
+    api_ok = shard(api_ok, "b", c_ax)
+    spread_ok = shard(spread_ok, "b", c_ax)
+    avail = shard(avail, "b", c_ax)
+    caps = shard(caps, "b", c_ax)
+    assignment = shard(assignment, "b", c_ax)
+    prev = shard(prev, "b", c_ax)
+    admitted = shard(admitted, "b")
+    dynamic = shard(dynamic, "b")
+    replicas = shard(replicas, "b")
+
+    def bit(cond, i: int):
+        return jnp.where(cond, jnp.uint8(1 << i), jnp.uint8(0))
+
+    # availability stages only speak for strategies that consult the
+    # estimator merge (Duplicated places everywhere feasible) and for
+    # actual workloads (replicas > 0)
+    consults = (dynamic & (replicas > 0))[:, None]
+    mask = (
+        bit(~aff_ok, BIT_AFFINITY)
+        | bit(~taint_ok, BIT_TAINT)
+        | bit(~api_ok, BIT_API)
+        | bit(consults & (avail <= 0), BIT_AVAILABILITY)
+        | bit(consults & (caps <= 0), BIT_QUOTA_CAP)
+        | bit(~admitted[:, None], BIT_QUOTA_ADMIT)
+        | bit(~spread_ok, BIT_SPREAD)
+    )
+
+    # top-k candidates by (assigned desc, avail desc, index asc): the
+    # mixed-radix key packs both into one int64 — assigned < 2^31 and
+    # avail+1 in [0, 2^31] keep the product under 2^63; lax.top_k breaks
+    # ties toward the lower index, the reference's stable order
+    key = assignment.astype(jnp.int64) * jnp.int64(1 << 32) + (
+        avail.astype(jnp.int64) + 1
+    )
+    _vals, idx = lax.top_k(key, k)
+    take = lambda a: jnp.take_along_axis(a, idx, axis=1)
+    topk = jnp.stack(
+        [
+            idx.astype(jnp.int32),
+            take(avail).astype(jnp.int32),
+            take(prev).astype(jnp.int32),
+            take(assignment).astype(jnp.int32),
+            take(mask).astype(jnp.int32),
+        ],
+        axis=-1,
+    )
+    return mask, topk
+
+
+def topk_width(c: int, k: int = 8) -> int:
+    """The kernel's static ``k`` for a ``c``-cluster snapshot: the
+    requested width clamped to the cluster count (one trace per (padded
+    B, C, k) bucket)."""
+    return max(1, min(int(k), int(c)))

@@ -286,6 +286,14 @@ class TensorScheduler:
         # ledgers the solve family; admission dispatches engine-side)
         self._engine_traces: set = set()
         self._engine_new_trace = False
+        # placement provenance (ISSUE 13): when armed, every schedule()
+        # pass runs ONE extra batched explain dispatch per chunk and
+        # deposits the exclusion masks + top-k summaries in the
+        # process-wide ExplainStore. Disarmed — the default — the hot
+        # path costs one `is None` check (the quota/fault pattern).
+        from ..utils.explainstore import explain_armed, store as _estore
+
+        self.explain = _estore() if explain_armed() else None
 
     PLACEMENT_CACHE_CAP = 8192
     #: minimum eligible-batch size before the device-resident path engages
@@ -415,7 +423,11 @@ class TensorScheduler:
 
     # -- quota admission ---------------------------------------------------
 
-    _ENGINE_TRACE_KERNELS = {"Q": "quota_admit", "K": "quota_cluster_caps"}
+    _ENGINE_TRACE_KERNELS = {
+        "Q": "quota_admit",
+        "K": "quota_cluster_caps",
+        "E": "explain_pass",
+    }
 
     def _mark_trace(self, *key) -> bool:
         """Engine-side trace ledger for the quota kernels — the fleet
@@ -680,7 +692,35 @@ class TensorScheduler:
         it either fires (compiling inside warmup) or clears."""
         return bool(self._fleet is not None and self._fleet.shrink_pending)
 
+    def set_explain(self, store) -> None:
+        """Arm/disarm provenance capture for this engine (None =
+        disarmed; benches and tests arm programmatically, processes via
+        ``KARMADA_TPU_EXPLAIN=1``)."""
+        self.explain = store
+
     def schedule(self, problems: Sequence[BindingProblem]) -> list[ScheduleResult]:
+        """Provenance wrapper: the solve runs unchanged; when explain is
+        armed the pass's decision provenance captures AFTER the results
+        exist (one extra armed-only dispatch per chunk — telemetry, so
+        a capture failure logs and never aborts the wave)."""
+        results = self._schedule_quota(problems)
+        # the store's enabled gate honors KARMADA_TPU_EXPLAIN_CAP=0:
+        # a disabled ring must not pay the capture dispatch either
+        if self.explain is not None and self.explain.enabled and problems:
+            try:
+                self._capture_explain(list(problems), results)
+            except Exception as exc:  # noqa: BLE001 — provenance is
+                # telemetry: losing a capture must never lose the wave
+                import logging
+
+                logging.getLogger("karmada_tpu").warning(
+                    "explain capture failed (%s)", type(exc).__name__
+                )
+        return results
+
+    def _schedule_quota(
+        self, problems: Sequence[BindingProblem]
+    ) -> list[ScheduleResult]:
         """Quota admission wrapper around the solve: when a QuotaSnapshot
         is set and the wave touches quota'd namespaces, ONE batched
         admission kernel partitions the wave; denied bindings answer a
@@ -738,6 +778,271 @@ class TensorScheduler:
         limited = q.remaining < _UNL
         q.remaining = np.where(
             limited, np.maximum(q.remaining - debit, 0), q.remaining
+        )
+
+    # -- placement provenance (ISSUE 13) -----------------------------------
+
+    def _capture_explain(self, problems, results) -> None:
+        """One armed-only provenance dispatch per chunk: compose the
+        per-stage masks host-side (the same algebra ``_pack_chunk``
+        feeds the solve, kept PER STAGE instead of AND-folded), run the
+        ``ops.explain.explain_pass`` kernel, and deposit the capture in
+        the process-wide ExplainStore under the current wave."""
+        import time as _time
+
+        from ..utils.tracing import tracer as _tracer
+
+        t0 = _time.perf_counter()
+        wave = _tracer.current_context().wave
+        rows = 0
+        for start in range(0, len(problems), self.chunk_size):
+            chunk = problems[start : start + self.chunk_size]
+            res = results[start : start + self.chunk_size]
+            self.explain.add(self._explain_chunk(chunk, res, wave))
+            rows += len(chunk)
+        _tracer.record(
+            "scheduler.explain", _time.perf_counter() - t0, rows=rows
+        )
+
+    def _explain_chunk(self, problems, results, wave: int):
+        """Build one chunk's ExplainCapture. Stage masks carry the
+        solve's exact leniency rules (already-placed taint/API leniency,
+        evictions folded into the taint/NoExecute stage, the spread
+        selection where a derived row exists) so a bit here means "this
+        stage excluded this cluster in THIS pass". Out-of-tree custom
+        filters are engine-level host hooks with no stage identity and
+        are not attributed."""
+        from ..ops import masks as mops
+        from ..ops.divide import AGGREGATED as S_AGG, DYNAMIC_WEIGHT as S_DYN
+        from ..ops.explain import explain_pass, topk_width
+        from ..utils.explainstore import ExplainCapture
+        from .quota import QUOTA_EXCEEDED_ERROR
+
+        snap = self.snapshot
+        disabled = self.disabled_plugins
+        compiled = [self._compiled(p.placement) for p in problems]
+        b, c = len(problems), snap.num_clusters
+
+        cp_slot: dict[int, int] = {}
+        unique_cps: list[CompiledPlacement] = []
+        cp_idx = np.empty(b, np.int32)
+        for i, cp in enumerate(compiled):
+            slot = cp_slot.get(id(cp))
+            if slot is None:
+                slot = len(unique_cps)
+                cp_slot[id(cp)] = slot
+                unique_cps.append(cp)
+            cp_idx[i] = slot
+        spread_pl = np.stack([cp.spread_field_ok for cp in unique_cps])
+        taint_pl = np.stack([cp.taint_ok for cp in unique_cps])
+
+        gvk_slot: dict[str, int] = {}
+        gvk_masks: list[np.ndarray] = []
+        gvk_idx = np.empty(b, np.int32)
+        for i, p in enumerate(problems):
+            slot = gvk_slot.get(p.gvk)
+            if slot is None:
+                slot = len(gvk_masks)
+                gvk_slot[p.gvk] = slot
+                gid = snap.gvk_vocab.get(p.gvk) if p.gvk else None
+                if gid is None:
+                    m = (
+                        np.zeros(c, bool)
+                        if p.gvk and len(snap.gvk_vocab) > 0
+                        else np.ones(c, bool)
+                    )
+                else:
+                    word, bit_ = gid // 32, gid % 32
+                    m = (snap.gvk_bits[:, word] >> np.uint32(bit_)) & 1 != 0
+                gvk_masks.append(m)
+            gvk_idx[i] = slot
+        api_gvk = np.stack(gvk_masks)
+
+        replicas = np.fromiter((p.replicas for p in problems), np.int32, b)
+        fresh = np.fromiter((p.fresh for p in problems), bool, b)
+        strategy = np.fromiter(
+            (cp.strategy for cp in compiled), np.int32, b
+        )
+        r = len(snap.dims)
+        prev = np.zeros((b, c), np.int32)
+        evict = np.zeros((b, c), bool)
+        requests = np.zeros((b, r), np.int64)
+        dim_index = {d: j for j, d in enumerate(snap.dims)}
+        pods_dim = dim_index.get("pods")
+        for i, p in enumerate(problems):
+            for name, reps in p.prev.items():
+                j = snap.index.get(name)
+                if j is not None:
+                    prev[i, j] = reps
+            for name in p.evict_clusters:
+                j = snap.index.get(name)
+                if j is not None:
+                    evict[i, j] = True
+            for d, q in p.requests.items():
+                j = dim_index.get(d)
+                if j is not None:
+                    requests[i, j] = q
+            if pods_dim is not None and p.replicas > 0:
+                requests[i, pods_dim] = max(requests[i, pods_dim], 1)
+        prev_mask = prev > 0
+
+        taint_tol = taint_pl[cp_idx] | prev_mask
+        if "TaintToleration" in disabled:
+            taint_tol = np.ones((b, c), bool)
+        if "ClusterEviction" in disabled:
+            evict = np.zeros((b, c), bool)
+        taint_ok = taint_tol & ~evict
+        api_ok = api_gvk[gvk_idx] | (
+            prev_mask & ~snap.complete_enablements[None, :]
+        )
+        if "APIEnablement" in disabled:
+            api_ok = np.ones((b, c), bool)
+        spread_ok = spread_pl[cp_idx]
+        if "SpreadConstraint" in disabled:
+            spread_ok = np.ones((b, c), bool)
+        else:
+            # spread rows with a derived selection: the Select stage's
+            # surviving set IS the selection mask (id-pinned row cache)
+            for i, (p, cp) in enumerate(zip(problems, compiled)):
+                if len(cp.terms) == 1 and not cp.fleet_single_term:
+                    hit = self._derived_rows.get(p.key)
+                    if (
+                        hit is not None
+                        and hit[1] is p.placement
+                        and hit[2] is not None
+                    ):
+                        spread_ok[i] = spread_ok[i] & hit[2].terms[0][1]
+
+        # pre-cap merged availability: the host mirror when exact, the
+        # device merge (without the cap estimator — the cap is its own
+        # stage) when out-of-tree estimators are registered
+        if self.extra_estimators:
+            avail = np.asarray(
+                self._availability(requests, replicas, None)
+            ).astype(np.int32)
+        else:
+            avail = self._availability_np(requests, replicas, None)
+        mi = np.int32(2**31 - 1)
+        cap_rows = self._quota_cap_rows(problems)
+        caps = (
+            self._quota_caps_np(cap_rows, requests).astype(np.int32)
+            if cap_rows is not None
+            else np.full((b, c), mi, np.int32)
+        )
+
+        dynamic = (strategy == S_DYN) | (strategy == S_AGG)
+        admitted = np.fromiter(
+            (res.error != QUOTA_EXCEEDED_ERROR for res in results), bool, b
+        )
+        assignment = np.zeros((b, c), np.int32)
+        for i, res in enumerate(results):
+            for name, n_assigned in res.clusters.items():
+                j = snap.index.get(name)
+                if j is not None:
+                    assignment[i, j] = n_assigned
+
+        # selected affinity group: the tensorized ordered-failover
+        # selection (ops.masks.first_fit_group — the ranked path's exact
+        # predicate), so a displaced binding's capture records WHICH
+        # fallback group it landed on. The SELECTION consumes the same
+        # cap-folded availability the ranked solve ranks groups on
+        # (_schedule_chunk_ranked passes cap_rows into _availability) —
+        # only the kernel's per-stage avail input stays pre-cap, because
+        # the cap is its own stage bit there.
+        tmax = max(len(cp.terms) for cp in unique_cps)
+        if tmax > 1 and "ClusterAffinity" not in disabled:
+            if cap_rows is None:
+                avail_rank = avail
+            elif self.extra_estimators:
+                avail_rank = np.asarray(
+                    self._availability(requests, replicas, cap_rows)
+                ).astype(np.int32)
+            else:
+                avail_rank = self._availability_np(
+                    requests, replicas, cap_rows
+                )
+            term_stack = np.zeros((len(unique_cps), tmax, c), bool)
+            term_len_u = np.ones(len(unique_cps), np.int32)
+            for u, cp in enumerate(unique_cps):
+                term_len_u[u] = len(cp.terms)
+                for t, (_name, m) in enumerate(cp.terms):
+                    term_stack[u, t] = m
+            base = taint_ok & api_ok & spread_ok
+            cand_tc = base[:, None, :] & term_stack[cp_idx]
+            rank, _fit = mops.first_fit_group(
+                cand_tc,
+                term_len_u[cp_idx],
+                avail_rank.astype(np.int64),
+                replicas.astype(np.int64),
+                prev.astype(np.int64),
+                dynamic.astype(bool),
+                fresh.astype(bool),
+            )
+            group_rank = rank.astype(np.int32)
+            aff_ok = np.take_along_axis(
+                term_stack[cp_idx],
+                rank[:, None, None].astype(np.intp),
+                axis=1,
+            )[:, 0, :]
+        else:
+            group_rank = np.zeros(b, np.int32)
+            aff_ok = np.stack(
+                [cp.terms[0][1] for cp in unique_cps]
+            )[cp_idx]
+            if "ClusterAffinity" in disabled:
+                aff_ok = np.ones((b, c), bool)
+
+        # pow2 row padding bounds the trace count (the admission-kernel
+        # discipline); pad rows are zero-replica all-excluded and are
+        # sliced off before the capture
+        b_pad = 1 << max(0, (b - 1).bit_length())
+        b_pad = min(max(b_pad, b), max(self.chunk_size, b))
+        pad = b_pad - b
+
+        def pad_rows(a, value=0):
+            if pad == 0:
+                return a
+            width = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+            return np.pad(a, width, constant_values=value)
+
+        k = topk_width(c)
+        mesh = self.mesh
+        if mesh is not None and b_pad % max(mesh.shape.get("b", 1), 1):
+            mesh = None  # non-divisible batch: single-device semantics
+        shard_c = bool(self.shard_clusters and mesh is not None)
+        arrays = tuple(
+            jnp.asarray(a)
+            for a in (
+                pad_rows(aff_ok), pad_rows(taint_ok), pad_rows(api_ok),
+                pad_rows(spread_ok), pad_rows(avail), pad_rows(caps),
+                pad_rows(admitted, True), pad_rows(dynamic),
+                pad_rows(replicas), pad_rows(assignment), pad_rows(prev),
+            )
+        )
+        from ..parallel.mesh import mesh_shape
+
+        mesh_el = mesh_shape(mesh)
+        key = ("E", int(b_pad), int(c), int(k), mesh_el, shard_c)
+        if self._mark_trace(*key):
+            # recorded meshed too: explain_pass carries a real mesh
+            # static (the fleet-kernel contract), so replay can
+            # materialize the shape — unlike the static-less quota keys
+            self._record_trace(
+                "explain_pass", key, arrays,
+                k=k, mesh=mesh_el, shard_c=shard_c,
+            )
+        mask_dev, topk_dev = explain_pass(
+            *arrays, k=k, mesh=mesh, shard_c=shard_c
+        )
+        return ExplainCapture(
+            wave=wave,
+            names=snap.names,
+            keys=[p.key for p in problems],
+            masks=np.asarray(mask_dev)[:b],
+            topk=np.asarray(topk_dev)[:b],
+            group_rank=group_rank,
+            errors=[res.error for res in results],
+            assignment=assignment,
         )
 
     def _schedule_inner(

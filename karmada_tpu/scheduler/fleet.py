@@ -65,6 +65,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.divide import AGGREGATED, DUPLICATED as S_DUPLICATED, _divide_batch
 from ..ops.estimate import MAX_INT32, merge_estimates
+from ..ops.explain import explain_pass as _explain_pass
 from ..ops.quota import (
     quota_admit as _quota_admit,
     quota_cluster_caps as _quota_cluster_caps,
@@ -849,6 +850,10 @@ FLEET_KERNELS = {
     # the graftlint IR tier see them like every other solve-family kernel
     "quota_admit": _quota_admit,
     "quota_cluster_caps": _quota_cluster_caps,
+    # provenance plane (ops.explain): the armed-only per-pass "why"
+    # dispatch, engine-side like the quota kernels — registered so
+    # prewarm replay and the graftlint IR tier audit it with the rest
+    "explain_pass": _explain_pass,
 }
 
 

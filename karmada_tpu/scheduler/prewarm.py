@@ -73,6 +73,7 @@ _KERNELS = (
     "fleet_bits",
     "quota_admit",
     "quota_cluster_caps",
+    "explain_pass",
 )
 
 

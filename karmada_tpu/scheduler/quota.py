@@ -36,8 +36,13 @@ import numpy as np
 from ..ops.quota import DEMAND_CLAMP, UNLIMITED
 
 #: ScheduleResult.error for a quota-denied binding; the scheduler
-#: controller maps it to the Scheduled=False ``QuotaExceeded`` condition
-QUOTA_EXCEEDED_REASON = "QuotaExceeded"
+#: controller maps it to the Scheduled=False ``QuotaExceeded`` condition.
+#: The reason code comes from THE taxonomy (utils.reasons.REASONS —
+#: ISSUE 13 unification): it doubles as exclusion-mask stage bit 5, and
+#: graftlint GL010 keeps every emission site on registered codes.
+from ..utils.reasons import REASONS as _REASONS
+
+QUOTA_EXCEEDED_REASON = _REASONS["QuotaExceeded"].code
 QUOTA_EXCEEDED_ERROR = "namespace quota exceeded"
 
 

@@ -251,6 +251,22 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "sampling local-only (rows still record every local series).",
         ),
         EnvFlag(
+            "KARMADA_TPU_EXPLAIN", "",
+            "Placement-provenance arm switch (utils.explainstore): set "
+            "to 1 and every engine pass runs ONE extra batched explain "
+            "dispatch (ops.explain.explain_pass) capturing per-binding x "
+            "per-cluster stage-exclusion masks + top-k candidate "
+            "summaries into the /debug/explain ring. Unset/0 — the "
+            "default — costs one `is None` check per pass.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_EXPLAIN_CAP", "8",
+            "Explain-capture ring cap in WAVES (utils.explainstore."
+            "ExplainStore): older waves' captures evict (counted, never "
+            "silent) once more than this many waves are retained; 0 "
+            "disables the store even when armed.",
+        ),
+        EnvFlag(
             "KARMADA_TPU_TRACE_PEERS", "",
             "Comma-separated `name=host:port` metrics endpoints of the "
             "plane's peer processes (solver sidecar, estimator servers, "

@@ -179,6 +179,13 @@ HISTORY_SERIES: dict[str, HistorySeries] = {
             "sampled wave",
         ),
         HistorySeries(
+            "unschedulable", "counter",
+            "metric:karmada_tpu_unschedulable_total",
+            "bindings transitioning to Scheduled=False (any REASONS "
+            "code) since the previous sampled wave — the `top` "
+            "unschedulable/denied column",
+        ),
+        HistorySeries(
             "phases", "gauge", "span:settle",
             "per-phase SELF seconds dict — keys are SPAN_NAMES entries "
             "(digested as phases.<name> sub-series)",
@@ -289,6 +296,7 @@ class WaveHistory:
             kernel_compiles,
             quota_denied,
             trace_spans_dropped,
+            unschedulable_total,
             worker_queue_depth,
         )
 
@@ -386,6 +394,9 @@ class WaveHistory:
             },
             "quota_denied": int(
                 _counter_delta("quota_denied", quota_denied)
+            ),
+            "unschedulable": int(
+                _counter_delta("unschedulable", unschedulable_total)
             ),
             "phases": dict(summary.get("phases", {})),
         }
@@ -532,7 +543,8 @@ def render_history_table(rows: list[dict], proc: str = "") -> str:
     head = (
         f"{'proc':<10} {'wave':>5} {'wall_s':>8} {'cover':>6} "
         f"{'bind/s':>8} {'packed':>7} {'replay':>7} {'cmpl':>4} "
-        f"{'up/fetch MB':>12} {'rpc e/s/b':>11} {'devMB':>8} {'q':>4}"
+        f"{'up/fetch MB':>12} {'rpc e/s/b':>11} {'devMB':>8} "
+        f"{'uns/den':>8} {'q':>4}"
     )
     lines = [head]
     for r in rows:
@@ -549,6 +561,7 @@ def render_history_table(rows: list[dict], proc: str = "") -> str:
             f"{r.get('rpc_estimator', 0)}/{r.get('rpc_solver', 0)}"
             f"/{r.get('rpc_bus', 0):<5} "
             f"{r.get('device_bytes', 0) / 1e6:>8.2f} "
+            f"{r.get('unschedulable', 0)}/{r.get('quota_denied', 0):<4} "
             f"{r.get('queue_depth', 0):>4}"
         )
     return "\n".join(lines)

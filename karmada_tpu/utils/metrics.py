@@ -455,6 +455,14 @@ degraded_passes = registry.counter(
     "answered UnauthenticReplica (such a pass never arms batch-identity "
     "replay)",
 )
+unschedulable_total = registry.counter(
+    "karmada_tpu_unschedulable_total",
+    "bindings transitioning to Scheduled=False, by REASONS-taxonomy "
+    "code (QuotaExceeded, NoClusterFit, InsufficientReplicas, ...) — "
+    "one increment per (binding, reason, generation) transition; a "
+    "parked binding re-enqueued within one generation never "
+    "double-counts (utils.reasons.TransitionDedup)",
+)
 quota_denied = registry.counter(
     "karmada_tpu_quota_denied_total",
     "bindings newly denied admission by FederatedResourceQuota "
@@ -515,8 +523,10 @@ class MetricsServer:
     options.go:148); this is that endpoint for the TPU-native processes.
     Also answers /healthz (the readiness probe the reference wires via
     healthz.InstallHandler), /debug/traces (the wave-trace ring as
-    JSON — utils.tracing.tracer.dump()) and /debug/history (the per-wave
-    telemetry ring + sliding-window digests — utils.history)."""
+    JSON — utils.tracing.tracer.dump()), /debug/history (the per-wave
+    telemetry ring + sliding-window digests — utils.history) and
+    /debug/explain (the placement-provenance capture ring —
+    utils.explainstore)."""
 
     def __init__(
         self,
@@ -586,6 +596,44 @@ class MetricsServer:
                         history_for(tracer).debug_doc(
                             window=window, wave=wave,
                             with_digests=with_digests, proc=tracer.proc,
+                        )
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/debug/explain"):
+                    import json
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from .explainstore import store as explain_store
+                    from .tracing import tracer
+
+                    # query contract: ?binding=<ns>/<name> answers one
+                    # binding's decision chain, ?wave=N pins/narrows to
+                    # one wave; no binding = the wave's verdict summary
+                    # + worst bindings. Malformed wave answers 400.
+                    qs = parse_qs(urlsplit(self.path).query)
+                    raw_wave = (qs.get("wave") or [None])[0]
+                    try:
+                        wave = (
+                            int(raw_wave) if raw_wave is not None else None
+                        )
+                    except ValueError:
+                        body = json.dumps(
+                            {"error": f"bad wave={raw_wave!r}"}
+                        ).encode()
+                        self.send_response(400)
+                        self.send_header(
+                            "Content-Type", "application/json"
+                        )
+                        self.send_header(
+                            "Content-Length", str(len(body))
+                        )
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    binding = (qs.get("binding") or [None])[0]
+                    body = json.dumps(
+                        explain_store().debug_doc(
+                            binding=binding, wave=wave, proc=tracer.proc
                         )
                     ).encode()
                     ctype = "application/json"

@@ -1,0 +1,296 @@
+"""REASONS: the plane-wide reason-code taxonomy (ISSUE 13).
+
+Ref: the reference scheduler's whole diagnostic story is per-binding
+``Scheduled`` conditions and filter-stage events out of the
+Filter/Score/Select/AssignReplicas pipeline (scheduler.go:827-919,
+generic_scheduler.go) — every ``reason`` it stamps is a well-known
+CamelCase code, never free text. Until this module the repo's reasons
+were ad-hoc string literals scattered across controllers (a free-text
+``QuotaExceeded`` here, an uncoded ``NoClusterFit`` there) and silence
+from the kernels; provenance needs one registry the exclusion bitmask
+(ops/explain.py), the ``Scheduled=False`` breakdowns, the
+``karmada_tpu_unschedulable_total{reason}`` family, the generated docs
+table and graftlint GL010 can all key on.
+
+Three kinds of reason:
+
+- ``stage`` — one per decision stage of the scheduling pipeline, in
+  EXCLUSION-BIT ORDER: ``STAGE_REASONS[i]`` is the meaning of bit ``i``
+  in the packed per-binding x per-cluster exclusion mask the explain
+  kernel emits (ops/explain.py derives its bit constants from this
+  tuple, and refimpl/explain_np.py is asserted bit-identical against
+  it). Appending a stage appends a bit; NEVER reorder.
+- ``condition`` — codes written into API object conditions
+  (``Scheduled``, ``Ready``, ``Applied``...).
+- ``event`` — codes attached to evictions and other one-shot
+  transitions (graceful-eviction producers).
+
+graftlint GL010 (the GL008 span-taxonomy pattern) fails tier-1 when any
+``Condition(reason="...")`` or ``.inc(reason="...")`` literal in the
+import graph is missing here, and the docs reason table is generated
+from this registry between the ``reasontaxonomy`` markers
+(``tools/docs_from_bench.py --reasons-table`` + a drift check on every
+regen), so a code can never ship undocumented.
+
+Stdlib-only: the bus and every other lean process can import this (and
+the linter imports it live) without dragging in numpy or jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class Reason:
+    """One registered reason code. ``stage_bit`` is the exclusion-mask
+    bit position for ``kind="stage"`` reasons (None otherwise)."""
+
+    code: str
+    #: "stage" | "condition" | "event"
+    kind: str
+    description: str
+    stage_bit: Optional[int] = None
+
+
+#: THE decision-stage order — index IS the exclusion-mask bit position
+#: (ops/explain.py packs, utils/explainstore.py decodes, and the numpy
+#: oracle mirrors exactly this order). Append-only; never reorder.
+STAGE_REASONS: tuple[str, ...] = (
+    "AffinityMismatch",  # bit 0
+    "TaintUntolerated",  # bit 1
+    "ApiNotEnabled",  # bit 2
+    "NoAvailableReplicas",  # bit 3
+    "QuotaCapExceeded",  # bit 4
+    "QuotaExceeded",  # bit 5
+    "SpreadConstraintUnsatisfied",  # bit 6
+)
+
+
+def _stage(code: str, description: str) -> Reason:
+    return Reason(
+        code=code, kind="stage", description=description,
+        stage_bit=STAGE_REASONS.index(code),
+    )
+
+
+REASONS: dict[str, Reason] = {
+    r.code: r
+    for r in (
+        # -- decision stages (exclusion-mask bits, in order) ---------------
+        _stage(
+            "AffinityMismatch",
+            "cluster is outside the binding's selected ClusterAffinities "
+            "group (affinity/group-rank stage; the explain capture also "
+            "records WHICH ordered fallback group was selected)",
+        ),
+        _stage(
+            "TaintUntolerated",
+            "cluster carries an untolerated NoSchedule/NoExecute taint or "
+            "an active graceful-eviction task (already-placed leniency "
+            "composed, taint_toleration.go) — also the graceful-eviction "
+            "producer code the cluster controller stamps",
+        ),
+        _stage(
+            "ApiNotEnabled",
+            "cluster does not enable the workload's API/GVK "
+            "(api_enablement.go; already-placed leniency composed)",
+        ),
+        _stage(
+            "NoAvailableReplicas",
+            "merged estimator availability is zero for this cluster "
+            "(dynamic-weight strategies only — Duplicated never consults "
+            "availability)",
+        ),
+        _stage(
+            "QuotaCapExceeded",
+            "a FederatedResourceQuota static-assignment hard cap answers "
+            "zero replicas for this cluster (quota cluster-cap stage)",
+        ),
+        _stage(
+            "QuotaExceeded",
+            "binding denied by batched FIFO quota admission (wave-level: "
+            "the bit is set on every cluster) — also the Scheduled=False "
+            "condition code",
+        ),
+        _stage(
+            "SpreadConstraintUnsatisfied",
+            "cluster dropped by spread-constraint group selection "
+            "(select_clusters.go), or fails a spread field filter",
+        ),
+        # -- scheduling conditions (Scheduled + unschedulable taxonomy) ----
+        Reason("Success", "condition", "binding scheduled successfully"),
+        Reason(
+            "NoClusterFit", "condition",
+            "no cluster survives the filter stages for any affinity group",
+        ),
+        Reason(
+            "InsufficientReplicas", "condition",
+            "candidate clusters' summed availability cannot cover the "
+            "requested replicas (the divider's unschedulable cohort)",
+        ),
+        Reason(
+            "NoAffinityGroupFits", "condition",
+            "every ordered ClusterAffinities fallback group was tried and "
+            "none schedules",
+        ),
+        Reason(
+            "Unschedulable", "condition",
+            "binding not scheduled for an unclassified engine reason "
+            "(the residual bucket of the unschedulable taxonomy)",
+        ),
+        # -- cluster/remedy/work/operator conditions ------------------------
+        Reason("ClusterReady", "condition", "cluster reachable and healthy"),
+        Reason(
+            "ClusterNotReachable", "condition",
+            "push-mode cluster stopped answering collect",
+        ),
+        Reason(
+            "AgentLeaseRenewed", "condition",
+            "pull-mode agent lease is fresh",
+        ),
+        Reason(
+            "AgentLeaseExpired", "condition",
+            "pull-mode agent lease expired",
+        ),
+        Reason(
+            "DomainNameResolved", "condition",
+            "remedy probe: cluster ingress domain resolves",
+        ),
+        Reason(
+            "DomainNameResolutionFailed", "condition",
+            "remedy probe: cluster ingress domain resolution failed",
+        ),
+        Reason(
+            "AppliedSuccessful", "condition",
+            "work manifests applied on the member",
+        ),
+        Reason(
+            "ClusterUnreachable", "condition",
+            "work could not be dispatched: member unreachable",
+        ),
+        Reason(
+            "ResourceConflict", "condition",
+            "work apply rejected: conflicting resource on the member",
+        ),
+        Reason(
+            "SuspendDispatching", "condition",
+            "work dispatching administratively suspended",
+        ),
+        Reason(
+            "FullyAppliedSuccess", "condition",
+            "every scheduled cluster's work applied",
+        ),
+        Reason("Completed", "condition", "operator task completed"),
+        Reason("TaskFailed", "condition", "operator task failed"),
+        Reason("Removed", "condition", "operator instance removed"),
+        Reason(
+            "CrashLoopBackOff", "condition",
+            "operator-managed component restarting repeatedly",
+        ),
+        Reason(
+            "BackOff", "condition",
+            "operator-managed component down, restart pending",
+        ),
+        Reason(
+            "AllAlive", "condition",
+            "every operator-managed component process is alive",
+        ),
+        # -- eviction events -------------------------------------------------
+        Reason(
+            "ApplicationFailure", "event",
+            "graceful eviction produced by application-failure failover",
+        ),
+    )
+}
+
+assert all(
+    REASONS[c].stage_bit == i for i, c in enumerate(STAGE_REASONS)
+), "STAGE_REASONS order drifted from the registry"
+
+
+def reason_registered(code: str) -> bool:
+    return code in REASONS
+
+
+#: engine free-text errors -> reason codes (the unschedulable taxonomy).
+#: ScheduleResult.error strings are wire/compat surface (tests and the
+#: oracle match on them), so the classification maps rather than renames.
+_ERROR_REASONS: tuple[tuple[str, str], ...] = (
+    ("namespace quota exceeded", "QuotaExceeded"),
+    ("no clusters fit the placement", "NoClusterFit"),
+    ("clusters available replicas are not enough", "InsufficientReplicas"),
+    ("no affinity group fits", "NoAffinityGroupFits"),
+)
+
+
+def classify_error(error: str) -> str:
+    """Reason code for an engine ``ScheduleResult.error`` ("" answers
+    ``Success``; unknown text answers the residual ``Unschedulable``)."""
+    if not error:
+        return "Success"
+    for needle, code in _ERROR_REASONS:
+        if needle in error:
+            return code
+    return "Unschedulable"
+
+
+class TransitionDedup:
+    """Shared once-per-transition counter gate (ISSUE 13 satellite).
+
+    ``observe(key, reason, generation)`` answers True exactly when the
+    (reason, generation) pair differs from the last observation for
+    ``key`` — so a parked binding re-enqueued across passes within one
+    generation can never double-increment ``quota_denied_total`` /
+    ``unschedulable_total``, while a NEW generation (quota moved, spec
+    changed) counts again. Lock-disciplined; bounded by ``cap`` (full =
+    wholesale reset — counters over-count once rather than grow without
+    bound, the ring discipline)."""
+
+    def __init__(self, cap: int = 1 << 20):
+        self.cap = cap
+        self._lock = threading.Lock()
+        self._last: dict = {}
+
+    def observe(self, key, reason: str, generation=None) -> bool:
+        state = (reason, generation)
+        with self._lock:
+            if self._last.get(key) == state:
+                return False
+            if len(self._last) >= self.cap and key not in self._last:
+                self._last.clear()
+            self._last[key] = state
+            return True
+
+    def forget(self, key) -> None:
+        with self._lock:
+            self._last.pop(key, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._last.clear()
+
+
+def render_reasons_table() -> str:
+    """The docs/OPERATIONS.md reason-taxonomy table, generated from
+    ``REASONS`` so prose can never drift from the registry the linter
+    and the explain surface enforce (tools/docs_from_bench.py writes it
+    between the reasontaxonomy markers and fails loudly on drift)."""
+    lines = [
+        "| reason | kind | exclusion bit | meaning |",
+        "|---|---|---|---|",
+    ]
+
+    def sort_key(r: Reason):
+        return (
+            {"stage": 0, "condition": 1, "event": 2}[r.kind],
+            r.stage_bit if r.stage_bit is not None else -1,
+            r.code,
+        )
+
+    for r in sorted(REASONS.values(), key=sort_key):
+        bit = "—" if r.stage_bit is None else str(r.stage_bit)
+        lines.append(f"| `{r.code}` | {r.kind} | {bit} | {r.description} |")
+    return "\n".join(lines)

@@ -90,6 +90,10 @@ SPAN_NAMES: dict[str, str] = {
     ),
     "scheduler.host": "host-path (non-fleet) scheduling of a batch",
     "scheduler.solve": "one fleet-table solve pass",
+    "scheduler.explain": (
+        "armed-only provenance capture of a pass: per-stage mask "
+        "composition + the batched explain dispatch (ISSUE 13)"
+    ),
     "kernel.host": "kernel host phases: pack/upsert/sync/decode",
     "kernel.dispatch": (
         "kernel dispatch window (sync backends execute inside it; "
@@ -1398,6 +1402,19 @@ def maybe_flight_record(tr: WaveTracer, wave: int) -> Optional[str]:
         # — `trace analyze` renders breach-vs-recent-baseline offline
         "history": tr.history.breach_context(wave),
     }
+    # ISSUE 13: the K worst (denied/unschedulable/displaced) bindings'
+    # explanations, when the explain plane captured this wave — `trace
+    # analyze` answers "why" offline. Lazy import: the store is
+    # numpy-backed and most waves never arm it.
+    try:
+        from .explainstore import store as _explain_store
+
+        explain_ctx = _explain_store().worst_context(wave)
+        if explain_ctx is not None:
+            record["explain"] = explain_ctx
+    except Exception:  # noqa: BLE001 — provenance is attachment, not
+        # the record; a broken capture never blocks the flight write
+        pass
     return _flight_append(record)
 
 
@@ -1455,6 +1472,13 @@ def analyze_record(record: dict) -> dict:
         from .history import render_breach_table
 
         table += "\n" + render_breach_table(hist)
+    # ISSUE 13: a record carrying worst-binding explanations renders
+    # the "why" block too — the offline form of /debug/explain
+    expl = record.get("explain")
+    if expl and expl.get("worst"):
+        from .explainstore import render_worst_table
+
+        table += "\n" + render_worst_table(expl)
     # purity check tolerant of OLDER records: summary keys this build
     # added (coverage_degraded/dropped) are ignored when the recorded
     # summary predates them — a pre-upgrade flight record must still
@@ -1473,6 +1497,7 @@ def analyze_record(record: dict) -> dict:
         "metrics_delta": record.get("metrics_delta", {}),
         "fault_events": record.get("fault_events", []),
         "history": hist,
+        "explain": record.get("explain"),
         "table": table,
     }
 

@@ -1,0 +1,21 @@
+"""GL010 bad fixture: unregistered reason codes at emission sites."""
+
+
+class Condition:
+    def __init__(self, type="", status=True, reason="", message=""):
+        self.reason = reason
+
+
+class _Counter:
+    def inc(self, n=1, **labels):
+        return labels
+
+
+unschedulable_total = _Counter()
+
+
+def emit():
+    # BAD: Condition reason literal absent from utils.reasons REASONS
+    Condition(type="Scheduled", status=False, reason="RogueReason")
+    # BAD: metric reason label absent from the taxonomy
+    unschedulable_total.inc(reason="AnotherRogue")

@@ -1,0 +1,36 @@
+"""GL010 good fixture: registered codes, dynamic reasons, non-reason
+keywords and non-emission constructors stay silent."""
+
+import threading
+
+
+class Condition:
+    def __init__(self, type="", status=True, reason="", message=""):
+        self.reason = reason
+
+
+class _Counter:
+    def inc(self, n=1, **labels):
+        return labels
+
+
+class ManifestResult:
+    def __init__(self, index=0, kernel="", reason="ok"):
+        self.reason = reason
+
+
+unschedulable_total = _Counter()
+
+
+def emit(ready: bool):
+    # registered codes
+    Condition(type="Scheduled", status=True, reason="Success")
+    Condition(type="Scheduled", status=False, reason="QuotaExceeded")
+    unschedulable_total.inc(reason="NoClusterFit")
+    # dynamic reason: out of static reach, unchecked (the GL008 rule)
+    reason = "ClusterReady" if ready else "ClusterNotReachable"
+    Condition(type="Ready", status=ready, reason=reason)
+    # a reason kwarg on a NON-emission constructor is not an emission
+    ManifestResult(index=1, kernel="k", reason="unreadable")
+    # threading.Condition takes no reason and must not be confused
+    threading.Condition(threading.Lock())

@@ -1,0 +1,746 @@
+"""Placement provenance plane (ISSUE 13): kernel-vs-oracle identity,
+stage-bit semantics under quota and chaos, the ExplainStore ring, the
+/debug/explain + CLI surfaces, the unschedulable-reason taxonomy with
+transition dedup, and the flight-record "why" attachment."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from karmada_tpu.api.cluster import NO_EXECUTE, Taint
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.api.policy import (
+    ClusterAffinityTerm,
+    FederatedResourceQuota,
+    FederatedResourceQuotaSpec,
+    LabelSelector,
+    StaticClusterAssignment,
+)
+from karmada_tpu.ops.explain import (
+    N_STAGES,
+    TOPK_COLS,
+    explain_pass,
+    topk_width,
+)
+from karmada_tpu.parallel.mesh import scheduling_mesh
+from karmada_tpu.refimpl.explain_np import explain_batch_np
+from karmada_tpu.scheduler import (
+    QUOTA_EXCEEDED_ERROR,
+    BindingProblem,
+    ClusterSnapshot,
+    TensorScheduler,
+    build_quota_snapshot,
+)
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    new_cluster,
+)
+from karmada_tpu.utils.explainstore import (
+    ExplainCapture,
+    ExplainStore,
+    render_explanation,
+    render_worst_table,
+)
+from karmada_tpu.utils.quantity import parse_resource_list
+from karmada_tpu.utils.reasons import (
+    REASONS,
+    STAGE_REASONS,
+    TransitionDedup,
+    classify_error,
+)
+
+CPU_REQ = parse_resource_list({"cpu": "1"})
+
+
+def group_term(group: str) -> ClusterAffinityTerm:
+    return ClusterAffinityTerm(
+        affinity_name=f"grp-{group}",
+        label_selector=LabelSelector(match_labels={"group": group}),
+    )
+
+
+def random_inputs(rng, b, c):
+    return dict(
+        aff_ok=rng.random((b, c)) < 0.8,
+        taint_ok=rng.random((b, c)) < 0.9,
+        api_ok=rng.random((b, c)) < 0.95,
+        spread_ok=rng.random((b, c)) < 0.85,
+        avail=rng.integers(-1, 60, (b, c)).astype(np.int32),
+        caps=np.where(
+            rng.random((b, c)) < 0.2,
+            rng.integers(0, 4, (b, c)),
+            2**31 - 1,
+        ).astype(np.int32),
+        admitted=rng.random(b) < 0.8,
+        dynamic=rng.random(b) < 0.7,
+        replicas=rng.integers(0, 12, b).astype(np.int32),
+        assignment=rng.integers(0, 6, (b, c)).astype(np.int32),
+        prev=rng.integers(0, 6, (b, c)).astype(np.int32),
+    )
+
+
+# --------------------------------------------------------------------------
+# kernel vs the shared-free numpy oracle
+# --------------------------------------------------------------------------
+
+
+class TestKernelOracleIdentity:
+    def test_bit_layout_matches_taxonomy(self):
+        assert N_STAGES == len(STAGE_REASONS) <= 8
+        assert TOPK_COLS == 5
+        for code in STAGE_REASONS:
+            assert REASONS[code].kind == "stage"
+
+    def test_randomized_grid_bit_identical(self):
+        """Random shapes (incl. padded-tail-shaped odd sizes) across the
+        bucket grid: the vectorized kernel and the per-binding reference
+        loop must agree bit for bit on masks AND top-k summaries."""
+        rng = np.random.default_rng(42)
+        for _ in range(12):
+            b = int(rng.integers(1, 48))
+            c = int(rng.integers(2, 48))
+            k = topk_width(c)
+            args = random_inputs(rng, b, c)
+            m_dev, t_dev = explain_pass(*args.values(), k=k)
+            m_np, t_np = explain_batch_np(*args.values(), k=k)
+            assert np.array_equal(np.asarray(m_dev), m_np)
+            assert np.array_equal(np.asarray(t_dev), t_np)
+
+    @pytest.mark.parametrize("devices", (1, 2, 4, 8))
+    def test_mesh_identity(self, devices):
+        """The sharded dispatch (mesh 1/2/4/8 over the conftest virtual
+        devices) answers bit-identical masks/top-k to the oracle and the
+        single-device form — padded tails included (b=24 does not divide
+        8 evenly per shard boundary alignment, b=32 does)."""
+        rng = np.random.default_rng(devices)
+        mesh = scheduling_mesh(devices)
+        for b in (8, 32):
+            c = 16
+            k = topk_width(c)
+            args = random_inputs(rng, b, c)
+            m_np, t_np = explain_batch_np(*args.values(), k=k)
+            m_dev, t_dev = explain_pass(
+                *args.values(), k=k, mesh=mesh, shard_c=False
+            )
+            assert np.array_equal(np.asarray(m_dev), m_np)
+            assert np.array_equal(np.asarray(t_dev), t_np)
+
+    def test_topk_order_and_mask_column(self):
+        """Deterministic ordering: assigned desc, then avail desc, then
+        index asc; the 5th column is the candidate's own mask byte."""
+        aff = np.ones((1, 4), bool)
+        args = dict(
+            aff_ok=aff, taint_ok=aff.copy(), api_ok=aff.copy(),
+            spread_ok=np.array([[True, True, False, True]]),
+            avail=np.array([[5, 9, 9, 0]], np.int32),
+            caps=np.full((1, 4), 2**31 - 1, np.int32),
+            admitted=np.array([True]),
+            dynamic=np.array([True]),
+            replicas=np.array([3], np.int32),
+            assignment=np.array([[0, 3, 0, 0]], np.int32),
+            prev=np.zeros((1, 4), np.int32),
+        )
+        _m, topk = explain_pass(*args.values(), k=4)
+        topk = np.asarray(topk)[0]
+        # assigned row first, then avail 9 (idx 2), avail 5 (idx 0), 0
+        assert topk[:, 0].tolist() == [1, 2, 0, 3]
+        spread_bit = 1 << STAGE_REASONS.index("SpreadConstraintUnsatisfied")
+        avail_bit = 1 << STAGE_REASONS.index("NoAvailableReplicas")
+        assert topk[1, 4] == spread_bit  # idx 2 excluded by spread
+        assert topk[3, 4] == avail_bit  # idx 3 has zero availability
+
+
+# --------------------------------------------------------------------------
+# the ExplainStore ring
+# --------------------------------------------------------------------------
+
+
+def toy_capture(wave, keys=("ns/a",), error="", rank=0):
+    b = len(keys)
+    return ExplainCapture(
+        wave=wave,
+        names=("c0", "c1"),
+        keys=list(keys),
+        masks=np.zeros((b, 2), np.uint8),
+        topk=np.zeros((b, 2, TOPK_COLS), np.int32),
+        group_rank=np.full(b, rank, np.int32),
+        errors=[error] * b,
+        assignment=np.zeros((b, 2), np.int32),
+    )
+
+
+class TestExplainStore:
+    def test_wave_ring_evicts_whole_waves_counted(self):
+        store = ExplainStore(cap=2)
+        for wave in (1, 1, 2, 3):  # wave 1 has TWO captures (two chunks)
+            store.add(toy_capture(wave))
+        assert store.evicted == 2  # both wave-1 chunks left together
+        assert sorted({c.wave for c in store.captures()}) == [2, 3]
+        store.clear()
+        assert store.captures() == [] and store.evicted == 0
+
+    def test_zero_cap_disables(self):
+        store = ExplainStore(cap=0)
+        store.add(toy_capture(1))
+        assert not store.enabled and store.captures() == []
+
+    def test_binding_lookup_newest_wins_and_wave_pin(self):
+        store = ExplainStore(cap=4)
+        store.add(toy_capture(1, keys=("ns/a",), error="old"))
+        store.add(toy_capture(2, keys=("ns/a",), error=""))
+        assert store.explain_binding("ns/a")["wave"] == 2
+        assert store.explain_binding("ns/a", wave=1)["error"] == "old"
+        assert store.explain_binding("ns/zzz") is None
+
+    def test_worst_orders_denied_before_displaced(self):
+        store = ExplainStore(cap=4)
+        store.add(toy_capture(5, keys=("ns/ok",), error=""))
+        store.add(toy_capture(5, keys=("ns/displaced",), rank=1))
+        store.add(
+            toy_capture(5, keys=("ns/denied",), error=QUOTA_EXCEEDED_ERROR)
+        )
+        worst = store.worst(5, k=8)
+        assert [w["binding"] for w in worst] == [
+            "ns/denied", "ns/displaced",
+        ]
+        ctx = store.worst_context(5)
+        assert ctx["summary"]["wave"] == 5
+        table = render_worst_table(ctx)
+        assert "ns/denied" in table and "QuotaExceeded" in table
+
+    def test_worst_newest_capture_wins_over_stale_denial(self):
+        """A binding denied in an early pass but SCHEDULED by a later
+        pass of the same wave must not surface its stale denial — the
+        newest capture wins the key unconditionally."""
+        store = ExplainStore(cap=4)
+        store.add(
+            toy_capture(7, keys=("ns/b",), error=QUOTA_EXCEEDED_ERROR)
+        )
+        store.add(toy_capture(7, keys=("ns/b",), error=""))
+        assert store.worst(7) == []
+
+    def test_decode_assignment_complete_beyond_topk(self):
+        """The decoded assignment comes from the sparse full-assignment
+        store, never the top-k slice: a wide placement assigned on more
+        clusters than k reports them all."""
+        clusters = [
+            new_cluster(f"m{i:02d}", cpu="1000", memory="2000Gi")
+            for i in range(12)
+        ]
+        eng, store = make_engine(clusters)
+        from karmada_tpu.utils.builders import duplicated_placement
+
+        res = eng.schedule([
+            problem("d/wide", replicas=2, placement=duplicated_placement())
+        ])
+        assert len(res[0].clusters) == 12
+        doc = store.explain_binding("d/wide")
+        assert doc["assignment"] == res[0].clusters
+        assert len(doc["candidates"]) == 8  # the summary stays top-k
+
+    def test_debug_doc_shapes(self):
+        store = ExplainStore(cap=4)
+        store.add(toy_capture(3))
+        doc = store.debug_doc(proc="plane")
+        assert doc["waves"] == [3] and "summary" in doc and "worst" in doc
+        doc_b = store.debug_doc(binding="ns/a")
+        assert doc_b["binding"]["binding"] == "ns/a"
+        json.dumps(doc)  # the HTTP surface serializes this verbatim
+        json.dumps(doc_b)
+
+
+# --------------------------------------------------------------------------
+# engine captures: explain-under-quota and explain-under-chaos
+# --------------------------------------------------------------------------
+
+
+def make_engine(clusters, quota=None):
+    snap = ClusterSnapshot(clusters)
+    eng = TensorScheduler(snap, trace_manifest="")
+    store = ExplainStore(cap=8)
+    eng.set_explain(store)
+    if quota is not None:
+        eng.set_quota(build_quota_snapshot([quota], snap, generation=1))
+    return eng, store
+
+
+def frq(ns, overall, static=()):
+    return FederatedResourceQuota(
+        meta=ObjectMeta(name="q", namespace=ns),
+        spec=FederatedResourceQuotaSpec(
+            overall=dict(overall), static_assignments=list(static)
+        ),
+    )
+
+
+def problem(key, ns="", replicas=2, placement=None, prev=None, evict=()):
+    return BindingProblem(
+        key=key,
+        placement=placement or dynamic_weight_placement(),
+        replicas=replicas,
+        requests=CPU_REQ,
+        gvk="apps/v1/Deployment",
+        prev=dict(prev or {}),
+        evict_clusters=tuple(evict),
+        namespace=ns,
+    )
+
+
+class TestEngineCaptureStageBits:
+    def test_admission_denial_carries_exactly_its_bit(self):
+        """A binding denied by batched FIFO admission explains with the
+        QuotaExceeded stage bit on EVERY cluster and nothing else (the
+        clusters themselves were feasible)."""
+        clusters = [
+            new_cluster(f"m{i}", cpu="1000", memory="2000Gi")
+            for i in range(4)
+        ]
+        eng, store = make_engine(clusters, quota=frq("a", {"cpu": 0}))
+        res = eng.schedule([problem("a/b0", ns="a")])
+        assert res[0].error == QUOTA_EXCEEDED_ERROR
+        doc = store.explain_binding("a/b0")
+        assert doc["reason"] == "QuotaExceeded"
+        assert set(doc["stages"]) == {"QuotaExceeded"}
+        assert doc["stages"]["QuotaExceeded"]["count"] == 4
+        assert doc["clusters_feasible"] == 0
+
+    def test_static_cap_carries_cap_bit_not_admission(self):
+        """A cluster capped to zero by a static assignment explains with
+        QuotaCapExceeded on THAT cluster; the binding still admits."""
+        clusters = [
+            new_cluster(f"m{i}", cpu="1000", memory="2000Gi")
+            for i in range(3)
+        ]
+        q = frq(
+            "a", {"cpu": 100000},
+            static=[StaticClusterAssignment(
+                cluster_name="m0", hard={"cpu": 0}
+            )],
+        )
+        eng, store = make_engine(clusters, quota=q)
+        res = eng.schedule([problem("a/b0", ns="a", replicas=4)])
+        assert res[0].success and "m0" not in res[0].clusters
+        doc = store.explain_binding("a/b0")
+        assert set(doc["stages"]) == {"QuotaCapExceeded"}
+        assert doc["stages"]["QuotaCapExceeded"]["clusters"] == ["m0"]
+
+    def test_noexecute_taint_and_eviction_carry_taint_bit(self):
+        """An untolerated NoExecute taint — and an active graceful
+        eviction — both explain as the taints/NoExecute stage, exactly
+        that bit on exactly those clusters."""
+        clusters = [
+            new_cluster("m0", cpu="1000", memory="2000Gi",
+                        taints=[Taint(key="down", effect=NO_EXECUTE)]),
+            new_cluster("m1", cpu="1000", memory="2000Gi"),
+            new_cluster("m2", cpu="1000", memory="2000Gi"),
+        ]
+        eng, store = make_engine(clusters)
+        res = eng.schedule([
+            problem("d/tainted"),
+            problem("d/evicted", evict=["m1"]),
+        ])
+        assert all(r.success for r in res)
+        tainted = store.explain_binding("d/tainted")
+        assert set(tainted["stages"]) == {"TaintUntolerated"}
+        assert tainted["stages"]["TaintUntolerated"]["clusters"] == ["m0"]
+        evicted = store.explain_binding("d/evicted")
+        # m0 by its taint, m1 by the NoExecute eviction task
+        assert set(evicted["stages"]) == {"TaintUntolerated"}
+        assert evicted["stages"]["TaintUntolerated"]["clusters"] == [
+            "m0", "m1",
+        ]
+        assert "m1" not in res[1].clusters
+
+    def test_failover_displacement_explains_group_rank(self):
+        """A PR 7-style failover wave: the primary affinity group's
+        clusters are evicted, the binding reschedules onto the fallback
+        group — the capture records group_rank 1 and the primary
+        clusters excluded by AffinityMismatch (of the SELECTED group's
+        view) + TaintUntolerated (the evictions)."""
+        clusters = [
+            new_cluster(f"p{i}", cpu="1000", memory="2000Gi",
+                        labels={"group": "primary"})
+            for i in range(2)
+        ] + [
+            new_cluster(f"f{i}", cpu="1000", memory="2000Gi",
+                        labels={"group": "fallback"})
+            for i in range(2)
+        ]
+        pl = dynamic_weight_placement(
+            cluster_affinities=[
+                group_term("primary"), group_term("fallback"),
+            ]
+        )
+        eng, store = make_engine(clusters)
+        res = eng.schedule([
+            problem(
+                "d/displaced", replicas=4, placement=pl,
+                prev={"p0": 2, "p1": 2}, evict=["p0", "p1"],
+            ),
+        ])
+        assert res[0].success
+        assert set(res[0].clusters) <= {"f0", "f1"}
+        doc = store.explain_binding("d/displaced")
+        assert doc["group_rank"] == 1
+        assert set(doc["stages"]["AffinityMismatch"]["clusters"]) == {
+            "p0", "p1",
+        }
+        assert set(doc["stages"]["TaintUntolerated"]["clusters"]) == {
+            "p0", "p1",
+        }
+
+    def test_cap_zeroed_primary_group_rank_matches_solve(self):
+        """A static-assignment cap that zeroes the primary affinity
+        group's clusters displaces the binding onto the fallback group
+        — the capture's group selection must consume the SAME cap-folded
+        availability the ranked solve ranks on, so group_rank names the
+        group that actually placed."""
+        clusters = [
+            new_cluster(f"p{i}", cpu="1000", memory="2000Gi",
+                        labels={"group": "primary"})
+            for i in range(2)
+        ] + [
+            new_cluster(f"f{i}", cpu="1000", memory="2000Gi",
+                        labels={"group": "fallback"})
+            for i in range(2)
+        ]
+        q = frq(
+            "a", {"cpu": 100000},
+            static=[
+                StaticClusterAssignment(cluster_name="p0", hard={"cpu": 0}),
+                StaticClusterAssignment(cluster_name="p1", hard={"cpu": 0}),
+            ],
+        )
+        pl = dynamic_weight_placement(
+            cluster_affinities=[
+                group_term("primary"), group_term("fallback"),
+            ]
+        )
+        eng, store = make_engine(clusters, quota=q)
+        res = eng.schedule(
+            [problem("a/capped", ns="a", replicas=4, placement=pl)]
+        )
+        assert res[0].success and set(res[0].clusters) <= {"f0", "f1"}
+        doc = store.explain_binding("a/capped")
+        assert doc["group_rank"] == 1
+        assert doc["assignment"] == res[0].clusters
+
+    def test_cap_zero_ring_skips_the_dispatch(self):
+        """KARMADA_TPU_EXPLAIN_CAP=0 disables the store; an armed engine
+        must not pay the capture dispatch for a ring that drops
+        everything."""
+        clusters = [new_cluster("m0", cpu="1000", memory="2000Gi")]
+        eng, _store = make_engine(clusters)
+        dead = ExplainStore(cap=0)
+        eng.set_explain(dead)
+        eng.schedule([problem("d/x")])
+        assert dead.captures() == []
+        assert not any(k[0] == "E" for k in eng._engine_traces), (
+            "explain kernel dispatched for a disabled ring"
+        )
+
+    def test_stage_masks_compose_to_pack_chunk_feasibility(self):
+        """Drift guard for the duplicated packing algebra: AND-folding
+        the capture's FILTER-stage bits (affinity/taint/API/spread — the
+        stages _pack_chunk composes into `feasible`) must reproduce
+        _pack_chunk's output bit for bit over a batch exercising taints,
+        evictions, already-placed leniency, unknown GVKs and incomplete
+        enablements."""
+        clusters = [
+            new_cluster("m0", cpu="1000", memory="2000Gi"),
+            new_cluster("m1", cpu="1000", memory="2000Gi",
+                        taints=[Taint(key="t", effect=NO_EXECUTE)]),
+            new_cluster("m2", cpu="1000", memory="2000Gi",
+                        api_enablements=(), complete_enablements=True),
+            new_cluster("m3", cpu="1000", memory="2000Gi",
+                        complete_enablements=False),
+        ]
+        eng, store = make_engine(clusters)
+        probs = [
+            problem("d/plain"),
+            problem("d/lenient", prev={"m1": 1, "m2": 1, "m3": 1}),
+            problem("d/evicted", evict=["m0"]),
+            BindingProblem(
+                key="d/unknown-gvk",
+                placement=dynamic_weight_placement(),
+                replicas=2, requests=CPU_REQ, gvk="weird/v9/Thing",
+            ),
+        ]
+        eng.schedule(probs)
+        cap = store.captures()[-1]
+        compiled = [eng._compiled(p.placement) for p in probs]
+        feasible, *_rest = eng._pack_chunk(probs, compiled, 0)
+        filter_bits = np.uint8(0)
+        for code in (
+            "AffinityMismatch", "TaintUntolerated", "ApiNotEnabled",
+            "SpreadConstraintUnsatisfied",
+        ):
+            filter_bits |= np.uint8(1 << STAGE_REASONS.index(code))
+        masks = cap.uniq_masks[cap.mask_inv]
+        assert np.array_equal((masks & filter_bits) == 0, feasible)
+
+    def test_disarmed_engine_captures_nothing(self):
+        clusters = [new_cluster("m0", cpu="1000", memory="2000Gi")]
+        eng, store = make_engine(clusters)
+        eng.set_explain(None)
+        eng.schedule([problem("d/x")])
+        assert store.captures() == []
+
+    def test_capture_survives_batch_identity_replay(self):
+        """The replay fast path returns cached results; an armed engine
+        still captures the pass (provenance is per PASS, not per fresh
+        solve)."""
+        clusters = [
+            new_cluster(f"m{i}", cpu="1000", memory="2000Gi")
+            for i in range(3)
+        ]
+        eng, store = make_engine(clusters)
+        probs = [problem(f"d/b{i}") for i in range(4)]
+        eng.schedule(probs)
+        n1 = len(store.captures())
+        eng.schedule(probs)  # identity replay
+        assert len(store.captures()) == 2 * n1
+
+    def test_explain_trace_recorded_in_manifest(self, tmp_path):
+        manifest = str(tmp_path / "manifest.json")
+        clusters = [new_cluster("m0", cpu="1000", memory="2000Gi")]
+        snap = ClusterSnapshot(clusters)
+        eng = TensorScheduler(snap, trace_manifest=manifest)
+        eng.set_explain(ExplainStore(cap=4))
+        eng.schedule([problem("d/x")])
+        data = json.loads(open(manifest).read())
+        kernels = {r["kernel"] for r in data["records"]}
+        assert "explain_pass" in kernels
+        from karmada_tpu.scheduler.prewarm import TraceManifest, replay
+
+        stats = replay(TraceManifest(manifest), expand=False)
+        assert stats["compiled"] >= 1 and stats["failed"] == 0
+
+
+# --------------------------------------------------------------------------
+# reasons taxonomy + transition dedup
+# --------------------------------------------------------------------------
+
+
+class TestTransitionDedup:
+    def test_once_per_reason_generation(self):
+        d = TransitionDedup()
+        assert d.observe("k", "QuotaExceeded", 1)
+        assert not d.observe("k", "QuotaExceeded", 1)  # re-enqueue
+        assert d.observe("k", "QuotaExceeded", 2)  # new generation
+        assert d.observe("k", "NoClusterFit", 2)  # reason changed
+        d.forget("k")
+        assert d.observe("k", "NoClusterFit", 2)  # transition via forget
+
+    def test_cap_resets_wholesale(self):
+        d = TransitionDedup(cap=2)
+        assert d.observe("a", "X", 1) and d.observe("b", "X", 1)
+        assert d.observe("c", "X", 1)  # full: reset, then record
+        assert d.observe("a", "X", 1)  # over-counts once, never grows
+
+    def test_classifier_total(self):
+        assert classify_error("") == "Success"
+        assert classify_error("weird new failure") == "Unschedulable"
+
+
+class TestControllerReasonCounters:
+    def test_quota_denial_counts_once_per_generation(self):
+        from karmada_tpu import cli as _cli
+        from karmada_tpu.api import (
+            PropagationPolicy,
+            PropagationSpec,
+            ResourceSelector,
+        )
+        from karmada_tpu.utils.builders import new_deployment
+        from karmada_tpu.utils.metrics import unschedulable_total
+
+        base = unschedulable_total.value(reason="QuotaExceeded")
+        cp = _cli.cmd_init()
+        cp.join_cluster(new_cluster("m0", cpu="1000", memory="2000Gi"))
+        cp.settle()
+        cp.store.apply(PropagationPolicy(
+            meta=ObjectMeta(name="p", namespace="teamq"),
+            spec=PropagationSpec(
+                resource_selectors=[
+                    ResourceSelector(
+                        api_version="apps/v1", kind="Deployment"
+                    )
+                ],
+                placement=dynamic_weight_placement(),
+            ),
+        ))
+        cp.store.apply(frq("teamq", {"cpu": 0}))
+        cp.store.apply(
+            new_deployment("denied", namespace="teamq", replicas=2)
+        )
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "teamq/denied-deployment")
+        cond = [c for c in rb.status.conditions if c.type == "Scheduled"]
+        assert cond and cond[0].status is False
+        assert cond[0].reason == "QuotaExceeded"
+        after_first = unschedulable_total.value(reason="QuotaExceeded")
+        assert after_first == base + 1
+        # re-enqueue within the same binding generation: parked, no count
+        cp.scheduler.worker.enqueue(
+            ("ResourceBinding", "teamq/denied-deployment")
+        )
+        cp.settle()
+        assert unschedulable_total.value(
+            reason="QuotaExceeded"
+        ) == after_first
+        # a quota EVENT that re-denies the UNCHANGED binding is the same
+        # ongoing denial — still one count
+        cp.store.apply(frq("teamq", {"cpu": 0}))
+        cp.settle()
+        assert unschedulable_total.value(
+            reason="QuotaExceeded"
+        ) == after_first
+        # the binding's own spec changing (scale) is a new generation:
+        # a re-denial then counts again
+        cp.store.apply(
+            new_deployment("denied", namespace="teamq", replicas=3)
+        )
+        cp.settle()
+        assert unschedulable_total.value(
+            reason="QuotaExceeded"
+        ) == after_first + 1
+
+
+# --------------------------------------------------------------------------
+# surfaces: /debug/explain, the CLI verb, top columns, flight records
+# --------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def _armed_engine_with_denial(self):
+        clusters = [
+            new_cluster(f"m{i}", cpu="1000", memory="2000Gi")
+            for i in range(2)
+        ]
+        eng, store = make_engine(clusters, quota=frq("a", {"cpu": 0}))
+        eng.schedule([problem("a/denied", ns="a"), problem("d/ok")])
+        return eng, store
+
+    def test_debug_explain_endpoint(self, monkeypatch):
+        from karmada_tpu.utils import explainstore as expl
+        from karmada_tpu.utils.metrics import MetricsServer
+
+        _eng, store = self._armed_engine_with_denial()
+        monkeypatch.setattr(expl, "_STORE", store)
+        srv = MetricsServer()
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/explain"
+                "?binding=a/denied",
+                timeout=5,
+            ) as resp:
+                doc = json.loads(resp.read().decode())
+            assert doc["binding"]["reason"] == "QuotaExceeded"
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/explain", timeout=5
+            ) as resp:
+                summary = json.loads(resp.read().decode())
+            assert summary["summary"]["verdicts"]["QuotaExceeded"] == 1
+            assert summary["worst"][0]["binding"] == "a/denied"
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/debug/explain?wave=zap",
+                    timeout=5,
+                )
+            assert err.value.code == 400
+        finally:
+            srv.stop()
+
+    def test_cli_explain_placement_and_render(self, monkeypatch):
+        from karmada_tpu import cli as _cli
+        from karmada_tpu.utils import explainstore as expl
+
+        _eng, store = self._armed_engine_with_denial()
+        monkeypatch.setattr(expl, "_STORE", store)
+        doc = _cli.cmd_explain_placement("a/denied")
+        text = render_explanation(doc["binding"])
+        assert "QuotaExceeded" in text and "candidate" in text
+        # the field-docs form keeps working through main()
+        rc = _cli.main(["explain", "PropagationPolicy.spec"])
+        assert rc == 0
+
+    def test_flight_record_carries_worst_explanations(
+        self, tmp_path, monkeypatch
+    ):
+        from karmada_tpu.utils import explainstore as expl
+        from karmada_tpu.utils.tracing import (
+            WaveTracer,
+            analyze_record,
+            load_flight_records,
+        )
+        from karmada_tpu.utils import tracing as trc
+
+        monkeypatch.setenv("KARMADA_TPU_TRACE_SLO_SECONDS", "0.00001")
+        monkeypatch.setenv("KARMADA_TPU_FLIGHT_DIR", str(tmp_path))
+        tracer_obj = WaveTracer()
+        monkeypatch.setattr(trc, "tracer", tracer_obj)
+        clusters = [
+            new_cluster(f"m{i}", cpu="1000", memory="2000Gi")
+            for i in range(2)
+        ]
+        eng, store = make_engine(clusters, quota=frq("a", {"cpu": 0}))
+        monkeypatch.setattr(expl, "_STORE", store)
+        wave = tracer_obj.begin_wave("test")
+        with tracer_obj.span("scheduler.pass"):
+            eng.schedule([problem("a/denied", ns="a")])
+        closed = tracer_obj.end_wave()
+        assert closed == wave
+        records = load_flight_records(str(tmp_path / "flight.jsonl"))
+        rec = records[-1]
+        assert rec["wave"] == wave
+        worst = rec["explain"]["worst"]
+        assert worst[0]["binding"] == "a/denied"
+        assert worst[0]["reason"] == "QuotaExceeded"
+        analysis = analyze_record(rec)
+        assert analysis["identical"]
+        assert "explain: wave" in analysis["table"]
+        assert "a/denied" in analysis["table"]
+
+    def test_top_json_carries_device_bytes_and_unschedulable(self):
+        from karmada_tpu import cli as _cli
+        from karmada_tpu.utils.metrics import (
+            MetricsServer,
+            device_bytes,
+            unschedulable_total,
+        )
+
+        device_bytes.set(
+            1234567, kind="packed_grid", bucket="t", platform="cpu"
+        )
+        unschedulable_total.inc(reason="NoClusterFit")
+        srv = MetricsServer()
+        srv.start()
+        try:
+            doc = _cli.cmd_plane_top(metrics=f"127.0.0.1:{srv.port}")
+            entry = next(iter(doc["procs"].values()))
+            assert entry["device_bytes"] >= 1234567
+            assert entry["unschedulable_total"] >= 1
+            assert "NoClusterFit" in entry["unschedulable_by_reason"]
+            text = _cli.render_top(doc)
+            assert "unsched/denied" in text
+        finally:
+            srv.stop()
+
+    def test_history_row_samples_unschedulable(self):
+        from karmada_tpu.utils.history import WaveHistory
+        from karmada_tpu.utils.metrics import unschedulable_total
+        from karmada_tpu.utils.tracing import WaveTracer
+
+        tr = WaveTracer()
+        hist = WaveHistory(cap=8)
+        tr.begin_wave("t")
+        row0 = hist.sample(tr, tr.current_wave)  # seeds the baseline
+        unschedulable_total.inc(reason="InsufficientReplicas")
+        row = hist.sample(tr, tr.current_wave)
+        assert row0["unschedulable"] == 0
+        assert row["unschedulable"] == 1

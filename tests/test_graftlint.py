@@ -40,6 +40,7 @@ FIXTURE_ROLES = {
     "GL007": set(),
     "GL008": set(),
     "GL009": set(),
+    "GL010": set(),
 }
 
 
@@ -216,6 +217,59 @@ def test_gl008_taxonomy_covers_live_names():
     table = render_span_table()
     for name in SPAN_NAMES:
         assert f"`{name}`" in table
+
+
+def test_gl010_catches_each_pattern():
+    findings = lint_fixture("gl010_bad.py", FIXTURE_ROLES["GL010"])
+    details = {f.detail for f in findings}
+    assert "RogueReason" in details, (
+        "unregistered Condition reason literal not flagged"
+    )
+    assert "AnotherRogue" in details, (
+        "unregistered .inc(reason=...) label not flagged"
+    )
+
+
+def test_gl010_live_registry_resolves():
+    """The live taxonomy is GL010's ground truth: the stage order must
+    match the kernel's bit layout, every known emission constant must be
+    registered, and the classifier answers registered codes only."""
+    from karmada_tpu.api.work import (
+        EVICTION_REASON_APPLICATION_FAILURE,
+        EVICTION_REASON_TAINT_UNTOLERATED,
+    )
+    from karmada_tpu.scheduler.quota import QUOTA_EXCEEDED_REASON
+    from karmada_tpu.utils.reasons import (
+        REASONS,
+        STAGE_REASONS,
+        classify_error,
+        reason_registered,
+        render_reasons_table,
+    )
+
+    for i, code in enumerate(STAGE_REASONS):
+        assert REASONS[code].stage_bit == i
+        assert REASONS[code].kind == "stage"
+    for const in (
+        QUOTA_EXCEEDED_REASON,
+        EVICTION_REASON_TAINT_UNTOLERATED,
+        EVICTION_REASON_APPLICATION_FAILURE,
+    ):
+        assert reason_registered(const), const
+    for err, code in (
+        ("", "Success"),
+        ("namespace quota exceeded", "QuotaExceeded"),
+        ("no clusters fit the placement", "NoClusterFit"),
+        ("clusters available replicas are not enough",
+         "InsufficientReplicas"),
+        ("no affinity group fits", "NoAffinityGroupFits"),
+        ("something else entirely", "Unschedulable"),
+    ):
+        assert classify_error(err) == code
+        assert reason_registered(classify_error(err))
+    table = render_reasons_table()
+    for code in REASONS:
+        assert f"`{code}`" in table
 
 
 def test_gl003_resolves_constant_keys():

@@ -79,6 +79,11 @@ SPECS: dict[str, tuple[GuardMetric, ...]] = {
         ),
         GuardMetric("stitched_bindings_s", "higher", 3.0, required=False),
         GuardMetric("bus_unary_vs_batched", "higher", 3.0, required=False),
+        # ISSUE 13: armed-vs-disarmed explain overhead ratio — a value
+        # of 1.0 means free; the band allows shared-rig swing but fires
+        # if provenance capture ever becomes a structural storm cost.
+        # required=False: the tier exists only from BENCH_OBS_r04 on.
+        GuardMetric("explain_overhead_x", "lower", 2.0, required=False),
     ),
     "p50_engine_schedule": (
         GuardMetric("value", "lower", 2.0),

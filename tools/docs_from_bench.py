@@ -243,6 +243,33 @@ def obs_block(od: dict) -> str:
             f"{od.get('flight_reasons', [])}, `trace analyze` re-derives "
             f"identically={od.get('flight_analyze_identical')} |",
         ]
+    # ISSUE 13: the provenance-plane rows — armed-vs-disarmed storm
+    # overhead (benchguard-guarded), capture sizes, and the live
+    # denied-binding + flight-record "why" proofs
+    if od.get("explain_overhead_x") is not None:
+        resolved = {True: "resolved", False: "UNRESOLVED"}[
+            bool(od.get("explain_resolved"))
+        ]
+        flight = {True: "identical", False: "DIVERGED", None: "n/a"}[
+            od.get("explain_flight_identical")
+        ]
+        rows += [
+            f"| explain {scale}: armed vs disarmed storm wave | "
+            f"{fmt(od.get('explain_armed_wave_s'))} armed vs "
+            f"{fmt(od.get('explain_disarmed_wave_s'))} disarmed — "
+            f"{od.get('explain_overhead_x', 0):.3f}x (within the "
+            f"benchguard noise band; disarmed = one `is None` check) |",
+            f"| explain {scale}: capture sizes | "
+            f"{od.get('explain_capture_bindings', 0):,} bindings over "
+            f"{od.get('explain_captures', 0)} capture(s), "
+            f"{od.get('explain_capture_bytes', 0) / 1e6:.2f} MB interned "
+            f"({od.get('explain_unique_masks', 0)} unique mask rows) |",
+            f"| explain {scale}: decision chains | live denied binding "
+            f"{resolved} via `karmadactl-tpu explain` "
+            f"(stage={od.get('explain_denied_stage', '?')}); flight "
+            f"record carries worst-binding explanations, `trace "
+            f"analyze` re-renders {flight} |",
+        ]
     # ISSUE 11: the columnar bus channel rows — storm throughput over
     # the live 4-process bus, the unary re-run ratio, the top stitched
     # self-time phase (bus.rpc must no longer lead), and the batched↔
@@ -569,6 +596,42 @@ def check_history_schema() -> None:
         )
 
 
+def reasons_table() -> str:
+    """The generated reason-taxonomy table (karmada_tpu.utils.reasons
+    ``REASONS`` is the single source of truth; graftlint GL010 keeps the
+    emission sites honest)."""
+    sys.path.insert(0, str(ROOT))
+    from karmada_tpu.utils.reasons import render_reasons_table
+
+    return (
+        "_Generated from `karmada_tpu/utils/reasons.py` REASONS by "
+        "`tools/docs_from_bench.py --reasons-table` — regenerate, don't "
+        "hand-edit._\n\n" + render_reasons_table()
+    )
+
+
+def check_reasons_table() -> None:
+    """Fail loudly when the committed OPERATIONS.md reason-taxonomy
+    table drifted from the REASONS registry (a reason the table misses
+    is a reason operators can't decode off /debug/explain) — runs on
+    EVERY doc regeneration, same pattern as the env-flag gate."""
+    path = ROOT / "docs" / "OPERATIONS.md"
+    m = _marker_re("reasontaxonomy").search(path.read_text())
+    if not m:
+        raise SystemExit(
+            f"{path}: no reasontaxonomy markers — restore the Explaining "
+            "placements section and run `python tools/docs_from_bench.py "
+            "--reasons-table`"
+        )
+    committed_body = m.group(0).split("-->\n", 1)[1].rsplit("<!--", 1)[0]
+    if committed_body.strip() != reasons_table().strip():
+        raise SystemExit(
+            f"{path}: reason-taxonomy table drifted from "
+            "karmada_tpu/utils/reasons.py REASONS — run "
+            "`python tools/docs_from_bench.py --reasons-table`"
+        )
+
+
 def check_ir_registry() -> None:
     """Fail loudly when a kernel family exported from karmada_tpu/ops/ is
     missing from the graftlint IR entry-point registry (or the registry
@@ -589,42 +652,34 @@ def check_ir_registry() -> None:
         )
 
 
+#: the generated-table modes: flag -> (marker, body builder, drift check)
+_TABLE_MODES = {
+    "--env-table": ("envflags", env_table, check_env_table),
+    "--metrics-table": ("metricfamilies", metrics_table,
+                        check_metrics_table),
+    "--span-table": ("spantaxonomy", span_table, check_span_table),
+    "--history-table": ("historyschema", history_table,
+                        check_history_schema),
+    "--reasons-table": ("reasontaxonomy", reasons_table,
+                        check_reasons_table),
+}
+
+
+def _check_all(skip: str = "") -> None:
+    """Every generated table's drift guard (minus the one just
+    rewritten) + the IR registry gate — run on EVERY doc regeneration."""
+    for flag, (_marker, _body, check) in _TABLE_MODES.items():
+        if flag != skip:
+            check()
+    check_ir_registry()
+
+
 def main() -> None:
-    if sys.argv[1:] == ["--env-table"]:
-        rewrite(ROOT / "docs" / "OPERATIONS.md", env_table(), "envflags")
-        check_metrics_table()
-        check_span_table()
-        check_history_schema()
-        check_ir_registry()
-        return
-    if sys.argv[1:] == ["--metrics-table"]:
-        rewrite(
-            ROOT / "docs" / "OPERATIONS.md", metrics_table(),
-            "metricfamilies",
-        )
-        check_env_table()
-        check_span_table()
-        check_history_schema()
-        check_ir_registry()
-        return
-    if sys.argv[1:] == ["--span-table"]:
-        rewrite(
-            ROOT / "docs" / "OPERATIONS.md", span_table(), "spantaxonomy",
-        )
-        check_env_table()
-        check_metrics_table()
-        check_history_schema()
-        check_ir_registry()
-        return
-    if sys.argv[1:] == ["--history-table"]:
-        rewrite(
-            ROOT / "docs" / "OPERATIONS.md", history_table(),
-            "historyschema",
-        )
-        check_env_table()
-        check_metrics_table()
-        check_span_table()
-        check_ir_registry()
+    if len(sys.argv) == 2 and sys.argv[1] in _TABLE_MODES:
+        flag = sys.argv[1]
+        marker, body, _check = _TABLE_MODES[flag]
+        rewrite(ROOT / "docs" / "OPERATIONS.md", body(), marker)
+        _check_all(skip=flag)
         return
     src = Path(sys.argv[1])
     d = json.loads(src.read_text())
@@ -643,11 +698,7 @@ def main() -> None:
     )
     rewrite(ROOT / "docs" / "OPERATIONS.md", body)
     rewrite(ROOT / "BASELINE.md", body)
-    check_env_table()
-    check_metrics_table()
-    check_span_table()
-    check_history_schema()
-    check_ir_registry()
+    _check_all()
 
 
 if __name__ == "__main__":

@@ -302,6 +302,26 @@ class LintContext:
         )
 
     @property
+    def reasons_registry(self) -> dict:
+        """code -> Reason from utils/reasons.py REASONS (imported live,
+        the env_registry/span_registry pattern — the module is
+        stdlib-only, so the import stays jax-free). GL010's ground
+        truth: the linter's notion of "registered" can never drift from
+        the taxonomy the explain plane decodes with."""
+        if getattr(self, "_reasons_registry", None) is None:
+            import importlib
+            import sys
+
+            root = str(self.config.root)
+            if root not in sys.path:
+                sys.path.insert(0, root)
+            reasons = importlib.import_module(
+                self.config.package + ".utils.reasons"
+            )
+            self._reasons_registry = dict(reasons.REASONS)
+        return self._reasons_registry
+
+    @property
     def docs_text(self) -> str:
         if self._docs_text is None:
             path = self.config.root / self.config.docs_env_table

@@ -224,6 +224,28 @@ def _specs_quota_cluster_caps() -> list:
     ]
 
 
+def _specs_explain_pass() -> list:
+    # the engine's capture padding shape: pow2 binding rows x the
+    # snapshot's cluster columns, k clamped to C (ops.explain.topk_width)
+    row = (
+        ((_B, _C), "bool"), ((_B, _C), "bool"), ((_B, _C), "bool"),
+        ((_B, _C), "bool"), ((_B, _C), "int32"), ((_B, _C), "int32"),
+        ((_B,), "bool"), ((_B,), "bool"), ((_B,), "int32"),
+        ((_B, _C), "int32"), ((_B, _C), "int32"),
+    )
+    return [
+        KernelSpec("base", row, {"k": 4, "mesh": None, "shard_c": False}),
+        KernelSpec("wide-wave", tuple(
+            ((4 * _B,) + s[0][1:], s[1]) for s in row
+        ), {"k": 8, "mesh": None, "shard_c": False}),
+        # sharded grid: the provenance dispatch under a 2-device ("b")
+        # mesh — IR001-IR005 run over the PARTITIONED jaxpr, the fleet
+        # kernels' contract (ISSUE 9 / test_sharded_specs_cover_*)
+        KernelSpec("sharded-b2", row,
+                   {"k": 4, "mesh": _MESH2, "shard_c": False}),
+    ]
+
+
 def _specs_masks_contains_all() -> list:
     return [KernelSpec(
         "base", (((_C, 2), "uint32"), ((2,), "uint32")),
@@ -441,6 +463,12 @@ ENTRY_POINTS: dict = {
         _entry("quota_cluster_caps", "ops", "karmada_tpu.ops.quota",
                "quota_cluster_caps", "karmada_tpu/ops/quota.py",
                _specs_quota_cluster_caps, manifest="quota_cluster_caps"),
+        # provenance family: the armed-only per-pass explain dispatch
+        # (engine-side like the quota kernels, manifest-recorded, with a
+        # sharded-b2 variant so the partitioned form is audited too)
+        _entry("explain_pass", "ops", "karmada_tpu.ops.explain",
+               "explain_pass", "karmada_tpu/ops/explain.py",
+               _specs_explain_pass, manifest="explain_pass"),
         _entry("masks.contains_all", "masks", "karmada_tpu.ops.masks",
                "contains_all", "karmada_tpu/ops/masks.py",
                _specs_masks_contains_all),

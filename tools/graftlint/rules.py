@@ -16,6 +16,8 @@
 |       | registered in utils.tracing SPAN_NAMES (stitcher + docs key on)  |
 | GL009 | history series: every HistorySeries source must map to a         |
 |       | registered metric family or the SPAN_NAMES taxonomy              |
+| GL010 | reason taxonomy: every Condition(reason=...) / .inc(reason=...)  |
+|       | literal must be registered in utils.reasons REASONS              |
 
 Each rule is a pure-AST pass over one ``ModuleInfo`` (plus cross-module
 ``finalize`` hooks); nothing here imports jax.
@@ -1156,3 +1158,70 @@ class HistorySeriesSource(Rule):
                 anchor=mod.qualname(node) or "<module>",
                 detail=f"{name}:{source}",
             )
+
+
+# --------------------------------------------------------------------------
+# GL010 — reason taxonomy: emitted reason codes must be registered
+# --------------------------------------------------------------------------
+#
+# ISSUE 13 satellite: the provenance plane (exclusion masks, the
+# Scheduled=False breakdowns, karmada_tpu_unschedulable_total{reason},
+# the generated docs reason table) all key on utils.reasons REASONS — a
+# reason emitted outside the registry is invisible to every one of those
+# surfaces and undocumented by construction. The GL008 pattern: literal
+# emissions are checked statically (Condition(... reason="...") ctor
+# calls and .inc(reason="...") metric labels); a reason passed as a
+# plain variable is out of static reach and stays unchecked (resolution
+# through module constants rides LintContext's constant table only for
+# env vars — reason constants are covered by the tier-1 registry tests).
+
+
+@rule
+class ReasonTaxonomy(Rule):
+    id = "GL010"
+    title = (
+        "reason codes emitted via Condition(reason=...) or "
+        ".inc(reason=...) must be registered in utils.reasons REASONS"
+    )
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            ctor = (
+                func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None
+            )
+            is_condition = ctor == "Condition"
+            is_inc = (
+                isinstance(func, ast.Attribute) and func.attr == "inc"
+            )
+            if not (is_condition or is_inc):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "reason":
+                    continue
+                if not (
+                    isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                ):
+                    continue  # dynamic reason: out of static reach
+                code = kw.value.value
+                if code in ctx.reasons_registry:
+                    continue
+                surface = "Condition" if is_condition else ".inc"
+                yield Finding(
+                    rule=self.id, path=mod.rel, line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"reason code {code!r} ({surface} emission) is "
+                        "not registered in utils.reasons REASONS — the "
+                        "explain surface, the unschedulable metric "
+                        "family and the generated docs reason table all "
+                        "key on the taxonomy; register the code there"
+                    ),
+                    anchor=mod.qualname(node) or "<module>",
+                    detail=code,
+                )
