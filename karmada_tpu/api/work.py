@@ -31,6 +31,13 @@ WORK_DEGRADED = "Degraded"
 EVICTION_PRODUCER_TAINT_MANAGER = "TaintManager"
 EVICTION_REASON_TAINT_UNTOLERATED = "TaintUntolerated"
 EVICTION_REASON_APPLICATION_FAILURE = "ApplicationFailure"
+# scarcity plane (ISSUE 14): victim evictions produced by the batched
+# preemption kernel; doubles as exclusion-mask stage bit 7 and the
+# karmada_tpu_preemptions_total reason label
+EVICTION_PRODUCER_PREEMPTION = "PreemptionKernel"
+EVICTION_REASON_PREEMPTED = "PreemptedByHigherPriority"
+# victim condition type (the reason codes live in utils/reasons.py)
+PREEMPTED = "Preempted"
 # PurgeMode
 PURGE_IMMEDIATELY = "Immediately"
 PURGE_GRACIOUSLY = "Graciously"
@@ -114,6 +121,12 @@ class ResourceBindingSpec:
     replicas: int = 0
     replica_requirements: Optional[ReplicaRequirements] = None
     placement: Optional[Placement] = None
+    # scheduling priority class (ISSUE 14): plumbed from the matched
+    # PropagationPolicy's spec.priority by the detector so the scheduler
+    # can order waves and the preemption kernel can rank victims. 0 is
+    # the back-compat default — pre-priority bindings (and checkpoints
+    # restored from them) schedule exactly as before.
+    priority: int = 0
     clusters: list[TargetCluster] = field(default_factory=list)
     graceful_eviction_tasks: list[GracefulEvictionTask] = field(default_factory=list)
     required_by: list[BindingSnapshot] = field(default_factory=list)

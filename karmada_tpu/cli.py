@@ -1744,18 +1744,30 @@ def cmd_plane_top(
                     "karmada_tpu_device_bytes",
                     "karmada_tpu_unschedulable_total",
                     "karmada_tpu_quota_denied_total",
+                    "karmada_tpu_preemptions_total",
+                    "karmada_tpu_desched_disruption_budget",
+                    "karmada_tpu_desched_disruption_used",
                 ),
             )
             totals = {"karmada_tpu_device_bytes": 0.0,
                       "karmada_tpu_unschedulable_total": 0.0,
-                      "karmada_tpu_quota_denied_total": 0.0}
+                      "karmada_tpu_quota_denied_total": 0.0,
+                      "karmada_tpu_preemptions_total": 0.0,
+                      "karmada_tpu_desched_disruption_budget": 0.0,
+                      "karmada_tpu_desched_disruption_used": 0.0}
             by_reason: dict = {}
+            preempt_by_reason: dict = {}
             for fam, labels, value in levels:
                 totals[fam] += value
                 if fam == "karmada_tpu_unschedulable_total":
                     reason = labels.get("reason", "")
                     by_reason[reason] = (
                         by_reason.get(reason, 0) + int(value)
+                    )
+                elif fam == "karmada_tpu_preemptions_total":
+                    reason = labels.get("reason", "")
+                    preempt_by_reason[reason] = (
+                        preempt_by_reason.get(reason, 0) + int(value)
                     )
             entry["device_bytes"] = int(
                 totals["karmada_tpu_device_bytes"]
@@ -1766,9 +1778,25 @@ def cmd_plane_top(
             entry["quota_denied_total"] = int(
                 totals["karmada_tpu_quota_denied_total"]
             )
+            # ISSUE 14 satellite: the scarcity-plane levels — lifetime
+            # preemptions (by reason) plus the descheduler's live
+            # disruption budget/used pair
+            entry["preemptions_total"] = int(
+                totals["karmada_tpu_preemptions_total"]
+            )
+            entry["disruption_budget"] = int(
+                totals["karmada_tpu_desched_disruption_budget"]
+            )
+            entry["disruption_used"] = int(
+                totals["karmada_tpu_desched_disruption_used"]
+            )
             if by_reason:
                 entry["unschedulable_by_reason"] = dict(
                     sorted(by_reason.items())
+                )
+            if preempt_by_reason:
+                entry["preemptions_by_reason"] = dict(
+                    sorted(preempt_by_reason.items())
                 )
         out["procs"][name] = entry
     return out
@@ -1821,6 +1849,13 @@ def render_top(doc: dict) -> str:
             bits.append(
                 f"unsched/denied {entry.get('unschedulable_total', 0)}"
                 f"/{entry.get('quota_denied_total', 0)}"
+            )
+        if entry.get("preemptions_total"):
+            bits.append(f"preempted {entry['preemptions_total']}")
+        if entry.get("disruption_budget"):
+            bits.append(
+                f"disruption {entry.get('disruption_used', 0)}"
+                f"/{entry['disruption_budget']}"
             )
         if entry.get("evicted"):
             bits.append(f"evicted {entry['evicted']}")

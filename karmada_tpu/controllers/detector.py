@@ -273,6 +273,11 @@ class ResourceDetector:
             replicas=replicas,
             replica_requirements=requirements,
             placement=policy.spec.placement,
+            # ISSUE 14: the policy's explicit priority reaches the
+            # ResourceBinding spec (before this it only ordered policy
+            # MATCHING, so the scheduler could never see it); default 0
+            # keeps pre-priority bindings scheduling exactly as before
+            priority=policy.spec.priority,
             conflict_resolution=policy.spec.conflict_resolution,
             propagate_deps=policy.spec.propagate_deps,
             suspend_dispatching=policy.spec.suspend_dispatching,
@@ -293,6 +298,10 @@ class ResourceDetector:
                 existing.spec.placement != spec.placement
                 or existing.spec.replicas != spec.replicas
                 or existing.spec.replica_requirements != spec.replica_requirements
+                # getattr: a checkpoint written by a pre-priority build
+                # unpickles without the field (Store.restore bypasses
+                # __init__) — it reads as the 0 default, not a change
+                or getattr(existing.spec, "priority", 0) != spec.priority
             )
             existing.spec = spec
             if changed:

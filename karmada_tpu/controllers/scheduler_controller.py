@@ -170,6 +170,16 @@ class SchedulerController:
             "KARMADA_TPU_QUOTA_ENFORCEMENT", "1"
         ).lower() not in ("0", "false", "")
 
+    @staticmethod
+    def _preemption_enabled() -> bool:
+        """Scarcity-plane kill switch (ISSUE 14): read live per pass so
+        flipping KARMADA_TPU_PREEMPTION=0 disarms without a restart."""
+        import os
+
+        return os.environ.get(
+            "KARMADA_TPU_PREEMPTION", "1"
+        ).lower() not in ("0", "false", "")
+
     def _quota_namespaces(self) -> set:
         """Namespaces carrying an FRQ when enforcement is on (empty =
         the quota plane is inert for routing purposes)."""
@@ -205,6 +215,50 @@ class SchedulerController:
                 flush=True,
             )
         return self._inproc_engine()
+
+    def _route_engine_for_scarcity(self, engine, problems=()):
+        """The solver sidecar has no preemption channel either: a wave
+        carrying priority>0 bindings reroutes in-proc while preemption is
+        armed, scoped exactly like the quota reroute (a priority-free
+        wave never costs the sidecar)."""
+        if hasattr(engine, "set_preemption"):
+            return engine
+        if not self._preemption_enabled() or not any(
+            getattr(p, "priority", 0) > 0 for p in problems
+        ):
+            return engine
+        if not getattr(self, "_preempt_solver_warned", False):
+            self._preempt_solver_warned = True
+            print(
+                "# scheduler: priority preemption is not supported over "
+                "the solver sidecar; priority waves take the in-proc "
+                "engine (set KARMADA_TPU_PREEMPTION=0 to route them to "
+                "the sidecar without preemption)",
+                flush=True,
+            )
+        return self._inproc_engine()
+
+    def _victim_problems(self, exclude_keys):
+        """The resident victim pool the engine's preemption pass selects
+        from: every BOUND binding of this scheduler (assigned replicas
+        on at least one cluster) that is NOT in the current wave — a
+        binding being rescheduled this pass has its capacity in flux and
+        is never victimized in the same pass. Kind is remembered so the
+        eviction writer can find the object again."""
+        out = []
+        self._victim_kinds = {}
+        for kind in ("ResourceBinding", "ClusterResourceBinding"):
+            for rb in self.store.list(kind):
+                key = rb.meta.namespaced_name
+                if (
+                    rb.spec.scheduler_name != self.scheduler_name
+                    or key in exclude_keys
+                    or not rb.spec.clusters
+                ):
+                    continue
+                self._victim_kinds[key] = kind
+                out.append(self._problem_for(key, rb, False))
+        return out
 
     def _ensure_engine_quota(self, engine) -> None:
         """Hand the engine a current QuotaSnapshot (None = no FRQs or
@@ -271,8 +325,21 @@ class SchedulerController:
             return True, True
         if rb.status.scheduler_observed_generation != rb.meta.generation:
             return True, False
-        if not any(c.type == SCHEDULED for c in rb.status.conditions):
+        sched = next(
+            (c for c in rb.status.conditions if c.type == SCHEDULED), None
+        )
+        if sched is None:
             return True, False  # never attempted
+        if not sched.status:
+            # unschedulable bindings retry on every re-enqueue (the
+            # reference's unschedulable-queue semantics): cluster events
+            # re-enqueue the whole plane, so freed capacity — a
+            # completed preemption eviction, a scale-down, a node join —
+            # re-places a parked victim without any spec change. Quota
+            # denials are intercepted BEFORE this gate by the
+            # generation-gated _quota_denied park, so a denied binding
+            # still retries only on quota movement.
+            return True, False
         divided = (
             rb.spec.placement is not None
             and rb.spec.placement.replica_scheduling_type() == "Divided"
@@ -342,18 +409,51 @@ class SchedulerController:
             todo.append((kind_key, rb, self._problem_for(key, rb, fresh), fresh))
         if not todo:
             return out
+        # priority-descending wave ordering (ISSUE 14): higher priority
+        # classes solve — and hit batched FIFO quota admission — first;
+        # the sort is STABLE, so arrival order (queue order) is preserved
+        # inside each class. Priority-free waves (all 0) keep their exact
+        # pre-scarcity order, bit-for-bit.
+        if any(getattr(p, "priority", 0) for _, _, p, _ in todo):
+            todo.sort(
+                key=lambda item: -getattr(item[2], "priority", 0)
+            )
         start = time.perf_counter()
         # one engine pass = one scheduler.pass span; the fleet/kernel
         # spans (pack/dispatch/device/fetch) nest under it, so a storm
         # wave's solve time decomposes without per-binding bookkeeping
         with tracer.span("scheduler.pass") as sp:
             problems = [p for _, _, p, _ in todo]
-            try:
-                engine = self._route_engine_for_quota(
-                    self._get_engine(), problems
-                )
+
+            def _solve_on(engine):
+                """One engine pass with the scarcity plane armed for its
+                duration only (dry solves and other callers of the same
+                engine must never inherit an armed victim source)."""
                 self._ensure_engine_quota(engine)
-                results = engine.schedule(problems)
+                armed = (
+                    hasattr(engine, "set_preemption")
+                    and self._preemption_enabled()
+                    and any(
+                        getattr(p, "priority", 0) > 0 for p in problems
+                    )
+                )
+                if not armed:
+                    return engine.schedule(problems), None
+                engine.set_preemption(self._victim_problems)
+                try:
+                    results = engine.schedule(problems)
+                    return results, getattr(engine, "last_preemption", None)
+                finally:
+                    engine.set_preemption(None)
+
+            try:
+                engine = self._route_engine_for_scarcity(
+                    self._route_engine_for_quota(
+                        self._get_engine(), problems
+                    ),
+                    problems,
+                )
+                results, preemption = _solve_on(engine)
             except Exception as exc:  # noqa: BLE001 — transport triage below
                 if self.solver is None or not _is_transport_error(exc):
                     raise
@@ -372,13 +472,13 @@ class SchedulerController:
                     f"({type(exc).__name__}); in-proc solve for this pass",
                     flush=True,
                 )
-                fallback = self._inproc_engine()
                 # the fallback engine may retain a QuotaSnapshot from an
-                # earlier quota wave: refresh it (or clear it, when
-                # enforcement is off / the FRQ went away) before solving
-                self._ensure_engine_quota(fallback)
-                results = fallback.schedule(problems)
+                # earlier quota wave: _solve_on refreshes (or clears) it
+                # before solving
+                results, preemption = _solve_on(self._inproc_engine())
             sp.attrs["bindings"] = len(todo)
+            if preemption is not None and preemption.victims:
+                sp.attrs["preempted"] = len(preemption.victims)
         scheduler_pass_seconds.observe(sp.duration)
         per_item = (time.perf_counter() - start) / len(todo)
         # leadership check at the write barrier: a batched engine pass can
@@ -431,7 +531,103 @@ class SchedulerController:
                     self.store.apply(rb)
         finally:
             self._pending_writeback.clear()
+        if preemption is not None and preemption.victims:
+            self._evict_preemption_victims(preemption)
         return out
+
+    def _evict_preemption_victims(self, preemption) -> None:
+        """Route the pass's selected victims through PR 7's graceful-
+        eviction machinery: each assigned cluster becomes a
+        ``PreemptedByHigherPriority`` eviction task (preserved-state
+        labels ride the task exactly like a failover eviction), the
+        victim gets a ``Preempted`` condition naming its displacer, and
+        ``karmada_tpu_preemptions_total`` counts once per displacement
+        episode (TransitionDedup — a twice-enqueued victim within one
+        episode never double-counts; a fresh displacement after a
+        successful re-placement counts anew). The spec bump re-enqueues
+        the victim, which then reschedules via the existing ranked
+        failover path with the evicted clusters excluded."""
+        from ..api.work import (
+            EVICTION_PRODUCER_PREEMPTION,
+            EVICTION_REASON_PREEMPTED,
+            PREEMPTED,
+        )
+        from ..utils.metrics import preemptions_total
+        from .cluster import evict_binding
+
+        displacer = next(
+            iter(preemption.placed or preemption.still_unschedulable), ""
+        )
+        now = self.clock()
+        changed = []
+        for key, placement, _prio in preemption.victims:
+            kind = getattr(self, "_victim_kinds", {}).get(
+                key, "ResourceBinding"
+            )
+            rb = self.store.get(kind, key)
+            if rb is None or not rb.spec.clusters:
+                continue  # vanished or already displaced: nothing to free
+            for cluster in list(placement):
+                evict_binding(
+                    rb,
+                    cluster,
+                    reason=EVICTION_REASON_PREEMPTED,
+                    producer=EVICTION_PRODUCER_PREEMPTION,
+                    message=f"preempted by higher-priority {displacer}",
+                    now=now,
+                )
+            set_condition(
+                rb.status.conditions,
+                Condition(
+                    type=PREEMPTED,
+                    status=True,
+                    reason=EVICTION_REASON_PREEMPTED,
+                    message=f"preempted by higher-priority {displacer}",
+                ),
+            )
+            if self._reason_dedup.observe(
+                ("preempt", key), EVICTION_REASON_PREEMPTED, None
+            ):
+                preemptions_total.inc(reason=EVICTION_REASON_PREEMPTED)
+            changed.append(rb)
+        if not changed:
+            return
+        apply_many = getattr(self.store, "apply_many", None)
+        if apply_many is not None:
+            for rb, err in apply_many(changed):
+                print(
+                    f"# scheduler: preemption eviction rejected for "
+                    f"{rb.meta.namespaced_name}: {err}",
+                    flush=True,
+                )
+        else:
+            for rb in changed:
+                self.store.apply(rb)
+
+    def dry_solve(self, problems) -> list:
+        """One engine pass with NO store writes and NO scarcity arming —
+        the continuous descheduler's scoring seam (the engine still
+        enforces quota, so a drift score can never recommend a placement
+        admission would deny). A dry pass must leave NO trace on the
+        live plane: the quota working ``remaining`` is restored (a
+        scoring pass never debits budget real bindings need) and the
+        provenance store is disarmed for its duration (a hypothetical
+        fresh-solve capture must not overwrite a binding's real
+        decision chain in /debug/explain)."""
+        engine = self._route_engine_for_quota(self._get_engine(), problems)
+        self._ensure_engine_quota(engine)
+        q = getattr(engine, "quota", None)
+        saved_remaining = q.remaining.copy() if q is not None else None
+        saved_explain = getattr(engine, "explain", None)
+        if hasattr(engine, "set_explain"):
+            engine.set_explain(None)
+        try:
+            return engine.schedule(problems)
+        finally:
+            if hasattr(engine, "set_explain"):
+                engine.set_explain(saved_explain)
+            if q is not None:
+                q.remaining = saved_remaining
 
     def _problem_for(self, key: str, rb: ResourceBinding, fresh: bool) -> BindingProblem:
         return BindingProblem(
@@ -450,6 +646,14 @@ class SchedulerController:
             ),
             fresh=fresh,
             namespace=rb.meta.namespace or "",
+            # getattr: checkpoints written by a pre-scarcity build
+            # unpickle without the field (default-0 back-compat)
+            priority=getattr(rb.spec, "priority", 0),
+            preempt_clusters=tuple(
+                t.from_cluster
+                for t in rb.spec.graceful_eviction_tasks
+                if t.reason == "PreemptedByHigherPriority"
+            ),
         )
 
     def _write_back(self, rb: ResourceBinding, result, fresh: bool = False) -> bool:
@@ -506,6 +710,25 @@ class SchedulerController:
             # a later denial after a successful schedule is a NEW
             # transition and must count again
             self._reason_dedup.forget(("sched", rb.meta.namespaced_name))
+            # a successful (re-)placement closes the displacement
+            # episode: the next preemption of this binding counts anew,
+            # and the Preempted condition resolves
+            self._reason_dedup.forget(("preempt", rb.meta.namespaced_name))
+            from ..api.work import PREEMPTED
+
+            for cond in rb.status.conditions:
+                if cond.type == PREEMPTED and cond.status:
+                    if set_condition(
+                        rb.status.conditions,
+                        Condition(
+                            type=PREEMPTED,
+                            status=False,
+                            reason="Success",
+                            message="re-placed after displacement",
+                        ),
+                    ):
+                        changed = True
+                    break
         else:
             from ..scheduler.quota import QUOTA_EXCEEDED_ERROR
             from ..utils.reasons import classify_error

@@ -47,6 +47,11 @@ class ControlPlane:
         self,
         *,
         enable_descheduler: bool = False,
+        # ISSUE 14: the continuous drift-rebalance tier (bounded-
+        # disruption re-placement off a per-tick dry solve). Off by
+        # default like the estimator descheduler — benches and scarcity
+        # deployments opt in.
+        enable_drift_rebalancer: bool = False,
         enable_accurate_estimator: bool = False,
         # disabled by default like the reference (controllermanager.go:213-214)
         enable_member_hpa_sync: bool = False,
@@ -155,6 +160,14 @@ class ControlPlane:
             if enable_descheduler
             else None
         )
+        if enable_drift_rebalancer:
+            from .controllers.rebalance import ContinuousDescheduler
+
+            self.drift_rebalancer = ContinuousDescheduler(
+                self.store, self.runtime, self.scheduler, clock=self.clock
+            )
+        else:
+            self.drift_rebalancer = None
         self.dependencies_distributor = DependenciesDistributor(
             self.store, self.runtime, self.interpreter
         )

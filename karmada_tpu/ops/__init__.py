@@ -48,6 +48,9 @@ from .estimate import (  # noqa: E402,F401
 from .explain import (  # noqa: E402,F401
     explain_pass,
 )
+from .preempt import (  # noqa: E402,F401
+    preempt_select,
+)
 from .quota import (  # noqa: E402,F401
     quota_admit,
     quota_cluster_caps,

@@ -56,6 +56,7 @@ BIT_AVAILABILITY = STAGE_REASONS.index("NoAvailableReplicas")
 BIT_QUOTA_CAP = STAGE_REASONS.index("QuotaCapExceeded")
 BIT_QUOTA_ADMIT = STAGE_REASONS.index("QuotaExceeded")
 BIT_SPREAD = STAGE_REASONS.index("SpreadConstraintUnsatisfied")
+BIT_PREEMPTED = STAGE_REASONS.index("PreemptedByHigherPriority")
 N_STAGES = len(STAGE_REASONS)
 assert N_STAGES <= 8, "exclusion mask is one uint8 per cell"
 
@@ -76,6 +77,7 @@ def explain_pass(
     replicas,  # int32[B]
     assignment,  # int32[B, C]: the pass's final assignment
     prev,  # int32[B, C]: credited previous placements
+    preempted,  # bool[B, C]: active preemption-eviction task from cluster
     *,
     k: int,
     mesh=None,  # jax.sharding.Mesh with axes ("b", "c") — None = single-device
@@ -104,6 +106,7 @@ def explain_pass(
     caps = shard(caps, "b", c_ax)
     assignment = shard(assignment, "b", c_ax)
     prev = shard(prev, "b", c_ax)
+    preempted = shard(preempted, "b", c_ax)
     admitted = shard(admitted, "b")
     dynamic = shard(dynamic, "b")
     replicas = shard(replicas, "b")
@@ -123,6 +126,10 @@ def explain_pass(
         | bit(consults & (caps <= 0), BIT_QUOTA_CAP)
         | bit(~admitted[:, None], BIT_QUOTA_ADMIT)
         | bit(~spread_ok, BIT_SPREAD)
+        # a victim's evicted-from clusters carry their own bit beside the
+        # folded taint/NoExecute stage, so the decision chain names
+        # preemption rather than a generic untolerated taint
+        | bit(preempted, BIT_PREEMPTED)
     )
 
     # top-k candidates by (assigned desc, avail desc, index asc): the

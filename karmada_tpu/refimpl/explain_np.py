@@ -36,6 +36,7 @@ def explain_one(
     replicas: int,
     assignment_row,  # int[C]
     prev_row,  # int[C]
+    preempted_row,  # bool[C]
     k: int,
 ) -> tuple[np.ndarray, list[tuple]]:
     """One binding's exclusion bits + top-k summary, the reference way:
@@ -61,6 +62,8 @@ def explain_one(
             bits |= 1 << _BIT["QuotaExceeded"]
         if not spread_ok_row[j]:
             bits |= 1 << _BIT["SpreadConstraintUnsatisfied"]
+        if preempted_row[j]:
+            bits |= 1 << _BIT["PreemptedByHigherPriority"]
         mask[j] = bits
     # candidate summary: assigned desc, then availability desc, then
     # index asc — the reference's stable ordering for result rendering
@@ -93,6 +96,7 @@ def explain_batch_np(
     replicas,  # int[B]
     assignment,
     prev,
+    preempted,  # bool[B, C]
     k: int,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Batched driver: loops ``explain_one`` per binding and packs the
@@ -107,7 +111,7 @@ def explain_batch_np(
             np.asarray(avail)[i], np.asarray(caps)[i],
             bool(np.asarray(admitted)[i]), bool(np.asarray(dynamic)[i]),
             int(np.asarray(replicas)[i]), np.asarray(assignment)[i],
-            np.asarray(prev)[i], k,
+            np.asarray(prev)[i], np.asarray(preempted)[i], k,
         )
         masks[i] = mask
         for slot, row in enumerate(rows):

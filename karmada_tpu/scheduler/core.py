@@ -135,6 +135,23 @@ def host_profile_table(
     return np.where(np.asarray(snapshot.has_summary)[None, :], table, mi)
 
 
+class _BoostedSnapshot:
+    """Capacity-shifted view of a ClusterSnapshot for the preemption
+    re-solve: ``available_cap`` reads as ``base + freed_caps`` (the
+    victims' resources, per cluster column); every other attribute
+    delegates. Never cached anywhere — the per-profile/selection caches
+    key on the real snapshot only."""
+
+    def __init__(self, base, freed_caps):
+        self._base = base
+        self.available_cap = np.asarray(base.available_cap) + np.asarray(
+            freed_caps, dtype=np.asarray(base.available_cap).dtype
+        )
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+
 @dataclass
 class BindingProblem:
     """Engine-level scheduling unit (decoupled from the API object; the
@@ -149,6 +166,12 @@ class BindingProblem:
     evict_clusters: tuple[str, ...] = ()  # graceful-eviction tasks
     fresh: bool = False  # reschedule triggered
     namespace: str = ""  # quota-admission namespace ("" = not quota'd)
+    # scarcity plane (ISSUE 14): the binding's priority class (0 = the
+    # back-compat default — never preempts, preemptible by any class
+    # above it) and the subset of evict_clusters whose eviction task is
+    # a preemption (the explain capture's stage-7 bit)
+    priority: int = 0
+    preempt_clusters: tuple[str, ...] = ()
 
 
 @dataclass
@@ -162,6 +185,31 @@ class ScheduleResult:
     @property
     def success(self) -> bool:
         return not self.error
+
+
+#: the divider's insufficient-capacity verdict (wire/compat surface —
+#: tests and the oracle match on it; REASONS classifies it as
+#: InsufficientReplicas). The preemption plane's demander predicate:
+#: only THIS failure means "freeing capacity could place the binding".
+INSUFFICIENT_ERROR = "clusters available replicas are not enough"
+
+
+@dataclass
+class PreemptionOutcome:
+    """One pass's preemption verdict, deposited on the engine as
+    ``last_preemption`` for the scheduler controller to act on (victim
+    evictions are store writes — the engine never touches API objects,
+    the quota-plane division of labor)."""
+
+    #: (key, resident placement dict, priority) per selected victim
+    victims: list = dc_field(default_factory=list)
+    #: demander keys that re-solved successfully against the freed
+    #: capacity (their results were patched in place)
+    placed: list = dc_field(default_factory=list)
+    #: demander keys still unschedulable even with every victim freed
+    still_unschedulable: list = dc_field(default_factory=list)
+    #: int64[C, R] capacity the victims free, per cluster column
+    freed_caps: Optional[np.ndarray] = None
 
 
 class TensorScheduler:
@@ -294,6 +342,16 @@ class TensorScheduler:
         from ..utils.explainstore import explain_armed, store as _estore
 
         self.explain = _estore() if explain_armed() else None
+        # scarcity plane (ISSUE 14): when armed, a pass whose priority>0
+        # rows answer "available replicas are not enough" runs ONE
+        # batched plane-wide victim selection (ops.preempt) and re-solves
+        # the demanders against the freed capacity IN THE SAME PASS.
+        # ``preempt_source`` is a callable(exclude_keys) answering the
+        # resident victim pool as BindingProblems (the controller wires
+        # it per pass; None — the default — is the disarmed state: one
+        # `is None` check per schedule() call, the quota/fault pattern).
+        self.preempt_source = None
+        self.last_preemption: Optional[PreemptionOutcome] = None
 
     PLACEMENT_CACHE_CAP = 8192
     #: minimum eligible-batch size before the device-resident path engages
@@ -427,6 +485,7 @@ class TensorScheduler:
         "Q": "quota_admit",
         "K": "quota_cluster_caps",
         "E": "explain_pass",
+        "P": "preempt_select",
     }
 
     def _mark_trace(self, *key) -> bool:
@@ -698,12 +757,38 @@ class TensorScheduler:
         ``KARMADA_TPU_EXPLAIN=1``)."""
         self.explain = store
 
+    def set_preemption(self, source) -> None:
+        """Arm/disarm the preemption plane for this engine (None =
+        disarmed). ``source(exclude_keys)`` answers the resident victim
+        pool; the controller arms it per pass so dry solves and disarmed
+        planes never pay more than the `is None` check."""
+        self.preempt_source = source
+
     def schedule(self, problems: Sequence[BindingProblem]) -> list[ScheduleResult]:
         """Provenance wrapper: the solve runs unchanged; when explain is
         armed the pass's decision provenance captures AFTER the results
         exist (one extra armed-only dispatch per chunk — telemetry, so
         a capture failure logs and never aborts the wave)."""
+        self.last_preemption = None
         results = self._schedule_quota(problems)
+        # the preemption pass runs BEFORE the explain capture so a
+        # re-solved demander's provenance shows its final placement. A
+        # failed preemption pass logs and leaves the demanders' honest
+        # unschedulable results intact — never the wave.
+        if self.preempt_source is not None and problems:
+            try:
+                results = self._preempt_pass(list(problems), results)
+            except Exception as exc:  # noqa: BLE001 — scarcity remedy is
+                # optional; losing it must never lose the solve results.
+                # The outcome is cleared too: a pass that died AFTER
+                # victim selection but BEFORE the re-solve must not hand
+                # the controller victims to evict with no demander placed
+                self.last_preemption = None
+                import logging
+
+                logging.getLogger("karmada_tpu").warning(
+                    "preemption pass failed (%s)", type(exc).__name__
+                )
         # the store's enabled gate honors KARMADA_TPU_EXPLAIN_CAP=0:
         # a disabled ring must not pay the capture dispatch either
         if self.explain is not None and self.explain.enabled and problems:
@@ -779,6 +864,284 @@ class TensorScheduler:
         q.remaining = np.where(
             limited, np.maximum(q.remaining - debit, 0), q.remaining
         )
+
+    # -- scarcity plane: plane-wide preemption (ISSUE 14) -------------------
+
+    _PREEMPT_PAD = 256  # pow2 floor so tiny waves share one trace bucket
+
+    def _preempt_pass(self, problems, results) -> list:
+        """One armed-only preemption round per engine pass: demanders are
+        the wave's priority>0 rows whose solve answered insufficient
+        capacity AND that quota ADMITTED (a quota-denied row may never
+        preempt its way past its namespace budget); victims come from the
+        controller-wired resident pool. Victim selection is ONE
+        ``ops.preempt.preempt_select`` dispatch over the combined rows;
+        the freed per-cluster capacity re-enters the divide path in the
+        same pass via ``_resolve_boosted``, and the outcome (victims to
+        evict, re-solved placements) lands in ``last_preemption``.
+
+        Returns the results list — MATERIALIZED to a plain list when a
+        re-solve patched demander rows (the all-fleet path answers a
+        lazy column-oriented ``_FleetResultList`` that rejects item
+        assignment), the caller's original object otherwise."""
+        import time as _time
+
+        from ..ops.quota import DEMAND_CLAMP
+        from ..utils.tracing import tracer as _tracer
+
+        demand_idx = [
+            i
+            for i, (p, res) in enumerate(zip(problems, results))
+            if getattr(p, "priority", 0) > 0
+            and res.error == INSUFFICIENT_ERROR
+        ]
+        if not demand_idx:
+            return results
+        t0 = _time.perf_counter()
+        snap = self.snapshot
+        wave_keys = {p.key for p in problems}
+        victims_pool = [
+            v
+            for v in (self.preempt_source(wave_keys) or ())
+            if v.prev and sum(v.prev.values()) > 0
+        ]
+        outcome = PreemptionOutcome()
+        self.last_preemption = outcome
+        if not victims_pool:
+            outcome.still_unschedulable = [
+                problems[i].key for i in demand_idx
+            ]
+            return results
+        dims = list(snap.dims)
+        r = len(dims)
+        c = snap.num_clusters
+        demanders = [problems[i] for i in demand_idx]
+        rows = demanders + victims_pool
+        b = len(rows)
+        prio = np.fromiter(
+            (getattr(p, "priority", 0) for p in rows), np.int32, b
+        )
+        demand = np.zeros((b, r), np.int64)
+        freed = np.zeros((b, r), np.int64)
+        victim_ok = np.zeros(b, bool)
+        weight = np.zeros(b, np.int32)
+        assigned = np.zeros((b, c), np.int32)
+        requests = np.zeros((b, r), np.int64)
+        from .quota import per_replica_vector
+
+        def scaled(req_row, count: int) -> np.ndarray:
+            # scale in PYTHON ints (the quota demand_row rule): an
+            # absurd-but-legal request x a huge count must clamp, not
+            # wrap int64 to zero/negative and vanish from the cumsum
+            return np.fromiter(
+                (min(int(v) * count, DEMAND_CLAMP) for v in req_row),
+                np.int64,
+                len(req_row),
+            )
+
+        for i, p in enumerate(rows):
+            req = per_replica_vector(p.requests, dims)
+            requests[i] = np.minimum(req, DEMAND_CLAMP)
+            if i < len(demanders):
+                # unmet demand: the shortfall the divide could not cover
+                # (fresh rows re-place everything, so the whole request
+                # is unmet; scale-ups demand only the delta — the quota
+                # plane's delta-demand rule)
+                short = p.replicas - (
+                    0 if p.fresh else sum(p.prev.values())
+                )
+                if short > 0:
+                    demand[i] = scaled(requests[i], int(short))
+            else:
+                total = 0
+                for name, reps in p.prev.items():
+                    j = snap.index.get(name)
+                    if j is not None and reps > 0:
+                        assigned[i, j] = reps
+                        total += int(reps)
+                if total > 0:
+                    weight[i] = min(total, 2**20 - 1)
+                    victim_ok[i] = True
+                    freed[i] = scaled(requests[i], total)
+        if not demand.any() or not victim_ok.any():
+            outcome.still_unschedulable = [p.key for p in demanders]
+            return results
+
+        # pow2 row padding bounds the trace count (pad rows are
+        # priority-0 non-demander non-victims — inert by construction)
+        b_pad = max(1 << max(0, (b - 1).bit_length()), self._PREEMPT_PAD)
+
+        def pad(a):
+            if b_pad == b:
+                return a
+            w = ((0, b_pad - b),) + ((0, 0),) * (a.ndim - 1)
+            return np.pad(a, w)
+
+        from ..ops.preempt import preempt_select
+        from ..parallel.mesh import mesh_shape
+
+        mesh = self.mesh
+        if mesh is not None and b_pad % max(mesh.shape.get("b", 1), 1):
+            mesh = None  # non-divisible batch: single-device semantics
+        mesh_el = mesh_shape(mesh)
+        arrays = tuple(
+            jnp.asarray(a)
+            for a in (
+                pad(prio), pad(demand), pad(freed), pad(victim_ok),
+                pad(weight), pad(assigned), pad(requests),
+            )
+        )
+        key = ("P", int(b_pad), int(c), int(r), mesh_el)
+        if self._mark_trace(*key):
+            # recorded meshed too: preempt_select carries a real mesh
+            # static (the explain_pass contract), so replay can
+            # materialize the shape
+            self._record_trace(
+                "preempt_select", key, arrays, mesh=mesh_el
+            )
+        victims_dev, freed_caps_dev = preempt_select(*arrays, mesh=mesh)
+        victim_mask = np.asarray(victims_dev)[:b]
+        freed_caps = np.asarray(freed_caps_dev)
+        if not victim_mask.any():
+            outcome.still_unschedulable = [p.key for p in demanders]
+            _tracer.record(
+                "scheduler.preempt", _time.perf_counter() - t0,
+                demanders=len(demanders), victims=0,
+            )
+            return results
+        for i in np.flatnonzero(victim_mask):
+            p = rows[int(i)]
+            outcome.victims.append(
+                (p.key, dict(p.prev), int(getattr(p, "priority", 0)))
+            )
+        outcome.freed_caps = freed_caps
+
+        # freed capacity re-enters the divide path NOW: one extra batched
+        # solve over just the demanders, against availability recomputed
+        # on boosted capacity (still min-folded with static quota caps —
+        # preemption never lifts a cap)
+        compiled = [self._compiled(p.placement) for p in demanders]
+        self.solve_batches += 1
+        re_res = self._resolve_boosted(demanders, compiled, freed_caps)
+        # the all-fleet path answers a lazy _FleetResultList: patch a
+        # materialized copy (iteration decodes each row exactly once)
+        results = list(results)
+        for i, res in zip(demand_idx, re_res):
+            if res.success:
+                results[i] = res
+                outcome.placed.append(res.key)
+            else:
+                outcome.still_unschedulable.append(res.key)
+        _tracer.record(
+            "scheduler.preempt", _time.perf_counter() - t0,
+            demanders=len(demanders), victims=len(outcome.victims),
+        )
+        return results
+
+    def _resolve_boosted(self, problems, compiled, freed_caps):
+        """Re-solve a (small) demander batch against capacity boosted by
+        the victims' freed resources: the general/model estimator mirror
+        runs over ``available_cap + freed_caps`` (out-of-tree estimator
+        answers are deliberately NOT consulted — they estimate from live
+        member state, which cannot see a not-yet-evicted victim's
+        capacity), static quota caps still fold, and the divide runs the
+        oracle-identical numpy path when host-small (the
+        ``_schedule_chunk`` bound) else the device kernels."""
+        from ..ops import masks as mops
+        from ..ops.divide import AGGREGATED as S_AGG, DYNAMIC_WEIGHT as S_DYN
+
+        snap = self.snapshot
+        out: list[ScheduleResult] = []
+        for start in range(0, len(problems), self.chunk_size):
+            chunk = problems[start : start + self.chunk_size]
+            cchunk = compiled[start : start + self.chunk_size]
+            base, strategy, replicas, static_w, requests, prev, fresh = (
+                self._pack_chunk(chunk, cchunk, 0, with_affinity=False)
+            )
+            b = len(chunk)
+            mi = 2**31 - 1
+            # boosted availability: the host_profile_table mirror over a
+            # capacity-shifted view of the snapshot (sentinel semantics
+            # identical to _availability_np)
+            boosted = _BoostedSnapshot(snap, freed_caps)
+            uniq, inv = np.unique(requests, axis=0, return_inverse=True)
+            dense = host_profile_table(
+                boosted, uniq, models_active=self._models_active()
+            )[inv]
+            cap_rows = self._quota_cap_rows(chunk)
+            if cap_rows is not None:
+                dense = np.minimum(
+                    dense, self._quota_caps_np(cap_rows, requests)
+                )
+            reps_col = replicas.astype(np.int64)[:, None]
+            avail = np.where(reps_col == 0, mi, dense)
+            avail = np.where(avail == mi, reps_col, avail)
+            avail = np.minimum(avail, mi).astype(np.int32)
+
+            # ordered-affinity selection on the boosted numbers (the
+            # ranked path's exact predicate)
+            cp_slot: dict[int, int] = {}
+            unique_cps: list[CompiledPlacement] = []
+            cp_idx = np.zeros(b, np.int32)
+            for i, cp in enumerate(cchunk):
+                slot = cp_slot.get(id(cp))
+                if slot is None:
+                    slot = len(unique_cps)
+                    cp_slot[id(cp)] = slot
+                    unique_cps.append(cp)
+                cp_idx[i] = slot
+            tmax = max(len(cp.terms) for cp in unique_cps)
+            term_stack = np.zeros((len(unique_cps), tmax, snap.num_clusters), bool)
+            term_len_u = np.ones(len(unique_cps), np.int32)
+            for u, cp in enumerate(unique_cps):
+                term_len_u[u] = len(cp.terms)
+                for t, (_name, mask) in enumerate(cp.terms):
+                    term_stack[u, t] = mask
+            if "ClusterAffinity" in self.disabled_plugins:
+                term_stack[:] = True
+            cand_tc = base[:, None, :] & term_stack[cp_idx]
+            rank, _fit = mops.first_fit_group(
+                cand_tc,
+                term_len_u[cp_idx],
+                avail.astype(np.int64),
+                replicas.astype(np.int64),
+                prev.astype(np.int64),
+                (strategy == S_DYN) | (strategy == S_AGG),
+                fresh.astype(bool),
+            )
+            feasible = np.take_along_axis(
+                cand_tc, rank[:, None, None].astype(np.intp), axis=1
+            )[:, 0, :]
+            candidates = self._select_for_chunk(
+                chunk, cchunk, feasible, avail, prev
+            )
+            wmax = int(
+                max(
+                    int(avail.max(initial=0)) + int(prev.max(initial=0)),
+                    int(static_w.max(initial=0)),
+                    0,
+                )
+            )
+            lmax = int(prev.max(initial=0)) + 1
+            if (wmax + 1) * lmax * snap.num_clusters < 2**63:
+                from ..refimpl.divider_np import assign_batch_np
+
+                assignment, unschedulable = assign_batch_np(
+                    strategy, replicas, candidates, static_w,
+                    avail, prev, fresh,
+                )
+            else:
+                res = self._assign(
+                    strategy, replicas, candidates, static_w,
+                    jnp.asarray(avail), prev, fresh,
+                )
+                assignment = np.asarray(res.assignment)
+                unschedulable = np.asarray(res.unschedulable)
+            out.extend(
+                self._unpack(chunk, cchunk, rank, candidates,
+                             assignment, unschedulable)
+            )
+        return out
 
     # -- placement provenance (ISSUE 13) -----------------------------------
 
@@ -866,6 +1229,7 @@ class TensorScheduler:
         r = len(snap.dims)
         prev = np.zeros((b, c), np.int32)
         evict = np.zeros((b, c), bool)
+        preempted = np.zeros((b, c), bool)
         requests = np.zeros((b, r), np.int64)
         dim_index = {d: j for j, d in enumerate(snap.dims)}
         pods_dim = dim_index.get("pods")
@@ -878,6 +1242,10 @@ class TensorScheduler:
                 j = snap.index.get(name)
                 if j is not None:
                     evict[i, j] = True
+            for name in getattr(p, "preempt_clusters", ()):
+                j = snap.index.get(name)
+                if j is not None:
+                    preempted[i, j] = True
             for d, q in p.requests.items():
                 j = dim_index.get(d)
                 if j is not None:
@@ -1017,6 +1385,7 @@ class TensorScheduler:
                 pad_rows(spread_ok), pad_rows(avail), pad_rows(caps),
                 pad_rows(admitted, True), pad_rows(dynamic),
                 pad_rows(replicas), pad_rows(assignment), pad_rows(prev),
+                pad_rows(preempted),
             )
         )
         from ..parallel.mesh import mesh_shape
@@ -2059,7 +2428,7 @@ class TensorScheduler:
                     ScheduleResult(
                         key=p.key,
                         affinity_name=term_name,
-                        error="clusters available replicas are not enough",
+                        error=INSUFFICIENT_ERROR,
                     )
                 )
                 continue

@@ -66,6 +66,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops.divide import AGGREGATED, DUPLICATED as S_DUPLICATED, _divide_batch
 from ..ops.estimate import MAX_INT32, merge_estimates
 from ..ops.explain import explain_pass as _explain_pass
+from ..ops.preempt import preempt_select as _preempt_select
 from ..ops.quota import (
     quota_admit as _quota_admit,
     quota_cluster_caps as _quota_cluster_caps,
@@ -854,6 +855,10 @@ FLEET_KERNELS = {
     # dispatch, engine-side like the quota kernels — registered so
     # prewarm replay and the graftlint IR tier audit it with the rest
     "explain_pass": _explain_pass,
+    # scarcity plane (ops.preempt): the armed-only plane-wide victim
+    # selection, engine-side like quota/explain — same registration
+    # contract (prewarm replay + graftlint IR audit)
+    "preempt_select": _preempt_select,
 }
 
 

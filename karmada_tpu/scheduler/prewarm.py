@@ -74,6 +74,7 @@ _KERNELS = (
     "quota_admit",
     "quota_cluster_caps",
     "explain_pass",
+    "preempt_select",
 )
 
 

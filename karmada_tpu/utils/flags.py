@@ -309,6 +309,31 @@ ENV_FLAGS: dict[str, EnvFlag] = {
             "(TPU_E2E_r*.json); empty disables recording.",
             external=True,
         ),
+        EnvFlag(
+            "KARMADA_TPU_PREEMPTION", "1",
+            "Scarcity-plane kill switch (scheduler controller + engine): "
+            "0 disarms the batched preemption kernel — high-priority "
+            "waves that cannot fit stay unschedulable instead of "
+            "selecting victims. Disarmed costs one `is None` check per "
+            "engine pass (the quota/fault-injection pattern).",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_DESCHEDULE_MAX_DISRUPTION", "64",
+            "Continuous-descheduler disruption budget: the maximum "
+            "bindings one drift-rebalance round may stamp "
+            "RescheduleTriggeredAt on (highest-drift first; FIFO ties). "
+            "0 disables the tier entirely. Published per round as "
+            "karmada_tpu_desched_disruption_budget.",
+        ),
+        EnvFlag(
+            "KARMADA_TPU_ADMISSION_TIMEOUT", "5",
+            "Per-request read deadline (seconds) for the external "
+            "admission webhook channel (webhook.server.RemoteAdmission). "
+            "Each request gets ONE bounded retry on an unreachable/"
+            "timed-out webhook before admission fails — the webhook-boot "
+            "window under full-machine load is the case this absorbs; "
+            "raise it on oversubscribed CI rigs.",
+        ),
     )
 }
 

@@ -186,6 +186,25 @@ HISTORY_SERIES: dict[str, HistorySeries] = {
             "unschedulable/denied column",
         ),
         HistorySeries(
+            "preemptions", "counter",
+            "metric:karmada_tpu_preemptions_total",
+            "bindings displaced by the scarcity plane since the "
+            "previous sampled wave (victims of the preemption kernel + "
+            "descheduler drift triggers) — the `top` preempt column",
+        ),
+        HistorySeries(
+            "disruption_budget", "gauge",
+            "metric:karmada_tpu_desched_disruption_budget",
+            "the continuous descheduler's per-round trigger cap at wave "
+            "close (0 = tier disabled)",
+        ),
+        HistorySeries(
+            "disruption_used", "gauge",
+            "metric:karmada_tpu_desched_disruption_used",
+            "bindings the last drift-rebalance round re-placed (always "
+            "<= disruption_budget)",
+        ),
+        HistorySeries(
             "phases", "gauge", "span:settle",
             "per-phase SELF seconds dict — keys are SPAN_NAMES entries "
             "(digested as phases.<name> sub-series)",
@@ -292,8 +311,11 @@ class WaveHistory:
 
     def _build_row(self, tr, wave: int) -> dict:
         from .metrics import (
+            desched_disruption_budget,
+            desched_disruption_used,
             device_bytes as device_bytes_gauge,
             kernel_compiles,
+            preemptions_total,
             quota_denied,
             trace_spans_dropped,
             unschedulable_total,
@@ -397,6 +419,15 @@ class WaveHistory:
             ),
             "unschedulable": int(
                 _counter_delta("unschedulable", unschedulable_total)
+            ),
+            "preemptions": int(
+                _counter_delta("preemptions", preemptions_total)
+            ),
+            "disruption_budget": int(
+                sum(desched_disruption_budget.samples().values())
+            ),
+            "disruption_used": int(
+                sum(desched_disruption_used.samples().values())
             ),
             "phases": dict(summary.get("phases", {})),
         }
@@ -544,7 +575,7 @@ def render_history_table(rows: list[dict], proc: str = "") -> str:
         f"{'proc':<10} {'wave':>5} {'wall_s':>8} {'cover':>6} "
         f"{'bind/s':>8} {'packed':>7} {'replay':>7} {'cmpl':>4} "
         f"{'up/fetch MB':>12} {'rpc e/s/b':>11} {'devMB':>8} "
-        f"{'uns/den':>8} {'q':>4}"
+        f"{'uns/den':>8} {'pre':>4} {'dis u/b':>8} {'q':>4}"
     )
     lines = [head]
     for r in rows:
@@ -562,6 +593,8 @@ def render_history_table(rows: list[dict], proc: str = "") -> str:
             f"/{r.get('rpc_bus', 0):<5} "
             f"{r.get('device_bytes', 0) / 1e6:>8.2f} "
             f"{r.get('unschedulable', 0)}/{r.get('quota_denied', 0):<4} "
+            f"{r.get('preemptions', 0):>4} "
+            f"{r.get('disruption_used', 0)}/{r.get('disruption_budget', 0):<4} "
             f"{r.get('queue_depth', 0):>4}"
         )
     return "\n".join(lines)

@@ -463,6 +463,28 @@ unschedulable_total = registry.counter(
     "parked binding re-enqueued within one generation never "
     "double-counts (utils.reasons.TransitionDedup)",
 )
+preemptions_total = registry.counter(
+    "karmada_tpu_preemptions_total",
+    "bindings displaced by the scarcity plane, by REASONS-taxonomy code "
+    "(PreemptedByHigherPriority = victim of the batched preemption "
+    "kernel, RebalanceTriggered = continuous-descheduler drift "
+    "re-placement) — one increment per (binding, reason, generation) "
+    "transition via utils.reasons.TransitionDedup, so a twice-displaced "
+    "binding re-enqueued within one generation never double-counts",
+)
+desched_disruption_budget = registry.gauge(
+    "karmada_tpu_desched_disruption_budget",
+    "the continuous descheduler's per-wave disruption budget "
+    "(KARMADA_TPU_DESCHEDULE_MAX_DISRUPTION): the maximum bindings one "
+    "drift-rebalance round may stamp RescheduleTriggeredAt on; 0 = tier "
+    "disabled (published once per rebalance round beside the per-round "
+    "used level)",
+)
+desched_disruption_used = registry.gauge(
+    "karmada_tpu_desched_disruption_used",
+    "bindings the LAST drift-rebalance round actually re-placed (always "
+    "<= the published budget — the bench asserts the bound exactly)",
+)
 quota_denied = registry.counter(
     "karmada_tpu_quota_denied_total",
     "bindings newly denied admission by FederatedResourceQuota "

@@ -66,6 +66,7 @@ STAGE_REASONS: tuple[str, ...] = (
     "QuotaCapExceeded",  # bit 4
     "QuotaExceeded",  # bit 5
     "SpreadConstraintUnsatisfied",  # bit 6
+    "PreemptedByHigherPriority",  # bit 7
 )
 
 
@@ -119,6 +120,13 @@ REASONS: dict[str, Reason] = {
             "SpreadConstraintUnsatisfied",
             "cluster dropped by spread-constraint group selection "
             "(select_clusters.go), or fails a spread field filter",
+        ),
+        _stage(
+            "PreemptedByHigherPriority",
+            "the binding holds a preemption graceful-eviction task from "
+            "this cluster (the scarcity plane's victim path) — also the "
+            "eviction reason the preemption controller stamps and the "
+            "karmada_tpu_preemptions_total reason label",
         ),
         # -- scheduling conditions (Scheduled + unschedulable taxonomy) ----
         Reason("Success", "condition", "binding scheduled successfully"),
@@ -197,6 +205,21 @@ REASONS: dict[str, Reason] = {
         Reason(
             "AllAlive", "condition",
             "every operator-managed component process is alive",
+        ),
+        # -- scarcity-plane conditions/events (ISSUE 14) ---------------------
+        Reason(
+            "Preempted", "condition",
+            "victim binding displaced by the plane-wide preemption "
+            "kernel, awaiting re-placement through the ranked failover "
+            "path (condition type Preempted; the message names the "
+            "displacing binding)",
+        ),
+        Reason(
+            "RebalanceTriggered", "event",
+            "continuous-descheduler drift re-placement: the binding's "
+            "resident placement scored worse than a fresh solve and a "
+            "RescheduleTriggeredAt was stamped within the disruption "
+            "budget — also a karmada_tpu_preemptions_total reason label",
         ),
         # -- eviction events -------------------------------------------------
         Reason(

@@ -94,6 +94,10 @@ SPAN_NAMES: dict[str, str] = {
         "armed-only provenance capture of a pass: per-stage mask "
         "composition + the batched explain dispatch (ISSUE 13)"
     ),
+    "scheduler.preempt": (
+        "armed-only preemption round of a pass: plane-wide victim "
+        "selection + the boosted same-pass re-solve (ISSUE 14)"
+    ),
     "kernel.host": "kernel host phases: pack/upsert/sync/decode",
     "kernel.dispatch": (
         "kernel dispatch window (sync backends execute inside it; "

@@ -23,6 +23,11 @@ from typing import Optional
 from ..bus.service import decode_object, encode_object
 from .chain import AdmissionChain, default_admission_chain
 
+#: env knob for RemoteAdmission's per-request read deadline (registered
+#: in utils.flags ENV_FLAGS; module-level so the GL003 read-site scan
+#: resolves it)
+ADMISSION_TIMEOUT_ENV = "KARMADA_TPU_ADMISSION_TIMEOUT"
+
 
 class AdmissionDenied(Exception):
     pass
@@ -136,20 +141,40 @@ class RemoteAdmission:
     (default False = fail closed, the reference's default for its own
     policies)."""
 
+    #: A freshly-spawned webhook process on an oversubscribed machine
+    #: can take longer than the old fixed 5s to answer its FIRST request
+    #: (TLS handshake + interpreter warm-up behind a full test suite) —
+    #: the known spawn-family flake. The deadline is env-tunable
+    #: (ADMISSION_TIMEOUT_ENV) and every request gets ONE bounded retry
+    #: on an unreachable/timed-out channel (admission is a pure
+    #: check/mutate, so the retry is idempotent by construction).
+    TIMEOUT_ENV = ADMISSION_TIMEOUT_ENV
+
     def __init__(
         self,
         url: str,
         *,
         ca_bundle: Optional[bytes] = None,
-        timeout_seconds: float = 5.0,
+        timeout_seconds: Optional[float] = None,
         fail_open: bool = False,
     ):
+        import os
+
         self.url = url
+        if timeout_seconds is None:
+            raw = os.environ.get(ADMISSION_TIMEOUT_ENV, "").strip()
+            try:
+                timeout_seconds = float(raw) if raw else 5.0
+            except ValueError:
+                timeout_seconds = 5.0
         self.timeout = timeout_seconds
         self.fail_open = fail_open
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if ca_bundle is not None:
             self._ssl_ctx = ssl.create_default_context(cadata=ca_bundle.decode())
+
+    #: transport retries per request (bounded: exactly one re-dial)
+    RETRIES = 1
 
     def _post(self, kind: str, obj, operation: str):
         payload = json.dumps(
@@ -159,19 +184,27 @@ class RemoteAdmission:
                 "object": json.loads(encode_object(obj)),
             }
         ).encode()
-        req = urllib.request.Request(
-            self.url, data=payload,
-            headers={"Content-Type": "application/json"},
-        )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ssl_ctx
-            ) as resp:
-                body = json.loads(resp.read())
-        except (urllib.error.URLError, OSError) as exc:
+        body = None
+        last_exc: Optional[Exception] = None
+        for attempt in range(1 + self.RETRIES):
+            req = urllib.request.Request(
+                self.url, data=payload,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout, context=self._ssl_ctx
+                ) as resp:
+                    body = json.loads(resp.read())
+                break
+            except (urllib.error.URLError, OSError) as exc:
+                last_exc = exc
+        if body is None:
             if self.fail_open:
                 return None
-            raise AdmissionDenied(f"admission webhook unreachable: {exc}")
+            raise AdmissionDenied(
+                f"admission webhook unreachable: {last_exc}"
+            )
         if not body.get("allowed"):
             raise ValueError(body.get("message", "admission denied"))
         return body.get("object")

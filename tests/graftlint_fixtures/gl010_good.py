@@ -27,6 +27,12 @@ def emit(ready: bool):
     Condition(type="Scheduled", status=True, reason="Success")
     Condition(type="Scheduled", status=False, reason="QuotaExceeded")
     unschedulable_total.inc(reason="NoClusterFit")
+    # scarcity-plane codes (ISSUE 14): the victim condition, the
+    # preemption metric label and the drift-trigger label all resolve
+    Condition(
+        type="Preempted", status=True, reason="PreemptedByHigherPriority"
+    )
+    unschedulable_total.inc(reason="RebalanceTriggered")
     # dynamic reason: out of static reach, unchecked (the GL008 rule)
     reason = "ClusterReady" if ready else "ClusterNotReachable"
     Condition(type="Ready", status=ready, reason=reason)

@@ -80,6 +80,7 @@ def random_inputs(rng, b, c):
         replicas=rng.integers(0, 12, b).astype(np.int32),
         assignment=rng.integers(0, 6, (b, c)).astype(np.int32),
         prev=rng.integers(0, 6, (b, c)).astype(np.int32),
+        preempted=rng.random((b, c)) < 0.1,
     )
 
 
@@ -143,6 +144,7 @@ class TestKernelOracleIdentity:
             replicas=np.array([3], np.int32),
             assignment=np.array([[0, 3, 0, 0]], np.int32),
             prev=np.zeros((1, 4), np.int32),
+            preempted=np.zeros((1, 4), bool),
         )
         _m, topk = explain_pass(*args.values(), k=4)
         topk = np.asarray(topk)[0]

@@ -236,6 +236,7 @@ def test_gl010_live_registry_resolves():
     registered, and the classifier answers registered codes only."""
     from karmada_tpu.api.work import (
         EVICTION_REASON_APPLICATION_FAILURE,
+        EVICTION_REASON_PREEMPTED,
         EVICTION_REASON_TAINT_UNTOLERATED,
     )
     from karmada_tpu.scheduler.quota import QUOTA_EXCEEDED_REASON
@@ -254,6 +255,9 @@ def test_gl010_live_registry_resolves():
         QUOTA_EXCEEDED_REASON,
         EVICTION_REASON_TAINT_UNTOLERATED,
         EVICTION_REASON_APPLICATION_FAILURE,
+        EVICTION_REASON_PREEMPTED,
+        "Preempted",
+        "RebalanceTriggered",
     ):
         assert reason_registered(const), const
     for err, code in (

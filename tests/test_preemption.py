@@ -1,0 +1,995 @@
+"""ISSUE 14: the scarcity plane — priority classes, the batched
+plane-wide preemption kernel, and the continuous descheduler tier.
+
+Coverage map:
+- kernel vs the sequential numpy oracle (randomized, multi-class,
+  multi-dim, equal-or-higher-priority immunity, fewest-displacements
+  order), single-device and sharded;
+- engine integration: same-pass re-solve, quota composition (a denied
+  row never preempts; caps still bound the boosted re-solve), the
+  disarmed `is None` check;
+- controller e2e: victim evictions through the graceful-eviction
+  machinery, the Preempted condition, the TransitionDedup-gated
+  preemptions counter, priority-descending FIFO wave ordering, the
+  KARMADA_TPU_PREEMPTION kill switch, detector priority plumbing with
+  default-0 back-compat;
+- the continuous descheduler: drift triggers bounded by the disruption
+  budget exactly, RescheduleTriggeredAt honored (no re-stamp while
+  unconsumed), oracle-identical trigger sets;
+- the explain stage bit and the history/top scarcity columns;
+- the spawn-family hardening: RemoteAdmission's env-tunable deadline
+  with one bounded retry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karmada_tpu import cli as _cli
+from karmada_tpu.api import (
+    PropagationPolicy,
+    PropagationSpec,
+    ResourceSelector,
+)
+from karmada_tpu.api.core import ObjectMeta
+from karmada_tpu.api.policy import (
+    FederatedResourceQuota,
+    FederatedResourceQuotaSpec,
+    LabelSelector,
+)
+from karmada_tpu.api.work import PREEMPTED, SCHEDULED
+from karmada_tpu.estimator.accurate import NodeState
+from karmada_tpu.ops.preempt import preempt_select
+from karmada_tpu.refimpl.preempt_np import (
+    rebalance_np,
+    select_victims_np,
+)
+from karmada_tpu.scheduler import (
+    BindingProblem,
+    ClusterSnapshot,
+    TensorScheduler,
+)
+from karmada_tpu.scheduler.core import INSUFFICIENT_ERROR
+from karmada_tpu.scheduler.quota import (
+    QUOTA_EXCEEDED_ERROR,
+    build_quota_snapshot,
+)
+from karmada_tpu.utils.builders import (
+    dynamic_weight_placement,
+    new_cluster,
+    new_deployment,
+)
+from karmada_tpu.utils.member import MemberCluster
+from karmada_tpu.utils.metrics import preemptions_total
+from karmada_tpu.utils.quantity import parse_resource_list
+
+CPU_REQ = {"cpu": 1000}
+
+
+def reset_counter(counter) -> None:
+    """Zero a process-global counter between tests (no public reset —
+    counters are monotone by design)."""
+    with counter._lock:
+        counter._values.clear()
+
+
+# --------------------------------------------------------------------------
+# kernel vs oracle
+# --------------------------------------------------------------------------
+
+
+def random_rows(rng, b, r, c, classes=5):
+    prio = rng.integers(0, classes, b).astype(np.int32)
+    demand = np.zeros((b, r), np.int64)
+    freed = np.zeros((b, r), np.int64)
+    victim_ok = np.zeros(b, bool)
+    weight = np.zeros(b, np.int32)
+    assigned = np.zeros((b, c), np.int32)
+    requests = rng.integers(0, 8, (b, r)).astype(np.int64)
+    for i in range(b):
+        role = rng.integers(0, 3)
+        if role == 0 and prio[i] > 0:
+            demand[i] = rng.integers(0, 24, r)
+        elif role == 1:
+            assigned[i] = rng.integers(0, 4, c)
+            weight[i] = assigned[i].sum()
+            victim_ok[i] = weight[i] > 0
+            freed[i] = int(weight[i]) * requests[i]
+    return prio, demand, freed, victim_ok, weight, assigned, requests
+
+
+class TestKernelOracleIdentity:
+    def test_randomized_victims_identical(self):
+        rng = np.random.default_rng(7)
+        checked = 0
+        for _ in range(120):
+            b = int(rng.integers(1, 64))
+            r = int(rng.integers(1, 4))
+            c = int(rng.integers(1, 8))
+            rows = random_rows(rng, b, r, c)
+            prio, demand, freed, victim_ok, weight, assigned, requests = rows
+            v_dev, caps_dev = preempt_select(*rows)
+            want = select_victims_np(prio, demand, freed, victim_ok, weight)
+            assert np.asarray(v_dev).tolist() == want
+            want_caps = np.zeros((c, r), np.int64)
+            for i in range(b):
+                if want[i]:
+                    want_caps += assigned[i][:, None].astype(np.int64) * requests[i]
+            assert np.array_equal(np.asarray(caps_dev), want_caps)
+            checked += int(sum(want))
+        assert checked > 50  # the fuzz actually exercised selections
+
+    def test_never_victimizes_equal_or_higher_priority(self):
+        # one demander at prio 5; victims at prio 5 and 7 are immune,
+        # prio 4 is taken
+        prio = np.array([5, 5, 7, 4], np.int32)
+        demand = np.array([[10], [0], [0], [0]], np.int64)
+        freed = np.array([[0], [50], [50], [50]], np.int64)
+        victim_ok = np.array([False, True, True, True])
+        weight = np.array([0, 5, 5, 5], np.int32)
+        assigned = np.array([[0], [5], [5], [5]], np.int32)
+        requests = np.array([[10], [10], [10], [10]], np.int64)
+        v, _ = preempt_select(
+            prio, demand, freed, victim_ok, weight, assigned, requests
+        )
+        assert np.asarray(v).tolist() == [False, False, False, True]
+        assert select_victims_np(prio, demand, freed, victim_ok, weight) == [
+            False, False, False, True,
+        ]
+
+    def test_fewest_displacements_order(self):
+        # demand 6; victims free 6 (weight 6) and 3+3 (weight 3 each):
+        # the largest-weight victim alone covers it
+        prio = np.array([3, 0, 0, 0], np.int32)
+        demand = np.array([[6], [0], [0], [0]], np.int64)
+        freed = np.array([[0], [3], [6], [3]], np.int64)
+        victim_ok = np.array([False, True, True, True])
+        weight = np.array([0, 3, 6, 3], np.int32)
+        assigned = np.array([[0], [3], [6], [3]], np.int32)
+        requests = np.ones((4, 1), np.int64)
+        v, caps = preempt_select(
+            prio, demand, freed, victim_ok, weight, assigned, requests
+        )
+        assert np.asarray(v).tolist() == [False, False, True, False]
+        assert int(np.asarray(caps)[0, 0]) == 6
+
+    def test_lower_class_demand_cannot_take_higher_victims(self):
+        # demanders at 10 (needs 5) and 5 (needs 5); victims prio 1
+        # (frees 5) and prio 6 (frees 5): the prio-6 victim may only
+        # serve the prio-10 demand, which the prio-1 victim already
+        # covered — so it survives and the prio-5 demand stays unmet
+        prio = np.array([10, 5, 1, 6], np.int32)
+        demand = np.array([[5], [5], [0], [0]], np.int64)
+        freed = np.array([[0], [0], [5], [5]], np.int64)
+        victim_ok = np.array([False, False, True, True])
+        weight = np.array([0, 0, 5, 5], np.int32)
+        assigned = np.array([[0], [0], [5], [5]], np.int32)
+        requests = np.ones((4, 1), np.int64)
+        v, _ = preempt_select(
+            prio, demand, freed, victim_ok, weight, assigned, requests
+        )
+        assert np.asarray(v).tolist() == [False, False, True, False]
+        assert select_victims_np(prio, demand, freed, victim_ok, weight) == [
+            False, False, True, False,
+        ]
+
+    @pytest.mark.parametrize("devices", (2, 4))
+    def test_sharded_identity(self, devices):
+        from karmada_tpu.parallel.mesh import scheduling_mesh
+
+        rng = np.random.default_rng(devices)
+        mesh = scheduling_mesh(devices)
+        for b in (16, 32):
+            rows = random_rows(rng, b, 3, 6)
+            v1, c1 = preempt_select(*rows)
+            v2, c2 = preempt_select(*rows, mesh=mesh)
+            assert np.array_equal(np.asarray(v1), np.asarray(v2))
+            assert np.array_equal(np.asarray(c1), np.asarray(c2))
+
+    def test_registries_in_lockstep(self):
+        from karmada_tpu.scheduler import fleet, prewarm
+
+        assert "preempt_select" in fleet.FLEET_KERNELS
+        assert "preempt_select" in prewarm._KERNELS
+        import tools.graftlint.ir as ir
+
+        assert "preempt_select" in ir.ENTRY_POINTS
+
+
+# --------------------------------------------------------------------------
+# engine integration
+# --------------------------------------------------------------------------
+
+
+def saturated_snapshot(c=2, cap_cpu=4):
+    """Clusters with all CPU allocated: any dynamic-weight demand is
+    insufficient until something frees capacity."""
+    return ClusterSnapshot([
+        new_cluster(
+            f"m{i}", cpu=str(cap_cpu), memory="100Gi",
+            allocated={"cpu": str(cap_cpu)},
+        )
+        for i in range(c)
+    ])
+
+
+def demander(key, replicas=4, prio=100, ns=""):
+    return BindingProblem(
+        key=key,
+        placement=dynamic_weight_placement(),
+        replicas=replicas,
+        requests=dict(CPU_REQ),
+        gvk="apps/v1/Deployment",
+        namespace=ns,
+        priority=prio,
+    )
+
+
+def resident(key, prev, prio=0):
+    return BindingProblem(
+        key=key,
+        placement=dynamic_weight_placement(),
+        replicas=sum(prev.values()),
+        requests=dict(CPU_REQ),
+        gvk="apps/v1/Deployment",
+        prev=dict(prev),
+        priority=prio,
+    )
+
+
+class TestEnginePreemption:
+    def test_same_pass_resolve(self):
+        eng = TensorScheduler(saturated_snapshot(), trace_manifest="")
+        pool = [
+            resident("v0", {"m0": 1, "m1": 1}),
+            resident("v1", {"m0": 1, "m1": 1}),
+            resident("v2", {"m0": 1, "m1": 1}),
+            resident("v3", {"m0": 1, "m1": 1}),
+        ]
+        eng.set_preemption(lambda exclude: pool)
+        res = eng.schedule([demander("hi", replicas=4)])
+        assert res[0].success, res[0].error
+        assert sum(res[0].clusters.values()) == 4
+        out = eng.last_preemption
+        assert out is not None and len(out.victims) == 2
+        assert out.placed == ["hi"]
+        # freed capacity landed on the victims' clusters
+        assert out.freed_caps is not None and out.freed_caps.sum() > 0
+
+    def test_disarmed_is_none_check(self):
+        eng = TensorScheduler(saturated_snapshot(), trace_manifest="")
+        res = eng.schedule([demander("hi")])
+        assert res[0].error == INSUFFICIENT_ERROR
+        assert eng.last_preemption is None
+
+    def test_priority_zero_never_demands(self):
+        eng = TensorScheduler(saturated_snapshot(), trace_manifest="")
+        called = []
+        eng.set_preemption(lambda exclude: called.append(1) or [])
+        res = eng.schedule([demander("lo", prio=0)])
+        assert res[0].error == INSUFFICIENT_ERROR
+        assert not called  # no priority>0 demander: no victim-pool call
+
+    def test_no_eligible_victims_stays_unschedulable(self):
+        eng = TensorScheduler(saturated_snapshot(), trace_manifest="")
+        # residents at the SAME priority: immune
+        pool = [resident("v0", {"m0": 2, "m1": 2}, prio=100)]
+        eng.set_preemption(lambda exclude: pool)
+        res = eng.schedule([demander("hi", prio=100)])
+        assert res[0].error == INSUFFICIENT_ERROR
+        out = eng.last_preemption
+        assert out is not None and not out.victims
+        assert out.still_unschedulable == ["hi"]
+
+    def test_quota_denied_row_never_preempts(self):
+        snap = saturated_snapshot()
+        eng = TensorScheduler(snap, trace_manifest="")
+        q = FederatedResourceQuota(
+            meta=ObjectMeta(name="q", namespace="a"),
+            spec=FederatedResourceQuotaSpec(overall={"cpu": 0}),
+        )
+        eng.set_quota(build_quota_snapshot([q], snap, generation=1))
+        pool = [resident("v0", {"m0": 2, "m1": 2})]
+        calls = []
+
+        def source(exclude):
+            calls.append(1)
+            return pool
+
+        eng.set_preemption(source)
+        res = eng.schedule([demander("a/hi", ns="a")])
+        assert res[0].error == QUOTA_EXCEEDED_ERROR
+        assert not calls  # denied by quota: never reached victim selection
+
+    def test_boosted_resolve_still_respects_static_caps(self):
+        from karmada_tpu.api.policy import StaticClusterAssignment
+
+        snap = saturated_snapshot()
+        eng = TensorScheduler(snap, trace_manifest="")
+        q = FederatedResourceQuota(
+            meta=ObjectMeta(name="q", namespace="a"),
+            spec=FederatedResourceQuotaSpec(
+                overall={"cpu": 1 << 40},
+                static_assignments=[StaticClusterAssignment(
+                    cluster_name="m0", hard={"cpu": 0}
+                )],
+            ),
+        )
+        eng.set_quota(build_quota_snapshot([q], snap, generation=1))
+        pool = [
+            resident("v0", {"m0": 2, "m1": 2}),
+            resident("v1", {"m0": 2, "m1": 2}),
+        ]
+        eng.set_preemption(lambda exclude: pool)
+        res = eng.schedule([demander("a/hi", replicas=2, ns="a")])
+        assert res[0].success, res[0].error
+        # the cap-zeroed cluster stays excluded even though victims
+        # freed capacity there
+        assert "m0" not in res[0].clusters
+
+    def test_trace_ledgered_and_manifest_kernel_registered(self):
+        eng = TensorScheduler(saturated_snapshot(), trace_manifest="")
+        pool = [resident("v0", {"m0": 2, "m1": 2})]
+        eng.set_preemption(lambda exclude: pool)
+        eng.schedule([demander("hi", replicas=2)])
+        assert any(k[0] == "P" for k in eng._engine_traces)
+
+
+# --------------------------------------------------------------------------
+# controller e2e (the scarcity storm in miniature)
+# --------------------------------------------------------------------------
+
+
+def scarcity_plane(n_clusters=2, cap_cpu=4):
+    cp = _cli.cmd_init()
+    members = {}
+    for i in range(n_clusters):
+        name = f"c{i}"
+        caps = {"cpu": str(cap_cpu), "memory": "100Gi", "pods": 1000}
+        m = MemberCluster(name)
+        m.nodes = [NodeState(
+            name=f"{name}-n0", allocatable=parse_resource_list(caps)
+        )]
+        members[name] = m
+        cp.join_cluster(new_cluster(name, **caps), m)
+    cp.settle()
+    pl = dynamic_weight_placement()
+
+    def policy(name, tier, priority=0):
+        return PropagationPolicy(
+            meta=ObjectMeta(name=name, namespace="default"),
+            spec=PropagationSpec(
+                resource_selectors=[ResourceSelector(
+                    api_version="apps/v1", kind="Deployment",
+                    label_selector=LabelSelector(
+                        match_labels={"tier": tier}
+                    ),
+                )],
+                placement=pl,
+                priority=priority,
+            ),
+        )
+
+    cp.store.apply(policy("low", "low"))
+    cp.store.apply(policy("high", "high", priority=100))
+
+    def sync_member_usage():
+        """The kubelet's role in this harness: node.requested mirrors
+        bound replicas so summaries are genuine capacity math."""
+        usage = {name: {} for name in members}
+        for rb in cp.store.list("ResourceBinding"):
+            req = (
+                rb.spec.replica_requirements.resource_request
+                if rb.spec.replica_requirements
+                else {}
+            )
+            for tc in rb.spec.clusters:
+                acc = usage.get(tc.name)
+                if acc is None:
+                    continue
+                for res, qty in req.items():
+                    acc[res] = acc.get(res, 0) + qty * tc.replicas
+                acc["pods"] = acc.get("pods", 0) + tc.replicas
+        for name, m in members.items():
+            m.nodes[0].requested = dict(usage[name])
+        cp.settle()
+
+    return cp, members, sync_member_usage
+
+
+def fill_low(cp, sync, n=4, replicas=2):
+    for i in range(n):
+        cp.store.apply(new_deployment(
+            f"low{i}", replicas=replicas, cpu="1", memory="1Gi",
+            labels={"tier": "low"},
+        ))
+    cp.settle()
+    sync()
+
+
+class TestControllerE2E:
+    def setup_method(self):
+        reset_counter(preemptions_total)
+
+    def test_surge_evicts_victims_and_places(self):
+        cp, members, sync = scarcity_plane()
+        fill_low(cp, sync)
+        cp.store.apply(new_deployment(
+            "hi", replicas=4, cpu="1", memory="1Gi",
+            labels={"tier": "high"},
+        ))
+        cp.settle()
+        hi = cp.store.get("ResourceBinding", "default/hi-deployment")
+        assert sum(tc.replicas for tc in hi.spec.clusters) == 4
+        assert hi.spec.priority == 100
+        victims = [
+            rb
+            for rb in cp.store.list("ResourceBinding")
+            if any(
+                t.reason == "PreemptedByHigherPriority"
+                for t in rb.spec.graceful_eviction_tasks
+            )
+        ]
+        assert len(victims) == 2
+        for rb in victims:
+            assert not rb.spec.clusters  # fully displaced
+            cond = next(
+                c for c in rb.status.conditions if c.type == PREEMPTED
+            )
+            assert cond.status and "hi-deployment" in cond.message
+            for t in rb.spec.graceful_eviction_tasks:
+                assert t.producer == "PreemptionKernel"
+        samples = preemptions_total.samples()
+        assert samples == {
+            (("reason", "PreemptedByHigherPriority"),): 2.0
+        }
+
+    def test_transition_dedup_never_double_counts(self):
+        """A displaced binding re-enqueued across settle waves within
+        one displacement episode counts exactly once; a NEW displacement
+        after a successful re-placement counts anew."""
+        cp, members, sync = scarcity_plane()
+        fill_low(cp, sync)
+        cp.store.apply(new_deployment(
+            "hi", replicas=4, cpu="1", memory="1Gi",
+            labels={"tier": "high"},
+        ))
+        cp.settle()
+        count0 = sum(preemptions_total.samples().values())
+        assert count0 == 2
+        # re-settle storms within the same episode: the parked victims
+        # re-enqueue but the counter must not move
+        for _ in range(3):
+            for kind in ("ResourceBinding",):
+                for rb in cp.store.list(kind):
+                    cp.scheduler.worker.enqueue(
+                        (kind, rb.meta.namespaced_name)
+                    )
+            cp.settle()
+        assert sum(preemptions_total.samples().values()) == count0
+        # free the fleet: drop the high-priority workload, let evictions
+        # time out, and re-place the victims — the episode closes
+        cp.store.delete("Resource", "default/hi")
+        for rb in cp.store.list("ResourceBinding"):
+            rb.spec.graceful_eviction_tasks = []
+            cp.store.apply(rb)
+        # sync-settle until the usage mirror is stable: freed capacity
+        # lets the parked victims re-place, and the NEXT sync must see
+        # those placements before the second storm arrives
+        for _ in range(3):
+            sync()
+            cp.settle()
+        placed = [
+            rb for rb in cp.store.list("ResourceBinding")
+            if rb.spec.clusters
+        ]
+        assert len(placed) == 4  # every low binding re-placed
+        for rb in placed:
+            cond = next(
+                (c for c in rb.status.conditions if c.type == PREEMPTED),
+                None,
+            )
+            assert cond is None or not cond.status  # episode resolved
+        # a second storm displaces fresh victims: counts again
+        cp.store.apply(new_deployment(
+            "hi2", replicas=4, cpu="1", memory="1Gi",
+            labels={"tier": "high"},
+        ))
+        cp.settle()
+        assert sum(preemptions_total.samples().values()) == count0 + 2
+
+    def test_kill_switch_disarms(self, monkeypatch):
+        monkeypatch.setenv("KARMADA_TPU_PREEMPTION", "0")
+        cp, members, sync = scarcity_plane()
+        fill_low(cp, sync)
+        cp.store.apply(new_deployment(
+            "hi", replicas=4, cpu="1", memory="1Gi",
+            labels={"tier": "high"},
+        ))
+        cp.settle()
+        hi = cp.store.get("ResourceBinding", "default/hi-deployment")
+        cond = next(
+            c for c in hi.status.conditions if c.type == SCHEDULED
+        )
+        assert not cond.status
+        assert cond.reason == "InsufficientReplicas"
+        assert not any(
+            rb.spec.graceful_eviction_tasks
+            for rb in cp.store.list("ResourceBinding")
+        )
+        assert preemptions_total.samples() == {}
+
+    def test_equal_priority_is_immune(self):
+        cp, members, sync = scarcity_plane()
+        # fill with HIGH-priority workloads, surge with the same class
+        for i in range(4):
+            cp.store.apply(new_deployment(
+                f"hi{i}", replicas=2, cpu="1", memory="1Gi",
+                labels={"tier": "high"},
+            ))
+        cp.settle()
+        sync()
+        cp.store.apply(new_deployment(
+            "hi-late", replicas=4, cpu="1", memory="1Gi",
+            labels={"tier": "high"},
+        ))
+        cp.settle()
+        late = cp.store.get("ResourceBinding", "default/hi-late-deployment")
+        cond = next(
+            c for c in late.status.conditions if c.type == SCHEDULED
+        )
+        assert not cond.status  # nothing below it to displace
+        assert not any(
+            rb.spec.graceful_eviction_tasks
+            for rb in cp.store.list("ResourceBinding")
+        )
+
+    def test_wave_orders_priority_desc_fifo_within_class(self):
+        cp, members, sync = scarcity_plane(n_clusters=2, cap_cpu=1000)
+        seen = []
+        orig = TensorScheduler.schedule
+
+        def spy(self, problems):
+            seen.append([
+                (p.key, getattr(p, "priority", 0)) for p in problems
+            ])
+            return orig(self, problems)
+
+        TensorScheduler.schedule = spy
+        try:
+            # interleave low/high arrivals in one wave
+            for i in range(3):
+                cp.store.apply(new_deployment(
+                    f"low{i}", replicas=1, cpu="1", memory="1Gi",
+                    labels={"tier": "low"},
+                ))
+                cp.store.apply(new_deployment(
+                    f"hi{i}", replicas=1, cpu="1", memory="1Gi",
+                    labels={"tier": "high"},
+                ))
+            cp.settle()
+        finally:
+            TensorScheduler.schedule = orig
+        wave = next(w for w in seen if len(w) == 6)
+        prios = [p for _, p in wave]
+        assert prios == sorted(prios, reverse=True)
+        his = [k for k, p in wave if p == 100]
+        lows = [k for k, p in wave if p == 0]
+        # FIFO within each class: arrival order preserved
+        assert his == sorted(his, key=lambda k: int(k[10]))
+        assert lows == sorted(lows, key=lambda k: int(k[11]))
+
+    def test_detector_priority_plumb_and_default(self):
+        cp, members, sync = scarcity_plane(cap_cpu=1000)
+        cp.store.apply(new_deployment(
+            "hi0", replicas=1, cpu="1", memory="1Gi",
+            labels={"tier": "high"},
+        ))
+        cp.store.apply(new_deployment(
+            "low0", replicas=1, cpu="1", memory="1Gi",
+            labels={"tier": "low"},
+        ))
+        cp.settle()
+        hi = cp.store.get("ResourceBinding", "default/hi0-deployment")
+        low = cp.store.get("ResourceBinding", "default/low0-deployment")
+        assert hi.spec.priority == 100
+        assert low.spec.priority == 0
+        # back-compat: a checkpoint written by a pre-priority build
+        # unpickles without the field — reads as 0, not a spec change
+        del low.spec.__dict__["priority"]
+        assert cp.scheduler._problem_for(
+            "default/low0-deployment", low, False
+        ).priority == 0
+        gen = low.meta.generation
+        cp.detector.worker.enqueue("default/low0")
+        cp.settle()
+        low2 = cp.store.get("ResourceBinding", "default/low0-deployment")
+        assert low2.meta.generation == gen  # no spurious generation bump
+
+
+# --------------------------------------------------------------------------
+# the continuous descheduler tier
+# --------------------------------------------------------------------------
+
+
+def drift_plane(budget=None, monkeypatch=None):
+    cp = _cli.cmd_init(enable_drift_rebalancer=True)
+    # manual rounds only: the ticker would re-run per settle pass
+    cp.drift_rebalancer.active = False
+    members = {}
+
+    def add_cluster(name, cpu):
+        caps = {"cpu": str(cpu), "memory": "100Gi", "pods": 1000}
+        m = MemberCluster(name)
+        m.nodes = [NodeState(
+            name=f"{name}-n0", allocatable=parse_resource_list(caps)
+        )]
+        members[name] = m
+        cp.join_cluster(new_cluster(name, **caps), m)
+
+    add_cluster("c0", 8)
+    add_cluster("c1", 8)
+    cp.settle()
+    cp.store.apply(PropagationPolicy(
+        meta=ObjectMeta(name="pol", namespace="default"),
+        spec=PropagationSpec(
+            resource_selectors=[ResourceSelector(
+                api_version="apps/v1", kind="Deployment"
+            )],
+            placement=dynamic_weight_placement(),
+        ),
+    ))
+    return cp, members, add_cluster
+
+
+class TestContinuousDescheduler:
+    def setup_method(self):
+        reset_counter(preemptions_total)
+
+    def test_steady_plane_triggers_nothing(self):
+        cp, members, _add = drift_plane()
+        for i in range(3):
+            cp.store.apply(new_deployment(
+                f"w{i}", replicas=4, cpu="1", memory="1Gi"
+            ))
+        cp.settle()
+        stats = cp.drift_rebalancer.rebalance_once()
+        assert stats["drifted"] == 0 and not stats["triggered"]
+
+    def test_drift_triggers_bounded_by_budget(self, monkeypatch):
+        monkeypatch.setenv("KARMADA_TPU_DESCHEDULE_MAX_DISRUPTION", "2")
+        cp, members, add_cluster = drift_plane()
+        for i in range(4):
+            cp.store.apply(new_deployment(
+                f"w{i}", replicas=4, cpu="1", memory="1Gi"
+            ))
+        cp.settle()
+        before = {
+            rb.meta.namespaced_name: {
+                tc.name: tc.replicas for tc in rb.spec.clusters
+            }
+            for rb in cp.store.list("ResourceBinding")
+        }
+        # a new, much larger cluster joins: the fresh solve would spread
+        # replicas onto it — every resident placement drifts
+        add_cluster("c2", 64)
+        cp.settle()
+        from karmada_tpu.utils.metrics import (
+            desched_disruption_budget,
+            desched_disruption_used,
+        )
+
+        stats = cp.drift_rebalancer.rebalance_once()
+        assert stats["budget"] == 2
+        assert stats["drifted"] >= 3
+        assert len(stats["triggered"]) == 2  # the budget, exactly
+        assert sum(desched_disruption_budget.samples().values()) == 2
+        assert sum(desched_disruption_used.samples().values()) == 2
+        samples = preemptions_total.samples()
+        assert samples == {(("reason", "RebalanceTriggered"),): 2.0}
+        # the triggered bindings re-place as Fresh waves
+        cp.settle()
+        for key in stats["triggered"]:
+            now = {
+                tc.name: tc.replicas
+                for tc in cp.store.get("ResourceBinding", key).spec.clusters
+            }
+            assert now != before[key]
+            assert "c2" in now
+        # a second round while nothing else drifted: the re-placed rows
+        # score 0; remaining drifted rows (beyond the old budget) trigger
+        stats2 = cp.drift_rebalancer.rebalance_once()
+        assert all(
+            k not in stats["triggered"] for k in stats2["triggered"]
+        )
+
+    def test_unconsumed_trigger_never_restamped(self, monkeypatch):
+        monkeypatch.setenv("KARMADA_TPU_DESCHEDULE_MAX_DISRUPTION", "8")
+        cp, members, add_cluster = drift_plane()
+        cp.store.apply(new_deployment("w0", replicas=4, cpu="1", memory="1Gi"))
+        cp.settle()
+        add_cluster("c2", 64)
+        cp.settle()
+        stats = cp.drift_rebalancer.rebalance_once()
+        assert stats["triggered"] == ["default/w0-deployment"]
+        rb = cp.store.get("ResourceBinding", "default/w0-deployment")
+        stamp = rb.spec.reschedule_triggered_at
+        # the trigger is pending (we have not settled): a second round
+        # must skip the binding entirely
+        stats2 = cp.drift_rebalancer.rebalance_once()
+        assert stats2 is None or not stats2["triggered"]
+        assert rb.spec.reschedule_triggered_at == stamp
+        assert sum(preemptions_total.samples().values()) == 1
+
+    def test_dry_solve_leaves_no_trace(self):
+        """A scoring pass must not touch the live plane: the quota
+        working remaining is restored (a dry admit never debits budget
+        real bindings need) and the provenance store captures nothing
+        (a hypothetical fresh solve must not overwrite a binding's real
+        decision chain)."""
+        from karmada_tpu.utils.explainstore import ExplainStore
+
+        cp, members, _add = drift_plane()
+        cp.store.apply(FederatedResourceQuota(
+            meta=ObjectMeta(name="q", namespace="default"),
+            spec=FederatedResourceQuotaSpec(overall={"cpu": 100000}),
+        ))
+        cp.store.apply(new_deployment(
+            "w0", replicas=4, cpu="1", memory="1Gi"
+        ))
+        cp.settle()
+        rb = cp.store.get("ResourceBinding", "default/w0-deployment")
+        # a pending scale-up: delta demand > 0, so a leaky dry solve
+        # WOULD debit remaining
+        rb.spec.replicas += 2
+        problem = cp.scheduler._problem_for(
+            "default/w0-deployment", rb, True
+        )
+        engine = cp.scheduler._inproc_engine()
+        store = ExplainStore(cap=4)
+        engine.set_explain(store)
+        cp.scheduler._ensure_engine_quota(engine)
+        before = engine.quota.remaining.copy()
+        res = cp.scheduler.dry_solve([problem])
+        assert res[0].success
+        assert np.array_equal(engine.quota.remaining, before)
+        assert store.debug_doc(proc="t")["waves"] == []  # no captures
+        assert engine.explain is store  # re-armed after the dry pass
+
+    def test_budget_zero_disables(self, monkeypatch):
+        monkeypatch.setenv("KARMADA_TPU_DESCHEDULE_MAX_DISRUPTION", "0")
+        cp, members, add_cluster = drift_plane()
+        cp.store.apply(new_deployment("w0", replicas=4, cpu="1", memory="1Gi"))
+        cp.settle()
+        add_cluster("c2", 64)
+        cp.settle()
+        assert cp.drift_rebalancer.rebalance_once() is None
+        rb = cp.store.get("ResourceBinding", "default/w0-deployment")
+        assert rb.spec.reschedule_triggered_at is None
+
+    def test_oracle_identical_trigger_set(self, monkeypatch):
+        """The controller's trigger set matches the sequential numpy
+        rebalance oracle exactly (drift desc, arrival asc, budget cap)."""
+        monkeypatch.setenv("KARMADA_TPU_DESCHEDULE_MAX_DISRUPTION", "2")
+        cp, members, add_cluster = drift_plane()
+        for i in range(4):
+            cp.store.apply(new_deployment(
+                f"w{i}", replicas=2 + i, cpu="1", memory="1Gi"
+            ))
+        cp.settle()
+        add_cluster("c2", 64)
+        cp.settle()
+        engine = cp.scheduler._inproc_engine()
+        snap = engine.snapshot
+        from karmada_tpu.scheduler.snapshot import compile_placement
+
+        keys, current, candidates, strategies, replicas, avail = (
+            [], {}, {}, {}, {}, {}
+        )
+        for rb in cp.store.list("ResourceBinding"):
+            key = rb.meta.namespaced_name
+            keys.append(key)
+            current[key] = {
+                tc.name: tc.replicas for tc in rb.spec.clusters
+            }
+            cpl = compile_placement(rb.spec.placement, snap)
+            candidates[key] = (
+                cpl.terms[0][1] & cpl.taint_ok & cpl.spread_field_ok
+            )
+            strategies[key] = int(cpl.strategy)
+            replicas[key] = rb.spec.replicas
+            req = np.zeros((1, len(snap.dims)), np.int64)
+            for d, q in (
+                rb.spec.replica_requirements.resource_request or {}
+            ).items():
+                j = snap.dim_index(d)
+                if j is not None:
+                    req[0, j] = q
+            pods = snap.dim_index("pods")
+            if pods is not None:
+                req[0, pods] = max(req[0, pods], 1)
+            avail[key] = engine._availability_np(
+                req, np.asarray([rb.spec.replicas], np.int32)
+            )[0]
+        _drifts, want = rebalance_np(
+            keys,
+            names=snap.names,
+            current=current,
+            candidates=candidates,
+            strategies=strategies,
+            replicas=replicas,
+            avail=avail,
+            budget=2,
+        )
+        stats = cp.drift_rebalancer.rebalance_once()
+        assert stats["triggered"] == want
+
+
+# --------------------------------------------------------------------------
+# explain stage bit + history columns
+# --------------------------------------------------------------------------
+
+
+class TestSurfaces:
+    def test_explain_preempted_stage_bit(self):
+        from karmada_tpu.utils.explainstore import ExplainStore
+
+        snap = ClusterSnapshot([
+            new_cluster("m0", cpu="1000", memory="100Gi"),
+            new_cluster("m1", cpu="1000", memory="100Gi"),
+        ])
+        eng = TensorScheduler(snap, trace_manifest="")
+        store = ExplainStore(cap=4)
+        eng.set_explain(store)
+        victim = BindingProblem(
+            key="d/victim",
+            placement=dynamic_weight_placement(),
+            replicas=2,
+            requests=dict(CPU_REQ),
+            gvk="apps/v1/Deployment",
+            evict_clusters=("m0",),
+            preempt_clusters=("m0",),
+        )
+        res = eng.schedule([victim])
+        assert res[0].success
+        doc = store.explain_binding("d/victim")
+        assert "PreemptedByHigherPriority" in doc["stages"]
+        assert doc["stages"]["PreemptedByHigherPriority"]["clusters"] == [
+            "m0"
+        ]
+        # the eviction ALSO explains as the folded taint stage — both
+        # bits name the same cluster, the preemption one says WHY
+        assert doc["stages"]["TaintUntolerated"]["clusters"] == ["m0"]
+
+    def test_history_row_carries_scarcity_columns(self):
+        from karmada_tpu.utils.history import (
+            HISTORY_SERIES,
+            WaveHistory,
+            render_history_table,
+        )
+        from karmada_tpu.utils.tracing import WaveTracer
+
+        for name in (
+            "preemptions", "disruption_budget", "disruption_used",
+        ):
+            assert name in HISTORY_SERIES
+        tr = WaveTracer()
+        hist = WaveHistory(cap=8)
+        wave = tr.ensure_wave("test")
+        with tr.span("settle"):
+            preemptions_total.inc(reason="PreemptedByHigherPriority")
+        hist.sample(tr, wave)
+        hist.sample(tr, wave)  # baseline seeded: second row deltas 0
+        preemptions_total.inc(reason="RebalanceTriggered")
+        row = hist.sample(tr, wave)
+        assert row["preemptions"] == 1
+        assert "disruption_budget" in row and "disruption_used" in row
+        table = render_history_table([row])
+        assert "pre" in table.splitlines()[0]
+
+    def test_top_parses_preemption_levels(self):
+        from karmada_tpu.cli import cmd_plane_top
+
+        reset_counter(preemptions_total)
+        preemptions_total.inc(reason="PreemptedByHigherPriority")
+        preemptions_total.inc(reason="RebalanceTriggered")
+        doc = cmd_plane_top()
+        entry = next(iter(doc["procs"].values()))
+        assert entry["preemptions_total"] == 2
+        assert entry["preemptions_by_reason"] == {
+            "PreemptedByHigherPriority": 1,
+            "RebalanceTriggered": 1,
+        }
+
+    def test_reasons_registered(self):
+        from karmada_tpu.utils.reasons import REASONS, STAGE_REASONS
+
+        assert STAGE_REASONS[7] == "PreemptedByHigherPriority"
+        assert REASONS["PreemptedByHigherPriority"].stage_bit == 7
+        assert REASONS["Preempted"].kind == "condition"
+        assert REASONS["RebalanceTriggered"].kind == "event"
+
+
+# --------------------------------------------------------------------------
+# spawn-family hardening: the admission channel's boot window
+# --------------------------------------------------------------------------
+
+
+class TestRemoteAdmissionRetry:
+    def test_env_tunable_deadline(self, monkeypatch):
+        from karmada_tpu.webhook.server import RemoteAdmission
+
+        monkeypatch.setenv("KARMADA_TPU_ADMISSION_TIMEOUT", "7.5")
+        assert RemoteAdmission("http://x/admit").timeout == 7.5
+        monkeypatch.setenv("KARMADA_TPU_ADMISSION_TIMEOUT", "bogus")
+        assert RemoteAdmission("http://x/admit").timeout == 5.0
+        monkeypatch.delenv("KARMADA_TPU_ADMISSION_TIMEOUT")
+        assert RemoteAdmission(
+            "http://x/admit", timeout_seconds=1.25
+        ).timeout == 1.25
+
+    def test_one_bounded_retry_absorbs_slow_first_request(self):
+        """The regression: a webhook process slow to answer its FIRST
+        request (machine under full-suite load) used to fail admission
+        outright; one bounded retry absorbs exactly that window."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from karmada_tpu.webhook.server import RemoteAdmission
+
+        hits = []
+
+        class SlowFirst(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                hits.append(time.time())
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if len(hits) == 1:
+                    time.sleep(1.0)  # past the 0.3s deadline
+                data = json.dumps(
+                    {"allowed": True, "object": body.get("object")}
+                ).encode()
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except (BrokenPipeError, ConnectionError):
+                    pass
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), SlowFirst)
+        thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        thread.start()
+        try:
+            remote = RemoteAdmission(
+                f"http://127.0.0.1:{httpd.server_address[1]}/admit",
+                timeout_seconds=0.3,
+            )
+            obj = new_deployment("w0")
+            remote.admit("Resource", obj)  # would raise without retry
+            assert len(hits) == 2
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+
+    def test_retry_is_bounded(self):
+        from karmada_tpu.webhook.server import (
+            AdmissionDenied,
+            RemoteAdmission,
+        )
+
+        remote = RemoteAdmission(
+            "http://127.0.0.1:9/admit", timeout_seconds=0.2
+        )
+        t0 = time.time()
+        with pytest.raises(AdmissionDenied):
+            remote.admit("Resource", new_deployment("w0"))
+        assert time.time() - t0 < 5.0  # two fast refusals, not a spin

@@ -104,6 +104,16 @@ SPECS: dict[str, tuple[GuardMetric, ...]] = {
     "quota_surge": (
         GuardMetric("value", "lower", 2.5),
     ),
+    "preempt_storm": (
+        # scarcity-storm time-to-stable (the surge settle wall)
+        GuardMetric("value", "lower", 2.5),
+        # disarmed-vs-armed engine.schedule ratio: 1.0 means arming is
+        # free; fires if the scarcity plane ever becomes a structural
+        # steady-storm cost (the explain_overhead_x discipline)
+        GuardMetric("preempt_overhead_x", "lower", 2.0),
+        # the bounded-disruption drift round's wall
+        GuardMetric("drift_round_s", "lower", 2.5, required=False),
+    ),
     "estimator512_wire": (
         GuardMetric("value", "lower", 2.5),
     ),

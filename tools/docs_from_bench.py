@@ -377,6 +377,52 @@ def quota_block(qd: dict) -> str:
     )
 
 
+def preempt_block(pd: dict) -> str:
+    """Rows for a ``bench.py --preemption`` record (the scarcity tier):
+    the high-priority surge against an exactly-saturated fleet with the
+    victim/placement oracle-parity flags, the batched-solve shape, the
+    armed-vs-disarmed steady-storm bound, and the bounded-disruption
+    drift round."""
+    scale = pd.get("metric", "").removeprefix("preempt_storm_")
+    vic = {True: "IDENTICAL", False: "DIVERGED"}[
+        bool(pd.get("victims_identical"))
+    ]
+    plc = {True: "IDENTICAL", False: "DIVERGED"}[
+        bool(pd.get("placements_identical"))
+    ]
+    return "\n".join(
+        [
+            f"| preempt {scale}: high-priority surge on a saturated "
+            f"fleet ({pd.get('surged_bindings', 0):,} priority-100 "
+            f"bindings, zero free capacity) | "
+            f"{fmt(pd.get('surge_wave_s'))} to stable, "
+            f"{pd.get('victims_evicted', 0):,} victims evicted in "
+            f"{pd.get('preemption_passes', 0)} preemption pass(es), "
+            f"{pd.get('surge_solves', 0)} batched solves over "
+            f"{pd.get('surge_engine_passes', 0)} engine passes |",
+            f"| preempt {scale}: oracle parity (sequential numpy victim "
+            f"selection + boosted per-binding divides) | victims {vic} "
+            f"({pd.get('victims_checked', 0):,} rows), demander "
+            f"placements {plc} ({pd.get('placements_checked', 0):,} "
+            f"rows) |",
+            f"| preempt {scale}: arming overhead on steady storms | "
+            f"wall armed {fmt(pd.get('steady_p50_armed_s'))} vs "
+            f"disarmed {fmt(pd.get('steady_p50_disarmed_s'))}; engine "
+            f"schedule {fmt(pd.get('steady_sched_armed_s'))} vs "
+            f"{fmt(pd.get('steady_sched_disarmed_s'))} "
+            f"({pd.get('preempt_overhead_x', 0):.3f}×) |",
+            f"| preempt {scale}: continuous-descheduler drift round | "
+            f"{pd.get('drift_drifted', 0):,} of "
+            f"{pd.get('drift_scored', 0):,} residents drifted; "
+            f"{pd.get('drift_triggered', 0)}/{pd.get('drift_budget', 0)} "
+            f"triggered (budget exact={pd.get('drift_budget_exact')}, "
+            f"oracle identical={pd.get('drift_oracle_identical')}), "
+            f"{pd.get('drift_replaced', 0)} re-placed in "
+            f"{fmt(pd.get('drift_round_s'))} |",
+        ]
+    )
+
+
 def multichip_block(md: dict) -> str:
     """Rows for a ``bench.py --multichip`` record (the sharded-engine
     tier): per-mesh steady p50 with the placement-identity flags, the
@@ -435,6 +481,8 @@ def extra_block(src: Path) -> str:
         return chaos_block(d)
     if metric.startswith("quota_surge"):
         return quota_block(d)
+    if metric.startswith("preempt_storm"):
+        return preempt_block(d)
     if metric.startswith("multichip_scaling"):
         return multichip_block(d)
     raise SystemExit(f"{src}: unrecognized bench record metric {metric!r}")

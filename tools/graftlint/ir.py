@@ -231,7 +231,7 @@ def _specs_explain_pass() -> list:
         ((_B, _C), "bool"), ((_B, _C), "bool"), ((_B, _C), "bool"),
         ((_B, _C), "bool"), ((_B, _C), "int32"), ((_B, _C), "int32"),
         ((_B,), "bool"), ((_B,), "bool"), ((_B,), "int32"),
-        ((_B, _C), "int32"), ((_B, _C), "int32"),
+        ((_B, _C), "int32"), ((_B, _C), "int32"), ((_B, _C), "bool"),
     )
     return [
         KernelSpec("base", row, {"k": 4, "mesh": None, "shard_c": False}),
@@ -243,6 +243,27 @@ def _specs_explain_pass() -> list:
         # kernels' contract (ISSUE 9 / test_sharded_specs_cover_*)
         KernelSpec("sharded-b2", row,
                    {"k": 4, "mesh": _MESH2, "shard_c": False}),
+    ]
+
+
+def _specs_preempt_select() -> list:
+    # the engine's preemption padding shape: pow2 combined demander+
+    # victim rows x cluster columns x resource dims
+    # (scheduler.core._preempt_pass)
+    row = (
+        ((_B,), "int32"), ((_B, _R), "int64"), ((_B, _R), "int64"),
+        ((_B,), "bool"), ((_B,), "int32"), ((_B, _C), "int32"),
+        ((_B, _R), "int64"),
+    )
+    return [
+        KernelSpec("base", row, {"mesh": None}),
+        KernelSpec("wide-wave", tuple(
+            ((4 * _B,) + s[0][1:], s[1]) for s in row
+        ), {"mesh": None}),
+        # sharded grid: the victim selection under a 2-device ("b")
+        # mesh — IR001-IR005 run over the PARTITIONED jaxpr (the global
+        # sort/cumsum replication guard is audited, not assumed)
+        KernelSpec("sharded-b2", row, {"mesh": _MESH2}),
     ]
 
 
@@ -469,6 +490,12 @@ ENTRY_POINTS: dict = {
         _entry("explain_pass", "ops", "karmada_tpu.ops.explain",
                "explain_pass", "karmada_tpu/ops/explain.py",
                _specs_explain_pass, manifest="explain_pass"),
+        # scarcity family: the armed-only plane-wide victim selection
+        # (engine-side like quota/explain, manifest-recorded, with a
+        # sharded-b2 variant auditing the partitioned jaxpr)
+        _entry("preempt_select", "ops", "karmada_tpu.ops.preempt",
+               "preempt_select", "karmada_tpu/ops/preempt.py",
+               _specs_preempt_select, manifest="preempt_select"),
         _entry("masks.contains_all", "masks", "karmada_tpu.ops.masks",
                "contains_all", "karmada_tpu/ops/masks.py",
                _specs_masks_contains_all),
