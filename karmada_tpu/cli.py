@@ -20,6 +20,8 @@ import json
 import sys
 from typing import Optional, Sequence
 
+from typing import TYPE_CHECKING
+
 from .api.cluster import NO_EXECUTE, NO_SCHEDULE, PULL, Cluster, Taint
 from .api.core import ObjectMeta
 from .api.policy import (
@@ -29,10 +31,23 @@ from .api.policy import (
     PropagationSpec,
     ResourceSelector,
 )
-from .controlplane import ControlPlane
-from .search import ProxyRequest
 from .utils.builders import new_cluster
-from .utils.member import MemberCluster
+
+if TYPE_CHECKING:  # runtime imports are DEFERRED: controlplane/search/
+    # member all reach the estimator (and therefore jax) at import time,
+    # and this is an entry module — the GL005 cold-start contract. The
+    # lint verb additionally depends on it: the IR/dep tiers must set
+    # XLA_FLAGS before this process's FIRST jax import or the sharded
+    # spec variants cannot materialize their >=2-device mesh.
+    from .controlplane import ControlPlane
+    from .search import ProxyRequest
+    from .utils.member import MemberCluster
+
+
+def _proxy_request(**kw) -> "ProxyRequest":
+    from .search import ProxyRequest
+
+    return ProxyRequest(**kw)
 
 CORDON_TAINT_KEY = "node.karmada.io/unschedulable"  # cordon analogue
 
@@ -237,6 +252,8 @@ class RemotePlane:
 
 def cmd_init(**kw) -> ControlPlane:
     """Bootstrap a control plane (karmadactl init / operator install)."""
+    from .controlplane import ControlPlane
+
     return ControlPlane(**kw)
 
 
@@ -355,7 +372,7 @@ def cmd_get(
     """Multi-cluster get/list through the proxy chain."""
     verb = "get" if name else "list"
     return cp.proxy.connect(
-        ProxyRequest(
+        _proxy_request(
             verb=verb, gvk=gvk, namespace=namespace, name=name,
             cluster=cluster, labels=dict(labels or {}),
         )
@@ -409,7 +426,7 @@ def cmd_promote(
     else:
         # remote plane: fetch the live object through the cluster proxy
         resp = cp.proxy.connect(
-            ProxyRequest(
+            _proxy_request(
                 verb="get", gvk=gvk, namespace=namespace, name=name,
                 cluster=cluster_name,
             )
@@ -473,7 +490,7 @@ def cmd_logs(
     """karmadactl logs: pod logs through the clusters/{name}/proxy
     passthrough (pkg/karmadactl/logs)."""
     resp = cp.proxy.connect(
-        ProxyRequest(
+        _proxy_request(
             verb="logs", gvk="v1/Pod", namespace=namespace, name=pod,
             cluster=cluster, options={"tail": tail},
         )
@@ -489,7 +506,7 @@ def cmd_exec(
     """karmadactl exec: run a command in a member pod via the proxy
     (pkg/karmadactl/exec)."""
     resp = cp.proxy.connect(
-        ProxyRequest(
+        _proxy_request(
             verb="exec", gvk="v1/Pod", namespace=namespace, name=pod,
             cluster=cluster, options={"command": list(command)},
         )
@@ -1303,10 +1320,13 @@ def build_parser() -> tuple:
         "tier (GL001 trace safety, GL002 trace-key completeness, GL003 "
         "env-flag registry, GL004 lock discipline, GL005 import hygiene, "
         "GL006 metric naming, GL007 bounded RPCs, GL008 span taxonomy, "
-        "GL009 history series sources, GL010 reason taxonomy) "
-        "and, with --ir, the jaxpr-level kernel auditor (IR001 dtype "
+        "GL009 history series sources, GL010 reason taxonomy, GL011 "
+        "lock-read discipline, GL012 budget-in-loop, GL013 bounded "
+        "caches), with --ir the jaxpr-level kernel auditor (IR001 dtype "
         "discipline, IR002 host round-trips, IR003 const capture, IR004 "
-        "trace-manifest fidelity, IR005 donation audit)",
+        "trace-manifest fidelity, IR005 donation audit), with --dep the "
+        "row-dependence certifier (IR006 row_coupled declarations, IR007 "
+        "replicated-scan discipline), and with --all every tier at once",
     )
     li.add_argument(
         "paths", nargs="*",
@@ -1325,13 +1345,24 @@ def build_parser() -> tuple:
         "rollout (docs/OPERATIONS.md)",
     )
     li.add_argument(
+        "--dep", action="store_true",
+        help="run the dep tier: certify every kernel's row_coupled "
+        "declaration against its jaxpr (delta-safety) and the "
+        "replicated-scan discipline in sharded variants",
+    )
+    li.add_argument(
+        "--all", dest="all_tiers", action="store_true",
+        help="run AST + IR + dep tiers in one invocation (merged exit "
+        "code, per-tier timing) — the CI/rollout gate shape",
+    )
+    li.add_argument(
         "--manifest", default=None, metavar="PATH",
         help="IR tier: also audit a prewarm trace manifest (every record "
         "must re-trace to its recorded signature)",
     )
     li.add_argument(
         "--changed-only", action="store_true",
-        help="AST tier: lint only files with uncommitted git changes "
+        help="scope every tier to files with uncommitted git changes "
         "(the pre-commit mode, see docs/DEVELOPMENT.md)",
     )
     return parser, sub
@@ -1339,8 +1370,8 @@ def build_parser() -> tuple:
 
 def cmd_lint(
     paths: Sequence[str] = (), *, fmt: str = "text", baseline: bool = True,
-    ir: bool = False, manifest: str | None = None,
-    changed_only: bool = False,
+    ir: bool = False, dep: bool = False, all_tiers: bool = False,
+    manifest: str | None = None, changed_only: bool = False,
 ) -> int:
     """The ``lint`` verb: run the repo's static analyzer
     (tools/graftlint) over ``paths`` (default: the package + tools).
@@ -1367,6 +1398,10 @@ def cmd_lint(
         argv.append("--no-baseline")
     if ir:
         argv.append("--ir")
+    if dep:
+        argv.append("--dep")
+    if all_tiers:
+        argv.append("--all")
     if manifest is not None:
         argv += ["--manifest", manifest]
     if changed_only:
@@ -1928,8 +1963,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.command == "lint":
         return cmd_lint(
             args.paths, fmt=args.format, baseline=not args.no_baseline,
-            ir=args.ir, manifest=args.manifest,
-            changed_only=args.changed_only,
+            ir=args.ir, dep=args.dep, all_tiers=args.all_tiers,
+            manifest=args.manifest, changed_only=args.changed_only,
         )
     if args.command == "trace":
         if args.action == "analyze":

@@ -91,6 +91,13 @@ def take_by_weight(
     return init + dispensed
 
 
+# row_coupled: the graftlint-dep delta-safety declarations — unbatched,
+# every vector lives over the cluster axis C and the batched form is a
+# vmap (one binding per row, no cross-binding flow); IR006-proven, see
+# tools/graftlint/dep.py
+take_by_weight.row_coupled = False
+
+
 def take_by_weight_fast(
     num: jnp.ndarray,  # int32 scalar
     weights: jnp.ndarray,  # int32[C], >= 0, < 2^w_bits
@@ -220,6 +227,9 @@ def take_by_weight_fast(
     return out
 
 
+take_by_weight_fast.row_coupled = False  # same C-axis-only math as above
+
+
 # Batched over bindings: num[B], weights[B,C], last[B,C], init[B,C] -> [B,C]
 _tbw_batch = {
     w: jax.vmap(partial(take_by_weight, wide=w), in_axes=(0, 0, 0, 0))
@@ -229,3 +239,6 @@ _tbw_batch = {
 
 def take_by_weight_batch(num, weights, last, init, wide: bool = True):
     return _tbw_batch[bool(wide)](num, weights, last, init)
+
+
+take_by_weight_batch.row_coupled = False  # vmap of the per-binding kernel

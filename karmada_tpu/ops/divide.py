@@ -257,3 +257,10 @@ def divide_replicas(
         has_aggregated, wide, fast,
     )
     return DivideResult(assignment=out, unschedulable=unsched)
+
+
+# row_coupled: the graftlint-dep delta-safety declaration — the batch is
+# a vmap of the per-binding _divide_one (its sorts/cumsums run over the
+# cluster axis, never across bindings); IR006-proven against the jaxpr,
+# see tools/graftlint/dep.py
+divide_replicas.row_coupled = False

@@ -38,6 +38,13 @@ def general_estimate(
     return jnp.minimum(best, jnp.int64(2**31 - 1)).astype(jnp.int32)
 
 
+# row_coupled: the graftlint-dep delta-safety declarations — request row
+# b reads only requests[b] (the cap table is replicated state), so the
+# estimator family is certified delta_safe (IR006-proven against the
+# jaxpr; see tools/graftlint/dep.py)
+general_estimate.row_coupled = False
+
+
 def gather_profile_rows(
     table: jnp.ndarray,  # int32[U, C]
     idx: jnp.ndarray,  # int32[B]
@@ -73,6 +80,9 @@ def gather_profile_rows(
     return (hi_g << 16) | lo_g
 
 
+gather_profile_rows.row_coupled = False  # row b reads table[idx[b]] only
+
+
 @jax.jit
 def general_estimate_interned(
     available_cap: jnp.ndarray,  # int64[C, R]
@@ -92,6 +102,9 @@ def general_estimate_interned(
     return gather_profile_rows(per_profile, prof_idx)
 
 
+general_estimate_interned.row_coupled = False  # per-row profile lookup
+
+
 @jax.jit
 def merge_estimates(
     replicas: jnp.ndarray,  # int32[B]
@@ -107,3 +120,6 @@ def merge_estimates(
         out = jnp.where(est == UNAUTHENTIC, out, jnp.minimum(out, est))
     out = jnp.where(replicas[:, None] == 0, MAX_INT32, out)
     return jnp.where(out == MAX_INT32, replicas[:, None], out)
+
+
+merge_estimates.row_coupled = False  # element-wise min across estimators

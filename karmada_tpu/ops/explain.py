@@ -154,6 +154,13 @@ def explain_pass(
     return mask, topk
 
 
+# row_coupled: the graftlint-dep delta-safety declaration — the stage
+# masks are element-wise bit-ors and the top-k summary ranks over the
+# CLUSTER axis within each row; IR006-proven row-independent, see
+# tools/graftlint/dep.py
+explain_pass.row_coupled = False
+
+
 def topk_width(c: int, k: int = 8) -> int:
     """The kernel's static ``k`` for a ``c``-cluster snapshot: the
     requested width clamped to the cluster count (one trace per (padded

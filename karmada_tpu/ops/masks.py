@@ -68,9 +68,18 @@ def contains_all(bits, require) -> np.ndarray:
     return ((bits & require) == require).all(axis=-1)
 
 
+# row_coupled: the graftlint-dep delta-safety declaration (row i of the
+# output reads only row i of ``bits``) — certified against the jaxpr by
+# IR006, see tools/graftlint/dep.py
+contains_all.row_coupled = False
+
+
 def intersects(bits, other) -> np.ndarray:
     """bool[...]: any common bit."""
     return ((bits & other) != 0).any(axis=-1)
+
+
+intersects.row_coupled = False  # per-row word reduce; IR006-certified
 
 
 def affinity_group_rank(term_masks: np.ndarray) -> np.ndarray:
@@ -111,37 +120,67 @@ def first_fit_group(
     ClusterAffinities length, almost always <= 4) over fully-batched
     [B, C] reductions — O(B*T*C) adds, no [B, T, C] integer temporaries.
     """
-    b, t, c = cand_tc.shape
-    num = replicas.astype(np.int64)
+    if isinstance(cand_tc, np.ndarray):
+        return _first_fit_group_kernel(
+            np, cand_tc, term_len, avail, replicas, prev, dynamic, fresh,
+        )
+    import jax.numpy as jnp  # device path: lazy so masks stays jax-free
+
+    return _first_fit_group_kernel(
+        jnp, cand_tc, term_len, avail, replicas, prev, dynamic, fresh,
+    )
+
+
+# the cohort selection consumes plane-merged availability: per-row over
+# B, but changing any binding moves avail for every other row (the
+# graftlint-dep plane channel; see tools/graftlint/dep.py)
+first_fit_group.row_coupled = True
+
+
+def _first_fit_group_kernel(
+    xp, cand_tc, term_len, avail, replicas, prev, dynamic, fresh
+):
+    """Backend-generic body of :func:`first_fit_group` (xp is numpy for
+    the snapshot path, jax.numpy under a trace)."""
+    _b, t, _c = cand_tc.shape
+    num = replicas.astype(xp.int64)
     prev_full_sum = prev.sum(axis=1)
-    avail_sum = np.empty((b, t), np.int64)
-    prev_sum = np.empty((b, t), np.int64)
     cand_any = cand_tc.any(axis=2)
-    for ti in range(t):
-        ct = cand_tc[:, ti, :]
-        avail_sum[:, ti] = np.where(ct, avail, 0).sum(axis=1)
-        prev_sum[:, ti] = np.where(ct, prev, 0).sum(axis=1)
+    # per-term masked sums as a stack over the short static T axis (the
+    # same O(B*T*C) adds as the old in-place fill, but expressible on
+    # immutable device arrays)
+    avail_sum = xp.stack(
+        [xp.where(cand_tc[:, ti, :], avail, 0).sum(axis=1)
+         for ti in range(t)],
+        axis=1,
+    )
+    prev_sum = xp.stack(
+        [xp.where(cand_tc[:, ti, :], prev, 0).sum(axis=1)
+         for ti in range(t)],
+        axis=1,
+    )
     dyn = dynamic[:, None]
     fr = fresh[:, None]
     num_col = num[:, None]
     scale_down = dyn & ~fr & (prev_sum > num_col)
     scale_up = dyn & ~fr & (prev_sum < num_col)
     steady = dyn & ~fr & (prev_sum == num_col)
-    target = np.where(scale_up, num_col - prev_sum, num_col)
-    w_sum = np.where(
+    target = xp.where(scale_up, num_col - prev_sum, num_col)
+    w_sum = xp.where(
         fr,
         avail_sum + prev_sum,
-        np.where(scale_down, prev_full_sum[:, None], avail_sum),
+        xp.where(scale_down, prev_full_sum[:, None], avail_sum),
     )
     unsched = dyn & ~steady & (w_sum < target)
-    live = np.arange(t, dtype=np.int32)[None, :] < term_len[:, None]
+    live = xp.arange(t, dtype=xp.int32)[None, :] < term_len[:, None]
     fit_t = cand_any & ~unsched & live
     fit = fit_t.any(axis=1)
-    # first-fitting-group extraction = affinity_group_rank over the fit
-    # matrix viewed as [B, T, 1] (same first-true-index primitive)
-    rank = affinity_group_rank(fit_t[:, :, None])[:, 0]
-    last = np.maximum(term_len - 1, 0).astype(np.int32)
-    return np.where(fit, rank, last).astype(np.int32), fit
+    # first-fitting-group extraction: first-true-index over the T axis
+    # (affinity_group_rank's primitive, inlined backend-generically)
+    term_idx = xp.arange(t, dtype=xp.int32)[None, :]
+    rank = xp.where(fit_t, term_idx, xp.int32(t)).min(axis=1)
+    last = xp.maximum(term_len - 1, 0).astype(xp.int32)
+    return xp.where(fit, rank, last).astype(xp.int32), fit
 
 
 def label_pair(key: str, value: str) -> str:

@@ -165,3 +165,11 @@ def preempt_select(
             freed_caps, NamedSharding(mesh, P(None, None))
         )
     return victims, freed_caps
+
+
+# row_coupled: the graftlint-dep delta-safety declaration — victim
+# selection is cross-row by design (plane-wide priority sorts and
+# cumulative freed-capacity scans over B, plus the row-contracting
+# freed-caps einsum); never delta-replayable. IR006 verifies the
+# coupling is still present, see tools/graftlint/dep.py
+preempt_select.row_coupled = True

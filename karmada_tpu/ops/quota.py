@@ -110,6 +110,13 @@ def quota_admit(
     return admitted, wave_used[:n]
 
 
+# row_coupled: the graftlint-dep delta-safety declaration — FIFO
+# admission is cross-row by design (the plane-wide argsort/cumsum over B
+# and the per-namespace running max); never delta-replayable. IR006
+# verifies the coupling is still present, see tools/graftlint/dep.py
+quota_admit.row_coupled = True
+
+
 def _cluster_caps_kernel(xp, caps, ns_rows, requests):
     """Shared body of the static-assignment cap estimate: ONE body serves
     both array modules (jit kernel + numpy mirror) so the host and device
@@ -152,3 +159,6 @@ def quota_cluster_caps(
     (MAX_INT32 = no constraint) — estimator-shaped, min-merged into the
     divide kernel's availability by the engine."""
     return _cluster_caps_kernel(jnp, caps, ns_rows, requests)
+
+
+quota_cluster_caps.row_coupled = False  # row b reads caps[ns_rows[b]] only

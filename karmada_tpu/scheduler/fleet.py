@@ -834,6 +834,18 @@ def _gather_meta(res_meta, rows):
     ).astype(jnp.uint8).reshape(-1)
 
 
+# row_coupled: the graftlint-dep delta-safety declarations (IR006-
+# checked against the traced jaxprs, see tools/graftlint/dep.py). The
+# solve/pass/entries kernels compact globally across the resident cap
+# axis (coupled); bits/meta are per-row — bits' scan windowing keeps the
+# analyzer's verdict 'unproven', so neither is delta_safe yet.
+_fleet_solve.row_coupled = True
+_fleet_pass.row_coupled = True
+_fleet_entries.row_coupled = True
+_fleet_bits.row_coupled = False
+_gather_meta.row_coupled = False
+
+
 #: THE solve-family kernel registry: prewarm's manifest replay
 #: (scheduler/prewarm._jit_registry) and the graftlint IR tier's
 #: entry-point registry (tools/graftlint/ir.py) both resolve kernels
@@ -1065,6 +1077,11 @@ _STATE_FIELDS = (
 @jax.jit
 def _scatter_rows(state, rows, vals):
     return tuple(a.at[rows].set(v) for a, v in zip(state, vals))
+
+
+# data-dependent row placement: writes land at ``rows``, so one update
+# moves another slot's data — cross-row by construction (IR006-proven)
+_scatter_rows.row_coupled = True
 
 
 class FleetTable:

@@ -65,24 +65,27 @@ _SCHEMA_VERSION = 1
 #: This is the jax-free NAME mirror of fleet.FLEET_KERNELS —
 #: TraceManifest._load filters on it without importing the engine;
 #: _jit_registry asserts the two stay in lockstep (and graftlint IR004
-#: machine-checks it in tier-1).
-_KERNELS = (
-    "fleet_solve",
-    "fleet_pass",
-    "fleet_entries",
-    "fleet_bits",
-    "quota_admit",
-    "quota_cluster_caps",
-    "explain_pass",
-    "preempt_select",
-)
+#: machine-checks it in tier-1). Values are the ``row_coupled``
+#: delta-safety declarations — the jax-free mirror of each kernel's own
+#: ``row_coupled`` attribute, checked for agreement (and proven against
+#: the traced jaxprs) by graftlint IR006.
+_KERNELS = {
+    "fleet_solve": True,
+    "fleet_pass": True,
+    "fleet_entries": True,
+    "fleet_bits": False,
+    "quota_admit": True,
+    "quota_cluster_caps": False,
+    "explain_pass": False,
+    "preempt_select": True,
+}
 
 
 def _jit_registry() -> dict:
     from . import fleet
 
     registry = dict(fleet.FLEET_KERNELS)
-    assert set(registry) == set(_KERNELS), (sorted(registry), _KERNELS)
+    assert set(registry) == set(_KERNELS), (sorted(registry), sorted(_KERNELS))
     return registry
 
 
@@ -144,6 +147,9 @@ class TraceManifest:
                         self._seen.add(c)
                         self.records.append(r)
 
+    # called-with-lock-held helper (the *_locked convention): load() and
+    # record() hold self._lock around it, so the self.records read is
+    # serialized with every writer  # graftlint: disable=GL011
     def _save(self) -> None:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         tmp = f"{self.path}.tmp.{os.getpid()}"
@@ -204,9 +210,11 @@ class TraceManifest:
 
     def keys(self) -> set:
         """The observed ledger keys, as tuples (seeding form)."""
+        with self._lock:
+            records = list(self.records)
         return {
             _retuple(r["key"])
-            for r in self.records
+            for r in records
             if r.get("key") is not None
         }
 
@@ -218,9 +226,11 @@ class TraceManifest:
         ok = _WARMED.get(self.path)
         if not ok:
             return set()
+        with self._lock:
+            records = list(self.records)
         return {
             _retuple(r["key"])
-            for r in self.records
+            for r in records
             if r.get("key") is not None and _canon(r) in ok
         }
 

@@ -105,7 +105,7 @@ class InvertedIndexBackend:
 
     # called-with-lock-held helper (the ``_locked`` suffix contract):
     # every caller above holds self._lock
-    # graftlint: disable=GL004
+    # graftlint: disable=GL004,GL011
     def _remove_locked(self, doc_id: str) -> None:
         doc = self._docs.pop(doc_id, None)
         if doc is None:

@@ -229,6 +229,11 @@ class ExplainStore:
         with self._lock:
             return self._evicted
 
+    @property
+    def added(self) -> int:
+        with self._lock:
+            return self._added
+
     def clear(self) -> None:
         with self._lock:
             self._captures.clear()
@@ -344,7 +349,7 @@ class ExplainStore:
         doc: dict = {
             "proc": proc,
             "cap": self.cap,
-            "added": self._added,
+            "added": self.added,
             "evicted": self.evicted,
             "waves": sorted({c.wave for c in self.captures()}),
         }

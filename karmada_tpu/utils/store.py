@@ -318,7 +318,13 @@ class Store:
             self._all_watchers = [h for h in self._all_watchers if h is not handler]
 
     def _deliver(self, event: Event) -> None:
-        for handler in list(self._watchers.get(event.kind, [])):
-            handler(event)
-        for handler in list(self._all_watchers):
+        # snapshot the handler lists under the lock, call OUTSIDE it — a
+        # handler mutating watchers mid-delivery must not tear the
+        # iteration, and delivery under the lock would hold it across
+        # arbitrary handler code (the lock is an RLock, but handlers can
+        # block on other threads that need the store)
+        with self._lock:
+            handlers = list(self._watchers.get(event.kind, ()))
+            handlers += list(self._all_watchers)
+        for handler in handlers:
             handler(event)

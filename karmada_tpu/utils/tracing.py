@@ -452,14 +452,17 @@ class WaveTracer:
         """This tracer's per-wave telemetry ring (utils.history.
         WaveHistory) — the process-wide tracer's instance backs
         ``/debug/history`` and ``karmadactl-tpu top``."""
-        if self._history is None:
+        # double-checked locking: the unlocked fast-path read is the
+        # point (every span close consults the ring); the locked
+        # re-check makes the one-time publication race-free
+        if self._history is None:  # graftlint: disable=GL011
             from .history import WaveHistory
 
             fresh = WaveHistory()
             with self._lock:
                 if self._history is None:
                     self._history = fresh
-        return self._history
+        return self._history  # graftlint: disable=GL011
 
     def wave_trace_id(self, wave: Optional[int] = None) -> str:
         with self._lock:
@@ -527,8 +530,12 @@ class WaveTracer:
         """The context a CLIENT seam propagates: the innermost open span
         (or ambient context) of this thread, else the current wave."""
         wave, trace_id, parent = self._open_ctx()
+        # self.proc is set once at entrypoint boot (set_process) before
+        # any span flows; the client-seam read stays deliberately
+        # lock-free on the span hot path
         return TraceContext(
-            wave=wave, trace_id=trace_id, span_id=parent, proc=self.proc
+            wave=wave, trace_id=trace_id, span_id=parent,
+            proc=self.proc,  # graftlint: disable=GL011
         )
 
     @contextmanager
@@ -611,7 +618,8 @@ class WaveTracer:
         a local parent (ids are per-process), so it lands in
         ``remote_parent`` (+ ``caller``) for the stitcher to re-parent;
         an in-process caller (same ``proc``) just nests naturally."""
-        if ctx is None or ctx.proc == self.proc:
+        # set-once proc read (see current_context), lock-free by design
+        if ctx is None or ctx.proc == self.proc:  # graftlint: disable=GL011
             with self.span(name, **attrs) as sp:
                 yield sp
             return
@@ -680,7 +688,8 @@ class WaveTracer:
         nests naturally) for handler windows that suspend across the
         handler thread (the bus Watch replay generator). Close with
         ``close_manual``."""
-        if ctx is not None and ctx.proc != self.proc:
+        # set-once proc read (see current_context), lock-free by design
+        if ctx is not None and ctx.proc != self.proc:  # graftlint: disable=GL011
             attrs = dict(attrs)
             attrs["remote_parent"] = ctx.span_id
             attrs["caller"] = ctx.proc

@@ -1,0 +1,24 @@
+"""GL011 bad fixture: attrs mutated under the lock in one method, READ
+lock-free in another. Parsed by graftlint only."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_key = {}
+        self._order = []
+
+    def put(self, key, value):
+        with self._lock:
+            self._by_key[key] = value
+            self._order.append(key)
+
+    def snapshot(self):
+        return dict(self._by_key)  # BAD: lock-free read of a guarded attr
+
+    def newest(self):
+        if not self._order:  # BAD: lock-free read of a guarded attr
+            return None
+        return self._order[-1]

@@ -1,0 +1,32 @@
+"""GL011 good fixture: every read of a guarded attr under the lock (or
+a documented racy-read invariant), plus the __init__ exemption and the
+write-side carve-outs that belong to GL004. Parsed by graftlint only."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_key = {}
+        self._order = []
+        self.count = len(self._by_key)  # OK: pre-publication window
+
+    def put(self, key, value):
+        with self._lock:
+            self._by_key[key] = value
+            self._order.append(key)
+
+    def snapshot(self):
+        with self._lock:  # OK: snapshot under the lock
+            return dict(self._by_key)
+
+    def drop(self, key):
+        with self._lock:
+            self._by_key.pop(key, None)
+            self._order.remove(key)
+
+    # stats() tolerates a torn size: the value feeds a gauge, and the
+    # next scrape self-corrects
+    def stats(self):
+        return len(self._by_key)  # graftlint: disable=GL011

@@ -1,0 +1,21 @@
+"""GL012 bad fixture: budget objects constructed inside retry loops —
+the budget resets every iteration. Parsed by graftlint only."""
+
+from karmada_tpu.utils.backoff import BackoffPolicy, Deadline
+
+
+def fetch_all(fetch, items):
+    results = []
+    for item in items:
+        deadline = Deadline(5.0)  # BAD: fresh budget per iteration
+        results.append(fetch(item, timeout=deadline.remaining()))
+    return results
+
+
+def reconnect(connect, stop):
+    while not stop.is_set():
+        policy = BackoffPolicy(base=0.1, cap=2.0)  # BAD: ladder resets
+        try:
+            return connect(policy)
+        except ConnectionError:
+            continue

@@ -1,0 +1,41 @@
+"""GL012 good fixture: budgets hoisted above their loops, the closure
+carve-out, and a documented per-item budget. Parsed by graftlint only."""
+
+from karmada_tpu.utils.backoff import BackoffPolicy, Deadline
+
+
+def fetch_all(fetch, items):
+    deadline = Deadline(5.0)  # OK: ONE budget bounds the whole loop
+    results = []
+    for item in items:
+        results.append(fetch(item, timeout=deadline.remaining()))
+    return results
+
+
+def reconnect(connect, stop):
+    policy = BackoffPolicy(base=0.1, cap=2.0)  # OK: hoisted
+    while not stop.is_set():
+        try:
+            return connect(policy)
+        except ConnectionError:
+            continue
+
+
+def spawn_workers(submit, items):
+    for item in items:
+        # OK: the def boundary resets the search — attempt() runs when
+        # CALLED, each call legitimately opening its own budget
+        def attempt():
+            return Deadline(1.0)
+
+        submit(attempt, item)
+
+
+def probe_each(probe, endpoints):
+    results = []
+    for ep in endpoints:
+        # per-endpoint budget is the CONTRACT here: one slow endpoint
+        # must not starve the rest of the sweep
+        d = Deadline(1.0)  # graftlint: disable=GL012
+        results.append(probe(ep, timeout=d.remaining()))
+    return results

@@ -1,0 +1,18 @@
+"""GL013 bad fixture: hot-path containers that only ever grow. Parsed by
+graftlint only (role-forced to the hotpath scope)."""
+
+from collections import deque
+
+
+class ResultCache:
+    def __init__(self):
+        self._memo = {}
+        self._events = deque()
+
+    def lookup(self, key, compute):
+        if key not in self._memo:
+            self._memo[key] = compute(key)  # BAD: grows, never evicts
+        return self._memo[key]
+
+    def record(self, event):
+        self._events.append(event)  # BAD: unbounded deque, no maxlen

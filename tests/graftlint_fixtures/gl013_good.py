@@ -1,0 +1,32 @@
+"""GL013 good fixture: every grown container has a cap or an eviction
+path, plus a documented bounded-by-construction table. Parsed by
+graftlint only (role-forced to the hotpath scope)."""
+
+from collections import deque
+
+
+class ResultCache:
+    CAP = 1024
+
+    def __init__(self):
+        self._memo = {}
+        self._events = deque(maxlen=256)  # OK: capped at construction
+        self._by_kind = {}
+
+    def lookup(self, key, compute):
+        if key not in self._memo:
+            if len(self._memo) >= self.CAP:
+                self._memo.clear()  # OK: eviction path exists
+            self._memo[key] = compute(key)
+        return self._memo[key]
+
+    def record(self, event):
+        self._events.append(event)  # OK: deque(maxlen=...) self-evicts
+
+    # keyed by the static kind enum: the table is bounded by code
+    # structure, never by traffic
+    def tally(self, kind):
+        self._by_kind[kind] = self._by_kind.get(kind, 0) + 1  # graftlint: disable=GL013
+
+    def reset(self):
+        self._memo = {}  # OK: reassignment outside __init__ is a reset

@@ -48,3 +48,38 @@ def ir005_reshaped_donation(x, buf):  # donation silently dropped: a
 def ir005_astype_donation(x, buf):  # donation silently dropped: a dtype
     # widen at the boundary breaks the identical-shape+dtype alias rule
     return (buf + x).astype(jnp.int64)
+
+
+# -- dep-tier mutants (IR006/IR007) -----------------------------------------
+#
+# Each declares row_coupled on BOTH checked surfaces (registry entry and
+# function attribute) so only the declaration-vs-proof contradiction —
+# the thing the mutant seeds — can fire.
+
+
+def ir006_hidden_cumsum(x):  # declared independent, but the "running
+    # normalizer" is a row-axis prefix scan: row k's output reads every
+    # row <= k — exactly the coupling a delta replay would miss
+    return x * 2 - jnp.cumsum(x, axis=0)
+
+
+ir006_hidden_cumsum.row_coupled = False
+
+
+def ir006_decoupled(x, caps):  # declared coupled, but a refactor left a
+    # purely elementwise body: the documented coupling no longer exists
+    return jnp.clip(x * 3 + 1, 0, caps)
+
+
+ir006_decoupled.row_coupled = True
+
+
+def ir007_sharded_scan(x, mesh=None):  # honestly declared coupled, but
+    # the sharded variant feeds the row-sharded operand straight into a
+    # global prefix scan with no re-replication — the PR 9 CPU-SPMD
+    # miscompile shape IR007 exists to catch
+    del mesh
+    return jnp.cumsum(x, axis=0)
+
+
+ir007_sharded_scan.row_coupled = True

@@ -383,3 +383,26 @@ class TestGetSelectorsAndOutput:
             cluster="m1",
         ))
         assert len(resp.items) == 2
+
+
+class TestColdStartImportHygiene:
+    def test_cli_import_never_pulls_jax(self):
+        """The GL005 cold-start contract, checked TRANSITIVELY: importing
+        the CLI entry module must not reach jax through any chain
+        (controlplane -> controllers -> member -> estimator was one). The
+        lint verb additionally depends on this — the IR/dep tiers must
+        set XLA_FLAGS before the process's first jax import or the
+        sharded spec variants cannot materialize their >=2-device mesh
+        (karmadactl-tpu lint --all would fail with IR004 trace errors)."""
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys; import karmada_tpu.cli; "
+             "sys.exit(1 if 'jax' in sys.modules else 0)"],
+            cwd=Path(__file__).resolve().parent.parent,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)

@@ -41,6 +41,9 @@ FIXTURE_ROLES = {
     "GL008": set(),
     "GL009": set(),
     "GL010": set(),
+    "GL011": set(),
+    "GL012": set(),
+    "GL013": {gl_core.ROLE_HOTPATH},
 }
 
 
@@ -289,6 +292,39 @@ def test_gl003_resolves_constant_keys():
     assert "KARMADA_TPU_ALIASED_ENVIRON" in names, (
         "`from os import environ` read slipped past the registry gate"
     )
+
+
+def test_gl011_catches_each_pattern():
+    findings = lint_fixture("gl011_bad.py", FIXTURE_ROLES["GL011"])
+    by_detail = {f.detail: f for f in findings}
+    assert "_by_key" in by_detail, "lock-free dict read not flagged"
+    assert "_order" in by_detail, "lock-free list read not flagged"
+    assert by_detail["_by_key"].anchor.endswith("snapshot")
+    # one finding per (method, attr): newest() reads _order twice
+    assert len([f for f in findings if f.detail == "_order"]) == 1
+
+
+def test_gl012_catches_each_pattern():
+    findings = lint_fixture("gl012_bad.py", FIXTURE_ROLES["GL012"])
+    details = {f.detail for f in findings}
+    assert "Deadline:for" in details, "Deadline in for loop not flagged"
+    assert "BackoffPolicy:while" in details, (
+        "BackoffPolicy in while loop not flagged"
+    )
+
+
+def test_gl013_catches_each_pattern():
+    findings = lint_fixture("gl013_bad.py", FIXTURE_ROLES["GL013"])
+    details = {f.detail for f in findings}
+    assert "_memo" in details, "grow-only dict not flagged"
+    assert "_events" in details, "uncapped deque not flagged"
+
+
+def test_gl013_needs_hotpath_role():
+    """Outside the worker/controller scope the rule stays silent — a
+    short-lived CLI helper cannot leak for months."""
+    findings = lint_fixture("gl013_bad.py", set())
+    assert not [f for f in findings if f.rule == "GL013"]
 
 
 # -- suppression + baseline workflow ----------------------------------------

@@ -680,6 +680,45 @@ def check_reasons_table() -> None:
         )
 
 
+def delta_safe_table() -> str:
+    """The generated delta-safe kernel registry table (the dep tier's
+    ``delta_safe_registry`` is the single source of truth; graftlint
+    IR006 proves every ``row_coupled`` declaration it summarizes).
+    Unlike the other generated tables this one traces the kernel grid —
+    it imports jax and costs a few seconds."""
+    sys.path.insert(0, str(ROOT))
+    from tools.graftlint.dep import render_delta_safe_table
+
+    return (
+        "_Generated from `tools/graftlint/dep.py` `delta_safe_registry` "
+        "by `tools/docs_from_bench.py --delta-safe-table` — regenerate, "
+        "don't hand-edit._\n\n" + render_delta_safe_table(ROOT)
+    )
+
+
+def check_delta_safe_table() -> None:
+    """Fail loudly when the committed DEVELOPMENT.md delta-safe table
+    drifted from the analyzer's verdicts (a kernel whose certification
+    changed under a refactor must change the committed docs in the same
+    PR) — runs on EVERY doc regeneration, same pattern as the env-flag
+    gate."""
+    path = ROOT / "docs" / "DEVELOPMENT.md"
+    m = _marker_re("deltasafe").search(path.read_text())
+    if not m:
+        raise SystemExit(
+            f"{path}: no deltasafe markers — restore the delta-safe "
+            "kernel contract section and run "
+            "`python tools/docs_from_bench.py --delta-safe-table`"
+        )
+    committed_body = m.group(0).split("-->\n", 1)[1].rsplit("<!--", 1)[0]
+    if committed_body.strip() != delta_safe_table().strip():
+        raise SystemExit(
+            f"{path}: delta-safe kernel table drifted from the dep "
+            "tier's certification registry — run "
+            "`python tools/docs_from_bench.py --delta-safe-table`"
+        )
+
+
 def check_ir_registry() -> None:
     """Fail loudly when a kernel family exported from karmada_tpu/ops/ is
     missing from the graftlint IR entry-point registry (or the registry
@@ -700,23 +739,29 @@ def check_ir_registry() -> None:
         )
 
 
-#: the generated-table modes: flag -> (marker, body builder, drift check)
+#: the generated-table modes:
+#: flag -> (marker, body builder, drift check, target doc)
 _TABLE_MODES = {
-    "--env-table": ("envflags", env_table, check_env_table),
+    "--env-table": ("envflags", env_table, check_env_table,
+                    "docs/OPERATIONS.md"),
     "--metrics-table": ("metricfamilies", metrics_table,
-                        check_metrics_table),
-    "--span-table": ("spantaxonomy", span_table, check_span_table),
+                        check_metrics_table, "docs/OPERATIONS.md"),
+    "--span-table": ("spantaxonomy", span_table, check_span_table,
+                     "docs/OPERATIONS.md"),
     "--history-table": ("historyschema", history_table,
-                        check_history_schema),
+                        check_history_schema, "docs/OPERATIONS.md"),
     "--reasons-table": ("reasontaxonomy", reasons_table,
-                        check_reasons_table),
+                        check_reasons_table, "docs/OPERATIONS.md"),
+    "--delta-safe-table": ("deltasafe", delta_safe_table,
+                           check_delta_safe_table,
+                           "docs/DEVELOPMENT.md"),
 }
 
 
 def _check_all(skip: str = "") -> None:
     """Every generated table's drift guard (minus the one just
     rewritten) + the IR registry gate — run on EVERY doc regeneration."""
-    for flag, (_marker, _body, check) in _TABLE_MODES.items():
+    for flag, (_marker, _body, check, _doc) in _TABLE_MODES.items():
         if flag != skip:
             check()
     check_ir_registry()
@@ -725,8 +770,8 @@ def _check_all(skip: str = "") -> None:
 def main() -> None:
     if len(sys.argv) == 2 and sys.argv[1] in _TABLE_MODES:
         flag = sys.argv[1]
-        marker, body, _check = _TABLE_MODES[flag]
-        rewrite(ROOT / "docs" / "OPERATIONS.md", body(), marker)
+        marker, body, _check, doc = _TABLE_MODES[flag]
+        rewrite(ROOT / doc, body(), marker)
         _check_all(skip=flag)
         return
     src = Path(sys.argv[1])

@@ -1,34 +1,46 @@
-"""graftlint — two-tier static analyzer for this repo.
+"""graftlint — three-tier static analyzer for this repo.
 
 AST tier (core.py/rules.py): trace-safety & concurrency invariants over
 Python source — pure ``ast``, no jax import, sub-second. IR tier
 (ir.py/irrules.py): jaxpr-level kernel auditor — abstractly traces every
 registered kernel entry point and machine-checks dtype, transfer,
 const-capture, manifest-fidelity and donation invariants in the lowered
-IR, where those bugs actually live.
+IR, where those bugs actually live. Dep tier (dep.py/deprules.py):
+abstract row-dependence propagation over those same jaxprs — certifies
+every kernel's ``row_coupled`` declaration (the delta-safety contract
+the incremental dirty-row solve will assert at arm time) and the
+replicated-scan discipline in sharded variants.
 
 Run it:
 
     python -m tools.graftlint                 # AST: karmada_tpu/ + tools/
-    python -m tools.graftlint --changed-only  # AST: pre-commit scope
+    python -m tools.graftlint --changed-only  # AST, changed files only
+    python -m tools.graftlint --all --changed-only  # pre-commit: all tiers
     python -m tools.graftlint --ir            # IR: the full kernel grid
-    karmadactl-tpu lint [--ir]                # same, as a CLI verb
+    python -m tools.graftlint --dep           # dep: row-dependence certify
+    python -m tools.graftlint --all           # AST + IR + dep, one gate
+    karmadactl-tpu lint [--ir|--dep|--all]    # same, as a CLI verb
 
 Rules: GL001 trace safety, GL002 trace-key completeness, GL003 env-flag
 registry, GL004 lock discipline, GL005 cold-start import hygiene, GL006
-metric naming & uniqueness; IR001
+metric naming & uniqueness, GL007 bounded RPCs, GL008 span taxonomy,
+GL009 history series, GL010 reason taxonomy, GL011 lock-READ
+discipline, GL012 budget-in-loop, GL013 bounded hot-path caches; IR001
 dtype discipline, IR002 host round-trips, IR003 const capture, IR004
-trace-manifest fidelity, IR005 donation audit. Suppress per line with
+trace-manifest fidelity, IR005 donation audit; IR006 row-independence
+certification, IR007 replicated-scan discipline. Suppress per line with
 ``# graftlint: disable=GL00X`` (same line, line above, or the enclosing
-``def`` line — the only form IR rules honor, anchored at the kernel's
-``def``), per file with ``# graftlint: disable-file=GL00X``.
-Grandfathered findings live in ``graftlint_baseline.json`` and MUST carry
-a written justification; both tiers share that baseline.
+``def`` line — the only form IR/dep rules honor, anchored at the
+kernel's ``def``), per file with ``# graftlint: disable-file=GL00X``.
+Grandfathered findings live in ``graftlint_baseline.json`` and MUST
+carry a written justification; all tiers share that baseline.
 """
 
+from . import deprules  # noqa: F401 — registers the dep-tier analyzers
 from . import irrules  # noqa: F401 — registers the IR00x analyzers
 from . import rules  # noqa: F401 — registers the GL00x analyzers
 from .core import (  # noqa: F401
+    DEP_RULES,
     IR_RULES,
     RULES,
     Config,
@@ -76,3 +88,10 @@ def run_ir(families=None, **kwargs):
     from .ir import run_ir as _run_ir
 
     return _run_ir(families, **kwargs)
+
+
+def run_dep(families=None, **kwargs):
+    """Dep-tier one-call API (lazy import, the run_ir pattern)."""
+    from .dep import run_dep as _run_dep
+
+    return _run_dep(families, **kwargs)

@@ -1,10 +1,18 @@
 """CLI: ``python -m tools.graftlint [paths ...]`` (see package docstring).
 
-Two tiers behind one surface: the default AST tier (GL00x, pure-ast,
-sub-second — pre-commit material with ``--changed-only``) and the IR tier
+Three tiers behind one surface: the default AST tier (GL00x, pure-ast,
+sub-second — pre-commit material with ``--changed-only``), the IR tier
 (``--ir``: IR00x, abstractly traces every registered kernel entry point
-under JAX_PLATFORMS=cpu and audits the jaxprs — run it before a rollout
-and in tier-1, see tests/test_graftlint_ir.py).
+under JAX_PLATFORMS=cpu and audits the jaxprs) and the dep tier
+(``--dep``: IR006/IR007, row-dependence certification over the same
+jaxprs — the delta-safety contract). ``--all`` runs every tier in one
+invocation with a merged exit code and per-tier timing — the CI/rollout
+gate shape (see docs/DEVELOPMENT.md).
+
+``--changed-only`` scopes every tier: the AST tier lints only the
+changed files; the IR/dep tiers audit only the registry entries whose
+kernel source or declared ``spec_deps`` intersect the changed set
+(full-scope-only negatives like registry coverage stay off scoped runs).
 
 Exit codes: 0 clean (baselined findings allowed), 1 findings or a
 baseline entry without justification, 2 usage error.
@@ -16,9 +24,10 @@ import argparse
 import json
 import subprocess
 import sys
+import time
 
 from . import DEFAULT_TARGETS, RULES, default_config, run
-from .core import IR_RULES, write_baseline
+from .core import DEP_RULES, IR_RULES, write_baseline
 
 
 def changed_py_files(root) -> list:
@@ -37,31 +46,81 @@ def changed_py_files(root) -> list:
     return sorted(
         n for n in names
         if n.endswith(".py") and (root / n).exists()
+        # the fixture corpus is deliberately-bad code: linted only by
+        # the fixture tests (with forced roles), never by the scoped gate
+        and "graftlint_fixtures" not in n.split("/")
+    )
+
+
+def _run_tier(tier: str, args, paths, changed, config):
+    """One tier's LintResult. ``changed`` is None (full scope) or the
+    changed-file list driving every tier's scoping."""
+    baseline = None if args.no_baseline else "auto"
+    if tier == "ast":
+        targets = changed if changed is not None else (
+            paths or DEFAULT_TARGETS
+        )
+        return run(
+            targets, root=args.root, baseline=baseline,
+            # an explicit path list (or the git-changed set) is a partial
+            # scan: whole-tree negative checks must not fire from it
+            full_scope=not paths and changed is None,
+        )
+    from .ir import entries_for_changed
+
+    entries = None
+    families = paths or None
+    if changed is not None:
+        entries = entries_for_changed(changed)
+        families = None
+    if tier == "ir":
+        from .ir import run_ir
+
+        return run_ir(
+            families, root=args.root, baseline=baseline,
+            manifest=args.manifest, entries=entries,
+        )
+    from .dep import run_dep
+
+    return run_dep(
+        families, root=args.root, baseline=baseline, entries=entries,
     )
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="graftlint",
-        description="trace-safety & concurrency analyzer (AST tier) and "
-        "jaxpr-level kernel auditor (--ir)",
+        description="trace-safety & concurrency analyzer (AST tier), "
+        "jaxpr-level kernel auditor (--ir) and row-dependence certifier "
+        "(--dep); --all runs every tier",
     )
     p.add_argument("paths", nargs="*", default=[],
                    help="files/directories to lint (default: karmada_tpu "
-                   "tools); with --ir, kernel family names to audit "
+                   "tools); with --ir/--dep, kernel family names to audit "
                    "(default: the full entry-point registry)")
     p.add_argument("--paths", dest="extra_paths", action="append",
                    default=[], metavar="PATH",
                    help="additional lint targets (repeatable; same as the "
                    "positionals — scripting convenience)")
     p.add_argument("--changed-only", action="store_true",
-                   help="AST tier: lint only .py files with uncommitted "
-                   "git changes (staged+unstaged+untracked) — the "
-                   "pre-commit mode, runs in well under a second")
+                   help="scope every tier to uncommitted git changes "
+                   "(staged+unstaged+untracked): AST lints only those "
+                   "files; IR/dep audit only the registry entries whose "
+                   "kernel source or spec_deps intersect them — the "
+                   "pre-commit mode")
     p.add_argument("--ir", action="store_true",
                    help="run the IR tier instead: abstractly trace every "
                    "registered kernel entry point (jax.make_jaxpr on CPU, "
                    "no compiles) and audit the jaxprs (IR001-IR005)")
+    p.add_argument("--dep", action="store_true",
+                   help="run the dep tier instead: abstract row-dependence "
+                   "propagation over the same jaxprs — certify every "
+                   "kernel's row_coupled declaration and the replicated-"
+                   "scan discipline (IR006/IR007)")
+    p.add_argument("--all", dest="all_tiers", action="store_true",
+                   help="run AST + IR + dep tiers in one invocation: "
+                   "merged exit code, per-tier timing, `tier` field on "
+                   "every JSON finding — the CI/rollout gate shape")
     p.add_argument("--manifest", default=None, metavar="PATH",
                    help="IR tier: additionally audit a prewarm trace "
                    "manifest — every record must re-trace to its recorded "
@@ -74,18 +133,29 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings to graftlint_baseline.json "
                    "with EMPTY justifications (the linter refuses them "
-                   "until each is justified); always runs BOTH tiers — "
+                   "until each is justified); always runs ALL tiers — "
                    "the baseline file is shared")
     p.add_argument("--list-rules", action="store_true")
     args = p.parse_args(argv)
 
     if args.list_rules:
-        for rid, r in sorted({**RULES, **IR_RULES}.items()):
+        for rid, r in sorted({**RULES, **IR_RULES, **DEP_RULES}.items()):
             print(f"{rid}  {r.title}")
         return 0
 
+    if args.ir + args.dep + args.all_tiers > 1:
+        print("error: --ir, --dep and --all are mutually exclusive",
+              file=sys.stderr)
+        return 2
+
     paths = list(args.paths) + list(args.extra_paths)
     config = default_config(args.root)
+    tiers = (
+        ["ast", "ir", "dep"] if args.all_tiers
+        else ["ir"] if args.ir
+        else ["dep"] if args.dep
+        else ["ast"]
+    )
 
     if args.manifest is not None and not args.manifest:
         # an empty path is almost always `--manifest "$UNSET_VAR"`: the
@@ -93,12 +163,18 @@ def main(argv=None) -> int:
         print("error: --manifest requires a non-empty path (is "
               "KARMADA_TPU_TRACE_MANIFEST set?)", file=sys.stderr)
         return 2
+    if args.manifest and "ir" not in tiers:
+        print("error: --manifest is an IR-tier audit (use --ir or --all)",
+              file=sys.stderr)
+        return 2
+    if args.all_tiers and paths:
+        print("error: --all takes no path/family scope (paths mean files "
+              "to the AST tier but family names to --ir/--dep; use "
+              "--changed-only for a scoped all-tier run)", file=sys.stderr)
+        return 2
 
+    changed = None
     if args.changed_only:
-        if args.ir:
-            print("error: --changed-only is an AST-tier mode (the IR tier "
-                  "audits traced kernels, not files)", file=sys.stderr)
-            return 2
         if args.write_baseline:
             print("error: --write-baseline needs the FULL lint scope — a "
                   "baseline regenerated from only the changed files would "
@@ -106,12 +182,12 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         try:
-            paths = changed_py_files(config.root)
+            changed = changed_py_files(config.root)
         except RuntimeError as exc:
             print(f"error: --changed-only needs a git checkout: {exc}",
                   file=sys.stderr)
             return 2
-        if not paths:
+        if not changed:
             print("0 changed python files: nothing to lint")
             return 0
 
@@ -119,48 +195,64 @@ def main(argv=None) -> int:
         # baseline=None: the new baseline must hold EVERY current finding
         # (a baselined run would drop — and thereby delete — entries that
         # still match); write_baseline carries existing justifications
-        # over. BOTH tiers always run here — the baseline file is shared,
-        # so an AST-only regeneration would delete the IR tier's entries.
+        # over. ALL tiers always run here — the baseline file is shared,
+        # so a one-tier regeneration would delete the other tiers' entries.
         raw = run(paths or DEFAULT_TARGETS, root=args.root, baseline=None)
         findings = list(raw.findings)
+        from .dep import run_dep
         from .ir import run_ir
 
         findings += run_ir(
             root=args.root, baseline=None, manifest=args.manifest
         ).findings
+        findings += run_dep(root=args.root, baseline=None).findings
         path = config.root / config.baseline_path
         n = write_baseline(path, findings)
         print(f"wrote {n} entries to {path} — add a justification to each "
               "new entry (empty justifications are rejected)")
         return 0
 
-    if args.ir:
-        from .ir import run_ir
-
+    results: dict = {}
+    timings: dict = {}
+    for tier in tiers:
+        t0 = time.perf_counter()
         try:
-            result = run_ir(
-                paths or None,
-                root=args.root,
-                baseline=None if args.no_baseline else "auto",
-                manifest=args.manifest,
-            )
+            results[tier] = _run_tier(tier, args, paths, changed, config)
         except KeyError as exc:
             print(f"error: {exc.args[0]}", file=sys.stderr)
             return 2
-    else:
-        result = run(
-            paths or DEFAULT_TARGETS,
-            root=args.root,
-            baseline=None if args.no_baseline else "auto",
-            # an explicit path list (or the git-changed set) is a partial
-            # scan: whole-tree negative checks must not fire from it
-            full_scope=not paths,
-        )
+        timings[tier] = time.perf_counter() - t0
+
+    ok = all(r.ok for r in results.values())
     if args.format == "json":
-        print(json.dumps(result.to_json(), indent=2))
+        if len(tiers) == 1:
+            tier = tiers[0]
+            doc = results[tier].to_json()
+            doc["tier"] = tier
+            doc["seconds"] = round(timings[tier], 3)
+            for f in doc["findings"] + doc["baselined"]:
+                f["tier"] = tier
+        else:
+            doc = {"ok": ok, "tiers": {}}
+            for tier in tiers:
+                td = results[tier].to_json()
+                td["tier"] = tier
+                td["seconds"] = round(timings[tier], 3)
+                for f in td["findings"] + td["baselined"]:
+                    f["tier"] = tier
+                doc["tiers"][tier] = td
+        print(json.dumps(doc, indent=2))
     else:
-        print(result.render_text())
-    return 0 if result.ok else 1
+        blocks = []
+        for tier in tiers:
+            text = results[tier].render_text()
+            if len(tiers) > 1:
+                text = (
+                    f"== {tier} tier ({timings[tier]:.2f}s) ==\n{text}"
+                )
+            blocks.append(text)
+        print("\n\n".join(blocks))
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -35,6 +35,7 @@ ROLE_JIT = "jit"  # trace-safety scope (ops/, scheduler/, parallel/, refimpl/)
 ROLE_LEDGER = "ledger"  # trace-key ledger scope (scheduler/)
 ROLE_ENTRY = "entry"  # cold-start-sensitive entry module
 ROLE_OPS = "ops"  # kernel layer: must not import the scheduler
+ROLE_HOTPATH = "hotpath"  # long-lived worker/controller scope (GL013)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable(?P<scope>-file)?\s*=\s*"
@@ -100,6 +101,13 @@ class Config:
         "__init__.py", "cli.py", "localup.py", "controlplane.py",
         "bus/agent.py",
     )
+    #: package subdirs hosting long-lived worker/controller/registry
+    #: objects — GL013's unbounded-cache scope (a short-lived CLI helper
+    #: cannot leak for months)
+    cache_dirs: tuple = (
+        "controllers", "bus", "scheduler", "estimator", "solver",
+        "metricsadapter", "operator", "webhook",
+    )
     flags_module: str = "karmada_tpu/utils/flags.py"
     docs_env_table: str = "docs/OPERATIONS.md"
     baseline_path: str = "graftlint_baseline.json"
@@ -118,6 +126,8 @@ class Config:
             roles.add(ROLE_LEDGER)
         if top == "ops":
             roles.add(ROLE_OPS)
+        if top in self.cache_dirs:
+            roles.add(ROLE_HOTPATH)
         if sub in self.entry_modules or sub.endswith("__main__.py"):
             roles.add(ROLE_ENTRY)
         return roles
@@ -332,7 +342,9 @@ class LintContext:
 class Rule:
     #: which analyzer tier the rule belongs to: "ast" rules walk parsed
     #: source modules (GL00x), "ir" rules walk traced kernel jaxprs
-    #: (IR00x, see ir.py/irrules.py) — the registries are separate so
+    #: (IR00x, see ir.py/irrules.py), "dep" rules consume the row-
+    #: dependence analyses the dep tier computes over those same jaxprs
+    #: (IR006+, see dep.py/deprules.py) — the registries are separate so
     #: the AST tier stays jax-free and sub-second
     kind = "ast"
     id = "GL000"
@@ -348,12 +360,15 @@ class Rule:
 
 RULES: dict = {}  # AST-tier analyzers (GL00x)
 IR_RULES: dict = {}  # IR-tier analyzers (IR00x)
+DEP_RULES: dict = {}  # dep-tier analyzers (row-dependence certification)
 
 
 def rule(cls):
     """Register an analyzer class (decorator); the registry is chosen by
-    ``cls.kind`` ("ast" default, "ir" for jaxpr-level analyzers)."""
-    registry = IR_RULES if getattr(cls, "kind", "ast") == "ir" else RULES
+    ``cls.kind`` ("ast" default, "ir" for jaxpr-level analyzers, "dep"
+    for the row-dependence certification tier)."""
+    kind = getattr(cls, "kind", "ast")
+    registry = {"ir": IR_RULES, "dep": DEP_RULES}.get(kind, RULES)
     registry[cls.id] = cls()
     return cls
 

@@ -1,0 +1,210 @@
+"""Dep-tier rules: row-independence certification over the dep tier's
+abstract dependence analyses (see dep.py for the lattice).
+
+IR006 — every registered kernel carries an explicit ``row_coupled``
+declaration on every surface (registry entry, live function attribute,
+prewarm manifest dict for manifest kernels), the surfaces agree, and the
+analyzer's PROOF never contradicts the declaration: a declared-
+independent kernel with a proven cross-row coupler (or a statically
+row-shifted output) is a finding, and so is a declared-coupled kernel
+the analyzer proves fully independent (the coupling the declaration
+documents no longer exists — either the declaration or the kernel
+regressed). ``unproven`` verdicts contradict nothing.
+
+IR007 — replicated-scan discipline: in a SHARDED spec variant, every
+cross-row coupler must consume operands that were re-replicated (a
+``with_sharding_constraint`` to a fully-replicated sharding) since the
+row-sharded inputs. A row-sharded value flowing into a sort/cumsum/
+global reduction is the PR 9 CPU-SPMD prefix-scan miscompile shape —
+promoted here from code-comment convention to checked rule.
+
+Both rules anchor findings at the kernel def (the IR-tier convention)
+and honour ``# graftlint: disable=IR006`` pragmas and the shared
+baseline.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from .core import Finding, Rule, rule
+
+
+class DepRule(Rule):
+    kind = "dep"
+    id = "DEP000"
+
+    def check(self, analysis, ctx) -> Iterator[Finding]:  # type: ignore[override]
+        return iter(())
+
+    def finalize(self, ctx) -> Iterator[Finding]:  # type: ignore[override]
+        return iter(())
+
+
+def _entry_finding(entry, line: int, rule_id: str, message: str,
+                   detail: str) -> Finding:
+    return Finding(
+        rule=rule_id, path=entry.path, line=line, col=1, message=message,
+        anchor=entry.attr, detail=detail, anchor_line=line,
+    )
+
+
+def _join_verdicts(analyses) -> str:
+    verdicts = [a.verdict for a in analyses]
+    if "coupled" in verdicts:
+        return "coupled"
+    if verdicts and all(v == "independent" for v in verdicts):
+        return "independent"
+    return "unproven"
+
+
+# -- IR006 — row-independence certification ---------------------------------
+
+
+@rule
+class RowIndependenceCertification(DepRule):
+    id = "IR006"
+    title = "row_coupled declarations present, agreeing, and proven"
+
+    def finalize(self, ctx) -> Iterator[Finding]:
+        from .dep import declared_row_coupled
+
+        by_entry = ctx.by_entry()
+        failed = {e.name for e, _s, _err in ctx.trace_failures}
+        for name, entry in ctx.entries.items():
+            line = ctx._ir.entry_line(entry)
+            decl = declared_row_coupled(entry)
+            registry = decl.get("registry")
+            kernel = decl.get("kernel")
+            prewarm = decl.get("prewarm", registry)
+
+            if registry is None:
+                if ctx.full_run:
+                    yield _entry_finding(
+                        entry, line, self.id,
+                        f"{name}: no `row_coupled` declaration on the "
+                        "ENTRY_POINTS registry entry — every registered "
+                        "kernel must declare whether its outputs couple "
+                        "batch rows (the delta-safety contract the "
+                        "incremental dirty-row solve asserts at arm "
+                        "time); set row_coupled=True|False on the "
+                        "KernelEntry",
+                        "missing-declaration",
+                    )
+                continue
+            mismatched = [
+                (surface, val)
+                for surface, val in (("kernel attribute", kernel),
+                                     ("prewarm._KERNELS", prewarm))
+                if val is not None and bool(val) != bool(registry)
+            ]
+            for surface, val in mismatched:
+                yield _entry_finding(
+                    entry, line, self.id,
+                    f"{name}: `row_coupled` disagrees across declaration "
+                    f"surfaces — registry says {registry} but the "
+                    f"{surface} says {val}; the three surfaces "
+                    "(ENTRY_POINTS, the jitted function's row_coupled "
+                    "attribute, prewarm._KERNELS) must state one truth",
+                    f"surface-mismatch:{surface}",
+                )
+            if kernel is None and "kernel_error" not in decl and \
+                    ctx.full_run:
+                yield _entry_finding(
+                    entry, line, self.id,
+                    f"{name}: the jitted kernel carries no `row_coupled` "
+                    "attribute — declare it at the def site "
+                    f"(`{entry.attr}.row_coupled = {bool(registry)}`) so "
+                    "the property is visible where the kernel body is "
+                    "edited, not only in the lint registry",
+                    "missing-kernel-attribute",
+                )
+
+            analyses = by_entry.get(name, ())
+            if not analyses or name in failed:
+                continue  # unprovable (trace failures are IR004's beat)
+            verdict = _join_verdicts(analyses)
+            if registry is False and verdict == "coupled":
+                reasons = sorted(
+                    {r for a in analyses for r in a.coupler_reasons}
+                ) or ["row-shifted-output"]
+                yield _entry_finding(
+                    entry, line, self.id,
+                    f"{name}: declared row_coupled=False but the jaxpr "
+                    "PROVES cross-row information flow "
+                    f"({', '.join(reasons)}) — a delta replay of this "
+                    "kernel would silently produce stale rows; either "
+                    "remove the coupler or declare row_coupled=True",
+                    f"declared-independent-but-coupled:"
+                    f"{','.join(reasons)}",
+                )
+            elif registry is True and verdict == "independent":
+                plane = set()
+                for a in analyses:
+                    plane |= a.plane_deps
+                declared_plane = set(
+                    getattr(entry, "plane_args", ()) or ()
+                )
+                if declared_plane and plane & declared_plane:
+                    continue  # coupled via the declared plane channel
+                yield _entry_finding(
+                    entry, line, self.id,
+                    f"{name}: declared row_coupled=True but every spec "
+                    "variant analyzes fully row-independent"
+                    + (" with no dependence on the declared plane-state "
+                       f"args {sorted(declared_plane)}"
+                       if declared_plane else "")
+                    + " — the coupling the declaration documents no "
+                    "longer exists; flip the declaration to False (and "
+                    "gain delta_safe) or restore the intended coupling",
+                    "declared-coupled-but-independent",
+                )
+
+
+# -- IR007 — replicated-scan discipline -------------------------------------
+
+#: the miscompile class: order/prefix-sensitive couplers the CPU SPMD
+#: partitioner evaluates per shard (PR 9's global prefix-scan bug).
+#: Scatters/contractions/gathers are partitioned with collectives and
+#: cross shards legitimately, so they are not IR007's business.
+_SCAN_CLASS = ("sort", "top_k", "cum", "reduce_", "argmax", "argmin",
+               "scan")
+
+
+def _is_scan_class(prim: str) -> bool:
+    return any(prim.startswith(p) for p in _SCAN_CLASS)
+
+
+@rule
+class ReplicatedScanDiscipline(DepRule):
+    id = "IR007"
+    title = "row-axis scans/sorts in sharded variants consume replicated operands"
+
+    def check(self, analysis, ctx) -> Iterator[Finding]:
+        if not analysis.sharded:
+            return
+        seen: set = set()
+        for ev in analysis.events:
+            # only PROVEN row-axis couplers convict: a coupler-class op
+            # over a 'mixed' value may be per-row (a sort along the wire
+            # axis of a selection) — unproven, no finding
+            if ev.replicated_ok or not ev.proven:
+                continue
+            if not _is_scan_class(ev.prim):
+                continue
+            key = (ev.prim, ev.reason)
+            if key in seen:
+                continue
+            seen.add(key)
+            traced = analysis.traced
+            yield traced.finding(
+                self.id,
+                f"{traced.label}: cross-row coupler `{ev.reason}` "
+                "consumes a row-sharded operand that was never "
+                "re-replicated — on the CPU SPMD partitioner a global "
+                "prefix-scan/sort over a row-sharded value is miscompiled "
+                "per shard (the PR 9 bug class); wrap the operands in "
+                "lax.with_sharding_constraint(x, NamedSharding(mesh, "
+                "P())) before the coupler",
+                f"unreplicated-coupler:{ev.prim}:{ev.reason}",
+            )

@@ -82,6 +82,20 @@ class KernelEntry:
     path: str  # repo-relative source file (findings anchor here)
     make_specs: Callable[[], list]
     manifest_kernel: Optional[str] = None  # name in the prewarm manifest
+    #: delta-safety declaration: do the kernel's outputs couple batch
+    #: rows? Mandatory (IR006 fails a missing one) and PROVEN against
+    #: the jaxpr by the dep tier — see tools/graftlint/dep.py
+    row_coupled: Optional[bool] = None
+    #: flat in_shapes positions whose leading axis is the batch-row axis
+    row_args: tuple = ()
+    #: positions carrying plane-wide state (cross-row by construction —
+    #: the first_fit_group avail channel); a declared-coupled kernel may
+    #: verify via proven dependence on these instead of a row coupler
+    plane_args: tuple = ()
+    #: repo-relative modules (beyond ``path``) whose change must
+    #: re-trace this entry under ``--changed-only`` — the spec builders'
+    #: and kernel bodies' import graph, kept explicit
+    spec_deps: tuple = ()
 
 
 # -- spec builders: the representative bucket grid --------------------------
@@ -433,10 +447,34 @@ def _specs_scatter_rows() -> list:
     )]
 
 
-def _entry(name, family, module, attr, path, make_specs, manifest=None):
+def _specs_first_fit_group() -> list:
+    t = 3
+    return [KernelSpec(
+        "base",
+        (
+            ((_B, t, _C), "bool"), ((_B,), "int32"), ((_B, _C), "int64"),
+            ((_B,), "int64"), ((_B, _C), "int64"), ((_B,), "bool"),
+            ((_B,), "bool"),
+        ),
+    )]
+
+
+#: fleet.py's full ops-module import surface (divide pulls dispense;
+#: fleet composes every family) — the --changed-only re-trace closure
+_FLEET_DEPS = (
+    "karmada_tpu/ops/divide.py", "karmada_tpu/ops/dispense.py",
+    "karmada_tpu/ops/estimate.py", "karmada_tpu/ops/explain.py",
+    "karmada_tpu/ops/preempt.py", "karmada_tpu/ops/quota.py",
+)
+
+
+def _entry(name, family, module, attr, path, make_specs, manifest=None,
+           row_coupled=None, row_args=(), plane_args=(), spec_deps=()):
     return KernelEntry(
         name=name, family=family, module=module, attr=attr, path=path,
         make_specs=make_specs, manifest_kernel=manifest,
+        row_coupled=row_coupled, row_args=tuple(row_args),
+        plane_args=tuple(plane_args), spec_deps=tuple(spec_deps),
     )
 
 
@@ -448,82 +486,139 @@ def _entry(name, family, module, attr, path, make_specs, manifest=None):
 ENTRY_POINTS: dict = {
     e.name: e
     for e in (
-        # ops/ — the dispense/divide/estimate/masks families
+        # ops/ — the dispense/divide/estimate/masks families. Every
+        # entry declares ``row_coupled`` (the delta-safety contract,
+        # IR006-checked) and which flat input positions carry the batch
+        # row axis; the unbatched dispense kernels have no row axis at
+        # all, so their independence is trivial (row_args=()).
         _entry("divide_replicas", "ops", "karmada_tpu.ops.divide",
                "divide_replicas", "karmada_tpu/ops/divide.py",
-               _specs_divide),
+               _specs_divide, row_coupled=False,
+               row_args=(0, 1, 2, 3, 4, 5, 6),
+               spec_deps=("karmada_tpu/ops/dispense.py",)),
         _entry("take_by_weight", "ops", "karmada_tpu.ops.dispense",
                "take_by_weight", "karmada_tpu/ops/dispense.py",
-               _specs_take_by_weight),
+               _specs_take_by_weight, row_coupled=False),
         _entry("take_by_weight_fast", "ops", "karmada_tpu.ops.dispense",
                "take_by_weight_fast", "karmada_tpu/ops/dispense.py",
-               _specs_take_by_weight_fast),
+               _specs_take_by_weight_fast, row_coupled=False),
         _entry("take_by_weight_batch", "ops", "karmada_tpu.ops.dispense",
                "take_by_weight_batch", "karmada_tpu/ops/dispense.py",
-               _specs_take_by_weight_batch),
+               _specs_take_by_weight_batch, row_coupled=False,
+               row_args=(0, 1, 2, 3)),
         _entry("general_estimate", "ops", "karmada_tpu.ops.estimate",
                "general_estimate", "karmada_tpu/ops/estimate.py",
-               _specs_general_estimate),
+               _specs_general_estimate, row_coupled=False,
+               row_args=(1,)),
         _entry("general_estimate_interned", "ops",
                "karmada_tpu.ops.estimate", "general_estimate_interned",
                "karmada_tpu/ops/estimate.py",
-               _specs_general_estimate_interned),
+               _specs_general_estimate_interned, row_coupled=False,
+               row_args=(2,)),
         _entry("gather_profile_rows", "ops", "karmada_tpu.ops.estimate",
                "gather_profile_rows", "karmada_tpu/ops/estimate.py",
-               _specs_gather_profile_rows),
+               _specs_gather_profile_rows, row_coupled=False,
+               row_args=(1,)),
         _entry("merge_estimates", "ops", "karmada_tpu.ops.estimate",
                "merge_estimates", "karmada_tpu/ops/estimate.py",
-               _specs_merge_estimates),
+               _specs_merge_estimates, row_coupled=False,
+               row_args=(0, 1, 2)),
         # quota family: dispatched engine-side (TensorScheduler) but
         # manifest-recorded like the fleet solve family, so prewarm can
         # replay admission traces at boot (IR004 keeps the three
         # registries — FLEET_KERNELS / prewarm._KERNELS / here — equal)
         _entry("quota_admit", "ops", "karmada_tpu.ops.quota",
                "quota_admit", "karmada_tpu/ops/quota.py",
-               _specs_quota_admit, manifest="quota_admit"),
+               _specs_quota_admit, manifest="quota_admit",
+               row_coupled=True, row_args=(0, 1), plane_args=(2,)),
         _entry("quota_cluster_caps", "ops", "karmada_tpu.ops.quota",
                "quota_cluster_caps", "karmada_tpu/ops/quota.py",
-               _specs_quota_cluster_caps, manifest="quota_cluster_caps"),
+               _specs_quota_cluster_caps, manifest="quota_cluster_caps",
+               row_coupled=False, row_args=(1, 2)),
         # provenance family: the armed-only per-pass explain dispatch
         # (engine-side like the quota kernels, manifest-recorded, with a
         # sharded-b2 variant so the partitioned form is audited too)
         _entry("explain_pass", "ops", "karmada_tpu.ops.explain",
                "explain_pass", "karmada_tpu/ops/explain.py",
-               _specs_explain_pass, manifest="explain_pass"),
+               _specs_explain_pass, manifest="explain_pass",
+               row_coupled=False,
+               row_args=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11)),
         # scarcity family: the armed-only plane-wide victim selection
         # (engine-side like quota/explain, manifest-recorded, with a
         # sharded-b2 variant auditing the partitioned jaxpr)
         _entry("preempt_select", "ops", "karmada_tpu.ops.preempt",
                "preempt_select", "karmada_tpu/ops/preempt.py",
-               _specs_preempt_select, manifest="preempt_select"),
+               _specs_preempt_select, manifest="preempt_select",
+               row_coupled=True, row_args=(0, 1, 2, 3, 4, 5, 6),
+               spec_deps=("karmada_tpu/ops/quota.py",)),
         _entry("masks.contains_all", "masks", "karmada_tpu.ops.masks",
                "contains_all", "karmada_tpu/ops/masks.py",
-               _specs_masks_contains_all),
+               _specs_masks_contains_all, row_coupled=False,
+               row_args=(0,)),
         _entry("masks.intersects", "masks", "karmada_tpu.ops.masks",
                "intersects", "karmada_tpu/ops/masks.py",
-               _specs_masks_intersects),
+               _specs_masks_intersects, row_coupled=False,
+               row_args=(0,)),
+        # cohort selection: row-wise over B but coupled THROUGH the
+        # plane-merged availability input (plane_args) — a declared-
+        # coupled kernel IR006 verifies via the plane channel
+        _entry("masks.first_fit_group", "masks", "karmada_tpu.ops.masks",
+               "first_fit_group", "karmada_tpu/ops/masks.py",
+               _specs_first_fit_group, row_coupled=True,
+               row_args=(0, 1, 3, 4, 5, 6), plane_args=(2,)),
         # scheduler fleet kernels (manifest-recorded solve family + the
-        # ledger-only utility kernels)
+        # ledger-only utility kernels). The row space is the resident
+        # cap axis; the solve/pass/entries kernels compact globally
+        # (declared coupled), bits/meta are per-row but scan-windowed,
+        # so the analyzer returns 'unproven' — declared honestly, not
+        # delta_safe (see DEVELOPMENT.md, delta-safe kernel contract).
         _entry("fleet_solve", "scheduler", "karmada_tpu.scheduler.fleet",
                "_fleet_solve", "karmada_tpu/scheduler/fleet.py",
-               _specs_fleet_solve, manifest="fleet_solve"),
+               _specs_fleet_solve, manifest="fleet_solve",
+               row_coupled=True,
+               row_args=(6, 7, 8, 9, 10, 11, 12, 13, 14),
+               spec_deps=_FLEET_DEPS),
         _entry("fleet_pass", "scheduler", "karmada_tpu.scheduler.fleet",
                "_fleet_pass", "karmada_tpu/scheduler/fleet.py",
-               _specs_fleet_pass, manifest="fleet_pass"),
+               _specs_fleet_pass, manifest="fleet_pass",
+               row_coupled=True,
+               row_args=(6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
+               spec_deps=_FLEET_DEPS),
         _entry("fleet_entries", "scheduler", "karmada_tpu.scheduler.fleet",
                "_fleet_entries", "karmada_tpu/scheduler/fleet.py",
-               _specs_fleet_entries, manifest="fleet_entries"),
+               _specs_fleet_entries, manifest="fleet_entries",
+               row_coupled=True, row_args=(0,), spec_deps=_FLEET_DEPS),
         _entry("fleet_bits", "scheduler", "karmada_tpu.scheduler.fleet",
                "_fleet_bits", "karmada_tpu/scheduler/fleet.py",
-               _specs_fleet_bits, manifest="fleet_bits"),
+               _specs_fleet_bits, manifest="fleet_bits",
+               row_coupled=False,
+               row_args=(6, 7, 8, 9, 10, 11, 12, 13),
+               spec_deps=_FLEET_DEPS),
         _entry("gather_meta", "scheduler", "karmada_tpu.scheduler.fleet",
                "_gather_meta", "karmada_tpu/scheduler/fleet.py",
-               _specs_gather_meta),
+               _specs_gather_meta, row_coupled=False, row_args=(0,),
+               spec_deps=_FLEET_DEPS),
         _entry("scatter_rows", "scheduler", "karmada_tpu.scheduler.fleet",
                "_scatter_rows", "karmada_tpu/scheduler/fleet.py",
-               _specs_scatter_rows),
+               _specs_scatter_rows, row_coupled=True,
+               row_args=tuple(range(17)), spec_deps=_FLEET_DEPS),
     )
 }
+
+
+def entries_for_changed(paths, registry: Optional[dict] = None) -> dict:
+    """The ``--changed-only`` scope for the IR/dep tiers: entries whose
+    source file or declared ``spec_deps`` intersect the changed set.
+    Like GL003's precedent, full-scope-only negatives (registry
+    coverage, manifest presence) stay off scoped runs — run_ir/run_dep
+    see ``entries is not None`` and drop them."""
+    changed = {str(p).replace("\\", "/") for p in paths}
+    registry = ENTRY_POINTS if registry is None else registry
+    return {
+        name: e
+        for name, e in registry.items()
+        if e.path in changed or set(e.spec_deps) & changed
+    }
 
 
 def exported_ops_kernels(root: Path) -> set:

@@ -18,6 +18,12 @@
 |       | registered metric family or the SPAN_NAMES taxonomy              |
 | GL010 | reason taxonomy: every Condition(reason=...) / .inc(reason=...)  |
 |       | literal must be registered in utils.reasons REASONS              |
+| GL011 | lock-READ discipline: attrs mutated under a class's lock must    |
+|       | not be read lock-free (GL004's write-side rule, read side)       |
+| GL012 | budget construction: Deadline/BackoffPolicy built inside a       |
+|       | for/while loop resets the budget every iteration                 |
+| GL013 | bounded caches: dict/deque attrs grown on worker/controller hot  |
+|       | paths must have an eviction site or a maxlen cap                 |
 
 Each rule is a pure-AST pass over one ``ModuleInfo`` (plus cross-module
 ``finalize`` hooks); nothing here imports jax.
@@ -30,6 +36,7 @@ from typing import Iterator, Optional
 
 from .core import (
     ROLE_ENTRY,
+    ROLE_HOTPATH,
     ROLE_JIT,
     ROLE_LEDGER,
     ROLE_OPS,
@@ -558,6 +565,79 @@ def _mutated_self_attr(node: ast.AST) -> Optional[str]:
     return None
 
 
+def _class_lock_attrs(cls: ast.ClassDef) -> set:
+    """Which self attrs ARE locks (threading.Lock/RLock/Condition(...))."""
+    lock_attrs: set = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Call
+        ):
+            fn = node.value.func
+            factory = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            if factory in _LOCK_FACTORIES:
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        lock_attrs.add(attr)
+    return lock_attrs
+
+
+def _under_lock(
+    mod: ModuleInfo, cls: ast.ClassDef, lock_attrs: set, node: ast.AST
+) -> bool:
+    """``node`` sits inside a ``with self.<lock>:`` block of ``cls``."""
+    cur = mod.parents.get(node)
+    while cur is not None and cur is not cls:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                # with self._lock: / with self._cond: (Condition
+                # wraps the same lock)
+                if isinstance(expr, ast.Call):
+                    expr = expr.func  # e.g. self._lock.acquire? no-op
+                attr = _self_attr(expr)
+                if attr in lock_attrs:
+                    return True
+        cur = mod.parents.get(cur)
+    return False
+
+
+def _class_mutations(cls: ast.ClassDef) -> list:
+    """(attr, node, method) mutation sites of non-lock self attrs —
+    methods are the DIRECT defs; nested closures attribute to their
+    outermost method."""
+    lock_attrs = _class_lock_attrs(cls)
+    mutations = []
+    for method in cls.body:
+        if not isinstance(
+            method, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            continue
+        for node in ast.walk(method):
+            attr = _mutated_self_attr(node)
+            if attr and attr not in lock_attrs:
+                mutations.append((attr, node, method))
+    return mutations
+
+
+def _guarded_attrs(mod: ModuleInfo, cls: ast.ClassDef) -> set:
+    """Attrs the class treats as lock-guarded: mutated under the class's
+    lock at least once — GL004's definition, shared with GL011 so the
+    write-side and read-side rules can never disagree on what 'guarded'
+    means."""
+    lock_attrs = _class_lock_attrs(cls)
+    if not lock_attrs:
+        return set()
+    return {
+        attr
+        for attr, node, _method in _class_mutations(cls)
+        if _under_lock(mod, cls, lock_attrs, node)
+    }
+
+
 @rule
 class LockDiscipline(Rule):
     id = "GL004"
@@ -569,54 +649,14 @@ class LockDiscipline(Rule):
                 yield from self._check_class(mod, cls)
 
     def _check_class(self, mod: ModuleInfo, cls: ast.ClassDef):
-        # which self attrs ARE locks (threading.Lock/RLock/Condition(...))
-        lock_attrs: set = set()
-        for node in ast.walk(cls):
-            if isinstance(node, ast.Assign) and isinstance(
-                node.value, ast.Call
-            ):
-                fn = node.value.func
-                factory = (
-                    fn.attr if isinstance(fn, ast.Attribute)
-                    else fn.id if isinstance(fn, ast.Name) else None
-                )
-                if factory in _LOCK_FACTORIES:
-                    for t in node.targets:
-                        attr = _self_attr(t)
-                        if attr:
-                            lock_attrs.add(attr)
+        lock_attrs = _class_lock_attrs(cls)
         if not lock_attrs:
             return
 
         def under_lock(node: ast.AST) -> bool:
-            cur = mod.parents.get(node)
-            while cur is not None and cur is not cls:
-                if isinstance(cur, ast.With):
-                    for item in cur.items:
-                        expr = item.context_expr
-                        # with self._lock: / with self._cond: (Condition
-                        # wraps the same lock)
-                        if isinstance(expr, ast.Call):
-                            expr = expr.func  # e.g. self._lock.acquire? no-op
-                        attr = _self_attr(expr)
-                        if attr in lock_attrs:
-                            return True
-                cur = mod.parents.get(cur)
-            return False
+            return _under_lock(mod, cls, lock_attrs, node)
 
-        # mutations: (attr, node, method) — methods are the DIRECT defs;
-        # nested closures attribute to their outermost method
-        mutations = []
-        for method in cls.body:
-            if not isinstance(
-                method, (ast.FunctionDef, ast.AsyncFunctionDef)
-            ):
-                continue
-            for node in ast.walk(method):
-                attr = _mutated_self_attr(node)
-                if attr and attr not in lock_attrs:
-                    mutations.append((attr, node, method))
-
+        mutations = _class_mutations(cls)
         guarded = {
             attr
             for attr, node, method in mutations
@@ -1225,3 +1265,300 @@ class ReasonTaxonomy(Rule):
                     anchor=mod.qualname(node) or "<module>",
                     detail=code,
                 )
+
+
+# --------------------------------------------------------------------------
+# GL011 — lock-READ discipline: guarded attrs must not be read lock-free
+# --------------------------------------------------------------------------
+#
+# ISSUE 17 satellite: GL004 polices the WRITE side of lock discipline; a
+# torn READ is the same bug from the other end — a thread that reads
+# ``self._by_key`` while the writer mutates it mid-``with self._lock``
+# sees a half-updated dict (or a RuntimeError from iterating a resizing
+# one). An attr GL004 establishes as lock-guarded (mutated under the
+# class's lock at least once) must be READ under that lock too, or the
+# single-reader/snapshot invariant documented with a pragma. ``__init__``
+# and ``__new__`` run before the object is shared, so their reads are the
+# same single-writer window GL004 exempts.
+
+
+@rule
+class LockReadDiscipline(Rule):
+    id = "GL011"
+    title = "lock-guarded attributes must not be read lock-free"
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        for cls in ast.walk(mod.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(mod, cls)
+
+    def _check_class(self, mod: ModuleInfo, cls: ast.ClassDef):
+        lock_attrs = _class_lock_attrs(cls)
+        guarded = _guarded_attrs(mod, cls)
+        if not guarded:
+            return
+        for method in cls.body:
+            if not isinstance(
+                method, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if method.name in ("__init__", "__new__"):
+                continue
+            flagged: set = set()  # one finding per (method, attr)
+            for node in ast.walk(method):
+                if not (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                ):
+                    continue
+                attr = _self_attr(node)
+                if attr not in guarded or attr in flagged:
+                    continue
+                parent = mod.parents.get(node)
+                # writes are GL004's beat, not reads: self.x[k] = v /
+                # del self.x[k] ...
+                if isinstance(parent, ast.Subscript) and isinstance(
+                    parent.ctx, (ast.Store, ast.Del)
+                ):
+                    continue
+                # ... and so are in-place mutator calls (self.x.append(v))
+                if (
+                    isinstance(parent, ast.Attribute)
+                    and parent.attr in MUTATORS
+                    and isinstance(mod.parents.get(parent), ast.Call)
+                    and mod.parents.get(parent).func is parent
+                ):
+                    continue
+                if _under_lock(mod, cls, lock_attrs, node):
+                    continue
+                flagged.add(attr)
+                yield Finding(
+                    rule=self.id, path=mod.rel, line=node.lineno,
+                    col=node.col_offset + 1,
+                    message=(
+                        f"self.{attr} is mutated under {cls.name}'s lock "
+                        f"but read lock-free in {method.name}() — a "
+                        "concurrent writer hands this read a half-updated "
+                        "structure; take the lock (or snapshot under it), "
+                        "or document the racy-read invariant with "
+                        f"`# graftlint: disable={self.id}`"
+                    ),
+                    anchor=f"{mod.qualname(cls)}.{method.name}",
+                    detail=attr, anchor_line=method.lineno,
+                )
+
+
+# --------------------------------------------------------------------------
+# GL012 — budget construction: no Deadline/BackoffPolicy inside a loop
+# --------------------------------------------------------------------------
+#
+# ISSUE 17 satellite: ``Deadline`` is ONE overall budget threaded through
+# a multi-step call (utils/backoff.py's contract) — constructing it
+# inside the retry loop resets the budget every iteration, so the loop
+# it was meant to bound never times out as a whole. Same for
+# ``BackoffPolicy``: a policy built per iteration restarts the
+# decorrelated-jitter ladder at ``base`` every time, defeating the
+# de-stampeding it exists for. Both must be hoisted above the loop; a
+# deliberately per-item budget (iterating independent requests) is a
+# pragma with the rationale attached.
+
+_BUDGET_CTORS = {"Deadline", "BackoffPolicy"}
+
+
+@rule
+class BudgetConstructionInLoop(Rule):
+    id = "GL012"
+    title = (
+        "Deadline/BackoffPolicy constructed inside a loop resets the "
+        "budget every iteration"
+    )
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else None
+            )
+            if name not in _BUDGET_CTORS:
+                continue
+            # a loop between the call and its enclosing def means a
+            # fresh budget per iteration; a def boundary resets the
+            # search (a closure body is not lexically "in" the loop
+            # that defines it — it runs when called)
+            loop = None
+            cur = mod.parents.get(node)
+            while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                    loop = cur
+                    break
+                cur = mod.parents.get(cur)
+            if loop is None:
+                continue
+            kind = "for" if isinstance(loop, (ast.For, ast.AsyncFor)) \
+                else "while"
+            yield Finding(
+                rule=self.id, path=mod.rel, line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"{name}(...) constructed inside a `{kind}` loop — "
+                    "the budget/jitter ladder resets every iteration, so "
+                    "the loop never times out (or never de-stampedes) as "
+                    "a whole; hoist the construction above the loop and "
+                    "thread the one instance through "
+                    "(utils.backoff.call_with_resilience's contract)"
+                ),
+                anchor=mod.qualname(node) or "<module>",
+                detail=f"{name}:{kind}",
+            )
+
+
+# --------------------------------------------------------------------------
+# GL013 — bounded caches: grown hot-path containers need an eviction site
+# --------------------------------------------------------------------------
+#
+# ISSUE 17 satellite: a dict/deque attribute on a long-lived worker,
+# controller or registry object that only ever GROWS is a slow leak — in
+# a control plane that runs for months, "per-key memo with no eviction"
+# is an OOM with a delay fuse. The rule is structural: a container attr
+# constructed unbounded (``{}``/``dict()``/``defaultdict(...)``/
+# ``OrderedDict()``/``deque()`` with no ``maxlen=``) that some method
+# outside ``__init__`` grows must have SOME shrink site anywhere in the
+# class (``pop``/``popitem``/``popleft``/``clear``/``remove``/
+# ``discard``/``del self.x[...]``/a reassignment that resets it).
+# Bounded-by-construction tables (keyed by a static enum, the trace-
+# ledger pattern) document the bound with a pragma. Scope: the
+# long-lived-process dirs (``cache_dirs`` in the config) — a CLI helper
+# that dies in seconds cannot leak for months.
+
+_CACHE_FACTORIES = {"dict", "OrderedDict", "defaultdict", "deque",
+                    "Counter"}
+_GROWERS = {"append", "appendleft", "extend", "extendleft", "add",
+            "setdefault", "update"}
+_SHRINKERS = {"pop", "popitem", "popleft", "clear", "remove", "discard"}
+
+
+def _unbounded_cache_attrs(cls: ast.ClassDef) -> dict:
+    """attr -> construction line for self attrs built as unbounded
+    dict/deque containers anywhere in the class."""
+    out: dict = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        unbounded = False
+        if isinstance(value, ast.Dict) and not value.keys:
+            unbounded = True
+        elif isinstance(value, ast.Call):
+            fn = value.func
+            factory = (
+                fn.id if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute)
+                else None
+            )
+            if factory in _CACHE_FACTORIES:
+                capped = any(
+                    kw.arg == "maxlen" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None
+                    )
+                    for kw in value.keywords
+                )
+                # deque(iterable, maxlen) positional form
+                if factory == "deque" and len(value.args) >= 2:
+                    capped = True
+                unbounded = not capped
+        if not unbounded:
+            continue
+        for t in node.targets:
+            attr = _self_attr(t)
+            if attr:
+                out.setdefault(attr, node.lineno)
+    return out
+
+
+@rule
+class BoundedHotPathCaches(Rule):
+    id = "GL013"
+    title = (
+        "hot-path dict/deque attrs that grow must have an eviction "
+        "site or a maxlen cap"
+    )
+
+    def check(self, mod: ModuleInfo, ctx: LintContext) -> Iterator[Finding]:
+        if ROLE_HOTPATH not in mod.roles:
+            return
+        for cls in ast.walk(mod.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(mod, cls)
+
+    def _check_class(self, mod: ModuleInfo, cls: ast.ClassDef):
+        caches = _unbounded_cache_attrs(cls)
+        if not caches:
+            return
+        grow: dict = {}  # attr -> (node, method) first grow site
+        shrinkable: set = set()
+        for method in cls.body:
+            if not isinstance(
+                method, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            init = method.name in ("__init__", "__new__")
+            for node in ast.walk(method):
+                # self.x[k] = v / self.x[k] += v
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        if isinstance(t, ast.Subscript):
+                            attr = _self_attr(t.value)
+                            if attr in caches and not init:
+                                grow.setdefault(attr, (node, method))
+                        else:
+                            # a reassignment outside __init__ resets the
+                            # container — that IS an eviction site
+                            attr = _self_attr(t)
+                            if attr in caches and not init:
+                                shrinkable.add(attr)
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        base = t.value if isinstance(t, ast.Subscript) \
+                            else t
+                        attr = _self_attr(base)
+                        if attr in caches:
+                            shrinkable.add(attr)
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    attr = _self_attr(node.func.value)
+                    if attr not in caches:
+                        continue
+                    if node.func.attr in _SHRINKERS:
+                        shrinkable.add(attr)
+                    elif node.func.attr in _GROWERS and not init:
+                        grow.setdefault(attr, (node, method))
+        for attr, (node, method) in sorted(grow.items()):
+            if attr in shrinkable:
+                continue
+            yield Finding(
+                rule=self.id, path=mod.rel, line=node.lineno,
+                col=node.col_offset + 1,
+                message=(
+                    f"self.{attr} grows in {method.name}() but no method "
+                    f"of {cls.name} ever shrinks it — on a long-lived "
+                    "worker/controller this is an OOM with a delay fuse; "
+                    "add an eviction path (pop/clear/TTL sweep), cap it "
+                    "(deque(maxlen=...)), or document the structural "
+                    "bound with "
+                    f"`# graftlint: disable={self.id}`"
+                ),
+                anchor=f"{mod.qualname(cls)}.{method.name}",
+                detail=attr, anchor_line=method.lineno,
+            )
