@@ -38,7 +38,9 @@ from __future__ import annotations
 
 import argparse
 import gc
+import hashlib
 import json
+import os
 import sys
 import time
 
@@ -207,6 +209,14 @@ def build_parser():
         "--preempt-budget", type=int, default=64,
         help="disruption budget for the drift-rebalance round "
         "(KARMADA_TPU_DESCHEDULE_MAX_DISRUPTION)",
+    )
+    p.add_argument(
+        "--scale", action="store_true",
+        help="force the scale-1M tier (1M bindings x 5k clusters: steady, "
+        "availability-drift churn, the row-churn delta tiers at "
+        "0.1%%/1%%/10%% churn with the full-solve bit-identity oracle, and "
+        "the legacy-path run) even when --bindings/--no-verify would "
+        "otherwise skip it; the default 100k run includes it already",
     )
     p.add_argument(
         "--estimator-only", action="store_true",
@@ -1247,6 +1257,85 @@ def run_engine_north_star(args) -> dict:
             m_times.append(time.perf_counter() - t0)
             show(f"1M steady pass {rep}", m_times[-1], m_engine)
         m1_steady = float(np.median(m_times))
+        # row churn: mutate a fixed fraction of rows per pass against a
+        # STABLE snapshot — the regime the incremental (dirty-row) solve
+        # path serves. Cost must track churn size, not plane size; the
+        # per-pass breakdown must prove the sub dispatch packed exactly
+        # the dirty set, and placements must stay bit-identical to the
+        # full-solve oracle (verified after the legacy tier below, once
+        # the resident memory is free for a second 1M engine).
+        def _digest_rows(res, n):
+            out = np.empty(n, np.uint64)
+            for i in range(n):
+                r = res[i]
+                blob = (
+                    repr(sorted(r.clusters.items()))
+                    if r.success else "!" + str(r.error)
+                )
+                out[i] = int.from_bytes(
+                    hashlib.blake2b(blob.encode(), digest_size=8).digest(),
+                    "little",
+                )
+            return out
+
+        rng_c = np.random.default_rng(20_777)
+        m_churn_tiers: dict = {}
+        m_churn_states: list = []  # (label, problems, digests) for oracle
+
+        def m_row_churn(frac):
+            dirty_n = int(b_m * frac)
+
+            def mutate():
+                for i in rng_c.choice(b_m, dirty_n, replace=False):
+                    p = m_problems[i]
+                    m_problems[i] = BindingProblem(
+                        key=p.key, placement=p.placement,
+                        replicas=(p.replicas % 99) + 1,
+                        requests=p.requests, gvk=p.gvk,
+                    )
+
+            def warm_pass(_i):
+                mutate()
+                m_engine.schedule(m_problems)
+
+            settle_engine(
+                m_engine, warm_pass, floor=2, cap=8,
+                label=f"1M row-churn {frac:.1%} settle",
+            )
+            times = []
+            res = None
+            for rep in range(3):
+                mutate()
+                t0 = time.perf_counter()
+                res = m_engine.schedule(m_problems)
+                times.append(time.perf_counter() - t0)
+                bd = m_engine._fleet.last_breakdown
+                dirty = int(bd.get("dirty_rows", -1))
+                packed = int(bd.get("rows_packed", -1))
+                show(
+                    f"1M row-churn {frac:.1%} pass {rep}", times[-1], m_engine
+                )
+                assert dirty == dirty_n and packed == dirty_n, (
+                    f"delta pass dispatched {dirty} dirty / {packed} packed "
+                    f"rows for a {dirty_n}-row churn set"
+                )
+            m_churn_states.append(
+                (f"{frac:.1%}", list(m_problems), _digest_rows(res, b_m))
+            )
+            return float(np.median(times))
+
+        for frac, t_key in (
+            (0.001, "churn0p1pct"),
+            (0.01, "churn1pct"),
+            (0.10, "churn10pct"),
+        ):
+            m_churn_tiers[t_key] = m_row_churn(frac)
+        print(
+            "# 1M row-churn p50: " + ", ".join(
+                f"{k} {v:.3f}s" for k, v in m_churn_tiers.items()
+            ),
+            file=sys.stderr,
+        )
         # churn: adaptive full-availability-drift warm (the onset pass
         # re-tiers the caps, the next compiles the delta-wire trace those
         # caps select; loop until compile-stable) + 4 timed passes
@@ -1327,18 +1416,50 @@ def run_engine_north_star(args) -> dict:
             del l_engine
         finally:
             _fleet_mod.DENSE_RESIDENT_MAX_BYTES = saved_budget
-        del m_problems
+        gc.collect()
+        # bit-identity oracle for the row-churn tiers: a fresh engine with
+        # the delta path killed (KARMADA_TPU_DELTA_SOLVE=0) full-solves
+        # each tier's final problem state; every row's placement must hash
+        # identical to what the delta passes returned.
+        saved_delta = os.environ.get("KARMADA_TPU_DELTA_SOLVE")
+        os.environ["KARMADA_TPU_DELTA_SOLVE"] = "0"
+        try:
+            o_engine = TensorScheduler(snap, chunk_size=args.chunk)
+            for label, o_probs, digests in m_churn_states:
+                t0 = time.perf_counter()
+                o_res = o_engine.schedule(o_probs)
+                o_dig = _digest_rows(o_res, b_m)
+                bad = int(np.count_nonzero(o_dig != digests))
+                print(
+                    f"# 1M row-churn {label} oracle: full solve "
+                    f"{time.perf_counter() - t0:.1f}s, {bad} rows diverge",
+                    file=sys.stderr,
+                )
+                assert bad == 0, (
+                    f"row-churn {label}: {bad} placements diverge from the "
+                    "full-solve oracle"
+                )
+            del o_engine, o_res
+        finally:
+            if saved_delta is None:
+                os.environ.pop("KARMADA_TPU_DELTA_SOLVE", None)
+            else:
+                os.environ["KARMADA_TPU_DELTA_SOLVE"] = saved_delta
+        del m_problems, m_churn_states
         gc.collect()
         return {
             "steady": m1_steady,
             "churn": m1_churn,
             "churn_max": m1_churn_max,
             "legacy": m1_legacy,
+            **m_churn_tiers,
         }
 
     m1 = None
     ran_1m = False
-    if not args.hetero and not args.no_verify and b_total == 100_000:
+    if args.scale or (
+        not args.hetero and not args.no_verify and b_total == 100_000
+    ):
         ran_1m = True
         m1 = _subtier("scale-1M", _scale1m_tier, None)
 
@@ -1504,6 +1625,9 @@ def run_engine_north_star(args) -> dict:
         out["scale1m_churn_p50"] = _r(m1d.get("churn"))
         out["scale1m_churn_max"] = _r(m1d.get("churn_max"))
         out["scale1m_legacy_p50"] = _r(m1d.get("legacy"))
+        out["scale1m_churn0p1pct_p50"] = _r(m1d.get("churn0p1pct"))
+        out["scale1m_churn1pct_p50"] = _r(m1d.get("churn1pct"))
+        out["scale1m_churn10pct_p50"] = _r(m1d.get("churn10pct"))
     if tier_status:
         out["tiers"] = tier_status
     if args.no_verify:

@@ -22,6 +22,19 @@ from ..utils import DONE, Runtime, Store
 
 DEFAULT_SCHEDULER = "default-scheduler"
 
+def _takes_dirty_keys(engine) -> bool:
+    """Whether ``engine.schedule`` is the genuine tensor-engine method
+    (which grew the ``dirty_keys`` kwarg) rather than a sidecar proxy or
+    a patched-in double with the narrower legacy signature."""
+    return (
+        isinstance(engine, TensorScheduler)
+        and "schedule" not in vars(engine)
+        and type(engine).schedule is _TENSOR_SCHEDULE
+    )
+
+
+_TENSOR_SCHEDULE = TensorScheduler.schedule
+
 
 def _is_transport_error(exc: Exception) -> bool:
     """Solver-channel failures that trigger the in-proc fallback (grpc is
@@ -98,6 +111,15 @@ class SchedulerController:
         self._quota_snapshot = None
         self._quota_snap_gen = -1  # generation the cached snapshot is for
         self._quota_denied: dict[tuple, int] = {}  # (kind, key) -> gen
+        # dirty-set plumbing (ISSUE 20): _problem_for answers the CACHED
+        # problem object when the rebuilt content is equal, so a steady
+        # binding keeps one identity across waves and the engine's
+        # batch-identity/delta paths can diff a wave by id(). Keys whose
+        # content DID move accumulate per wave in _dirty_problem_keys —
+        # the dirty-row set threaded into TensorScheduler.schedule()
+        # beside the identity token. Pruned on binding delete.
+        self._problem_cache: dict[str, BindingProblem] = {}
+        self._dirty_problem_keys: set[str] = set()
         # once-per-transition counter gate (ISSUE 13 satellite): the
         # SHARED dedup behind quota_denied_total AND unschedulable_total
         # — a parked binding re-enqueued across passes within one
@@ -379,6 +401,10 @@ class SchedulerController:
             rb = self.store.get(kind, key)
             if rb is None:
                 self._quota_denied.pop(kind_key, None)
+                # deleted binding: drop its cached problem so the key's
+                # identity cannot alias a later re-creation
+                self._problem_cache.pop(key, None)
+                self._dirty_problem_keys.discard(key)
                 out[kind_key] = DONE
                 continue
             should, fresh = self._needs_scheduling(rb)
@@ -424,6 +450,22 @@ class SchedulerController:
         # wave's solve time decomposes without per-binding bookkeeping
         with tracer.span("scheduler.pass") as sp:
             problems = [p for _, _, p, _ in todo]
+            # the wave's dirty-row set: keys whose problem content moved
+            # since their cached build (watch-bus spec changes, quota
+            # re-enqueues, eviction displacements all land here through
+            # _problem_for). Handed to the engine beside the identity
+            # token; reset so the next wave reports only ITS churn.
+            wave_dirty = self._dirty_problem_keys
+            self._dirty_problem_keys = set()
+            sp.attrs["dirty_rows"] = len(wave_dirty)
+
+            def _eng_schedule(engine):
+                # dirty keys ride only the in-proc tensor engine; a
+                # solver-sidecar proxy (or a patched-in test double)
+                # keeps its existing contract
+                if _takes_dirty_keys(engine):
+                    return engine.schedule(problems, dirty_keys=wave_dirty)
+                return engine.schedule(problems)
 
             def _solve_on(engine):
                 """One engine pass with the scarcity plane armed for its
@@ -438,10 +480,10 @@ class SchedulerController:
                     )
                 )
                 if not armed:
-                    return engine.schedule(problems), None
+                    return _eng_schedule(engine), None
                 engine.set_preemption(self._victim_problems)
                 try:
-                    results = engine.schedule(problems)
+                    results = _eng_schedule(engine)
                     return results, getattr(engine, "last_preemption", None)
                 finally:
                     engine.set_preemption(None)
@@ -604,7 +646,7 @@ class SchedulerController:
             for rb in changed:
                 self.store.apply(rb)
 
-    def dry_solve(self, problems) -> list:
+    def dry_solve(self, problems, dirty_keys=None) -> list:
         """One engine pass with NO store writes and NO scarcity arming —
         the continuous descheduler's scoring seam (the engine still
         enforces quota, so a drift score can never recommend a placement
@@ -613,7 +655,10 @@ class SchedulerController:
         scoring pass never debits budget real bindings need) and the
         provenance store is disarmed for its duration (a hypothetical
         fresh-solve capture must not overwrite a binding's real
-        decision chain in /debug/explain)."""
+        decision chain in /debug/explain). ``dirty_keys`` threads the
+        caller's known-churn set into the engine's delta path — the
+        descheduler's whole-plane scoring rounds replay untouched rows
+        from the resident mirrors instead of re-packing the plane."""
         engine = self._route_engine_for_quota(self._get_engine(), problems)
         self._ensure_engine_quota(engine)
         q = getattr(engine, "quota", None)
@@ -622,6 +667,8 @@ class SchedulerController:
         if hasattr(engine, "set_explain"):
             engine.set_explain(None)
         try:
+            if _takes_dirty_keys(engine):
+                return engine.schedule(problems, dirty_keys=dirty_keys)
             return engine.schedule(problems)
         finally:
             if hasattr(engine, "set_explain"):
@@ -630,6 +677,21 @@ class SchedulerController:
                 q.remaining = saved_remaining
 
     def _problem_for(self, key: str, rb: ResourceBinding, fresh: bool) -> BindingProblem:
+        """Build the engine problem for ``rb`` — answering the CACHED
+        object when the rebuilt content is equal (identity ⇔ content, the
+        delta plumbing's contract: the engine diffs waves by id(), so an
+        unchanged binding must keep ONE problem object across waves). A
+        content move replaces the cache entry and marks the key dirty
+        for the wave's dirty-row set."""
+        p = self._build_problem(key, rb, fresh)
+        cached = self._problem_cache.get(key)
+        if cached is not None and cached == p:
+            return cached
+        self._problem_cache[key] = p
+        self._dirty_problem_keys.add(key)
+        return p
+
+    def _build_problem(self, key: str, rb: ResourceBinding, fresh: bool) -> BindingProblem:
         return BindingProblem(
             key=key,
             placement=rb.spec.placement,

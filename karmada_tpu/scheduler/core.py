@@ -301,6 +301,16 @@ class TensorScheduler:
         self._batch_problems: Optional[list] = None
         self._batch_spread = True  # batch holds derived spread selections
         self._batch_token = None  # snapshot.mask_token at cache time
+        # per-pass dirty-key set (ISSUE 20): the controller's invalidation
+        # sources (watch bus, quota bumps, estimator movement, evictions)
+        # accumulate binding keys whose problems changed since the last
+        # wave; schedule() stages them here and the batch-identity diff
+        # unions them with the id()-diff to form the delta positions.
+        # None = caller supplied no dirty info (diff alone decides).
+        self._dirty_keys: Optional[set] = None
+        # key -> position map of the armed batch (lazily built, only when
+        # dirty keys need resolving against a large wave)
+        self._key_pos: Optional[dict] = None
         # estimator-backed batch-identity fast path (see schedule()):
         # (ids, snapshot gen, estimator ids, confirm tokens, results +
         # pinned problems) of the last host-path batch whose estimators
@@ -646,6 +656,9 @@ class TensorScheduler:
             if cache[2] is None:  # cached all-admitted wave
                 return None, None
             return (cache[2], cache[3]), None
+        out = self._quota_admission_delta(problems, ids, ns_ids, cache)
+        if out is not None:
+            return out
         demand = np.zeros((b, len(q.dims)), np.int64)
         for i in np.flatnonzero(ns_ids >= 0):
             p = problems[i]
@@ -744,6 +757,130 @@ class TensorScheduler:
         )
         return (sub, denied), debit
 
+    def _quota_admission_delta(self, problems, ids, ns_ids, cache):
+        """Delta admission (ISSUE 20): a wave whose ids moved in a
+        MINORITY of positions within the SAME quota generation re-admits
+        only the changed rows. ``quota_admit`` is row_coupled (FIFO
+        segments share a per-namespace cumsum), so the changed rows run
+        through a COMPLETE admission kernel over their own sub-batch — a
+        scoped full pass over the affected segment, never a partial
+        dispatch — against the working remaining, which already carries
+        every previously admitted row's debit. Unchanged rows replay
+        their cached outcome exactly: within one generation the working
+        remaining only decreases, so a prior denial stays denied and a
+        prior admission stays charged. The returned debit covers ONLY
+        the changed rows' delta demand — replayed rows are never
+        re-charged (the PR 14 working-remaining restore contract,
+        extended to the delta path). Returns (partition, debit), or None
+        when ineligible (the caller runs the full admission)."""
+        from ..ops.quota import quota_admit
+        from .quota import QUOTA_EXCEEDED_ERROR
+
+        q = self.quota
+        b = len(problems)
+        if (
+            cache is None
+            or cache[1] != q.generation
+            or len(cache[0]) != b
+            or not self._delta_enabled()
+        ):
+            return None
+        ch = np.flatnonzero(ids != cache[0])
+        if ch.size == 0 or ch.size * 2 > b:
+            return None
+        nd = len(q.dims)
+        m = int(ch.size)
+        ns_ch = ns_ids[ch]
+        demand = np.zeros((m, nd), np.int64)
+        for j in np.flatnonzero(ns_ch >= 0):
+            p = problems[int(ch[j])]
+            delta = p.replicas - sum(p.prev.values())
+            if delta > 0:
+                demand[j] = q.demand_row(p.requests, delta)
+        old_denied = cache[4]
+        if demand.any():
+            b_pad = 1 << max(0, (m - 1).bit_length())
+            ns_pad, dem_pad = ns_ch, demand
+            if b_pad > m:
+                ns_pad = np.pad(ns_ch, (0, b_pad - m), constant_values=-1)
+                dem_pad = np.pad(demand, ((0, b_pad - m), (0, 0)))
+            n_pad = 1 << max(2, (q.remaining.shape[0] - 1).bit_length())
+            remaining = q.remaining
+            if n_pad > remaining.shape[0]:
+                from ..ops.quota import UNLIMITED
+
+                remaining = np.pad(
+                    remaining,
+                    ((0, n_pad - remaining.shape[0]), (0, 0)),
+                    constant_values=UNLIMITED,
+                )
+            arrays = (
+                jnp.asarray(ns_pad),
+                jnp.asarray(dem_pad),
+                jnp.asarray(remaining),
+            )
+            q_mesh_el = None
+            if self.mesh is not None:
+                from ..parallel.mesh import mesh_shape, shard_rows
+
+                ns_dev, dem_dev = shard_rows(self.mesh, arrays[0], arrays[1])
+                if ns_dev is not arrays[0]:
+                    q_mesh_el = mesh_shape(self.mesh)
+                arrays = (ns_dev, dem_dev, arrays[2])
+            key = ("Q", b_pad, n_pad, int(remaining.shape[1]), q_mesh_el)
+            if self._mark_trace(*key) and q_mesh_el is None:
+                self._record_trace("quota_admit", key, arrays)
+            admitted_dev, wave_used = quota_admit(*arrays)
+            adm_ch = np.asarray(admitted_dev)[:m]
+            wu = np.asarray(wave_used)[: q.remaining.shape[0]]
+            debit = wu if wu.any() else None
+        else:
+            # no changed row carries positive delta demand: all admit
+            # trivially and nothing is charged
+            adm_ch = np.ones(m, bool)
+            debit = None
+        new_denied = np.union1d(
+            np.setdiff1d(old_denied, ch), ch[~adm_ch]
+        ).astype(np.int64)
+        if new_denied.size == 0:
+            self._quota_cache = (
+                ids.copy(), q.generation, None, None,
+                np.zeros(0, np.int64), list(problems),
+            )
+            return (None, debit)
+        denied = [
+            (
+                int(i),
+                ScheduleResult(
+                    key=problems[int(i)].key, error=QUOTA_EXCEEDED_ERROR
+                ),
+            )
+            for i in new_denied
+        ]
+        if (
+            cache[2] is not None
+            and len(old_denied) == new_denied.size
+            and np.array_equal(old_denied, new_denied)
+        ):
+            # partition shape unchanged: swap the changed admitted rows
+            # into the PREVIOUS sub-list so the solve-level delta path
+            # sees an identity-stable wave downstream
+            sub = list(cache[2])
+            ch_adm = ch[adm_ch]
+            if ch_adm.size:
+                sub_pos = ch_adm - np.searchsorted(new_denied, ch_adm)
+                for s_i, i in zip(sub_pos, ch_adm):
+                    sub[int(s_i)] = problems[int(i)]
+        else:
+            admitted_mask = np.ones(b, bool)
+            admitted_mask[new_denied] = False
+            sub = [problems[i] for i in np.flatnonzero(admitted_mask)]
+        self._quota_cache = (
+            ids.copy(), q.generation, sub, denied, new_denied,
+            list(problems),
+        )
+        return ((sub, denied), debit)
+
     @property
     def cap_shrink_pending(self) -> bool:
         """A buffer-cap shrink desire is accumulating in the fleet table
@@ -764,13 +901,31 @@ class TensorScheduler:
         planes never pay more than the `is None` check."""
         self.preempt_source = source
 
-    def schedule(self, problems: Sequence[BindingProblem]) -> list[ScheduleResult]:
+    def schedule(
+        self,
+        problems: Sequence[BindingProblem],
+        dirty_keys: Optional[set] = None,
+    ) -> list[ScheduleResult]:
         """Provenance wrapper: the solve runs unchanged; when explain is
         armed the pass's decision provenance captures AFTER the results
         exist (one extra armed-only dispatch per chunk — telemetry, so
-        a capture failure logs and never aborts the wave)."""
+        a capture failure logs and never aborts the wave).
+
+        ``dirty_keys`` (optional) is the caller's per-wave dirty-row set:
+        binding keys whose problems changed since the last wave (watch-bus
+        spec/generation movement, quota bumps, estimator pings, eviction
+        displacements — the controller accumulates them). It rides beside
+        the batch-identity token: the delta solve unions it with the
+        object-identity diff, so a caller that rebuilds a problem object
+        without changing content still gets the row re-dispatched when it
+        says so. Disarmed (``KARMADA_TPU_DELTA_SOLVE=0``) or absent, the
+        pass costs one ``is None`` check over the existing paths."""
         self.last_preemption = None
-        results = self._schedule_quota(problems)
+        self._dirty_keys = set(dirty_keys) if dirty_keys else None
+        try:
+            results = self._schedule_quota(problems)
+        finally:
+            self._dirty_keys = None
         # the preemption pass runs BEFORE the explain capture so a
         # re-solved demander's provenance shows its final placement. A
         # failed preemption pass logs and leaves the demanders' honest
@@ -1414,6 +1569,86 @@ class TensorScheduler:
             assignment=assignment,
         )
 
+    def _delta_enabled(self) -> bool:
+        """The ISSUE 20 kill switch, read per pass so flipping
+        ``KARMADA_TPU_DELTA_SOLVE=0`` takes effect on the next wave with
+        no restart. Disarmed, every delta site collapses to one cheap
+        check and the pre-existing full paths run untouched."""
+        import os
+
+        return os.environ.get("KARMADA_TPU_DELTA_SOLVE", "1") != "0"
+
+    def _delta_pass(self, problems, ids, t0):
+        """Batch-identity DELTA path (ISSUE 20): the wave has the shape
+        of the armed batch but a minority of positions hold new problem
+        objects (and/or the caller marked keys dirty). Compiles just the
+        changed rows, verifies each against the fleet-eligibility
+        predicate, and hands the fleet the swapped lists plus the dirty
+        positions — the table packs and dispatches only those rows and
+        replays the rest from its resident mirrors. Returns None when
+        ineligible and the caller runs the full prologue: armed
+        preemption (preempt_select is row_coupled — a partial wave
+        cannot see the plane-wide victim cumsum), a moved snapshot
+        generation (the replay base is stale), a changed row that is not
+        fleet-eligible, or majority churn where the full pass wins."""
+        import time as _time
+
+        if (
+            self._fleet is None
+            or self.preempt_source is not None
+            or self._batch_gen != self._snapshot_gen
+            or not self._delta_enabled()
+        ):
+            return None
+        n = len(problems)
+        diff = np.flatnonzero(ids != self._batch_ids)
+        dk = self._dirty_keys
+        if dk:
+            # dirty keys are advisory positions ON TOP of the id diff: a
+            # mapping miss only over-dispatches (safe superset) — a truly
+            # changed row always shows in the id diff as well
+            kp = self._key_pos
+            if kp is None or len(kp) != n:
+                kp = {p.key: i for i, p in enumerate(problems)}
+                self._key_pos = kp
+            extra = [kp[k] for k in dk if k in kp]
+            if extra:
+                diff = np.union1d(diff, np.asarray(extra, np.int64))
+        if diff.size * 2 > n:
+            return None
+        from ..ops.divide import DUPLICATED as _DUP
+        from .fleet import K_PREV as _KP, MAX_REPLICAS_FAST as _MRF
+
+        fp, fc = self._batch_cache
+        fp2 = list(fp)
+        fc2 = list(fc)
+        for pos in diff:
+            pos = int(pos)
+            p = problems[pos]
+            cp = self._compiled(p.placement)
+            if not (
+                cp.fleet_single_term
+                and not p.evict_clusters
+                and len(p.prev) <= _KP
+                and (cp.strategy == _DUP or p.replicas <= _MRF)
+            ):
+                # a changed row left the fleet-eligible set (spread/
+                # multi-term/eviction): the full prologue partitions it
+                return None
+            fp2[pos] = p
+            fc2[pos] = cp
+        self.last_breakdown = {"compile": _time.perf_counter() - t0}
+        self.solve_batches += 1
+        res = self._fleet.schedule(fp2, fc2, delta=diff)
+        self.last_breakdown.update(self._fleet.last_breakdown)
+        # re-arm the identity token on the swapped lists (gen and mask
+        # token are unchanged by construction; _batch_spread likewise —
+        # swapped-in rows are never derived selections)
+        self._batch_problems = fp2
+        self._batch_ids = ids
+        self._batch_cache = (fp2, fc2)
+        return res
+
     def _schedule_inner(
         self, problems: Sequence[BindingProblem]
     ) -> list[ScheduleResult]:
@@ -1481,7 +1716,7 @@ class TensorScheduler:
         ):
             t0 = _time.perf_counter()
             ids = np.fromiter(map(id, problems), np.int64, len(problems))
-            if np.array_equal(ids, self._batch_ids):
+            if np.array_equal(ids, self._batch_ids) and not self._dirty_keys:
                 self.last_breakdown = {
                     "compile": _time.perf_counter() - t0
                 }
@@ -1489,6 +1724,13 @@ class TensorScheduler:
                 self.solve_batches += 1
                 res = self._fleet.schedule(fp, fc)
                 self.last_breakdown.update(self._fleet.last_breakdown)
+                return res
+            # not the identical batch: a minority of moved positions (or
+            # caller-declared dirty keys) is the DELTA case — pack and
+            # dispatch just those rows, replay the rest from the fleet's
+            # resident mirrors (ISSUE 20)
+            res = self._delta_pass(problems, ids, t0)
+            if res is not None:
                 return res
 
         t0 = _time.perf_counter()

@@ -874,6 +874,48 @@ FLEET_KERNELS = {
 }
 
 
+#: solve-path kernels the delta pass drives with PARTIAL batches — the
+#: runtime consumes the dep-lint tier's jaxpr row-dependence certification
+#: (tools/graftlint/dep.delta_safe_registry) instead of re-declaring
+#: independence here. row_coupled kernels (quota_admit's FIFO segments,
+#: preempt_select's plane-wide cumsum) are NOT in this list: their waves
+#: force a scoped full pass (see TensorScheduler.schedule).
+_DELTA_SAFE_REQUIRED = (
+    "divide_replicas", "take_by_weight_batch", "general_estimate",
+)
+
+_DELTA_CERT: Optional[bool] = None
+
+
+def delta_certified() -> bool:
+    """True when the dep-lint tier proves every kernel the delta solve
+    dispatches row-independent (``delta_safe``: declared uncoupled AND
+    jaxpr-analyzed "independent"). Cached per process — the registry
+    traces every entry spec once. Fail-closed: an import failure, a
+    missing registry row, or a coupled/unproven verdict DISARMS the
+    delta path rather than risk a partial dispatch of a row-coupled
+    kernel silently dropping cross-row effects."""
+    global _DELTA_CERT
+    if _DELTA_CERT is None:
+        try:
+            from tools.graftlint.dep import delta_safe_registry
+
+            rows = {r["name"]: r for r in delta_safe_registry()}
+            _DELTA_CERT = all(
+                rows[k]["delta_safe"] for k in _DELTA_SAFE_REQUIRED
+            )
+        except Exception:  # noqa: BLE001 — certification is a gate, not
+            # a dependency: anything short of a positive verdict disarms
+            _DELTA_CERT = False
+        if not _DELTA_CERT:
+            log.warning(
+                "delta solve disarmed: dep-lint certification of %s "
+                "did not prove row-independence",
+                ", ".join(_DELTA_SAFE_REQUIRED),
+            )
+    return _DELTA_CERT
+
+
 # --------------------------------------------------------------------------
 # results
 # --------------------------------------------------------------------------
@@ -1195,6 +1237,15 @@ class FleetTable:
         # skipped upserts would have done (consumed by _compact).
         self._reuse: Optional[tuple] = None
         self._reuse_pass = 0
+        # mirror staleness fence for the delta solve: _mirror_epoch bumps
+        # whenever a resident/mirror pair is (re)allocated zeroed, and
+        # _reuse_epoch records the epoch whose mirrors fully cover the
+        # reuse rows (synced at the end of every full pass). A delta pass
+        # only replays untouched rows when the epochs agree — a realloc
+        # between the covering pass and now means the mirrors no longer
+        # hold those rows' results.
+        self._mirror_epoch = 0
+        self._reuse_epoch = -1
         # bumped whenever _host_entries is rewritten (each pass, and on
         # compaction remaps); _FleetBatch captures it so stale result
         # views fail loudly instead of decoding another pass's entries
@@ -1362,6 +1413,7 @@ class FleetTable:
         self._res_meta = None
         self._host_meta = None
         self._host_entries = None
+        self._mirror_epoch += 1
 
     def _grow(self, need: int) -> None:
         new_cap = max(self.chunk, _pow2(need))
@@ -1843,22 +1895,35 @@ class FleetTable:
 
     # -- scheduling --------------------------------------------------------
 
-    def schedule(self, problems: Sequence, compiled: Sequence) -> list:
+    def schedule(
+        self, problems: Sequence, compiled: Sequence, delta=None
+    ) -> list:
         """One fleet pass, wrapped in a ``scheduler.solve`` wave span with
         per-phase kernel child spans (host pack / dispatch / fenced device
         execute / fetch+fold) emitted from the pass breakdown — the
         device/host attribution surface of ISSUE 6 (b). The span carries
         the pass's packed-vs-replayed row split (the churn-attribution
         series the history ring records per wave, ISSUE 12), and the
-        device-byte ledger publishes after every pass."""
+        device-byte ledger publishes after every pass.
+
+        ``delta`` (optional) is a sequence of POSITIONS into ``problems``
+        that changed since the last pass; every other position must hold
+        the same problem/compiled objects the last pass scheduled (the
+        caller's contract — the engine's batch-identity diff and the
+        dirty-key plumbing both construct batches that way). When the
+        table can prove its resident mirrors still cover the untouched
+        rows, only the delta positions are packed and dispatched and the
+        rest replay from the mirrors; otherwise the pass silently runs
+        full."""
         from ..utils.tracing import tracer
 
         with tracer.span("scheduler.solve") as sp:
-            res = self._schedule_pass(problems, compiled)
+            res = self._schedule_pass(problems, compiled, delta)
             tmr = self.last_breakdown
             sp.attrs["rows"] = len(problems)
             sp.attrs["rows_packed"] = int(tmr.get("rows_packed", 0))
             sp.attrs["rows_replayed"] = int(tmr.get("rows_replayed", 0))
+            sp.attrs["dirty_rows"] = int(tmr.get("dirty_rows", 0))
             self._emit_phase_spans()
         self._publish_device_bytes()
         return res
@@ -1979,8 +2044,17 @@ class FleetTable:
             tracer.record(name, seconds, kind=kind, **attrs)
             kernel_phase_seconds.observe(seconds, phase=name.split(".")[1])
 
-    def _schedule_pass(self, problems: Sequence, compiled: Sequence) -> list:
+    def _schedule_pass(
+        self, problems: Sequence, compiled: Sequence, delta=None
+    ) -> list:
         import time as _time
+
+        if delta is not None:
+            res = self._schedule_delta(problems, compiled, delta)
+            if res is not None:
+                return res
+            # ineligible (stale mirrors / uncertified / majority dirty):
+            # fall through to the full pass below
 
         tmr: dict[str, float] = {}
         t0 = _time.perf_counter()
@@ -2146,8 +2220,186 @@ class FleetTable:
         # steady storm must ship changed rows' bytes, never the grid
         tmr["upload_mb"] = self._last_upload_bytes / 1e6
         if self.cap * c <= DENSE_RESIDENT_MAX_BYTES:
-            return self._solve_dense(**shared)
-        return self._solve_legacy(**shared)
+            res = self._solve_dense(**shared)
+        else:
+            res = self._solve_legacy(**shared)
+        # this pass dispatched every reuse row, so the mirrors now cover
+        # them at the current epoch — the delta-eligibility fence
+        self._reuse_epoch = self._mirror_epoch
+        return res
+
+    #: full-pass buffer-tuning attributes frozen across a delta sub-pass:
+    #: a few-thousand-row delta must never shrink the caps the next full
+    #: storm dispatches at (every distinct cap pair is an XLA trace)
+    _TUNE_ATTRS = (
+        "_last_total", "_e_cap_cur", "_e_shrink_desire", "_m_cap_cur",
+        "_shrink_desire", "_d_cap_cur", "_last_changed", "_last_dtotal",
+        "_delta_live",
+    )
+
+    def _schedule_delta(self, problems, compiled, delta):
+        """Partial pass: pack + dispatch ONLY the ``delta`` positions,
+        replay every other row's result from the host mirrors. Returns
+        None when ineligible — stale mirrors (a resident realloc since
+        the covering pass), a moved snapshot generation, an uncertified
+        kernel set, or a majority-dirty batch where the full pass is
+        simply cheaper — and the caller runs the full pass."""
+        import time as _time
+
+        ru = self._reuse
+        n = len(problems)
+        if (
+            ru is None
+            or len(ru[0]) != n
+            or len(ru[2]) != n
+            or self._host_entries is None
+            or self._host_meta is None
+            or getattr(self.engine, "_snapshot_gen", 0) != self._snapshot_gen
+            or self._reuse_epoch != self._mirror_epoch
+            or not delta_certified()
+        ):
+            return None
+        idx = np.unique(np.asarray(list(delta), np.int64))
+        if idx.size and (idx[0] < 0 or idx[-1] >= n):
+            return None
+        if idx.size * 2 > n:
+            return None  # majority dirty: the full pass wins
+        t_all = _time.perf_counter()
+        rows_full = ru[2]
+        n_sub = int(idx.size)
+        if n_sub == 0:
+            # pure replay: nothing changed — serve the whole batch from
+            # the mirrors without touching the device
+            self._pass += 1
+            self.new_trace_last_pass = False
+            self._packed_this_pass = 0
+            self._reuse = (problems, compiled, rows_full)
+            self._reuse_pass = self._pass
+            tmr: dict[str, float] = {
+                "rows_packed": 0.0,
+                "rows_replayed": float(n),
+                "dirty_rows": 0.0,
+            }
+            res = self._replay_result(problems, rows_full, tmr)
+            tmr["post"] = _time.perf_counter() - t_all
+            self.last_breakdown = tmr
+            return res
+        sub_p = [problems[int(i)] for i in idx]
+        sub_c = [compiled[int(i)] for i in idx]
+        epoch = self._mirror_epoch
+        cap_before = self.cap
+        tune = tuple(getattr(self, a) for a in self._TUNE_ATTRS)
+        # virgin tuning state for the sub dispatch: demand-sized caps
+        # (the safe bounds for a sub batch — no overflow rerun possible)
+        # keyed per pow2 sub-size bucket, so a settle train of equal-size
+        # deltas converges to one trace instead of thrashing the tuned
+        # full-pass caps
+        self._last_total = None
+        self._e_cap_cur = None
+        self._e_shrink_desire = (None, 0)
+        self._m_cap_cur = None
+        self._shrink_desire = (None, 0)
+        self._d_cap_cur = None
+        self._last_changed = None
+        self._last_dtotal = None
+        self._delta_live = False
+        try:
+            self._schedule_pass(sub_p, sub_c)
+        finally:
+            for a, v in zip(self._TUNE_ATTRS, tune):
+                setattr(self, a, v)
+        if (
+            self._mirror_epoch != epoch
+            or self.cap != cap_before
+            or self._reuse is None
+        ):
+            # a resident/mirror realloc (or table growth) happened inside
+            # the sub pass: the replay base for the untouched rows is
+            # gone — hand back to the caller for a full pass
+            return None
+        sub_rows = self._reuse[2]
+        rows_new = rows_full
+        if not np.array_equal(sub_rows, rows_full[idx]):
+            rows_new = rows_full.copy()
+            rows_new[idx] = sub_rows
+        tmr = self.last_breakdown  # the sub pass's phase breakdown
+        tmr["rows_replayed"] = float(n - n_sub)
+        tmr["dirty_rows"] = float(n_sub)
+        self._reuse = (problems, compiled, rows_new)
+        self._reuse_pass = self._pass
+        t0 = _time.perf_counter()
+        res = self._replay_result(problems, rows_new, tmr)
+        tmr["post"] = tmr.get("post", 0.0) + (_time.perf_counter() - t0)
+        return res
+
+    def _replay_result(self, problems, rows_full, tmr):
+        """Batch result for ``rows_full`` built entirely from the host
+        mirrors (entry runs + meta words) — the replay half of a delta
+        pass. The mirrors cover every reuse row by induction: each row
+        was dispatched by the pass that established the mapping (or a
+        later one), and the _mirror_epoch fence rejects any realloc in
+        between."""
+        st = self._st
+        n = len(problems)
+        meta_sel = self._host_meta[rows_full]
+        n_placed = (meta_sel & 0xFF).astype(np.int64)
+        unsched = (meta_sel >> 8) & 1
+        has_cand = (meta_sel >> 9) & 1
+        reps_sel = st["replicas"][rows_full]
+        is_dup = st["strategy"][rows_full] == S_DUPLICATED
+        need_bits = bool(is_dup.any() or (reps_sel == 0).any())
+        eff_chunk = min(self.chunk, _pow2(max(n, 256)))
+        n_pad = max(eff_chunk, -(-n // eff_chunk) * eff_chunk)
+        bits_src = None
+        if need_bits:
+            bits_src = self._bits_full_src(rows_full, n, n_pad, eff_chunk)
+        self._result_gen += 1
+        names = self.engine.snapshot.names
+        batches = [
+            _FleetBatch(
+                names, self._host_entries, rows_full, bits_src,
+                self, self._result_gen,
+            )
+        ]
+        terms = [self._terms[r] for r in rows_full]
+        return _FleetResultList(
+            problems, terms, batches, n_pad, n_placed, unsched,
+            has_cand, is_dup,
+        )
+
+    def _bits_full_src(self, rows_full, n, n_pad, eff_chunk):
+        """Lazy feasibility-bitset thunk over the FULL reuse rows — the
+        delta-pass counterpart of the inline bits closure in
+        _schedule_pass (a replayed Duplicated row's consumer needs the
+        whole batch's bitsets, not just the dirty sub-batch's). Dispatch
+        + row-index upload are deferred to first access: most delta
+        batches never decode a Duplicated row."""
+        _tables = self._dev_tables
+        _state = self._dev_state
+        n_chunks = n_pad // eff_chunk
+
+        def bits_src():
+            from ..parallel.mesh import mesh_shape as _bits_mesh_shape
+
+            ar = np.full(n_pad, -1, np.int32)
+            ar[:n] = rows_full
+            rows_dev = jnp.asarray(ar)
+            key = (
+                "B", eff_chunk, n_chunks, _tables[0].shape,
+                int(rows_dev.shape[0]), int(_state[0].shape[0]),
+                _bits_mesh_shape(self._mesh),
+            )
+            if self._mark_trace(*key) and self._mesh is None:
+                self._record_trace(
+                    "fleet_bits", key, (*_tables, rows_dev, *_state),
+                    chunk=eff_chunk, n_chunks=n_chunks,
+                )
+            return _fleet_bits(
+                *_tables, rows_dev, *_state, chunk=eff_chunk,
+                n_chunks=n_chunks,
+            )
+
+        return bits_src
 
     def _alloc_resident(self, shape, dtype, mesh, *, c_axis=False):
         """Zeroed resident born on the solve's sharding layout (rows over
@@ -2203,6 +2455,14 @@ class FleetTable:
             )
             self._host_entries = np.zeros((self.cap, k_res), np.int32)
             self._resident_mesh = mesh_el
+            self._mirror_epoch += 1
+        if self._host_meta is None or self._host_meta.shape[0] != self.cap:
+            # legacy meta mirror: the wire ships full meta every pass, so
+            # the mirror is pure bookkeeping here — but it is what lets a
+            # delta pass replay untouched rows' n_placed/unsched/has_cand
+            # without re-dispatching them
+            self._host_meta = np.zeros(self.cap, np.int32)
+            self._mirror_epoch += 1
         self._k_res = k_res
 
         # fetched bytes scale with e_cap, so tune it to ~1.25x the last
@@ -2341,6 +2601,11 @@ class FleetTable:
         unsched = (meta >> 8) & 1
         has_cand = (meta >> 9) & 1
         changed = ((meta >> 10) & 1).astype(bool)
+        # meta mirror covers every dispatched row (state bits only — the
+        # changed flag is a per-pass wire artifact, not row state)
+        self._host_meta[rows_np] = (
+            np.asarray(meta[:n]) & 0x3FF
+        ).astype(np.int32)
         # fold the changed rows' entry runs into the persistent host mirror
         ch_pos = np.flatnonzero(changed[:n])
         if len(ch_pos):
@@ -2477,6 +2742,7 @@ class FleetTable:
             )
             self._host_meta = np.zeros(self.cap, np.int32)
             self._resident_mesh = mesh_el
+            self._mirror_epoch += 1
         # host entry mirror: width grows in place (no resident to reset —
         # the dense base is width-independent)
         k_res = max(self._k_res, k_out)

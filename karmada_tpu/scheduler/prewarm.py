@@ -27,9 +27,19 @@ wire caps), so a fleet of a given size maps to a small, stable signature
 set. ``replay(expand=True)`` additionally compiles the NEXT bucket of
 each tuned cap (entry/meta/delta), so a churn burst that grows a cap
 mid-storm lands on an already-compiled bucket instead of minting a fresh
-compile on the critical path. Expanded specs carry no ledger key — the
+compile on the critical path. Grown specs carry no ledger key — the
 signature genuinely was not observed, so ``new_trace_last_pass`` still
 reports it honestly; only the compile is prepaid.
+
+Shrink buckets (the compaction/rebucket family) expand too: a settle
+train's demand collapses toward the cap FLOORS (sustained-shrink
+policy), so for each observed record the predecessor bucket and the
+floor bucket of each tuned cap are synthesized WITH their derived
+ledger keys — the key is a pure function of the record's key and the
+substituted cap element, so seeding it after a successful compile is
+honest (the compile genuinely happened; the first settle dispatch is a
+dispatch-cache hit). Without these, a restored 1M-shape engine minted a
+fresh multi-second solve trace mid-settle (BENCH_r05 pass 5).
 
 Restore contract: after ``replay()`` ran in this process, an engine
 constructed with the same manifest seeds its fleet ledger from the
@@ -56,6 +66,11 @@ from typing import Optional
 #: actually succeeded, or the first pass claims new_trace=False while a
 #: compile still runs on the serving path.
 _WARMED: dict[str, set[str]] = {}
+#: per manifest path, the ledger keys replay() proved compiled — the
+#: observed records' keys plus the DERIVED shrink-bucket keys (which
+#: have no manifest record to recover a key from, hence key set rather
+#: than canon set).
+_WARMED_KEYS: dict[str, set] = {}
 _WARM_LOCK = threading.Lock()
 
 _SCHEMA_VERSION = 1
@@ -219,20 +234,23 @@ class TraceManifest:
         }
 
     def warmed_keys(self) -> set:
-        """The ledger keys whose records ``replay()`` COMPILED in this
-        process — the only keys an engine may seed its new-trace ledger
-        from. Empty before replay; excludes records whose compile failed
-        (their trace would still run at first dispatch)."""
+        """The ledger keys ``replay()`` proved compiled in this process —
+        the only keys an engine may seed its new-trace ledger from:
+        observed records' keys plus derived shrink-bucket keys. Empty
+        before replay; excludes records whose compile failed (their
+        trace would still run at first dispatch)."""
         ok = _WARMED.get(self.path)
         if not ok:
             return set()
         with self._lock:
             records = list(self.records)
-        return {
+        keys = {
             _retuple(r["key"])
             for r in records
             if r.get("key") is not None and _canon(r) in ok
         }
+        keys.update(_WARMED_KEYS.get(self.path, set()))
+        return keys
 
 
 def _listify(v):
@@ -253,21 +271,91 @@ def _statics_from_json(statics: dict) -> dict:
     return {k: _retuple(v) for k, v in statics.items()}
 
 
+def _cap_prev(cap: int) -> Optional[int]:
+    """Largest quantized entry cap strictly below ``cap`` (None at the
+    1024 floor) — the bucket a sustained shrink lands on next. Bisects
+    against ``_cap_round`` (monotone, rounds up) so the result tracks
+    the engine's quantization policy verbatim."""
+    from .fleet import _cap_round
+
+    if cap <= 1024:
+        return None
+    lo, hi = 1, cap - 1
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if _cap_round(mid) < cap:
+            lo = mid
+        else:
+            hi = mid - 1
+    return _cap_round(lo)
+
+
+#: kernel -> {static name: index of that cap in the record's ledger key}
+#: (fleet.l_key / _e_key / a_key layouts). Shrink-bucket derivation
+#: substitutes the cap element of an OBSERVED key; the sanity check in
+#: expand_records (key[idx] == statics[cap]) keeps a layout drift from
+#: ever seeding a wrong key.
+_KEY_CAP_INDEX = {
+    "fleet_solve": {"e_cap": 8},
+    "fleet_entries": {"e_cap": 6},
+    "fleet_pass": {"m_cap": 10, "d_cap": 11},
+}
+
+
+def _derived(r: dict, updates: dict) -> Optional[dict]:
+    """A synthesized record: ``r`` with the cap statics in ``updates``
+    substituted and the ledger key re-derived by element substitution.
+    None when the observed key does not match the declared layout."""
+    idx_map = _KEY_CAP_INDEX.get(r["kernel"], {})
+    key = list(r["key"]) if r.get("key") is not None else None
+    statics = dict(r["statics"])
+    for name, cap in updates.items():
+        if key is not None:
+            i = idx_map.get(name)
+            if i is None or i >= len(key) or key[i] != statics.get(name):
+                key = None  # layout drift: compile-only, never seed
+            else:
+                key[i] = cap
+        statics[name] = cap
+    return {
+        "kernel": r["kernel"],
+        "key": key,
+        "in_shapes": r["in_shapes"],
+        "statics": statics,
+    }
+
+
 def expand_records(records: list[dict]) -> list[dict]:
     """Shape-bucket expansion: for each observed record, synthesize the
-    NEXT bucket of each tuned wire cap so mid-storm cap growth lands on a
-    prepaid compile. Expanded specs have key=None (the signature was
-    never dispatched; the ledger must stay honest)."""
-    from .fleet import M_ROUND, _cap_round, d_round
+    NEXT bucket of each tuned wire cap (so mid-storm cap growth lands on
+    a prepaid compile) and the PREDECESSOR + FLOOR buckets (so a settle
+    train's sustained shrink does too). Grown specs have key=None (the
+    signature was never dispatched; the ledger must stay honest); shrink
+    specs carry their derived key — see the module docstring."""
+    from .fleet import D_FLOOR, D_ROUND, M_ROUND, _cap_round, d_round
 
     out: list[dict] = []
+    seen = {_canon(r) for r in records}
+
+    def _emit(rec: dict) -> None:
+        c = _canon(rec)
+        if c not in seen:
+            seen.add(c)
+            out.append(rec)
+
     for r in records:
         statics = dict(r["statics"])
         grown: list[dict] = []
+        shrunk: list[dict] = []
         if r["kernel"] in ("fleet_solve", "fleet_entries"):
             e_cap = statics.get("e_cap")
             if isinstance(e_cap, int):
                 grown.append({**statics, "e_cap": _cap_round(e_cap + 1)})
+                prev = _cap_prev(e_cap)
+                if prev is not None:
+                    shrunk.append({"e_cap": prev})
+                    if prev > 1024:
+                        shrunk.append({"e_cap": 1024})
         elif r["kernel"] == "fleet_pass":
             m_cap = statics.get("m_cap")
             d_cap = statics.get("d_cap", 0)
@@ -290,8 +378,35 @@ def expand_records(records: list[dict]) -> list[dict]:
                 # same successor-rounding for the delta cap (D_FLOOR,
                 # then D_ROUND multiples)
                 grown.append({**statics, "d_cap": d_round(d_cap + 1)})
+            # shrink: the settle train tunes each cap down its own
+            # sustain vote, so cover the single-step predecessors and
+            # the joint floor state the train terminates in
+            m_floor = (
+                min(4096, r["in_shapes"][5][0][0])
+                if isinstance(m_cap, int)
+                else None
+            )
+            m_prev = None
+            if isinstance(m_cap, int) and m_cap > m_floor:
+                q = (m_cap - 1) // M_ROUND * M_ROUND
+                m_prev = q if q > m_floor else m_floor
+            d_prev = None
+            if isinstance(d_cap, int) and d_cap > D_FLOOR:
+                q = (d_cap - 1) // D_ROUND * D_ROUND
+                d_prev = q if q > D_FLOOR else D_FLOOR
+            if m_prev is not None:
+                shrunk.append({"m_cap": m_prev})
+            if d_prev is not None:
+                shrunk.append({"d_cap": d_prev})
+            floors = {}
+            if m_prev is not None:
+                floors["m_cap"] = m_floor
+            if d_prev is not None:
+                floors["d_cap"] = D_FLOOR
+            if floors:
+                shrunk.append(floors)
         for st in grown:
-            out.append(
+            _emit(
                 {
                     "kernel": r["kernel"],
                     "key": None,
@@ -299,6 +414,10 @@ def expand_records(records: list[dict]) -> list[dict]:
                     "statics": st,
                 }
             )
+        for updates in shrunk:
+            d = _derived(r, updates)
+            if d is not None:
+                _emit(d)
     return out
 
 
@@ -324,6 +443,7 @@ def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
             todo.append(r)
     compiled = failed = 0
     ok_canons: set[str] = set()
+    ok_keys: set = set()
     errors: list[str] = []
     # kernel -> {temp/output/argument/generated_code bytes}: the MAX
     # footprint across this replay's records per kernel family — what an
@@ -367,6 +487,10 @@ def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
                 ).compile()
             compiled += 1
             ok_canons.add(_canon(r))
+            if r.get("key") is not None:
+                # proved-compiled ledger key (observed or derived
+                # shrink bucket) — the seeding surface of warmed_keys()
+                ok_keys.add(_retuple(r["key"]))
             # device-memory footprint (ISSUE 12 b), best-effort: an
             # already-annotated record reuses its stored footprint —
             # zero extra lowerings on every boot after the first; a
@@ -440,6 +564,7 @@ def replay(manifest: TraceManifest, *, expand: bool = True) -> dict:
         kernel_prewarmed.inc(failed, result="failed")
     with _WARM_LOCK:
         _WARMED.setdefault(manifest.path, set()).update(ok_canons)
+        _WARMED_KEYS.setdefault(manifest.path, set()).update(ok_keys)
     return stats
 
 

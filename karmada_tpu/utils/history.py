@@ -128,6 +128,11 @@ HISTORY_SERIES: dict[str, HistorySeries] = {
             "or batch-identity replay)",
         ),
         HistorySeries(
+            "dirty_rows", "counter", "span:scheduler.solve",
+            "rows the wave's delta passes dispatched as dirty (summed "
+            "dirty_rows attrs; 0 = every pass was full or pure replay)",
+        ),
+        HistorySeries(
             "upload_mb", "counter", "span:kernel.host",
             "host->device megabytes shipped (state scatter/upload + row "
             "indices; summed upload_mb attrs)",
@@ -346,7 +351,7 @@ class WaveHistory:
 
         # span-attribute aggregation over the LOCAL ring (engine pass
         # stats ride local span attrs; remote handler spans carry none)
-        packed = replayed = bindings = 0
+        packed = replayed = bindings = dirty = 0
         upload_mb = fetch_mb = 0.0
         for sp in tr.spans_for(wave):
             if sp.name == "scheduler.pass":
@@ -354,6 +359,7 @@ class WaveHistory:
             elif sp.name == "scheduler.solve":
                 packed += int(sp.attrs.get("rows_packed", 0) or 0)
                 replayed += int(sp.attrs.get("rows_replayed", 0) or 0)
+                dirty += int(sp.attrs.get("dirty_rows", 0) or 0)
             elif sp.name == "kernel.host":
                 upload_mb += float(sp.attrs.get("upload_mb", 0.0) or 0.0)
             elif sp.name == "kernel.fetch":
@@ -399,6 +405,7 @@ class WaveHistory:
             ),
             "rows_packed": packed,
             "rows_replayed": replayed,
+            "dirty_rows": dirty,
             "upload_mb": round(upload_mb, 6),
             "fetch_mb": round(fetch_mb, 6),
             "device_s": float(summary.get("device_s", 0.0)),
@@ -573,7 +580,8 @@ def render_history_table(rows: list[dict], proc: str = "") -> str:
     bench print (the JSON row stays the machine surface)."""
     head = (
         f"{'proc':<10} {'wave':>5} {'wall_s':>8} {'cover':>6} "
-        f"{'bind/s':>8} {'packed':>7} {'replay':>7} {'cmpl':>4} "
+        f"{'bind/s':>8} {'packed':>7} {'replay':>7} {'dirty':>7} "
+        f"{'cmpl':>4} "
         f"{'up/fetch MB':>12} {'rpc e/s/b':>11} {'devMB':>8} "
         f"{'uns/den':>8} {'pre':>4} {'dis u/b':>8} {'q':>4}"
     )
@@ -587,6 +595,7 @@ def render_history_table(rows: list[dict], proc: str = "") -> str:
             f"{r.get('wall_s', 0.0):>8.3f} {cov:>6} "
             f"{r.get('bindings_s', 0.0):>8.1f} "
             f"{r.get('rows_packed', 0):>7} {r.get('rows_replayed', 0):>7} "
+            f"{r.get('dirty_rows', 0):>7} "
             f"{r.get('kernel_compiles', 0):>4} "
             f"{r.get('upload_mb', 0.0):>5.1f}/{r.get('fetch_mb', 0.0):<6.1f} "
             f"{r.get('rpc_estimator', 0)}/{r.get('rpc_solver', 0)}"

@@ -157,8 +157,13 @@ class TestTraceManifest:
             }
 
         grown = prewarm.expand_records([pass_rec(4 * M_ROUND)])
-        assert [g["statics"]["m_cap"] for g in grown] == [2 * M_ROUND]
-        assert prewarm.expand_records([pass_rec(M_ROUND)]) == []
+        # grow to the next quantum AND shrink to the 4096 floor (the
+        # settle-train bucket); the toy key is too short for derivation,
+        # so the shrink spec stays compile-only (key=None)
+        assert [g["statics"]["m_cap"] for g in grown] == [2 * M_ROUND, 4096]
+        assert all(g["key"] is None for g in grown)
+        shrunk_only = prewarm.expand_records([pass_rec(M_ROUND)])
+        assert [g["statics"]["m_cap"] for g in shrunk_only] == [4096]
 
         # floor caps expand to the engine's REAL next bucket, not
         # floor+quantum: m_round's first step is 4096 -> M_ROUND, and
@@ -285,6 +290,110 @@ class TestRestoreContract:
         single = TensorScheduler(snap, trace_manifest=str(path))
         single.schedule(toy_problems())
         assert single.last_pass_new_trace is False
+
+    def test_restored_engine_settle_train_stays_warm(
+        self, tmp_path, monkeypatch
+    ):
+        """The BENCH_r05 mid-settle compile, at toy scale: a manifest that
+        only observed CHURN passes misses the shrink-bucket solve family
+        (a settle train's entry demand collapses to the cap floor, and
+        the sustained-shrink retune mints a fresh trace mid-settle). The
+        shrink expansion must cover it: an engine restored from the
+        churn-only manifest reports new_trace=False across a FULL settle
+        train. Legacy path (the tier that regressed), full passes only
+        (the delta path freezes cap tuning, so shrink dynamics live on
+        the full-pass side)."""
+        import karmada_tpu.scheduler.fleet as fleet_mod
+
+        monkeypatch.setattr(fleet_mod, "DENSE_RESIDENT_MAX_BYTES", 0)
+        monkeypatch.setenv("KARMADA_TPU_DELTA_SOLVE", "0")
+
+        def churned(problems, seed):
+            rng = np.random.default_rng(seed)
+            out = list(problems)
+            for i in rng.choice(len(out), len(out) // 2, replace=False):
+                p = out[i]
+                out[i] = BindingProblem(
+                    key=p.key, placement=p.placement,
+                    replicas=int(rng.integers(1, 40)),
+                    requests=p.requests, gvk=p.gvk,
+                )
+            return out
+
+        def settled(problems, seed):
+            # exactly 3 rows, replicas GUARANTEED changed and bounded by
+            # the churn range (a new max would legitimately re-key the
+            # solve) — the settle dispatch shapes stay deterministic
+            rng = np.random.default_rng(seed)
+            out = list(problems)
+            for i in rng.choice(len(out), 3, replace=False):
+                p = out[i]
+                out[i] = BindingProblem(
+                    key=p.key, placement=p.placement,
+                    replicas=(p.replicas % 39) + 1,
+                    requests=p.requests, gvk=p.gvk,
+                )
+            return out
+
+        path = tmp_path / "churn.json"
+        snap = ClusterSnapshot(synthetic_fleet(C, seed=7))
+        eng = TensorScheduler(snap, trace_manifest=str(path))
+        problems = toy_problems()
+        eng.schedule(problems)
+        for s in range(1, 4):  # the churn storm: caps grow and stay up
+            problems = churned(problems, s)
+            eng.schedule(problems)
+        # one light pass: the small-scatter upload shapes are part of any
+        # real churn history; what the manifest must NOT have observed is
+        # the settle train's shrink retune
+        problems = settled(problems, 5)
+        eng.schedule(problems)
+        churn_records = path.read_bytes()
+        settle_start = problems
+        # the manifest-persisted solve families (fleet.py ledger-key
+        # prefixes): the multi-second compiles the warmup contract
+        # covers. Tiny ledger-only utility kernels (the "S" row scatter)
+        # stay out of the manifest by design — their first-dispatch
+        # compiles are sub-millisecond and allowed.
+        solve_fams = ("L", "A", "E", "B")
+
+        def fresh_solve_keys(fleet, before):
+            return [
+                k for k in fleet._seen_traces - before
+                if k[0] in solve_fams
+            ]
+
+        # the repro: keep settling THIS engine (light churn, demand near
+        # zero) — the cap shrink retunes mid-train and mints a fresh
+        # SOLVE trace the churn records never covered
+        saw_fresh = []
+        for s in range(10, 20):
+            problems = settled(problems, s)
+            before = set(eng._fleet._seen_traces)
+            eng.schedule(problems)
+            saw_fresh += fresh_solve_keys(eng._fleet, before)
+        assert saw_fresh, (
+            "settle train minted no fresh solve trace — shrink dynamics "
+            "moved; re-point this regression at the new retune path"
+        )
+        # restore from the CHURN-ONLY record set: shrink expansion must
+        # prepay (and honestly seed) the settle train's buckets
+        path2 = tmp_path / "restored.json"
+        path2.write_bytes(churn_records)
+        stats = prewarm.warmup(str(path2))
+        assert stats["failed"] == 0 and stats["compiled"] > 0
+        eng2 = TensorScheduler(snap, trace_manifest=str(path2))
+        problems = settle_start
+        eng2.schedule(problems)
+        assert eng2.last_pass_new_trace is False
+        for s in range(10, 20):
+            problems = settled(problems, s)
+            before = set(eng2._fleet._seen_traces)
+            eng2.schedule(problems)
+            assert not fresh_solve_keys(eng2._fleet, before), (
+                f"settle pass {s - 9} compiled a solve trace on the "
+                "restored engine"
+            )
 
     def test_restart_smoke_subprocess(self, tmp_path):
         """The real restart: process 1 schedules and exits; process 2

@@ -471,6 +471,52 @@ class TestBenchguard:
         with pytest.raises(SystemExit, match="no committed BENCH_"):
             benchguard.check_record(fresh, root=tmp_path)
 
+    def test_churn_tier_guard_lifecycle(self, tmp_path):
+        """The incremental-solve guard across its adoption arc: a
+        baseline predating the churn series passes (baseline-missing),
+        a fresh record that DROPS the required 1% tier fails loudly
+        (missing), and once both sides carry it the band fires on a
+        drift back toward full-solve cost."""
+        engine = {
+            "metric": "p50_engine_schedule_100kx5000_dynamic_weight",
+            "value": 0.31,
+        }
+        # committed trajectory predates the churn series entirely
+        _write(tmp_path / "BENCH_r01.json", engine)
+        fresh = _write(
+            tmp_path / "fresh.json",
+            {**engine, "scale1m_churn1pct_p50": 0.8},
+        )
+        code, report = benchguard.check_record(fresh, root=tmp_path)
+        assert code == 0
+        verdicts = {v["metric"]: v["verdict"] for v in report["verdicts"]}
+        assert verdicts["scale1m_churn1pct_p50"] == "baseline-missing"
+
+        # a default record that stops carrying the 1% tier means the
+        # delta path (or its measurement) silently died: required fires
+        dropped = _write(tmp_path / "dropped.json", dict(engine))
+        code, report = benchguard.check_record(dropped, root=tmp_path)
+        assert code == 1
+        verdicts = {v["metric"]: v["verdict"] for v in report["verdicts"]}
+        assert verdicts["scale1m_churn1pct_p50"] == "missing"
+
+        # with a churn-carrying baseline, a 4x drift back toward
+        # full-solve cost is a regression; the unrequired 0.1%/10%
+        # tiers ride along without failing when absent
+        _write(
+            tmp_path / "BENCH_r02.json",
+            {**engine, "scale1m_churn1pct_p50": 0.8},
+        )
+        slow = _write(
+            tmp_path / "slow.json",
+            {**engine, "scale1m_churn1pct_p50": 3.2},
+        )
+        code, report = benchguard.check_record(slow, root=tmp_path)
+        assert code == 1
+        verdicts = {v["metric"]: v["verdict"] for v in report["verdicts"]}
+        assert verdicts["scale1m_churn1pct_p50"] == "regression"
+        assert verdicts["scale1m_churn0p1pct_p50"] == "absent"
+
     def test_cli_exit_codes(self, tmp_path):
         _write(tmp_path / "BENCH_OBS_r01.json", _BASELINE)
         good = _write(tmp_path / "fresh.json", dict(_BASELINE))

@@ -89,6 +89,17 @@ SPECS: dict[str, tuple[GuardMetric, ...]] = {
         GuardMetric("value", "lower", 2.0),
         GuardMetric("scale1m_steady_p50", "lower", 2.0, required=False),
         GuardMetric("scale1m_churn_p50", "lower", 2.0, required=False),
+        # ISSUE 20: the incremental (dirty-row) solve contract — churn
+        # cost proportional to churn size. The 1% tier is the headline
+        # and REQUIRED: a default record that stopped carrying it means
+        # the delta path (or its measurement) silently died. Older
+        # records predate the series, so the first guarded run reports
+        # baseline-missing and passes; from then on the band fires if a
+        # 1M-plane 1%-churn pass ever drifts back toward full-solve
+        # cost. 0.1%/10% ride along unrequired (diagnostic envelope).
+        GuardMetric("scale1m_churn1pct_p50", "lower", 2.0),
+        GuardMetric("scale1m_churn0p1pct_p50", "lower", 2.0, required=False),
+        GuardMetric("scale1m_churn10pct_p50", "lower", 2.0, required=False),
         GuardMetric("churn_p50", "lower", 2.0, required=False),
         GuardMetric(
             "whole_plane_bindings_s", "higher", 2.0, required=False
